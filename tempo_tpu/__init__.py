@@ -1,0 +1,29 @@
+"""tempo-tpu: a TPU-native distributed tracing backend.
+
+A brand-new framework with the capabilities of Grafana Tempo (reference:
+/root/reference): OTLP/Jaeger/Zipkin ingest sharded over a hash ring,
+WAL-backed ingesters, immutable columnar trace blocks on object storage,
+background compaction/retention, multi-tenant limits, a query-frontend /
+querier read path, and a metrics-generator.
+
+The differentiator: the read-side hot path -- trace-ID lookup, columnar
+search with TraceQL predicate pushdown, compaction's bloom/index merge,
+and span-metrics aggregation -- executes as jit-compiled JAX/XLA kernels,
+sharded across a TPU mesh with `shard_map`, instead of Go iterator trees
+on CPU.
+
+Package layout (mirrors the reference's layer map, SURVEY.md section 1):
+  wire/      L0: OTLP-compatible trace model + codecs
+  backend/   L2: object-store abstraction (local, in-memory, ...)
+  block/     L3: the `vtpu` columnar block format (device-friendly SoA)
+  ops/       TPU kernels: predicate scans, segmented ops, bloom, lookup
+  db/        L3: tempodb facade -- WAL, blocklist, compaction, retention
+  traceql/   L4: TraceQL subset parser + device predicate planner
+  parallel/  mesh/sharding: multi-chip find/search via shard_map
+  services/  L5: distributor, ingester, querier, frontend, compactor
+  generator/ metrics-generator (span-metrics, service-graphs)
+  api/       HTTP API + param codecs
+  cli/       offline block tools (tempo-cli equivalent)
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,2 @@
+from .hashing import fnv1a_32, fnv1a_64, ring_token
+from .traceid import parse_trace_id, trace_id_to_hex, pad_trace_id

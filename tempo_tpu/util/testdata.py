@@ -1,0 +1,99 @@
+"""Random OTLP trace generation for tests and benchmarks.
+
+Role parity with the reference's pkg/util/test MakeTrace helpers used
+throughout its test suite (SURVEY.md section 4.4). Deterministic given a
+seed so golden tests are stable.
+"""
+
+from __future__ import annotations
+
+import random
+
+from ..wire.model import Event, Resource, ResourceSpans, Scope, ScopeSpans, Span, Trace
+
+_SERVICES = ["api-gateway", "auth", "cart", "checkout", "db", "frontend", "payments", "search"]
+_OPS = ["GET /", "GET /api", "POST /api/orders", "db.query", "cache.get", "rpc.Call", "render"]
+_HTTP_METHODS = ["GET", "POST", "PUT", "DELETE"]
+
+
+def make_trace_id(rng: random.Random) -> bytes:
+    return rng.getrandbits(128).to_bytes(16, "big")
+
+
+def make_span_id(rng: random.Random) -> bytes:
+    return rng.getrandbits(64).to_bytes(8, "big")
+
+
+def make_trace(
+    rng: random.Random | int = 0,
+    trace_id: bytes | None = None,
+    n_spans: int = 8,
+    base_time_ns: int = 1_700_000_000_000_000_000,
+    n_batches: int = 2,
+) -> Trace:
+    if isinstance(rng, int):
+        rng = random.Random(rng)
+    tid = trace_id or make_trace_id(rng)
+    t = Trace()
+    span_ids: list[bytes] = []
+    per_batch = max(1, n_spans // max(1, n_batches))
+    remaining = n_spans
+    while remaining > 0:
+        n = min(per_batch, remaining)
+        remaining -= n
+        svc = rng.choice(_SERVICES)
+        rs = ResourceSpans(
+            resource=Resource(
+                attrs={
+                    "service.name": svc,
+                    "k8s.cluster.name": "prod",
+                    "k8s.namespace.name": rng.choice(["default", "apps"]),
+                }
+            )
+        )
+        ss = ScopeSpans(scope=Scope(name="test-scope", version="1"))
+        for _ in range(n):
+            start = base_time_ns + rng.randrange(0, 10**9)
+            dur = rng.randrange(10_000, 2 * 10**9)
+            sid = make_span_id(rng)
+            sp = Span(
+                trace_id=tid,
+                span_id=sid,
+                parent_span_id=rng.choice(span_ids) if span_ids and rng.random() < 0.7 else b"",
+                name=rng.choice(_OPS),
+                kind=rng.randrange(1, 6),
+                start_unix_nano=start,
+                end_unix_nano=start + dur,
+                status_code=2 if rng.random() < 0.1 else 0,
+                attrs={
+                    "http.method": rng.choice(_HTTP_METHODS),
+                    "http.status_code": rng.choice([200, 200, 200, 404, 500]),
+                    "component": rng.choice(["net/http", "grpc", "sql"]),
+                    "latency.weight": rng.random(),
+                    "cache.hit": rng.random() < 0.5,
+                },
+            )
+            if rng.random() < 0.3:
+                sp.events.append(
+                    Event(time_unix_nano=start + dur // 2, name="exception", attrs={"exception.type": "IOError"})
+                )
+            span_ids.append(sid)
+            ss.spans.append(sp)
+        rs.scope_spans.append(ss)
+        t.resource_spans.append(rs)
+    return t
+
+
+def make_traces(n: int, seed: int = 0, n_spans: int = 8) -> list[tuple[bytes, Trace]]:
+    """n distinct traces, sorted by trace id (block-build friendly)."""
+    rng = random.Random(seed)
+    out = []
+    seen = set()
+    while len(out) < n:
+        tid = make_trace_id(rng)
+        if tid in seen:
+            continue
+        seen.add(tid)
+        out.append((tid, make_trace(rng, trace_id=tid, n_spans=n_spans)))
+    out.sort(key=lambda p: p[0])
+    return out

@@ -1,0 +1,14 @@
+from .model import (
+    AnyValue,
+    Event,
+    Link,
+    Resource,
+    ResourceSpans,
+    Scope,
+    ScopeSpans,
+    Span,
+    SpanKind,
+    StatusCode,
+    Trace,
+)
+from .otlp_pb import decode_trace, encode_trace

@@ -1,0 +1,73 @@
+"""Trace combination and sorting.
+
+Partial traces for the same ID arrive from replicated ingesters, sharded
+queriers and compaction inputs; combining them must dedupe spans that were
+replicated RF-way. The reference dedupes by span-ID token and re-sorts
+(pkg/model/trace/combine.go, sort.go); we dedupe on (span_id, start) and
+sort batches by earliest span start.
+"""
+
+from __future__ import annotations
+
+from .model import Resource, ResourceSpans, ScopeSpans, Span, Trace
+
+
+def _span_key(sp: Span) -> tuple:
+    return (sp.span_id, sp.start_unix_nano, sp.name)
+
+
+def combine_traces(traces: list[Trace]) -> Trace:
+    """Merge traces, deduping spans; keeps the first-seen copy of a span.
+
+    Never mutates its inputs: the result shares Span objects with the
+    inputs but owns all list structure.
+    """
+    seen: set[tuple] = set()
+    out = Trace()
+    # group output batches by resource identity to avoid exploding batches
+    by_resource: dict[tuple, ResourceSpans] = {}
+    for t in traces:
+        for rs in t.resource_spans:
+            rkey = tuple(sorted((k, repr(v)) for k, v in rs.resource.attrs.items()))
+            dst = by_resource.get(rkey)
+            if dst is None:
+                dst = ResourceSpans(resource=Resource(attrs=dict(rs.resource.attrs)))
+                by_resource[rkey] = dst
+                out.resource_spans.append(dst)
+            for ss in rs.scope_spans:
+                kept = []
+                for sp in ss.spans:
+                    k = _span_key(sp)
+                    if k in seen:
+                        continue
+                    seen.add(k)
+                    kept.append(sp)
+                if kept:
+                    dst.scope_spans.append(ScopeSpans(scope=ss.scope, spans=kept))
+    return sort_trace(out)
+
+
+def sort_trace(t: Trace) -> Trace:
+    """Return a structurally-new trace with batches ordered by earliest span
+    start and spans within each scope by start time: deterministic output
+    for tests and compaction. Shares Span objects with the input."""
+
+    def batch_start(rs: ResourceSpans) -> int:
+        starts = [sp.start_unix_nano for ss in rs.scope_spans for sp in ss.spans]
+        return min(starts) if starts else 0
+
+    new_batches = [
+        ResourceSpans(
+            resource=rs.resource,
+            scope_spans=[
+                ScopeSpans(
+                    scope=ss.scope,
+                    spans=sorted(ss.spans, key=lambda sp: (sp.start_unix_nano, sp.span_id)),
+                )
+                for ss in rs.scope_spans
+            ],
+        )
+        for rs in t.resource_spans
+    ]
+    new_batches.sort(key=batch_start)
+    return Trace(resource_spans=new_batches)

@@ -1,0 +1,132 @@
+"""Canonical in-memory trace model, wire-compatible with OTLP.
+
+The reference's wire model is gogo-proto generated OTLP clones
+(pkg/tempopb/trace/v1, SURVEY.md section 2.8); a Trace is the list of
+resource-span batches of an OTLP ExportTraceServiceRequest
+(modules/distributor/receiver/shim.go:209-215). We keep the same shape
+as plain dataclasses: cheap to build from any receiver format and to
+flatten into the columnar block layout.
+
+Attribute values are restricted to the OTLP AnyValue space: str, bool,
+int, float, bytes, or a (possibly nested) list of those.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Union
+
+AnyValue = Union[str, bool, int, float, bytes, list]
+
+
+class SpanKind(enum.IntEnum):
+    UNSPECIFIED = 0
+    INTERNAL = 1
+    SERVER = 2
+    CLIENT = 3
+    PRODUCER = 4
+    CONSUMER = 5
+
+
+class StatusCode(enum.IntEnum):
+    UNSET = 0
+    OK = 1
+    ERROR = 2
+
+
+@dataclass
+class Event:
+    time_unix_nano: int = 0
+    name: str = ""
+    attrs: dict[str, AnyValue] = field(default_factory=dict)
+    dropped_attributes_count: int = 0
+
+
+@dataclass
+class Link:
+    trace_id: bytes = b""
+    span_id: bytes = b""
+    trace_state: str = ""
+    attrs: dict[str, AnyValue] = field(default_factory=dict)
+
+
+@dataclass
+class Span:
+    trace_id: bytes = b""
+    span_id: bytes = b""
+    parent_span_id: bytes = b""
+    trace_state: str = ""
+    name: str = ""
+    kind: int = SpanKind.UNSPECIFIED
+    start_unix_nano: int = 0
+    end_unix_nano: int = 0
+    attrs: dict[str, AnyValue] = field(default_factory=dict)
+    dropped_attributes_count: int = 0
+    events: list[Event] = field(default_factory=list)
+    links: list[Link] = field(default_factory=list)
+    status_code: int = StatusCode.UNSET
+    status_message: str = ""
+
+    @property
+    def duration_nanos(self) -> int:
+        return max(0, self.end_unix_nano - self.start_unix_nano)
+
+
+@dataclass
+class Resource:
+    attrs: dict[str, AnyValue] = field(default_factory=dict)
+
+    @property
+    def service_name(self) -> str:
+        v = self.attrs.get("service.name", "")
+        return v if isinstance(v, str) else str(v)
+
+
+@dataclass
+class Scope:
+    name: str = ""
+    version: str = ""
+
+
+@dataclass
+class ScopeSpans:
+    scope: Scope = field(default_factory=Scope)
+    spans: list[Span] = field(default_factory=list)
+
+
+@dataclass
+class ResourceSpans:
+    resource: Resource = field(default_factory=Resource)
+    scope_spans: list[ScopeSpans] = field(default_factory=list)
+
+
+@dataclass
+class Trace:
+    """One trace (or a partial trace segment): a batch of ResourceSpans."""
+
+    resource_spans: list[ResourceSpans] = field(default_factory=list)
+
+    def all_spans(self):
+        for rs in self.resource_spans:
+            for ss in rs.scope_spans:
+                for sp in ss.spans:
+                    yield rs.resource, ss.scope, sp
+
+    def span_count(self) -> int:
+        return sum(1 for _ in self.all_spans())
+
+    def trace_id(self) -> bytes:
+        for _, _, sp in self.all_spans():
+            if sp.trace_id:
+                return sp.trace_id
+        return b""
+
+    def time_range_nanos(self) -> tuple[int, int]:
+        lo, hi = None, None
+        for _, _, sp in self.all_spans():
+            if lo is None or sp.start_unix_nano < lo:
+                lo = sp.start_unix_nano
+            if hi is None or sp.end_unix_nano > hi:
+                hi = sp.end_unix_nano
+        return (lo or 0, hi or 0)

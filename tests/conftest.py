@@ -1,0 +1,22 @@
+"""Test harness config.
+
+Forces JAX onto the host CPU platform with 8 virtual devices BEFORE jax
+is imported anywhere, so multi-chip sharding tests (shard_map over a
+Mesh) run without TPU hardware. Mirrors the driver's dryrun environment.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    import random
+
+    return random.Random(1234)

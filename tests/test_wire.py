@@ -1,0 +1,125 @@
+"""Wire model round-trip tests (model <-> OTLP proto <-> OTLP JSON, segments)."""
+
+import random
+
+import pytest
+
+from tempo_tpu.util.hashing import bloom_hashes, fnv1a_32, fnv1a_64, ring_token
+from tempo_tpu.util.testdata import make_trace, make_traces
+from tempo_tpu.util.traceid import InvalidTraceID, parse_trace_id, trace_id_to_hex
+from tempo_tpu.wire import combine, otlp_json, otlp_pb, segment
+from tempo_tpu.wire.model import Span, Trace
+
+
+def test_fnv_known_vectors():
+    # published FNV-1a test vectors
+    assert fnv1a_32(b"") == 0x811C9DC5
+    assert fnv1a_32(b"a") == 0xE40C292C
+    assert fnv1a_64(b"") == 0xCBF29CE484222325
+    assert fnv1a_64(b"a") == 0xAF63DC4C8601EC8C
+
+
+def test_ring_token_stable():
+    t1 = ring_token("tenant-a", b"\x01" * 16)
+    assert t1 == ring_token("tenant-a", b"\x01" * 16)
+    assert t1 != ring_token("tenant-b", b"\x01" * 16)
+    assert 0 <= t1 < 2**32
+
+
+def test_bloom_hashes_in_range():
+    hs = bloom_hashes(b"trace-id-bytes", k=5, m_bits=1024)
+    assert len(hs) == 5
+    assert all(0 <= h < 1024 for h in hs)
+
+
+def test_trace_id_parse():
+    assert parse_trace_id("0102") == b"\x00" * 14 + b"\x01\x02"
+    assert trace_id_to_hex(b"\x01\x02") == "00" * 14 + "0102"
+    with pytest.raises(InvalidTraceID):
+        parse_trace_id("zz")
+    with pytest.raises(InvalidTraceID):
+        parse_trace_id("ab" * 17)
+
+
+def _spans_by_id(t: Trace) -> dict:
+    return {sp.span_id: sp for _, _, sp in t.all_spans()}
+
+
+def test_otlp_pb_roundtrip():
+    t = make_trace(7, n_spans=20)
+    data = otlp_pb.encode_trace(t)
+    t2 = otlp_pb.decode_trace(data)
+    a, b = _spans_by_id(t), _spans_by_id(t2)
+    assert set(a) == set(b)
+    for sid, sp in a.items():
+        sp2 = b[sid]
+        assert sp2.name == sp.name
+        assert sp2.start_unix_nano == sp.start_unix_nano
+        assert sp2.end_unix_nano == sp.end_unix_nano
+        assert sp2.kind == sp.kind
+        assert sp2.status_code == sp.status_code
+        assert sp2.attrs == sp.attrs
+        assert len(sp2.events) == len(sp.events)
+    # resource attrs preserved
+    assert t2.resource_spans[0].resource.attrs == t.resource_spans[0].resource.attrs
+
+
+def test_otlp_pb_value_types():
+    t = make_trace(3, n_spans=1)
+    sp = next(t.all_spans())[2]
+    sp.attrs = {"s": "x", "b_t": True, "b_f": False, "i": -42, "f": 2.5, "by": b"\x00\x01", "arr": ["a", 1]}
+    t2 = otlp_pb.decode_trace(otlp_pb.encode_trace(t))
+    sp2 = next(t2.all_spans())[2]
+    assert sp2.attrs == sp.attrs
+    assert sp2.attrs["b_f"] is False
+
+
+def test_otlp_json_roundtrip():
+    t = make_trace(11, n_spans=12)
+    s = otlp_json.dumps(t)
+    t2 = otlp_json.loads(s)
+    a, b = _spans_by_id(t), _spans_by_id(t2)
+    assert set(a) == set(b)
+    for sid in a:
+        assert a[sid].attrs == b[sid].attrs
+        assert a[sid].name == b[sid].name
+
+
+def test_segment_roundtrip_and_fastrange():
+    t = make_trace(5, n_spans=6)
+    seg = segment.segment_for_write(t, 100, 200)
+    assert segment.segment_fast_range(seg) == (100, 200)
+    t2 = segment.segment_to_trace(seg)
+    assert _spans_by_id(t2).keys() == _spans_by_id(t).keys()
+
+    obj = segment.segments_to_object([seg, segment.segment_for_write(t, 50, 150)])
+    assert segment.object_fast_range(obj) == (50, 200)
+    t3 = segment.object_to_trace(obj)
+    # same spans after dedupe
+    assert _spans_by_id(t3).keys() == _spans_by_id(t).keys()
+
+
+def test_combine_dedupes_replicas():
+    rng = random.Random(9)
+    t = make_trace(rng, n_spans=10)
+    import copy
+
+    t_copy = copy.deepcopy(t)
+    combined = combine.combine_traces([t, t_copy])
+    assert combined.span_count() == 10
+
+
+def test_combine_merges_disjoint():
+    tid = b"\xaa" * 16
+    t1 = make_trace(1, trace_id=tid, n_spans=4)
+    t2 = make_trace(2, trace_id=tid, n_spans=5)
+    combined = combine.combine_traces([t1, t2])
+    assert combined.span_count() == 9
+    assert combined.trace_id() == tid
+
+
+def test_make_traces_sorted_unique():
+    traces = make_traces(20, seed=3)
+    ids = [tid for tid, _ in traces]
+    assert ids == sorted(ids)
+    assert len(set(ids)) == 20
