@@ -1,0 +1,26 @@
+from .base import (
+    BackendError,
+    CompactedMarker,
+    DoesNotExist,
+    RawBackend,
+    block_object_path,
+    meta_name,
+)
+from .local import LocalBackend
+from .mem import MemBackend
+
+
+def open_backend(cfg: dict) -> RawBackend:
+    """Select a backend by config, like the reference's string-keyed
+    selection (tempodb/tempodb.go:141-152)."""
+    kind = cfg.get("backend", "local")
+    if kind == "local":
+        return LocalBackend(cfg.get("path", "./tempo-data"))
+    if kind in ("mem", "memory"):
+        return MemBackend()
+    if kind in ("gcs", "s3", "azure"):
+        raise NotImplementedError(
+            f"backend {kind!r} requires cloud SDKs not present in this build; "
+            "use 'local' (works for all single-host and test deployments)"
+        )
+    raise ValueError(f"unknown backend {kind!r}")

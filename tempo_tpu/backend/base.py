@@ -1,0 +1,94 @@
+"""Object-store backend abstraction.
+
+One flat interface per the reference's RawReader/RawWriter/RawCompactor
+seam (tempodb/backend/raw.go:55-133, backend.go:22-66): named objects
+under <tenant>/<block uuid>/<name>, plus tenant-level objects (the
+per-tenant blocklist index), list operations, and the compacted-marker
+protocol (meta.json renamed to meta.compacted.json, as the local/gcs
+compactors do).
+"""
+
+from __future__ import annotations
+
+import abc
+from dataclasses import dataclass
+
+META_NAME = "meta.json"
+COMPACTED_META_NAME = "meta.compacted.json"
+TENANT_INDEX_NAME = "index.json.gz"
+
+
+class BackendError(Exception):
+    pass
+
+
+class DoesNotExist(BackendError):
+    pass
+
+
+@dataclass(frozen=True)
+class CompactedMarker:
+    block_id: str
+    compacted_at_unix: float
+
+
+def block_object_path(tenant: str, block_id: str, name: str) -> str:
+    return f"{tenant}/{block_id}/{name}"
+
+
+def meta_name(compacted: bool = False) -> str:
+    return COMPACTED_META_NAME if compacted else META_NAME
+
+
+class RawBackend(abc.ABC):
+    """Reader+writer+compactor over raw named objects."""
+
+    # ---- write
+    @abc.abstractmethod
+    def write(self, tenant: str, block_id: str, name: str, data: bytes) -> None: ...
+
+    @abc.abstractmethod
+    def write_tenant_object(self, tenant: str, name: str, data: bytes) -> None: ...
+
+    # ---- read
+    @abc.abstractmethod
+    def read(self, tenant: str, block_id: str, name: str) -> bytes: ...
+
+    @abc.abstractmethod
+    def read_range(self, tenant: str, block_id: str, name: str, offset: int, length: int) -> bytes: ...
+
+    @abc.abstractmethod
+    def read_tenant_object(self, tenant: str, name: str) -> bytes: ...
+
+    # ---- list
+    @abc.abstractmethod
+    def tenants(self) -> list[str]: ...
+
+    @abc.abstractmethod
+    def blocks(self, tenant: str) -> list[str]:
+        """Block UUIDs that have either a live or a compacted meta."""
+
+    # ---- delete
+    @abc.abstractmethod
+    def delete_block(self, tenant: str, block_id: str) -> None: ...
+
+    @abc.abstractmethod
+    def delete_tenant_object(self, tenant: str, name: str) -> None: ...
+
+    # ---- compacted-marker protocol
+    def mark_compacted(self, tenant: str, block_id: str) -> None:
+        """Rename meta.json -> meta.compacted.json (same protocol as the
+        reference's local/gcs compactors)."""
+        data = self.read(tenant, block_id, META_NAME)
+        self.write(tenant, block_id, COMPACTED_META_NAME, data)
+        self._delete_object(tenant, block_id, META_NAME)
+
+    def has_object(self, tenant: str, block_id: str, name: str) -> bool:
+        try:
+            self.read(tenant, block_id, name)
+            return True
+        except DoesNotExist:
+            return False
+
+    @abc.abstractmethod
+    def _delete_object(self, tenant: str, block_id: str, name: str) -> None: ...

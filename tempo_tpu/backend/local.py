@@ -1,0 +1,120 @@
+"""Filesystem object store: <path>/<tenant>/<block>/<name>.
+
+Same layout role as the reference's local backend
+(tempodb/backend/local/local.go); doubles as the in-test object store so
+no cloud credentials are ever needed for the full engine test suite.
+Writes are atomic (tmp file + rename) so a crashed writer never leaves a
+half-written meta visible to pollers.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+
+from .base import COMPACTED_META_NAME, META_NAME, DoesNotExist, RawBackend
+
+_TENANT_OBJECT_DIR = "__tenant__"
+
+
+class LocalBackend(RawBackend):
+    def __init__(self, path: str):
+        self.path = os.path.abspath(path)
+        os.makedirs(self.path, exist_ok=True)
+
+    # ---- helpers
+    def _obj_path(self, tenant: str, block_id: str, name: str) -> str:
+        return os.path.join(self.path, tenant, block_id, name)
+
+    def _write_file(self, path: str, data: bytes) -> None:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path), prefix=".tmp-")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(data)
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+
+    def _read_file(self, path: str) -> bytes:
+        try:
+            with open(path, "rb") as f:
+                return f.read()
+        except FileNotFoundError:
+            raise DoesNotExist(path) from None
+
+    # ---- write
+    def write(self, tenant: str, block_id: str, name: str, data: bytes) -> None:
+        self._write_file(self._obj_path(tenant, block_id, name), data)
+
+    def write_tenant_object(self, tenant: str, name: str, data: bytes) -> None:
+        self._write_file(os.path.join(self.path, tenant, _TENANT_OBJECT_DIR, name), data)
+
+    # ---- read
+    def read(self, tenant: str, block_id: str, name: str) -> bytes:
+        return self._read_file(self._obj_path(tenant, block_id, name))
+
+    def read_range(self, tenant: str, block_id: str, name: str, offset: int, length: int) -> bytes:
+        path = self._obj_path(tenant, block_id, name)
+        try:
+            with open(path, "rb") as f:
+                f.seek(offset)
+                return f.read(length)
+        except FileNotFoundError:
+            raise DoesNotExist(path) from None
+
+    def read_tenant_object(self, tenant: str, name: str) -> bytes:
+        return self._read_file(os.path.join(self.path, tenant, _TENANT_OBJECT_DIR, name))
+
+    # ---- list
+    def tenants(self) -> list[str]:
+        try:
+            return sorted(
+                d for d in os.listdir(self.path) if os.path.isdir(os.path.join(self.path, d))
+            )
+        except FileNotFoundError:
+            return []
+
+    def blocks(self, tenant: str) -> list[str]:
+        tdir = os.path.join(self.path, tenant)
+        out = []
+        try:
+            entries = os.listdir(tdir)
+        except FileNotFoundError:
+            return []
+        for d in entries:
+            if d == _TENANT_OBJECT_DIR:
+                continue
+            bdir = os.path.join(tdir, d)
+            if not os.path.isdir(bdir):
+                continue
+            if os.path.exists(os.path.join(bdir, META_NAME)) or os.path.exists(
+                os.path.join(bdir, COMPACTED_META_NAME)
+            ):
+                out.append(d)
+        return sorted(out)
+
+    # ---- delete
+    def delete_block(self, tenant: str, block_id: str) -> None:
+        bdir = os.path.join(self.path, tenant, block_id)
+        if not os.path.isdir(bdir):
+            return
+        for name in os.listdir(bdir):
+            os.unlink(os.path.join(bdir, name))
+        os.rmdir(bdir)
+
+    def delete_tenant_object(self, tenant: str, name: str) -> None:
+        try:
+            os.unlink(os.path.join(self.path, tenant, _TENANT_OBJECT_DIR, name))
+        except FileNotFoundError:
+            pass
+
+    def _delete_object(self, tenant: str, block_id: str, name: str) -> None:
+        try:
+            os.unlink(self._obj_path(tenant, block_id, name))
+        except FileNotFoundError:
+            pass

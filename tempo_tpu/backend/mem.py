@@ -1,0 +1,88 @@
+"""In-memory object store for unit tests (MockRawReader/Writer analog,
+tempodb/backend/mocks.go:1-176) with optional fault injection."""
+
+from __future__ import annotations
+
+import threading
+
+from .base import COMPACTED_META_NAME, META_NAME, DoesNotExist, RawBackend
+
+
+class MemBackend(RawBackend):
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._objects: dict[tuple[str, str, str], bytes] = {}
+        self._tenant_objects: dict[tuple[str, str], bytes] = {}
+        self.fail_reads = 0  # >0: next N reads raise (fault injection)
+        self.read_count = 0
+        self.bytes_read = 0
+
+    def _maybe_fail(self):
+        if self.fail_reads > 0:
+            self.fail_reads -= 1
+            raise DoesNotExist("injected read failure")
+
+    def write(self, tenant, block_id, name, data):
+        with self._lock:
+            self._objects[(tenant, block_id, name)] = bytes(data)
+
+    def write_tenant_object(self, tenant, name, data):
+        with self._lock:
+            self._tenant_objects[(tenant, name)] = bytes(data)
+
+    def read(self, tenant, block_id, name):
+        with self._lock:
+            self._maybe_fail()
+            self.read_count += 1
+            try:
+                data = self._objects[(tenant, block_id, name)]
+            except KeyError:
+                raise DoesNotExist(f"{tenant}/{block_id}/{name}") from None
+            self.bytes_read += len(data)
+            return data
+
+    def read_range(self, tenant, block_id, name, offset, length):
+        with self._lock:
+            self._maybe_fail()
+            self.read_count += 1
+            try:
+                data = self._objects[(tenant, block_id, name)]
+            except KeyError:
+                raise DoesNotExist(f"{tenant}/{block_id}/{name}") from None
+            out = data[offset : offset + length]
+            self.bytes_read += len(out)
+            return out
+
+    def read_tenant_object(self, tenant, name):
+        with self._lock:
+            self._maybe_fail()
+            try:
+                return self._tenant_objects[(tenant, name)]
+            except KeyError:
+                raise DoesNotExist(f"{tenant}/{name}") from None
+
+    def tenants(self):
+        with self._lock:
+            ts = {t for (t, _, _) in self._objects} | {t for (t, _) in self._tenant_objects}
+            return sorted(ts)
+
+    def blocks(self, tenant):
+        with self._lock:
+            out = set()
+            for (t, b, name) in self._objects:
+                if t == tenant and name in (META_NAME, COMPACTED_META_NAME):
+                    out.add(b)
+            return sorted(out)
+
+    def delete_block(self, tenant, block_id):
+        with self._lock:
+            for key in [k for k in self._objects if k[0] == tenant and k[1] == block_id]:
+                del self._objects[key]
+
+    def delete_tenant_object(self, tenant, name):
+        with self._lock:
+            self._tenant_objects.pop((tenant, name), None)
+
+    def _delete_object(self, tenant, block_id, name):
+        with self._lock:
+            self._objects.pop((tenant, block_id, name), None)

@@ -1,0 +1,7 @@
+from .meta import BlockMeta, RowGroupStats
+from .builder import BlockBuilder, FinalizedBlock, build_block_from_traces, write_block
+from .reader import BackendBlock, open_block
+from .bloom import ShardedBloom
+from .dictionary import Dictionary
+
+VERSION = "vtpu1"

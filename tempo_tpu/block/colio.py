@@ -1,0 +1,163 @@
+"""Column blob IO: named numpy arrays in one backend object, chunked by
+row group.
+
+Layout: [chunk buffers, each independently zstd-compressed] [footer JSON]
+[uint32le footer len] [magic 'VTPU'].
+
+Every column belongs to an *axis* (span rows, trace rows, attr rows, ...)
+and is stored as one compressed chunk per row group along that axis. The
+footer maps column name -> dtype/shape/axis/chunk table, so a reader can
+fetch the footer with two small range reads and then range-read only the
+(column, row-group) chunks a query touches -- the role parquet column
+chunks + pages play for the reference (vparquet block_search.go,
+parquetquery), but deserializing straight into flat device-uploadable
+arrays with zero transposition.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+
+import numpy as np
+import zstandard
+
+MAGIC = b"VTPU"
+_TAIL = struct.Struct("<I4s")
+
+CODEC_RAW = "raw"
+CODEC_ZSTD = "zstd"
+_MIN_COMPRESS = 128
+
+
+class AxisChunks:
+    """Row boundaries of the row groups along one axis: offsets[g] ..
+    offsets[g+1] are the rows of group g."""
+
+    def __init__(self, offsets: list[int]):
+        assert len(offsets) >= 2 and offsets[0] == 0
+        self.offsets = list(offsets)
+
+    @property
+    def n_groups(self) -> int:
+        return len(self.offsets) - 1
+
+    @property
+    def n_rows(self) -> int:
+        return self.offsets[-1]
+
+
+def pack_columns(
+    cols: dict[str, np.ndarray],
+    axes: dict[str, AxisChunks] | None = None,
+    col_axis: dict[str, str] | None = None,
+    level: int = 3,
+) -> bytes:
+    """Serialize columns. Columns named in col_axis are chunked along the
+    given axis' row groups; others are stored as a single chunk."""
+    axes = axes or {}
+    col_axis = col_axis or {}
+    parts: list[bytes] = []
+    footer: dict = {"cols": {}, "axes": {k: v.offsets for k, v in axes.items()}}
+    offset = 0
+    comp = zstandard.ZstdCompressor(level=level)
+
+    def store(raw: bytes) -> list:
+        nonlocal offset
+        codec = CODEC_RAW
+        data = raw
+        if len(raw) >= _MIN_COMPRESS:
+            z = comp.compress(raw)
+            if len(z) < len(raw):
+                data, codec = z, CODEC_ZSTD
+        parts.append(data)
+        rec = [offset, len(data), len(raw), codec]
+        offset += len(data)
+        return rec
+
+    for name, arr in cols.items():
+        arr = np.ascontiguousarray(arr)
+        axis = col_axis.get(name)
+        chunks = []
+        if axis is not None:
+            ax = axes[axis]
+            if ax.n_rows != arr.shape[0]:
+                raise ValueError(
+                    f"column {name}: {arr.shape[0]} rows != axis {axis} ({ax.n_rows})"
+                )
+            for g in range(ax.n_groups):
+                lo, hi = ax.offsets[g], ax.offsets[g + 1]
+                chunks.append(store(arr[lo:hi].tobytes()))
+        else:
+            chunks.append(store(arr.tobytes()))
+        footer["cols"][name] = {
+            "dtype": str(arr.dtype),
+            "shape": list(arr.shape),
+            "axis": axis,
+            "chunks": chunks,
+        }
+
+    fbytes = json.dumps(footer, separators=(",", ":")).encode("utf-8")
+    parts.append(fbytes)
+    parts.append(_TAIL.pack(len(fbytes), MAGIC))
+    return b"".join(parts)
+
+
+class ColumnPack:
+    """Lazy chunked-column reader over a backend object via range reads."""
+
+    def __init__(self, read_range, total_size: int):
+        """read_range(offset, length) -> bytes."""
+        self._read_range = read_range
+        self._size = total_size
+        tail = self._read_range(total_size - _TAIL.size, _TAIL.size)
+        flen, magic = _TAIL.unpack(tail)
+        if magic != MAGIC:
+            raise ValueError("not a vtpu column pack (bad magic)")
+        fbytes = self._read_range(total_size - _TAIL.size - flen, flen)
+        footer = json.loads(fbytes)
+        self._cols: dict[str, dict] = footer["cols"]
+        self.axes: dict[str, AxisChunks] = {
+            k: AxisChunks(v) for k, v in footer.get("axes", {}).items()
+        }
+        self.bytes_read = _TAIL.size + flen  # inspected-bytes accounting
+        self._dctx = zstandard.ZstdDecompressor()
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "ColumnPack":
+        return cls(lambda off, ln: data[off : off + ln], len(data))
+
+    def names(self) -> list[str]:
+        return list(self._cols)
+
+    def has(self, name: str) -> bool:
+        return name in self._cols
+
+    def _chunk(self, rec: list) -> bytes:
+        off, stored_len, raw_len, codec = rec
+        data = self._read_range(off, stored_len)
+        self.bytes_read += stored_len
+        if codec == CODEC_ZSTD:
+            return self._dctx.decompress(data, max_output_size=raw_len)
+        return data
+
+    def read(self, name: str) -> np.ndarray:
+        meta = self._cols[name]
+        raw = b"".join(self._chunk(rec) for rec in meta["chunks"])
+        return np.frombuffer(raw, dtype=np.dtype(meta["dtype"])).reshape(meta["shape"])
+
+    def read_groups(self, name: str, groups: list[int]) -> np.ndarray:
+        """Concatenated rows of the given row groups (in the given order).
+        Column must be axis-chunked."""
+        meta = self._cols[name]
+        if meta["axis"] is None:
+            raise ValueError(f"column {name} is not axis-chunked")
+        raw = b"".join(self._chunk(meta["chunks"][g]) for g in groups)
+        shape = [-1] + meta["shape"][1:]
+        return np.frombuffer(raw, dtype=np.dtype(meta["dtype"])).reshape(shape)
+
+    def read_many(self, names: list[str]) -> dict[str, np.ndarray]:
+        return {n: self.read(n) for n in names if n in self._cols}
+
+    def read_all(self) -> dict[str, np.ndarray]:
+        return {n: self.read(n) for n in self._cols}

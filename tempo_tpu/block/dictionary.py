@@ -1,0 +1,100 @@
+"""Per-block string dictionary.
+
+All strings in a vtpu block (service names, span names, attribute keys
+and string values, URLs, ...) live in ONE sorted dictionary; every
+string column is an int32 code column. This is the core trick that makes
+trace data TPU-friendly: string predicates become integer compares on
+device, with the string->code mapping resolved host-side per query
+(a miss prunes the whole block). Sorting at finalize means codes are
+ordered lexicographically, so device kernels can do range/prefix
+predicates as integer range checks.
+
+Serialized form: zstd( uvarint count | repeated (uvarint len | utf8) ).
+"""
+
+from __future__ import annotations
+
+import bisect
+
+import numpy as np
+import zstandard
+
+from ..wire import pbwire as w
+
+NO_CODE = np.int32(-1)  # "absent" sentinel in every code column
+
+
+class DictBuilder:
+    def __init__(self):
+        self._codes: dict[str, int] = {}
+
+    def code(self, s: str) -> int:
+        c = self._codes.get(s)
+        if c is None:
+            c = len(self._codes)
+            self._codes[s] = c
+        return c
+
+    def __len__(self) -> int:
+        return len(self._codes)
+
+    def finalize(self) -> tuple["Dictionary", np.ndarray]:
+        """Sort strings; return (dictionary, remap) where remap[old_code]
+        -> sorted code. Apply remap to every code column before writing."""
+        strings = sorted(self._codes)
+        remap = np.empty(len(strings), dtype=np.int32)
+        for new_code, s in enumerate(strings):
+            remap[self._codes[s]] = new_code
+        return Dictionary(strings), remap
+
+
+def apply_remap(col: np.ndarray, remap: np.ndarray) -> np.ndarray:
+    """Remap a code column, passing through NO_CODE sentinels."""
+    out = np.where(col >= 0, remap[np.maximum(col, 0)], col)
+    return out.astype(np.int32)
+
+
+class Dictionary:
+    def __init__(self, strings: list[str]):
+        self.strings = strings
+
+    def __len__(self) -> int:
+        return len(self.strings)
+
+    def lookup(self, s: str) -> int:
+        """Code for s, or -1 if absent (prunes the block)."""
+        i = bisect.bisect_left(self.strings, s)
+        if i < len(self.strings) and self.strings[i] == s:
+            return i
+        return -1
+
+    def prefix_range(self, prefix: str) -> tuple[int, int]:
+        """[lo, hi) code range of strings with the given prefix."""
+        lo = bisect.bisect_left(self.strings, prefix)
+        hi = bisect.bisect_left(self.strings, prefix + "￿")
+        return lo, hi
+
+    def string(self, code: int) -> str:
+        if 0 <= code < len(self.strings):
+            return self.strings[code]
+        return ""
+
+    def to_bytes(self) -> bytes:
+        buf = bytearray()
+        w.write_varint(buf, len(self.strings))
+        for s in self.strings:
+            b = s.encode("utf-8")
+            w.write_varint(buf, len(b))
+            buf.extend(b)
+        return zstandard.ZstdCompressor(level=3).compress(bytes(buf))
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "Dictionary":
+        raw = zstandard.ZstdDecompressor().decompress(data)
+        count, pos = w.read_varint(raw, 0)
+        strings = []
+        for _ in range(count):
+            ln, pos = w.read_varint(raw, pos)
+            strings.append(raw[pos : pos + ln].decode("utf-8"))
+            pos += ln
+        return cls(strings)

@@ -1,0 +1,77 @@
+"""Block metadata: the poller/blocklist currency.
+
+Role of the reference's backend.BlockMeta (tempodb/backend), extended
+with vtpu row-group stats so the query planner can prune row groups
+host-side (the control-plane half of predicate pushdown) before any
+bytes ship to the device.
+"""
+
+from __future__ import annotations
+
+import json
+import uuid
+from dataclasses import asdict, dataclass, field
+
+
+@dataclass
+class RowGroupStats:
+    span_lo: int = 0
+    span_hi: int = 0
+    trace_lo: int = 0
+    trace_hi: int = 0  # exclusive; last group may share a trace boundary exactly
+    start_ms_min: int = 0
+    start_ms_max: int = 0
+    dur_us_max: int = 0
+
+
+@dataclass
+class BlockMeta:
+    version: str = "vtpu1"
+    block_id: str = ""
+    tenant_id: str = ""
+    min_id: str = ""  # hex trace ids
+    max_id: str = ""
+    start_time_unix_nano: int = 0  # block time range
+    end_time_unix_nano: int = 0
+    total_traces: int = 0
+    total_spans: int = 0
+    size_bytes: int = 0
+    compaction_level: int = 0
+    bloom_shards: int = 0
+    bloom_shard_bits: int = 0
+    dict_size: int = 0
+    row_groups: list[RowGroupStats] = field(default_factory=list)
+    # replication/dedupe bookkeeping used by the ingester
+    replication_factor: int = 1
+
+    @staticmethod
+    def new(tenant: str, block_id: str | None = None) -> "BlockMeta":
+        return BlockMeta(block_id=block_id or str(uuid.uuid4()), tenant_id=tenant)
+
+    def to_json(self) -> bytes:
+        d = asdict(self)
+        return json.dumps(d, separators=(",", ":")).encode("utf-8")
+
+    @classmethod
+    def from_json(cls, data: bytes) -> "BlockMeta":
+        d = json.loads(data)
+        rgs = [RowGroupStats(**rg) for rg in d.pop("row_groups", [])]
+        known = {f for f in cls.__dataclass_fields__}  # tolerate future fields
+        m = cls(**{k: v for k, v in d.items() if k in known and k != "row_groups"})
+        m.row_groups = rgs
+        return m
+
+    # ---- id-range pruning (reference: includeBlock, tempodb/tempodb.go:483-502)
+    def may_contain_id(self, trace_id_hex: str) -> bool:
+        if not self.min_id or not self.max_id:
+            return False
+        return self.min_id <= trace_id_hex <= self.max_id
+
+    def overlaps_time(self, start_unix: int, end_unix: int) -> bool:
+        """[start,end] in unix seconds vs the block's nano range."""
+        if end_unix <= 0:
+            return True
+        return not (
+            self.end_time_unix_nano < start_unix * 1_000_000_000
+            or self.start_time_unix_nano > end_unix * 1_000_000_000
+        )

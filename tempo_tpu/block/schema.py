@@ -1,0 +1,151 @@
+"""vtpu block schema: the column set and its device/host split.
+
+Design (TPU-first rethink of vparquet's one-row-per-trace nested schema,
+tempodb/encoding/vparquet/schema.go:75-172):
+
+* span-major structure-of-arrays: every span is a row across flat,
+  fixed-dtype columns; traces are contiguous runs bounded by
+  `trace.span_off` (a segment-offsets array). Dremel rep/def levels are
+  never needed -- hierarchy is explicit segment ids, so trace-level
+  aggregation is a segmented reduce and "structural" joins are masks.
+* all strings are int32 codes into one sorted per-block dictionary
+  (dictionary.py); string predicates become integer compares on device.
+* every DEVICE column is int32/float32 and uploads with zero
+  transposition. Quantities that don't fit (u64 nanos, 128-bit ids,
+  byte blobs) keep an exact HOST column for verification +
+  materialization, and a *conservative* int32 device encoding for
+  filtering: device filters may over-match (like a bloom), never
+  under-match; the host re-checks survivors exactly.
+
+Time encoding: span start is milliseconds relative to the block's start
+(int32: +-24 days), duration is microseconds clamped to int32
+(~35 min); the planner rounds thresholds outward so clamping stays
+conservative.
+
+Attribute tables are CSR-style: one row per attribute with an owner-row
+column (`sattr.span`, `rattr.res`, ...), so device predicate hits
+scatter back to spans with one segment-max.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+INT32_MAX = np.int32(2**31 - 1)
+
+# attribute value types
+VT_STR = 0
+VT_INT = 1
+VT_FLOAT = 2
+VT_BOOL = 3
+VT_COMPLEX = 4  # arrays/bytes/kvlists, stored as OTLP-JSON in the dict
+
+# axes (row-group chunking dimensions in the column pack)
+AX_SPAN = "span"
+AX_TRACE = "trace"
+AX_SATTR = "sattr"
+AX_RATTR = "rattr"
+AX_RES = "res"
+AX_EVENT = "ev"
+AX_EVATTR = "evattr"
+AX_LINK = "ln"
+AX_LNATTR = "lnattr"
+
+# columns shipped to the device for filtering (all int32/float32)
+DEVICE_SPAN_COLS = [
+    "span.trace_sid",
+    "span.name_id",
+    "span.service_id",
+    "span.kind",
+    "span.status",
+    "span.start_ms",
+    "span.dur_us",
+    "span.http_status",
+    "span.http_method_id",
+    "span.http_url_id",
+    "span.res_idx",
+]
+DEVICE_SATTR_COLS = [
+    "sattr.span",
+    "sattr.key_id",
+    "sattr.vtype",
+    "sattr.str_id",
+    "sattr.int32",
+    "sattr.f32",
+]
+DEVICE_RATTR_COLS = [
+    "rattr.res",
+    "rattr.key_id",
+    "rattr.vtype",
+    "rattr.str_id",
+    "rattr.int32",
+    "rattr.f32",
+]
+
+# host-exact span columns (materialization + exact verify)
+HOST_SPAN_COLS = [
+    "span.start_ns",
+    "span.end_ns",
+    "span.id",
+    "span.parent_id",
+    "span.trace_state_id",
+    "span.status_msg_id",
+    "span.dropped_attrs",
+    "span.scope_idx",
+]
+
+TRACE_COLS = [
+    "trace.id",  # (n,16) u8, sorted
+    "trace.id_codes",  # (n,4) i32 order-preserving codes
+    "trace.span_off",  # (n+1,) i32 segment offsets into span rows
+    "trace.start_ms",
+    "trace.end_ms",
+    "trace.dur_us",
+    "trace.root_service_id",
+    "trace.root_name_id",
+    "trace.start_ns",  # u64 exact
+    "trace.end_ns",
+]
+
+WELL_KNOWN_SPAN_ATTRS = {
+    # attr key -> dedicated device column (vparquet's dedicated-column idea)
+    "http.status_code": "span.http_status",
+    "http.method": "span.http_method_id",
+    "http.url": "span.http_url_id",
+}
+WELL_KNOWN_RES_ATTRS = {
+    "service.name": "res.service_id",
+    "k8s.cluster.name": "res.cluster_id",
+    "k8s.namespace.name": "res.namespace_id",
+    "k8s.pod.name": "res.pod_id",
+    "k8s.container.name": "res.container_id",
+    "cluster": "res.cluster_id2",
+    "namespace": "res.namespace_id2",
+    "pod": "res.pod_id2",
+    "container": "res.container_id2",
+}
+
+DEFAULT_ROW_GROUP_SPANS = 1 << 16  # 64Ki span rows per group
+
+
+def trace_id_to_codes(tid: bytes) -> tuple[int, int, int, int]:
+    """16-byte id -> 4 order-preserving int32 codes: big-endian u32 words
+    XOR 0x80000000, so signed int32 comparison == unsigned byte order."""
+    t = tid.rjust(16, b"\x00")
+    return tuple(
+        int.from_bytes(t[i : i + 4], "big") - 0x80000000 for i in (0, 4, 8, 12)
+    )
+
+
+def codes_to_trace_id(codes) -> bytes:
+    return b"".join(int(int(c) + 0x80000000).to_bytes(4, "big") for c in codes)
+
+
+def ns_to_rel_ms(ns: int, base_ns: int) -> int:
+    """Conservative int32 millisecond offset (floor), clamped."""
+    v = (int(ns) - int(base_ns)) // 1_000_000
+    return int(np.clip(v, -(2**31), 2**31 - 1))
+
+
+def ns_to_dur_us(dur_ns: int) -> int:
+    return int(min(max(0, int(dur_ns)) // 1_000, 2**31 - 1))

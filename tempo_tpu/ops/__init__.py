@@ -1,0 +1,8 @@
+"""TPU kernels for the read-side hot path.
+
+Everything in this package is jit-compiled JAX operating on the flat
+int32/float32 columns of a vtpu block. Shapes are padded to power-of-two
+buckets (device.py) so the jit cache stays small across blocks; kernels
+are data-driven -- predicate operand VALUES are traced arrays, only the
+predicate STRUCTURE (column set + op kinds) keys a compile.
+"""

@@ -1,0 +1,41 @@
+"""Shape bucketing and host->device column staging.
+
+XLA compiles one program per input-shape signature; trace blocks all have
+different row counts. Padding every axis to a power-of-two bucket keeps
+the number of distinct compiled programs logarithmic in block size
+(SURVEY.md 7.3 "recompilation"). Pad rows carry sentinels that can never
+match a predicate and never land in a real segment.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+MIN_BUCKET = 1024
+PAD_I32 = np.int32(-(2**31))  # sentinel for code/int columns (never a valid code)
+
+
+def bucket(n: int) -> int:
+    """Next power-of-two >= max(n, MIN_BUCKET)."""
+    b = MIN_BUCKET
+    while b < n:
+        b <<= 1
+    return b
+
+
+def pad_rows(arr: np.ndarray, n: int, fill) -> np.ndarray:
+    """Pad axis 0 to n rows with `fill`."""
+    if arr.shape[0] == n:
+        return arr
+    pad_shape = (n - arr.shape[0],) + arr.shape[1:]
+    return np.concatenate([arr, np.full(pad_shape, fill, dtype=arr.dtype)])
+
+
+def pad_columns(
+    cols: dict[str, np.ndarray],
+    n: int,
+    fills: dict[str, object] | None = None,
+    default_fill=PAD_I32,
+) -> dict[str, np.ndarray]:
+    fills = fills or {}
+    return {k: pad_rows(v, n, fills.get(k, default_fill)) for k, v in cols.items()}

@@ -1,0 +1,239 @@
+"""Data-driven predicate evaluation over vtpu columns: the TraceQL /
+tag-search execution kernel.
+
+This replaces the reference's iterator-tree engine (pkg/parquetquery
+ColumnIterator/JoinIterator + vparquet/block_search.go pipelines) with
+one vectorized pass: every condition becomes a boolean mask over its
+axis (span rows, attr rows, resource rows), attr/resource hits scatter
+to span rows with a segment-max, masks combine with AND/OR on the VPU,
+and the span mask aggregates to a trace mask with another segment-max.
+No Dremel rep/def levels anywhere: hierarchy is explicit segment ids
+(SURVEY.md 7.3 "the crux" -- this layout dissolves it).
+
+Only the condition STRUCTURE (targets/ops/value kinds) keys a jit
+compile; operand values -- dictionary codes, thresholds -- are traced
+arrays, so `{span.foo = "bar"}` and `{span.foo = "baz"}` share one
+compiled program.
+
+Device filters are *conservative* (may over-match, never under-match):
+clamped int32 / f32 encodings use widened comparisons; conditions whose
+encodings can over-match are flagged needs_verify and re-checked
+exactly on host over the surviving spans (db/search.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import lru_cache, partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# condition targets
+T_SPAN = "span"  # direct span-axis column
+T_TRACE = "trace"  # trace-axis column
+T_RES = "res"  # resource-axis dedicated column (gathered via span.res_idx)
+T_SATTR = "sattr"  # generic span attr table
+T_RATTR = "rattr"  # generic resource attr table
+
+# ops: v0/v1 are the int operands, f0/f1 the float operands
+OPS = ("eq", "ne", "ne_present", "lt", "le", "gt", "ge", "range", "exists", "ne_clamped")
+
+
+@dataclass(frozen=True)
+class Cond:
+    """One predicate. Hashable => part of the jit key."""
+
+    target: str
+    col: str  # device column ('span.dur_us', 'res.service_id', ...) or
+    # value kind for attr targets: 'str', 'int', 'float', 'bool', 'any'
+    op: str
+    is_float: bool = False
+    needs_verify: bool = False
+
+
+@dataclass
+class Operands:
+    """Per-condition operand values (traced; NOT part of the jit key).
+    ints[i] = (key_code, v0, v1); floats[i] = (f0, f1)."""
+
+    ints: np.ndarray  # (n_conds, 3) int32
+    floats: np.ndarray  # (n_conds, 2) float32
+
+    @classmethod
+    def build(cls, rows: list[tuple[int, int, int, float, float]]) -> "Operands":
+        if not rows:
+            return cls(np.zeros((0, 3), np.int32), np.zeros((0, 2), np.float32))
+        ints = np.asarray([[r[0], r[1], r[2]] for r in rows], dtype=np.int64)
+        ints = np.clip(ints, -(2**31), 2**31 - 1).astype(np.int32)
+        floats = np.asarray([[r[3], r[4]] for r in rows], dtype=np.float32)
+        return cls(ints, floats)
+
+
+_ATTR_VALUE_COL = {"str": "str_id", "int": "int32", "bool": "int32", "float": "f32"}
+
+
+def required_columns(conds: tuple[Cond, ...]) -> list[str]:
+    need = {"span.trace_sid"}
+    for c in conds:
+        if c.target in (T_SPAN, T_TRACE):
+            need.add(c.col)
+        elif c.target == T_RES:
+            need.add(c.col)
+            need.add("span.res_idx")
+        elif c.target == T_SATTR:
+            need.update({"sattr.span", "sattr.key_id", "sattr.vtype"})
+            if c.col in _ATTR_VALUE_COL:
+                need.add(f"sattr.{_ATTR_VALUE_COL[c.col]}")
+        elif c.target == T_RATTR:
+            # res.service_id rides along to size the resource axis
+            need.update({"rattr.res", "rattr.key_id", "rattr.vtype", "span.res_idx", "res.service_id"})
+            if c.col in _ATTR_VALUE_COL:
+                need.add(f"rattr.{_ATTR_VALUE_COL[c.col]}")
+    return sorted(need)
+
+
+def _cmp(op: str, col, v0, v1, f0, f1, is_float: bool):
+    x = col
+    if is_float:
+        a, b = f0, f1
+    else:
+        a, b = v0, v1
+    if op == "eq":
+        return x == a
+    if op == "ne":
+        return x != a
+    if op == "ne_present":  # value present (code >= 0) and differs
+        return (x != a) & (x >= 0)
+    if op == "ne_clamped":  # conservative ne on a clamped int encoding
+        return (x != a) | (x == 2**31 - 1) | (x == -(2**31) + 1)
+    if op == "lt":
+        return x < a
+    if op == "le":
+        return x <= a
+    if op == "gt":
+        return x > a
+    if op == "ge":
+        return x >= a
+    if op == "range":  # inclusive [a, b]
+        return (x >= a) & (x <= b)
+    if op == "exists":
+        return jnp.ones_like(x, dtype=bool)
+    raise ValueError(f"unknown op {op}")
+
+
+_VT_CODE = {"str": 0, "int": 1, "float": 2, "bool": 3, "any": -1}
+
+
+def _eval_conds(conds, cols, ops_i, ops_f, n_spans_b, n_res_b, valid_span):
+    """-> list of (span-level mask) per condition."""
+    masks = []
+    for i, c in enumerate(conds):
+        v0, v1, key = ops_i[i, 1], ops_i[i, 2], ops_i[i, 0]
+        f0, f1 = ops_f[i, 0], ops_f[i, 1]
+        if c.target in (T_SPAN,):
+            m = _cmp(c.op, cols[c.col], v0, v1, f0, f1, c.is_float) & valid_span
+        elif c.target == T_RES:
+            res_mask = _cmp(c.op, cols[c.col], v0, v1, f0, f1, c.is_float)
+            idx = jnp.clip(cols["span.res_idx"], 0, res_mask.shape[0] - 1)
+            m = res_mask[idx] & (cols["span.res_idx"] >= 0) & valid_span
+        elif c.target in (T_SATTR, T_RATTR):
+            pre = c.target
+            key_match = cols[f"{pre}.key_id"] == key
+            if c.col == "any":
+                row_hit = key_match
+            else:
+                vcol = cols[f"{pre}.{_ATTR_VALUE_COL[c.col]}"]
+                vt_ok = cols[f"{pre}.vtype"] == _VT_CODE[c.col]
+                if c.col == "bool":
+                    vt_ok = cols[f"{pre}.vtype"] == 3
+                row_hit = key_match & vt_ok & _cmp(c.op, vcol, v0, v1, f0, f1, c.is_float)
+            if pre == T_SATTR:
+                owner = jnp.clip(cols["sattr.span"], 0, n_spans_b - 1)
+                m = (
+                    jax.ops.segment_max(
+                        row_hit.astype(jnp.int32), owner, num_segments=n_spans_b
+                    )
+                    > 0
+                ) & valid_span
+            else:
+                owner = jnp.clip(cols["rattr.res"], 0, n_res_b - 1)
+                res_mask = (
+                    jax.ops.segment_max(
+                        row_hit.astype(jnp.int32), owner, num_segments=n_res_b
+                    )
+                    > 0
+                )
+                idx = jnp.clip(cols["span.res_idx"], 0, n_res_b - 1)
+                m = res_mask[idx] & (cols["span.res_idx"] >= 0) & valid_span
+        else:
+            raise ValueError(f"bad target {c.target}")
+        masks.append(m)
+    return masks
+
+
+@lru_cache(maxsize=256)
+def _compiled(conds: tuple, combinator: str, n_spans_b: int, n_res_b: int, n_traces_b: int):
+    span_conds = tuple((i, c) for i, c in enumerate(conds) if c.target != T_TRACE)
+    trace_conds = tuple((i, c) for i, c in enumerate(conds) if c.target == T_TRACE)
+
+    @jax.jit
+    def run(cols, ops_i, ops_f, n_spans, n_traces):
+        valid_span = jnp.arange(n_spans_b, dtype=jnp.int32) < n_spans
+        if span_conds:
+            sub = tuple(c for _, c in span_conds)
+            idx = jnp.asarray([i for i, _ in span_conds], dtype=jnp.int32)
+            masks = _eval_conds(sub, cols, ops_i[idx], ops_f[idx], n_spans_b, n_res_b, valid_span)
+            span_mask = masks[0]
+            for m in masks[1:]:
+                span_mask = (span_mask & m) if combinator == "and" else (span_mask | m)
+        else:
+            span_mask = valid_span
+
+        sid = jnp.where(valid_span & span_mask, cols["span.trace_sid"], n_traces_b)
+        sid = jnp.clip(sid, 0, n_traces_b)
+        trace_mask = (
+            jax.ops.segment_max(
+                span_mask.astype(jnp.int32), sid, num_segments=n_traces_b + 1
+            )[:n_traces_b]
+            > 0
+        )
+        span_count = jax.ops.segment_sum(
+            span_mask.astype(jnp.int32), sid, num_segments=n_traces_b + 1
+        )[:n_traces_b]
+
+        valid_trace = jnp.arange(n_traces_b, dtype=jnp.int32) < n_traces
+        trace_mask = trace_mask & valid_trace
+        for i, c in trace_conds:
+            tm = _cmp(c.op, cols[c.col], ops_i[i, 1], ops_i[i, 2], ops_f[i, 0], ops_f[i, 1], c.is_float)
+            trace_mask = trace_mask & tm & valid_trace
+
+        return span_mask, trace_mask, span_count
+
+    return run
+
+
+def eval_block(
+    conds: tuple[Cond, ...],
+    combinator: str,
+    cols: dict[str, jnp.ndarray],
+    operands: Operands,
+    n_spans: int,
+    n_traces: int,
+    n_spans_b: int,
+    n_res_b: int,
+    n_traces_b: int,
+):
+    """Run the filter over staged (padded) device columns.
+
+    Returns (span_mask (n_spans_b,), trace_mask (n_traces_b,),
+    per-trace matched span count)."""
+    fn = _compiled(conds, combinator, n_spans_b, n_res_b, n_traces_b)
+    return fn(
+        cols,
+        jnp.asarray(operands.ints),
+        jnp.asarray(operands.floats),
+        jnp.int32(n_spans),
+        jnp.int32(n_traces),
+    )

@@ -1,0 +1,74 @@
+"""Batched trace-ID lookup kernel.
+
+Replaces the reference's per-block bloom -> index binary search -> page
+scan (vparquet/block_findtracebyid.go:56-203) with one vectorized
+device binary search: Q query ids against a block's sorted 128-bit
+trace-id index, ids as 4 order-preserving int32 lanes
+(schema.trace_id_to_codes). All Q queries step through the log2(T)
+bisection together as one (Q,4) vs (T,4) lexicographic compare per
+step -- the shape the VPU wants, and the unit the sharded multi-chip
+Find distributes (parallel/find.py).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .device import PAD_I32, bucket, pad_rows
+
+
+def _lex_less(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Rowwise a < b for (..., 4) int32 lanes, lexicographic."""
+    lt = a < b
+    eq = a == b
+    return lt[..., 0] | (
+        eq[..., 0] & (lt[..., 1] | (eq[..., 1] & (lt[..., 2] | (eq[..., 2] & lt[..., 3]))))
+    )
+
+
+def _lex_eq(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return jnp.all(a == b, axis=-1)
+
+
+@partial(jax.jit, static_argnames=("n_steps",))
+def _lookup_kernel(ids: jnp.ndarray, queries: jnp.ndarray, n_valid: jnp.ndarray, n_steps: int):
+    """ids: (T,4) sorted i32 codes (padded with +max rows), queries: (Q,4),
+    n_valid: () number of real id rows. -> (Q,) int32 sid or -1."""
+    T = ids.shape[0]
+    Q = queries.shape[0]
+    lo = jnp.zeros((Q,), dtype=jnp.int32)
+    hi = jnp.full((Q,), n_valid, dtype=jnp.int32)
+
+    def step(_, state):
+        lo, hi = state
+        mid = (lo + hi) // 2
+        mid_ids = ids[jnp.clip(mid, 0, T - 1)]
+        less = _lex_less(mid_ids, queries)
+        lo = jnp.where(less, mid + 1, lo)
+        hi = jnp.where(less, hi, mid)
+        return lo, hi
+
+    lo, hi = jax.lax.fori_loop(0, n_steps, step, (lo, hi))
+    found_ids = ids[jnp.clip(lo, 0, T - 1)]
+    ok = (lo < n_valid) & _lex_eq(found_ids, queries)
+    return jnp.where(ok, lo, -1)
+
+
+def lookup_ids(id_codes: np.ndarray, query_codes: np.ndarray) -> np.ndarray:
+    """Host wrapper: pad to buckets, run the kernel, return (Q,) sids (-1 miss)."""
+    n = id_codes.shape[0]
+    q = query_codes.shape[0]
+    if n == 0 or q == 0:
+        return np.full((q,), -1, dtype=np.int32)
+    tb = bucket(n)
+    qb = bucket(q)
+    # pad ids with +inf rows (max codes) so they sort after everything
+    ids = pad_rows(np.asarray(id_codes, dtype=np.int32), tb, np.int32(2**31 - 1))
+    queries = pad_rows(np.asarray(query_codes, dtype=np.int32), qb, PAD_I32)
+    n_steps = int(tb).bit_length()  # ceil(log2(tb)) + 1 covers the range
+    out = _lookup_kernel(jnp.asarray(ids), jnp.asarray(queries), jnp.int32(n), n_steps)
+    return np.asarray(out)[:q]

@@ -1,0 +1,113 @@
+"""Stage vtpu block columns onto the device for filtering.
+
+Reads only the columns a condition set needs (ops.filter.required_columns),
+optionally only a row-group range (the unit of search-job sharding,
+mirroring the reference's StartPage/TotalPages jobs,
+modules/frontend/searchsharding.go), pads every axis to its power-of-two
+bucket, and uploads. StagedBlock caches the device arrays so repeated
+queries against a hot block skip both IO and transfer.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..block import schema as S
+from ..block.reader import BackendBlock
+from .device import PAD_I32, bucket, pad_rows
+
+_AXIS_OF = {
+    "span": S.AX_SPAN,
+    "sattr": S.AX_SATTR,
+    "rattr": None,  # res-axis tables are small: always loaded whole
+    "res": None,
+    "trace": None,
+}
+
+
+@dataclass
+class StagedBlock:
+    n_spans: int
+    n_traces: int
+    n_res: int
+    n_spans_b: int
+    n_traces_b: int
+    n_res_b: int
+    span_base: int  # global row of first staged span (group-range staging)
+    cols: dict[str, jnp.ndarray] = field(default_factory=dict)
+
+
+def stage_block(
+    blk: BackendBlock,
+    needed: list[str],
+    groups: list[int] | None = None,
+) -> StagedBlock:
+    """Load `needed` columns (padded, on device). If `groups` is given,
+    span/sattr-axis columns cover only those contiguous row groups."""
+    pack = blk.pack
+    span_ax = pack.axes[S.AX_SPAN]
+    if groups is None:
+        groups = list(range(span_ax.n_groups))
+    span_base = span_ax.offsets[groups[0]] if groups else 0
+    span_hi = span_ax.offsets[groups[-1] + 1] if groups else 0
+
+    host: dict[str, np.ndarray] = {}
+    n_res = 0
+    for name in needed:
+        pref = name.split(".", 1)[0]
+        ax = _AXIS_OF.get(pref)
+        if ax is None:
+            arr = pack.read(name)
+            if pref == "res" or name == "rattr.res":
+                n_res = max(n_res, arr.shape[0] if name.startswith("res.") else 0)
+        else:
+            arr = pack.read_groups(name, groups) if span_ax.n_groups else pack.read(name)
+        host[name] = arr
+
+    n_spans = span_hi - span_base
+    n_traces = blk.meta.total_traces
+    for name, arr in host.items():
+        if name.startswith("res."):
+            n_res = max(n_res, arr.shape[0])
+
+    n_spans_b = bucket(max(n_spans, 1))
+    n_traces_b = bucket(max(n_traces, 1))
+    n_res_b = bucket(max(n_res, 1))
+
+    staged = StagedBlock(
+        n_spans=n_spans,
+        n_traces=n_traces,
+        n_res=n_res,
+        n_spans_b=n_spans_b,
+        n_traces_b=n_traces_b,
+        n_res_b=n_res_b,
+        span_base=span_base,
+    )
+    for name, arr in host.items():
+        pref = name.split(".", 1)[0]
+        if pref == "span":
+            if name == "span.trace_sid" or name == "span.res_idx":
+                fill = PAD_I32
+            else:
+                fill = PAD_I32
+            arr = pad_rows(arr, n_spans_b, fill)
+        elif pref == "sattr":
+            if name == "sattr.span":
+                # rebase owner to staged-local rows; pads clip safely since
+                # their key_id sentinel never matches
+                arr = arr - span_base
+            arr = pad_rows(arr, bucket(max(arr.shape[0], 1)), PAD_I32)
+        elif pref == "rattr":
+            arr = pad_rows(arr, bucket(max(arr.shape[0], 1)), PAD_I32)
+        elif pref == "res":
+            arr = pad_rows(arr, n_res_b, PAD_I32)
+        elif pref == "trace":
+            if arr.dtype in (np.int32, np.float32):
+                arr = pad_rows(arr, n_traces_b, PAD_I32 if arr.dtype == np.int32 else np.float32(0))
+            else:
+                continue  # host-only trace columns are not staged
+        staged.cols[name] = jnp.asarray(arr)
+    return staged

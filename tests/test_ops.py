@@ -1,0 +1,176 @@
+"""Device kernel tests: lookup, predicate filter, bloom ops.
+
+Run on the 8-virtual-device CPU platform (conftest); results are checked
+against numpy oracles over the same block columns."""
+
+import numpy as np
+import pytest
+
+from tempo_tpu.backend import MemBackend
+from tempo_tpu.block import build_block_from_traces, open_block
+from tempo_tpu.block import schema as S
+from tempo_tpu.block.bloom import ShardedBloom
+from tempo_tpu.ops import bloom_ops
+from tempo_tpu.ops.filter import Cond, Operands, eval_block, required_columns
+from tempo_tpu.ops.find import lookup_ids
+from tempo_tpu.ops.stage import stage_block
+from tempo_tpu.util.testdata import make_traces
+
+TENANT = "t"
+
+
+@pytest.fixture(scope="module")
+def block():
+    backend = MemBackend()
+    traces = make_traces(120, seed=5, n_spans=10)
+    meta = build_block_from_traces(backend, TENANT, traces, row_group_spans=256)
+    return open_block(backend, TENANT, meta.block_id), traces
+
+
+def test_lookup_ids(block):
+    blk, traces = block
+    codes = blk.trace_index["trace.id_codes"]
+    # every present id found at the right sid
+    queries = np.asarray([S.trace_id_to_codes(tid) for tid, _ in traces], dtype=np.int32)
+    sids = lookup_ids(codes, queries)
+    np.testing.assert_array_equal(sids, np.arange(len(traces)))
+    # misses return -1
+    miss = np.asarray(
+        [S.trace_id_to_codes(b"\x00" * 16), S.trace_id_to_codes(b"\xff" * 16)], dtype=np.int32
+    )
+    np.testing.assert_array_equal(lookup_ids(codes, miss), [-1, -1])
+
+
+def test_lookup_extreme_ids():
+    # ids around the signed/unsigned transform boundary
+    ids = sorted([b"\x00" * 16, b"\x7f" + b"\xff" * 15, b"\x80" + b"\x00" * 15, b"\xff" * 16])
+    codes = np.asarray([S.trace_id_to_codes(t) for t in ids], dtype=np.int32)
+    sids = lookup_ids(codes, codes)
+    np.testing.assert_array_equal(sids, np.arange(4))
+
+
+def _oracle_span_mask(blk, pred):
+    """numpy oracle: spans matching pred(dict of host arrays) -> bool (n_spans,)"""
+    cols = blk.pack.read_all()
+    return pred(cols)
+
+
+def test_filter_service_eq(block):
+    blk, traces = block
+    d = blk.dictionary
+    svc = "db"
+    code = d.lookup(svc)
+    assert code >= 0
+    conds = (Cond(target="res", col="res.service_id", op="eq"),)
+    ops = Operands.build([(0, code, 0, 0.0, 0.0)])
+    staged = stage_block(blk, required_columns(conds))
+    span_mask, trace_mask, counts = eval_block(
+        conds, "and", staged.cols, ops,
+        staged.n_spans, staged.n_traces, staged.n_spans_b, staged.n_res_b, staged.n_traces_b,
+    )
+    span_mask = np.asarray(span_mask)[: staged.n_spans]
+    oracle = _oracle_span_mask(
+        blk, lambda c: c["res.service_id"][c["span.res_idx"]] == code
+    )
+    np.testing.assert_array_equal(span_mask, oracle)
+    # trace mask agrees with any-span aggregation
+    tm = np.asarray(trace_mask)[: staged.n_traces]
+    sid = blk.pack.read("span.trace_sid")
+    oracle_tm = np.zeros(staged.n_traces, dtype=bool)
+    np.maximum.at(oracle_tm, sid, oracle)
+    np.testing.assert_array_equal(tm, oracle_tm)
+    assert np.asarray(counts)[: staged.n_traces].sum() == oracle.sum()
+
+
+def test_filter_attr_and_duration(block):
+    blk, _ = block
+    d = blk.dictionary
+    method_code = d.lookup("GET")
+    key_code = d.lookup("http.method")
+    assert method_code >= 0 and key_code >= 0
+    dur_thresh_us = 500_000  # 500ms
+    conds = (
+        Cond(target="sattr", col="str", op="eq"),
+        Cond(target="span", col="span.dur_us", op="ge"),
+    )
+    ops = Operands.build([
+        (key_code, method_code, 0, 0.0, 0.0),
+        (0, dur_thresh_us, 0, 0.0, 0.0),
+    ])
+    staged = stage_block(blk, required_columns(conds))
+    span_mask, trace_mask, _ = eval_block(
+        conds, "and", staged.cols, ops,
+        staged.n_spans, staged.n_traces, staged.n_spans_b, staged.n_res_b, staged.n_traces_b,
+    )
+    span_mask = np.asarray(span_mask)[: staged.n_spans]
+
+    def oracle(c):
+        hit = np.zeros(staged.n_spans, dtype=bool)
+        rows = (c["sattr.key_id"] == key_code) & (c["sattr.vtype"] == 0) & (c["sattr.str_id"] == method_code)
+        np.maximum.at(hit, c["sattr.span"], rows)
+        return hit & (c["span.dur_us"] >= dur_thresh_us)
+
+    np.testing.assert_array_equal(span_mask, _oracle_span_mask(blk, oracle))
+    assert span_mask.sum() > 0  # query actually selects something
+
+
+def test_filter_int_attr(block):
+    blk, _ = block
+    d = blk.dictionary
+    key_code = d.lookup("http.status_code")
+    conds = (Cond(target="sattr", col="int", op="eq"),)
+    ops = Operands.build([(key_code, 500, 0, 0.0, 0.0)])
+    staged = stage_block(blk, required_columns(conds))
+    span_mask, _, _ = eval_block(
+        conds, "and", staged.cols, ops,
+        staged.n_spans, staged.n_traces, staged.n_spans_b, staged.n_res_b, staged.n_traces_b,
+    )
+    span_mask = np.asarray(span_mask)[: staged.n_spans]
+
+    def oracle(c):
+        hit = np.zeros(staged.n_spans, dtype=bool)
+        rows = (c["sattr.key_id"] == key_code) & (c["sattr.vtype"] == 1) & (c["sattr.int32"] == 500)
+        np.maximum.at(hit, c["sattr.span"], rows)
+        return hit
+
+    np.testing.assert_array_equal(span_mask, _oracle_span_mask(blk, oracle))
+    assert span_mask.sum() > 0
+
+
+def test_filter_group_range(block):
+    """Staging a row-group subrange gives the same hits as slicing the full mask."""
+    blk, _ = block
+    d = blk.dictionary
+    code = d.lookup("db.query")
+    conds = (Cond(target="span", col="span.name_id", op="eq"),)
+    ops = Operands.build([(0, code, 0, 0.0, 0.0)])
+
+    full = stage_block(blk, required_columns(conds))
+    fm, _, _ = eval_block(conds, "and", full.cols, ops, full.n_spans, full.n_traces,
+                          full.n_spans_b, full.n_res_b, full.n_traces_b)
+    fm = np.asarray(fm)[: full.n_spans]
+
+    part = stage_block(blk, required_columns(conds), groups=[1])
+    pm, _, _ = eval_block(conds, "and", part.cols, ops, part.n_spans, part.n_traces,
+                          part.n_spans_b, part.n_res_b, part.n_traces_b)
+    pm = np.asarray(pm)[: part.n_spans]
+    np.testing.assert_array_equal(pm, fm[part.span_base : part.span_base + part.n_spans])
+
+
+def test_bloom_union_and_batch_test():
+    b1 = ShardedBloom(4, 1 << 13)
+    b2 = ShardedBloom(4, 1 << 13)
+    ids1 = [bytes([1, i]) + b"\x00" * 14 for i in range(50)]
+    ids2 = [bytes([2, i]) + b"\x00" * 14 for i in range(50)]
+    b1.add_many(ids1)
+    b2.add_many(ids2)
+    u = bloom_ops.union_blooms([b1, b2])
+    assert all(u.test(t) for t in ids1 + ids2)
+    hits = bloom_ops.batch_test(u.words, u.shard_bits, u.n_shards, ids1 + ids2)
+    assert hits.all()
+    misses = bloom_ops.batch_test(
+        u.words, u.shard_bits, u.n_shards, [bytes([9, i]) + b"\x01" * 14 for i in range(100)]
+    )
+    assert misses.sum() < 10
+    with pytest.raises(ValueError):
+        bloom_ops.union_blooms([b1, ShardedBloom(2, 1 << 13)])
