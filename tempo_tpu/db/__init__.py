@@ -1,0 +1,2 @@
+from .tempodb import TempoDB, TempoDBConfig
+from .search import SearchRequest, SearchResult, SearchResponse

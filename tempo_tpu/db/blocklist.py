@@ -1,0 +1,173 @@
+"""In-memory per-tenant blocklist + backend poller + tenant index.
+
+Mirrors the reference's blocklist/poller design (tempodb/blocklist/
+list.go:29-123, poller.go:122-180): queriers and compactors never list
+the backend on the query path -- they consult this in-memory list,
+refreshed by a poll loop. Elected builders write a per-tenant
+`index.json.gz` so the other readers do one object read instead of
+O(blocks) meta reads. Updates that arrive while a poll is in flight are
+patched into the fresh results (ApplyPollResults semantics).
+"""
+
+from __future__ import annotations
+
+import gzip
+import json
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+from ..backend.base import DoesNotExist, RawBackend, TENANT_INDEX_NAME
+from ..block.meta import BlockMeta
+
+
+class Blocklist:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metas: dict[str, list[BlockMeta]] = {}
+        self._compacted: dict[str, list[BlockMeta]] = {}
+        # blocks added/removed since the current poll started
+        self._added: dict[str, list[BlockMeta]] = {}
+        self._removed: dict[str, set[str]] = {}
+
+    def tenants(self) -> list[str]:
+        with self._lock:
+            return [t for t, m in self._metas.items() if m]
+
+    def metas(self, tenant: str) -> list[BlockMeta]:
+        with self._lock:
+            return list(self._metas.get(tenant, []))
+
+    def compacted_metas(self, tenant: str) -> list[BlockMeta]:
+        with self._lock:
+            return list(self._compacted.get(tenant, []))
+
+    def update(
+        self,
+        tenant: str,
+        add: list[BlockMeta] | None = None,
+        remove: list[str] | None = None,
+        add_compacted: list[BlockMeta] | None = None,
+    ) -> None:
+        """Immediate local mutation (flush/compaction) -- also remembered
+        so an in-flight poll can't resurrect/delete it."""
+        with self._lock:
+            metas = self._metas.setdefault(tenant, [])
+            removed = self._removed.setdefault(tenant, set())
+            if add:
+                known = {m.block_id for m in metas}
+                for m in add:
+                    if m.block_id not in known:
+                        metas.append(m)
+                self._added.setdefault(tenant, []).extend(add)
+            if remove:
+                rm = set(remove)
+                self._metas[tenant] = [m for m in metas if m.block_id not in rm]
+                removed |= rm
+            if add_compacted:
+                self._compacted.setdefault(tenant, []).extend(add_compacted)
+
+    def apply_poll_results(
+        self, metas: dict[str, list[BlockMeta]], compacted: dict[str, list[BlockMeta]]
+    ) -> None:
+        with self._lock:
+            for tenant in set(metas) | set(self._metas):
+                fresh = metas.get(tenant, [])
+                ids = {m.block_id for m in fresh}
+                # patch in updates that raced the poll
+                for m in self._added.get(tenant, []):
+                    if m.block_id not in ids:
+                        fresh.append(m)
+                        ids.add(m.block_id)
+                rm = self._removed.get(tenant, set())
+                self._metas[tenant] = [m for m in fresh if m.block_id not in rm]
+            self._compacted = {t: list(v) for t, v in compacted.items()}
+            self._added.clear()
+            self._removed.clear()
+
+
+class Poller:
+    """Scans the backend (or reads tenant indexes) into poll results; when
+    `build_index` is set this poller also writes the per-tenant index
+    (the reference elects N builders per tenant via the ring;
+    services/compactor wires that ownership in)."""
+
+    def __init__(
+        self,
+        backend: RawBackend,
+        build_index: bool = True,
+        stale_index_max_age_s: float = 0.0,
+        concurrency: int = 16,
+    ):
+        self.backend = backend
+        self.build_index = build_index
+        self.stale_max = stale_index_max_age_s
+        self.concurrency = concurrency
+
+    def poll(self) -> tuple[dict[str, list[BlockMeta]], dict[str, list[BlockMeta]]]:
+        metas: dict[str, list[BlockMeta]] = {}
+        compacted: dict[str, list[BlockMeta]] = {}
+        for tenant in self.backend.tenants():
+            m, c = self.poll_tenant(tenant)
+            metas[tenant] = m
+            compacted[tenant] = c
+        return metas, compacted
+
+    def poll_tenant(self, tenant: str) -> tuple[list[BlockMeta], list[BlockMeta]]:
+        if not self.build_index:
+            got = self._read_index(tenant)
+            if got is not None:
+                return got
+        metas, compacted = self._list_tenant(tenant)
+        if self.build_index:
+            self._write_index(tenant, metas, compacted)
+        return metas, compacted
+
+    # ---- raw listing
+    def _list_tenant(self, tenant: str) -> tuple[list[BlockMeta], list[BlockMeta]]:
+        metas: list[BlockMeta] = []
+        compacted: list[BlockMeta] = []
+
+        def read_one(block_id: str):
+            try:
+                return BlockMeta.from_json(self.backend.read(tenant, block_id, "meta.json")), False
+            except DoesNotExist:
+                pass
+            try:
+                return (
+                    BlockMeta.from_json(self.backend.read(tenant, block_id, "meta.compacted.json")),
+                    True,
+                )
+            except DoesNotExist:
+                return None, False
+
+        block_ids = self.backend.blocks(tenant)
+        with ThreadPoolExecutor(max_workers=self.concurrency) as pool:
+            for meta, is_compacted in pool.map(read_one, block_ids):
+                if meta is None:
+                    continue
+                (compacted if is_compacted else metas).append(meta)
+        metas.sort(key=lambda m: m.block_id)
+        compacted.sort(key=lambda m: m.block_id)
+        return metas, compacted
+
+    # ---- tenant index
+    def _write_index(self, tenant, metas, compacted) -> None:
+        doc = {
+            "created_at": time.time(),
+            "meta": [json.loads(m.to_json()) for m in metas],
+            "compacted": [json.loads(m.to_json()) for m in compacted],
+        }
+        data = gzip.compress(json.dumps(doc).encode("utf-8"))
+        self.backend.write_tenant_object(tenant, TENANT_INDEX_NAME, data)
+
+    def _read_index(self, tenant) -> tuple[list[BlockMeta], list[BlockMeta]] | None:
+        try:
+            raw = self.backend.read_tenant_object(tenant, TENANT_INDEX_NAME)
+        except DoesNotExist:
+            return None
+        doc = json.loads(gzip.decompress(raw))
+        if self.stale_max and time.time() - doc.get("created_at", 0) > self.stale_max:
+            return None
+        to_meta = lambda d: BlockMeta.from_json(json.dumps(d).encode())  # noqa: E731
+        return [to_meta(d) for d in doc["meta"]], [to_meta(d) for d in doc["compacted"]]

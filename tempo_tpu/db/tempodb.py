@@ -1,0 +1,196 @@
+"""TempoDB: the storage-engine facade (reader / writer / compactor).
+
+The role of tempodb.New + Reader/Writer/Compactor interfaces in the
+reference (tempodb/tempodb.go:68-197): backend selection, WAL, blocklist
++ polling, parallel multi-block Find, per-block Search fan-out, and the
+compaction/retention drivers. Services (L5) sit on top of this facade;
+everything below it is columnar blocks + device kernels.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+
+from ..backend import open_backend
+from ..backend.base import RawBackend
+from ..block.builder import build_block_from_traces
+from ..block.meta import BlockMeta
+from ..block.reader import BackendBlock
+from ..util.distinct import DistinctStringCollector
+from ..wire.combine import combine_traces
+from ..wire.model import Trace
+from . import compactor as comp
+from .blocklist import Blocklist, Poller
+from .search import SearchRequest, SearchResponse, search_block, search_tag_values, search_tags
+from .wal import WAL
+
+
+@dataclass
+class TempoDBConfig:
+    backend: dict = field(default_factory=lambda: {"backend": "local", "path": "./tempo-data"})
+    wal_path: str = "./tempo-wal"
+    row_group_spans: int = 1 << 16
+    pool_workers: int = 8
+    blocklist_poll_s: float = 15.0
+    block_cache_blocks: int = 64
+    search_default_limit: int = 20
+    compaction: comp.CompactorConfig = field(default_factory=comp.CompactorConfig)
+
+
+class TempoDB:
+    def __init__(self, cfg: TempoDBConfig, backend: RawBackend | None = None):
+        self.cfg = cfg
+        self.backend = backend or open_backend(cfg.backend)
+        os.makedirs(cfg.wal_path, exist_ok=True)
+        self.wal = WAL(os.path.join(cfg.wal_path, "wal"))
+        self.blocklist = Blocklist()
+        self.poller = Poller(self.backend)
+        self.pool = ThreadPoolExecutor(max_workers=cfg.pool_workers)
+        self._block_cache: dict[tuple[str, str], BackendBlock] = {}
+        self._cache_lock = threading.Lock()
+        self._poll_thread: threading.Thread | None = None
+        self._stop = threading.Event()
+        # compaction ownership + dedupe hooks, overridden by the service layer
+        self.owns_job = lambda job_hash: True
+
+    # ------------------------------------------------------------ blocks
+    def open_block(self, meta: BlockMeta) -> BackendBlock:
+        key = (meta.tenant_id, meta.block_id)
+        with self._cache_lock:
+            blk = self._block_cache.get(key)
+            if blk is None:
+                blk = BackendBlock(self.backend, meta)
+                if len(self._block_cache) >= self.cfg.block_cache_blocks:
+                    self._block_cache.pop(next(iter(self._block_cache)))
+                self._block_cache[key] = blk
+            return blk
+
+    def write_block(self, tenant: str, traces: list[tuple[bytes, Trace]]) -> BlockMeta:
+        """Build + flush a complete block from sorted traces (ingester's
+        CompleteBlock + WriteBlock path, tempodb.go:199-251)."""
+        meta = build_block_from_traces(
+            self.backend, tenant, traces, row_group_spans=self.cfg.row_group_spans
+        )
+        self.blocklist.update(tenant, add=[meta])
+        return meta
+
+    # ------------------------------------------------------------- find
+    def find_trace_by_id(
+        self, tenant: str, trace_id: bytes, time_start: int = 0, time_end: int = 0
+    ) -> Trace | None:
+        """Parallel candidate-block lookup + combine
+        (reference: tempodb.Find, tempodb/tempodb.go:271-352)."""
+        hex_id = trace_id.rjust(16, b"\x00").hex()
+        candidates = [
+            m
+            for m in self.blocklist.metas(tenant)
+            if m.may_contain_id(hex_id) and m.overlaps_time(time_start, time_end)
+        ]
+        if not candidates:
+            return None
+        results = list(
+            self.pool.map(lambda m: self.open_block(m).find_trace_by_id(trace_id), candidates)
+        )
+        found = [t for t in results if t is not None]
+        if not found:
+            return None
+        return combine_traces(found)
+
+    # ------------------------------------------------------------ search
+    def search(self, tenant: str, req: SearchRequest) -> SearchResponse:
+        metas = [m for m in self.blocklist.metas(tenant) if m.overlaps_time(req.start, req.end)]
+        resp = SearchResponse()
+        if not metas:
+            return resp
+        for r in self.pool.map(lambda m: search_block(self.open_block(m), req), metas):
+            resp.merge(r, req.limit or self.cfg.search_default_limit)
+            if len(resp.traces) >= (req.limit or self.cfg.search_default_limit):
+                break
+        resp.traces.sort(key=lambda t: -t.start_time_unix_nano)
+        return resp
+
+    def search_block_shard(self, tenant: str, meta: BlockMeta, req: SearchRequest, groups_range) -> SearchResponse:
+        """One sharded search job (frontend's StartPage/TotalPages analog)."""
+        return search_block(self.open_block(meta), req, groups_range=groups_range)
+
+    def search_tags(self, tenant: str, max_bytes: int = 0) -> list[str]:
+        c = DistinctStringCollector(max_bytes)
+        for m in self.blocklist.metas(tenant):
+            search_tags(self.open_block(m), c)
+        return c.strings()
+
+    def search_tag_values(self, tenant: str, tag: str, max_bytes: int = 0) -> list[str]:
+        c = DistinctStringCollector(max_bytes)
+        for m in self.blocklist.metas(tenant):
+            search_tag_values(self.open_block(m), tag, c)
+        return c.strings()
+
+    # ----------------------------------------------------------- polling
+    def poll_now(self) -> None:
+        metas, compacted = self.poller.poll()
+        self.blocklist.apply_poll_results(metas, compacted)
+        with self._cache_lock:  # drop cached readers for vanished blocks
+            live = {(t, m.block_id) for t in metas for m in metas[t]}
+            for key in [k for k in self._block_cache if k not in live]:
+                self._block_cache.pop(key, None)
+
+    def enable_polling(self) -> None:
+        if self._poll_thread:
+            return
+
+        def loop():
+            while not self._stop.wait(self.cfg.blocklist_poll_s):
+                try:
+                    self.poll_now()
+                except Exception:  # noqa: BLE001 - poll errors keep last list
+                    pass
+
+        self.poll_now()
+        self._poll_thread = threading.Thread(target=loop, daemon=True, name="blocklist-poller")
+        self._poll_thread.start()
+
+    # --------------------------------------------------------- compaction
+    def compact_once(self, tenant: str) -> list[comp.CompactionResult]:
+        """One compaction sweep for a tenant: select jobs, run owned ones."""
+        metas = self.blocklist.metas(tenant)
+        jobs = comp.select_jobs(tenant, metas, self.cfg.compaction)
+        results = []
+        for job in jobs:
+            if not self.owns_job(job.hash):
+                continue
+            res = comp.compact(self.backend, job, self.cfg.compaction)
+            removed = set(res.compacted_ids)
+            self.blocklist.update(
+                tenant,
+                add=res.new_blocks,
+                remove=list(removed),
+                add_compacted=[m for m in metas if m.block_id in removed],
+            )
+            results.append(res)
+        return results
+
+    def retention_once(self, tenant: str) -> comp.RetentionResult:
+        res = comp.apply_retention(
+            self.backend,
+            tenant,
+            self.blocklist.metas(tenant),
+            self.blocklist.compacted_metas(tenant),
+            self.cfg.compaction,
+            owns=self.owns_job,
+        )
+        if res.marked:
+            self.blocklist.update(tenant, remove=res.marked)
+        return res
+
+    def tenants(self) -> list[str]:
+        return self.blocklist.tenants()
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._poll_thread:
+            self._poll_thread.join(timeout=2)
+        self.pool.shutdown(wait=False)

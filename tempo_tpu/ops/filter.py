@@ -74,9 +74,19 @@ class Operands:
 _ATTR_VALUE_COL = {"str": "str_id", "int": "int32", "bool": "int32", "float": "f32"}
 
 
-def required_columns(conds: tuple[Cond, ...]) -> list[str]:
+def _flatten(groups) -> list[Cond]:
+    out = []
+    for g in groups:
+        if isinstance(g, Cond):
+            out.append(g)
+        else:
+            out.extend(g)
+    return out
+
+
+def required_columns(groups) -> list[str]:
     need = {"span.trace_sid"}
-    for c in conds:
+    for c in _flatten(groups):
         if c.target in (T_SPAN, T_TRACE):
             need.add(c.col)
         elif c.target == T_RES:
@@ -174,20 +184,44 @@ def _eval_conds(conds, cols, ops_i, ops_f, n_spans_b, n_res_b, valid_span):
 
 
 @lru_cache(maxsize=256)
-def _compiled(conds: tuple, combinator: str, n_spans_b: int, n_res_b: int, n_traces_b: int):
-    span_conds = tuple((i, c) for i, c in enumerate(conds) if c.target != T_TRACE)
-    trace_conds = tuple((i, c) for i, c in enumerate(conds) if c.target == T_TRACE)
+def _compiled(groups: tuple, combinator: str, n_spans_b: int, n_res_b: int, n_traces_b: int):
+    """groups: tuple of condition groups; members of a group OR together
+    (a tag may live in span attrs OR resource attrs OR a dedicated
+    column), groups combine with `combinator`. Trace-target conditions
+    must be single-member groups (applied after span->trace aggregation).
+    Operand rows index flattened (group, member) order."""
+    flat: list[tuple[int, Cond]] = []
+    span_groups: list[list[int]] = []  # per group: flat indices of non-trace members
+    trace_conds: list[tuple[int, Cond]] = []
+    pos = 0
+    for g in groups:
+        members = []
+        for c in g:
+            if c.target == T_TRACE:
+                trace_conds.append((pos, c))
+            else:
+                flat.append((pos, c))
+                members.append(len(flat) - 1)
+            pos += 1
+        if members:
+            span_groups.append(members)
 
     @jax.jit
     def run(cols, ops_i, ops_f, n_spans, n_traces):
         valid_span = jnp.arange(n_spans_b, dtype=jnp.int32) < n_spans
-        if span_conds:
-            sub = tuple(c for _, c in span_conds)
-            idx = jnp.asarray([i for i, _ in span_conds], dtype=jnp.int32)
+        if flat:
+            sub = tuple(c for _, c in flat)
+            idx = jnp.asarray([i for i, _ in flat], dtype=jnp.int32)
             masks = _eval_conds(sub, cols, ops_i[idx], ops_f[idx], n_spans_b, n_res_b, valid_span)
-            span_mask = masks[0]
-            for m in masks[1:]:
-                span_mask = (span_mask & m) if combinator == "and" else (span_mask | m)
+            gmasks = []
+            for members in span_groups:
+                gm = masks[members[0]]
+                for m in members[1:]:
+                    gm = gm | masks[m]
+                gmasks.append(gm)
+            span_mask = gmasks[0]
+            for gm in gmasks[1:]:
+                span_mask = (span_mask & gm) if combinator == "and" else (span_mask | gm)
         else:
             span_mask = valid_span
 
@@ -215,7 +249,7 @@ def _compiled(conds: tuple, combinator: str, n_spans_b: int, n_res_b: int, n_tra
 
 
 def eval_block(
-    conds: tuple[Cond, ...],
+    groups,
     combinator: str,
     cols: dict[str, jnp.ndarray],
     operands: Operands,
@@ -227,9 +261,13 @@ def eval_block(
 ):
     """Run the filter over staged (padded) device columns.
 
-    Returns (span_mask (n_spans_b,), trace_mask (n_traces_b,),
-    per-trace matched span count)."""
-    fn = _compiled(conds, combinator, n_spans_b, n_res_b, n_traces_b)
+    `groups` is a tuple of condition groups (inner tuples OR, outer
+    `combinator`); a bare tuple of Cond is accepted and treated as
+    single-member groups. Returns (span_mask (n_spans_b,), trace_mask
+    (n_traces_b,), per-trace matched span count)."""
+    if groups and isinstance(groups[0], Cond):
+        groups = tuple((c,) for c in groups)
+    fn = _compiled(tuple(groups), combinator, n_spans_b, n_res_b, n_traces_b)
     return fn(
         cols,
         jnp.asarray(operands.ints),

@@ -12,6 +12,13 @@ _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
+import jax  # noqa: E402
+
+# the environment ships an 'axon' TPU plugin that re-registers itself even
+# when JAX_PLATFORMS=cpu is set pre-import; the config update after import
+# is authoritative (verified: 8 CpuDevice, no axon)
+jax.config.update("jax_platforms", "cpu")
+
 import pytest  # noqa: E402
 
 
