@@ -284,19 +284,18 @@ class BlockBuilder:
         end_ns = np.asarray(self.sp_end_ns, dtype=np.uint64)
         base_ns = int(start_ns.min()) if n_spans else 0
         start_ms = ((start_ns.astype(np.int64) - base_ns) // 1_000_000).astype(np.int32)
-        dur_us = np.clip(
-            (end_ns.astype(np.int64) - start_ns.astype(np.int64)) // 1_000,
-            0,
-            2**31 - 1,
-        ).astype(np.int32)
+        dur_ns_full = np.maximum(end_ns.astype(np.int64) - start_ns.astype(np.int64), 0)
+        dur_us = np.clip(dur_ns_full // 1_000, 0, 2**31 - 1).astype(np.int32)
+        # ns remainder: (dur_us, dur_lo) compare == exact ns compare on device
+        dur_lo = (dur_ns_full % 1_000).astype(np.int32)
 
         tr_start_ns = np.asarray(self.tr_start_ns, dtype=np.uint64)
         tr_end_ns = np.asarray(self.tr_end_ns, dtype=np.uint64)
         tr_start_ms = ((tr_start_ns.astype(np.int64) - base_ns) // 1_000_000).astype(np.int32)
         tr_end_ms = ((tr_end_ns.astype(np.int64) - base_ns) // 1_000_000).astype(np.int32)
-        tr_dur_us = np.clip(
-            (tr_end_ns.astype(np.int64) - tr_start_ns.astype(np.int64)) // 1_000, 0, 2**31 - 1
-        ).astype(np.int32)
+        tr_dur_full = np.maximum(tr_end_ns.astype(np.int64) - tr_start_ns.astype(np.int64), 0)
+        tr_dur_us = np.clip(tr_dur_full // 1_000, 0, 2**31 - 1).astype(np.int32)
+        tr_dur_lo = (tr_dur_full % 1_000).astype(np.int32)
 
         id_codes = np.asarray(
             [S.trace_id_to_codes(t) for t in self.tr_ids], dtype=np.int32
@@ -310,6 +309,7 @@ class BlockBuilder:
             "span.status": np.asarray(self.sp_status, dtype=np.int32),
             "span.start_ms": start_ms,
             "span.dur_us": dur_us,
+            "span.dur_lo": dur_lo,
             "span.http_status": np.asarray(self.sp_http_status, dtype=np.int32),
             "span.http_method_id": rm(self.sp_http_method),
             "span.http_url_id": rm(self.sp_http_url),
@@ -328,6 +328,7 @@ class BlockBuilder:
             "trace.start_ms": tr_start_ms,
             "trace.end_ms": tr_end_ms,
             "trace.dur_us": tr_dur_us,
+            "trace.dur_lo": tr_dur_lo,
             "trace.root_service_id": rm(self.tr_root_service),
             "trace.root_name_id": rm(self.tr_root_name),
             "trace.start_ns": tr_start_ns,

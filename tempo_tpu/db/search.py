@@ -1,14 +1,12 @@
-"""Search execution: tag queries -> device filter plan -> results.
+"""Search execution: tag / TraceQL queries -> device filter plan -> results.
 
 The per-block pipeline (analog of vparquet/block_search.go:78-116 +
-makePipelineWithRowGroups): resolve strings through the block dictionary
-(a miss prunes the whole block -- the dictionary IS the page-level
-dictionary pre-filter of parquetquery predicates.go:38-89), build
-condition groups (each tag ORs across span attrs / resource attrs /
-dedicated columns), run ops.filter.eval_block over staged columns, then
-exactly re-verify time/duration on host trace columns (device encodings
-are conservative; see ops/filter.py).
-"""
+block_traceql.go Fetch): the traceql planner resolves strings through
+the block dictionary (a miss prunes the whole block -- the dictionary IS
+the page-dictionary pre-filter of parquetquery predicates.go:38-89) and
+emits a trace-level condition tree; ops.filter evaluates it over staged
+columns; surviving trace candidates are exactly re-verified host-side
+for time/duration (device encodings are conservative)."""
 
 from __future__ import annotations
 
@@ -17,11 +15,21 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from ..block.reader import BackendBlock
-from ..ops.filter import Cond, Operands, eval_block, required_columns
+from ..ops.filter import Operands, eval_block, required_columns
 from ..ops.stage import stage_block
+from ..traceql.plan import plan_search_request
 from ..util.distinct import DistinctStringCollector
 
 DEFAULT_LIMIT = 20
+
+_INTRINSIC_NAME = "name"
+_WELL_KNOWN_RES = {
+    "service.name": "res.service_id",
+    "k8s.cluster.name": "res.cluster_id",
+    "k8s.namespace.name": "res.namespace_id",
+    "k8s.pod.name": "res.pod_id",
+    "k8s.container.name": "res.container_id",
+}
 
 
 @dataclass
@@ -32,7 +40,7 @@ class SearchRequest:
     start: int = 0  # unix seconds, 0 = unbounded
     end: int = 0
     limit: int = DEFAULT_LIMIT
-    query: str = ""  # TraceQL (planned by traceql/ when set)
+    query: str = ""  # TraceQL spanset filter
 
 
 @dataclass
@@ -42,6 +50,7 @@ class SearchResult:
     root_trace_name: str
     start_time_unix_nano: int
     duration_ms: int
+    matched_spans: int = 0
 
     def to_dict(self) -> dict:
         return {
@@ -69,88 +78,29 @@ class SearchResponse:
         self.inspected_spans += other.inspected_spans
 
 
-_INTRINSIC_NAME = "name"
-_WELL_KNOWN_SPAN_STR = {"http.method": "span.http_method_id", "http.url": "span.http_url_id"}
-_WELL_KNOWN_RES = {
-    "service.name": "res.service_id",
-    "k8s.cluster.name": "res.cluster_id",
-    "k8s.namespace.name": "res.namespace_id",
-    "k8s.pod.name": "res.pod_id",
-    "k8s.container.name": "res.container_id",
-}
-
-
-def plan_tags(blk: BackendBlock, req: SearchRequest):
-    """-> (groups, operand_rows) or None when the block can be pruned."""
-    d = blk.dictionary
-    groups: list[tuple[Cond, ...]] = []
-    rows: list[tuple[int, int, int, float, float]] = []
-
-    for key, value in req.tags.items():
-        alts: list[Cond] = []
-        arows: list[tuple] = []
-        if key == _INTRINSIC_NAME:
-            code = d.lookup(value)
-            if code >= 0:
-                alts.append(Cond(target="span", col="span.name_id", op="eq"))
-                arows.append((0, code, 0, 0.0, 0.0))
-        else:
-            scode = d.lookup(value)
-            kcode = d.lookup(key)
-            if scode >= 0:
-                ded = _WELL_KNOWN_SPAN_STR.get(key)
-                if ded:
-                    alts.append(Cond(target="span", col=ded, op="eq"))
-                    arows.append((0, scode, 0, 0.0, 0.0))
-                dedr = _WELL_KNOWN_RES.get(key)
-                if dedr:
-                    alts.append(Cond(target="res", col=dedr, op="eq"))
-                    arows.append((0, scode, 0, 0.0, 0.0))
-            if kcode >= 0:
-                if scode >= 0:
-                    alts.append(Cond(target="sattr", col="str", op="eq"))
-                    arows.append((kcode, scode, 0, 0.0, 0.0))
-                    alts.append(Cond(target="rattr", col="str", op="eq"))
-                    arows.append((kcode, scode, 0, 0.0, 0.0))
-                # numeric / bool forms of the value
-                try:
-                    iv = int(value)
-                    alts.append(Cond(target="sattr", col="int", op="eq"))
-                    arows.append((kcode, iv, 0, 0.0, 0.0))
-                    alts.append(Cond(target="rattr", col="int", op="eq"))
-                    arows.append((kcode, iv, 0, 0.0, 0.0))
-                except ValueError:
-                    pass
-                if value in ("true", "false"):
-                    bv = 1 if value == "true" else 0
-                    alts.append(Cond(target="sattr", col="bool", op="eq"))
-                    arows.append((kcode, bv, 0, 0.0, 0.0))
-                    alts.append(Cond(target="rattr", col="bool", op="eq"))
-                    arows.append((kcode, bv, 0, 0.0, 0.0))
-        if not alts:
-            return None  # no way this block matches this tag
-        groups.append(tuple(alts))
-        rows.extend(arows)
-
-    # coarse duration / time-range conditions (exact-verified host-side)
-    if req.min_duration_ms or req.max_duration_ms:
-        lo = req.min_duration_ms * 1000 if req.min_duration_ms else 0
-        hi = req.max_duration_ms * 1000 if req.max_duration_ms else 2**31 - 1
-        groups.append((Cond(target="trace", col="trace.dur_us", op="range", needs_verify=True),))
-        rows.append((0, max(0, lo - 1), min(2**31 - 1, hi + 1), 0.0, 0.0))
+def _plan_for_block(blk: BackendBlock, req: SearchRequest):
+    start_rel = None
     if req.start or req.end:
         base_ms = blk.meta.start_time_unix_nano // 1_000_000
         lo = (req.start * 1000 - base_ms - 1) if req.start else -(2**31)
         hi = (req.end * 1000 - base_ms + 1) if req.end else 2**31 - 1
-        lo = int(np.clip(lo, -(2**31), 2**31 - 1))
-        hi = int(np.clip(hi, -(2**31), 2**31 - 1))
-        groups.append((Cond(target="trace", col="trace.start_ms", op="range", needs_verify=True),))
-        rows.append((0, lo, hi, 0.0, 0.0))
+        start_rel = (
+            int(np.clip(lo, -(2**31), 2**31 - 1)),
+            int(np.clip(hi, -(2**31), 2**31 - 1)),
+        )
+    return plan_search_request(
+        blk.dictionary,
+        req.tags,
+        query=req.query,
+        min_duration_ms=req.min_duration_ms,
+        max_duration_ms=req.max_duration_ms,
+        start_rel_ms=start_rel,
+    )
 
-    return tuple(groups), rows
 
-
-def _verify_and_build(blk: BackendBlock, req: SearchRequest, sids: np.ndarray) -> list[SearchResult]:
+def _verify_and_build(
+    blk: BackendBlock, req: SearchRequest, sids: np.ndarray, counts: np.ndarray
+) -> list[SearchResult]:
     """Exact host re-check of time/duration + result materialization from
     the cached trace-level index."""
     ti = blk.trace_index
@@ -175,6 +125,7 @@ def _verify_and_build(blk: BackendBlock, req: SearchRequest, sids: np.ndarray) -
                 root_trace_name=d.string(int(ti["trace.root_name_id"][sid])),
                 start_time_unix_nano=start_ns,
                 duration_ms=dur_ms,
+                matched_spans=int(counts[sid]),
             )
         )
     return out
@@ -189,15 +140,13 @@ def search_block(
     resp = SearchResponse()
     if not blk.meta.overlaps_time(req.start, req.end):
         return resp
-    plan = plan_tags(blk, req)
-    if plan is None:
+    planned = _plan_for_block(blk, req)
+    if planned.prune:
         return resp
-    cond_groups, rows = plan
-    staged = stage_block(blk, required_columns(cond_groups), groups=groups_range)
-    operands = Operands.build(rows)
-    _, trace_mask, _ = eval_block(
-        cond_groups,
-        "and",
+    staged = stage_block(blk, required_columns(planned.conds), groups=groups_range)
+    operands = Operands.build(planned.rows, planned.tables or None)
+    _, trace_mask, counts = eval_block(
+        (planned.tree, planned.conds),
         staged.cols,
         operands,
         staged.n_spans,
@@ -206,8 +155,9 @@ def search_block(
         staged.n_res_b,
         staged.n_traces_b,
     )
+    counts = np.asarray(counts)
     sids = np.nonzero(np.asarray(trace_mask)[: staged.n_traces])[0]
-    results = _verify_and_build(blk, req, sids)
+    results = _verify_and_build(blk, req, sids, counts)
     results.sort(key=lambda r: -r.start_time_unix_nano)
     resp.traces = results[: req.limit]
     resp.inspected_spans = staged.n_spans

@@ -5,26 +5,32 @@ This replaces the reference's iterator-tree engine (pkg/parquetquery
 ColumnIterator/JoinIterator + vparquet/block_search.go pipelines) with
 one vectorized pass: every condition becomes a boolean mask over its
 axis (span rows, attr rows, resource rows), attr/resource hits scatter
-to span rows with a segment-max, masks combine with AND/OR on the VPU,
-and the span mask aggregates to a trace mask with another segment-max.
-No Dremel rep/def levels anywhere: hierarchy is explicit segment ids
-(SURVEY.md 7.3 "the crux" -- this layout dissolves it).
+to span rows with a segment-max, masks combine through a static boolean
+expression tree on the VPU, and the span mask aggregates to a trace
+mask with another segment-max. No Dremel rep/def levels anywhere:
+hierarchy is explicit segment ids (SURVEY.md 7.3 "the crux" -- this
+layout dissolves it).
 
-Only the condition STRUCTURE (targets/ops/value kinds) keys a jit
-compile; operand values -- dictionary codes, thresholds -- are traced
-arrays, so `{span.foo = "bar"}` and `{span.foo = "baz"}` share one
-compiled program.
+Only the STRUCTURE (expression tree + condition targets/ops) keys a jit
+compile; operand values -- dictionary codes, thresholds, regex-match
+tables -- are traced arrays, so `{span.foo = "bar"}` and
+`{span.foo = "baz"}` share one compiled program.
+
+Regex and set predicates use *dictionary tables*: the host evaluates the
+regex once over the block's sorted dictionary (the same trick as
+parquet dictionary-page pruning, pkg/parquetquery/predicates.go:38-89)
+and ships a boolean table; on device the predicate is a single gather.
 
 Device filters are *conservative* (may over-match, never under-match):
 clamped int32 / f32 encodings use widened comparisons; conditions whose
 encodings can over-match are flagged needs_verify and re-checked
-exactly on host over the surviving spans (db/search.py).
+exactly on host over the surviving candidates (db/search.py).
 """
 
 from __future__ import annotations
 
 from dataclasses import dataclass
-from functools import lru_cache, partial
+from functools import lru_cache
 
 import jax
 import jax.numpy as jnp
@@ -37,8 +43,11 @@ T_RES = "res"  # resource-axis dedicated column (gathered via span.res_idx)
 T_SATTR = "sattr"  # generic span attr table
 T_RATTR = "rattr"  # generic resource attr table
 
-# ops: v0/v1 are the int operands, f0/f1 the float operands
-OPS = ("eq", "ne", "ne_present", "lt", "le", "gt", "ge", "range", "exists", "ne_clamped")
+# ops: v0/v1 int operands, f0/f1 float operands, table = dict-code table
+OPS = (
+    "eq", "ne", "ne_present", "lt", "le", "gt", "ge", "range",
+    "exists", "ne_clamped", "intable", "notintable",
+)
 
 
 @dataclass(frozen=True)
@@ -56,27 +65,39 @@ class Cond:
 @dataclass
 class Operands:
     """Per-condition operand values (traced; NOT part of the jit key).
-    ints[i] = (key_code, v0, v1); floats[i] = (f0, f1)."""
+    ints[i] = (key_code, v0, v1); floats[i] = (f0, f1);
+    tables[i] = bool array over dictionary codes (intable ops only)."""
 
     ints: np.ndarray  # (n_conds, 3) int32
     floats: np.ndarray  # (n_conds, 2) float32
+    tables: dict[int, np.ndarray] | None = None
 
     @classmethod
-    def build(cls, rows: list[tuple[int, int, int, float, float]]) -> "Operands":
+    def build(cls, rows: list, tables: dict[int, np.ndarray] | None = None) -> "Operands":
         if not rows:
-            return cls(np.zeros((0, 3), np.int32), np.zeros((0, 2), np.float32))
-        ints = np.asarray([[r[0], r[1], r[2]] for r in rows], dtype=np.int64)
-        ints = np.clip(ints, -(2**31), 2**31 - 1).astype(np.int32)
-        floats = np.asarray([[r[3], r[4]] for r in rows], dtype=np.float32)
-        return cls(ints, floats)
+            ints = np.zeros((0, 3), np.int32)
+            floats = np.zeros((0, 2), np.float32)
+        else:
+            ints = np.asarray([[r[0], r[1], r[2]] for r in rows], dtype=np.int64)
+            ints = np.clip(ints, -(2**31), 2**31 - 1).astype(np.int32)
+            floats = np.asarray([[r[3], r[4]] for r in rows], dtype=np.float32)
+        return cls(ints, floats, tables)
 
 
 _ATTR_VALUE_COL = {"str": "str_id", "int": "int32", "bool": "int32", "float": "f32"}
+_VT_CODE = {"str": 0, "int": 1, "float": 2, "bool": 3, "any": -1}
+
+# expression trees: ('cond', i) | ('and', *children) | ('or', *children)
+CondTree = tuple
 
 
-def _flatten(groups) -> list[Cond]:
+def all_conds_tree(n: int) -> CondTree:
+    return ("and",) + tuple(("cond", i) for i in range(n))
+
+
+def _flatten(conds) -> list:
     out = []
-    for g in groups:
+    for g in conds:
         if isinstance(g, Cond):
             out.append(g)
         else:
@@ -84,9 +105,9 @@ def _flatten(groups) -> list[Cond]:
     return out
 
 
-def required_columns(groups) -> list[str]:
+def required_columns(conds) -> list[str]:
     need = {"span.trace_sid"}
-    for c in _flatten(groups):
+    for c in _flatten(conds):
         if c.target in (T_SPAN, T_TRACE):
             need.add(c.col)
         elif c.target == T_RES:
@@ -104,8 +125,7 @@ def required_columns(groups) -> list[str]:
     return sorted(need)
 
 
-def _cmp(op: str, col, v0, v1, f0, f1, is_float: bool):
-    x = col
+def _cmp(op: str, x, v0, v1, f0, f1, is_float: bool, table):
     if is_float:
         a, b = f0, f1
     else:
@@ -130,148 +150,207 @@ def _cmp(op: str, col, v0, v1, f0, f1, is_float: bool):
         return (x >= a) & (x <= b)
     if op == "exists":
         return jnp.ones_like(x, dtype=bool)
+    if op in ("intable", "notintable"):
+        hit = table[jnp.clip(x, 0, table.shape[0] - 1)] > 0
+        if op == "notintable":
+            hit = ~hit
+        return hit & (x >= 0)
     raise ValueError(f"unknown op {op}")
 
 
-_VT_CODE = {"str": 0, "int": 1, "float": 2, "bool": 3, "any": -1}
-
-
-def _eval_conds(conds, cols, ops_i, ops_f, n_spans_b, n_res_b, valid_span):
-    """-> list of (span-level mask) per condition."""
-    masks = []
-    for i, c in enumerate(conds):
-        v0, v1, key = ops_i[i, 1], ops_i[i, 2], ops_i[i, 0]
-        f0, f1 = ops_f[i, 0], ops_f[i, 1]
-        if c.target in (T_SPAN,):
-            m = _cmp(c.op, cols[c.col], v0, v1, f0, f1, c.is_float) & valid_span
-        elif c.target == T_RES:
-            res_mask = _cmp(c.op, cols[c.col], v0, v1, f0, f1, c.is_float)
-            idx = jnp.clip(cols["span.res_idx"], 0, res_mask.shape[0] - 1)
-            m = res_mask[idx] & (cols["span.res_idx"] >= 0) & valid_span
-        elif c.target in (T_SATTR, T_RATTR):
-            pre = c.target
-            key_match = cols[f"{pre}.key_id"] == key
-            if c.col == "any":
-                row_hit = key_match
-            else:
-                vcol = cols[f"{pre}.{_ATTR_VALUE_COL[c.col]}"]
-                vt_ok = cols[f"{pre}.vtype"] == _VT_CODE[c.col]
-                if c.col == "bool":
-                    vt_ok = cols[f"{pre}.vtype"] == 3
-                row_hit = key_match & vt_ok & _cmp(c.op, vcol, v0, v1, f0, f1, c.is_float)
-            if pre == T_SATTR:
-                owner = jnp.clip(cols["sattr.span"], 0, n_spans_b - 1)
-                m = (
-                    jax.ops.segment_max(
-                        row_hit.astype(jnp.int32), owner, num_segments=n_spans_b
-                    )
-                    > 0
-                ) & valid_span
-            else:
-                owner = jnp.clip(cols["rattr.res"], 0, n_res_b - 1)
-                res_mask = (
-                    jax.ops.segment_max(
-                        row_hit.astype(jnp.int32), owner, num_segments=n_res_b
-                    )
-                    > 0
-                )
-                idx = jnp.clip(cols["span.res_idx"], 0, n_res_b - 1)
-                m = res_mask[idx] & (cols["span.res_idx"] >= 0) & valid_span
+def _cond_mask(c: Cond, i, cols, ops_i, ops_f, tables, n_spans_b, n_res_b, valid_span):
+    """Span-level mask for one condition."""
+    key, v0, v1 = ops_i[i, 0], ops_i[i, 1], ops_i[i, 2]
+    f0, f1 = ops_f[i, 0], ops_f[i, 1]
+    table = tables.get(i)
+    if c.target == T_SPAN:
+        return _cmp(c.op, cols[c.col], v0, v1, f0, f1, c.is_float, table) & valid_span
+    if c.target == T_RES:
+        res_mask = _cmp(c.op, cols[c.col], v0, v1, f0, f1, c.is_float, table)
+        idx = jnp.clip(cols["span.res_idx"], 0, res_mask.shape[0] - 1)
+        return res_mask[idx] & (cols["span.res_idx"] >= 0) & valid_span
+    if c.target in (T_SATTR, T_RATTR):
+        pre = c.target
+        key_match = cols[f"{pre}.key_id"] == key
+        if c.col == "any":
+            row_hit = key_match
         else:
-            raise ValueError(f"bad target {c.target}")
-        masks.append(m)
-    return masks
+            vcol = cols[f"{pre}.{_ATTR_VALUE_COL[c.col]}"]
+            vt_ok = cols[f"{pre}.vtype"] == _VT_CODE[c.col]
+            row_hit = key_match & vt_ok & _cmp(c.op, vcol, v0, v1, f0, f1, c.is_float, table)
+        if pre == T_SATTR:
+            owner = jnp.clip(cols["sattr.span"], 0, n_spans_b - 1)
+            return (
+                jax.ops.segment_max(row_hit.astype(jnp.int32), owner, num_segments=n_spans_b) > 0
+            ) & valid_span
+        owner = jnp.clip(cols["rattr.res"], 0, n_res_b - 1)
+        res_mask = (
+            jax.ops.segment_max(row_hit.astype(jnp.int32), owner, num_segments=n_res_b) > 0
+        )
+        idx = jnp.clip(cols["span.res_idx"], 0, n_res_b - 1)
+        return res_mask[idx] & (cols["span.res_idx"] >= 0) & valid_span
+    raise ValueError(f"bad target {c.target}")
+
+
+def normalize_tree(tree: CondTree, conds: tuple[Cond, ...]) -> CondTree:
+    """Lift a mixed tree into trace-level form: pure-span subtrees wrap in
+    ('tracify', t); trace-target conds stay direct. A mix below an 'or'
+    of span and trace conds is allowed: the span side tracifies."""
+    trace_idx = {i for i, c in enumerate(conds) if c.target == T_TRACE}
+
+    def purity(t):  # 'trace' | 'span' | 'mixed'
+        if t[0] == "tracify":
+            return "trace"
+        if t[0] == "cond":
+            return "trace" if t[1] in trace_idx else "span"
+        kinds = {purity(ch) for ch in t[1:]}
+        return kinds.pop() if len(kinds) == 1 else "mixed"
+
+    def lift(t):
+        p = purity(t)
+        if p == "span":
+            return ("tracify", t)
+        if p == "trace":
+            return t
+        return (t[0],) + tuple(lift(ch) for ch in t[1:])
+
+    return lift(tree)
 
 
 @lru_cache(maxsize=256)
-def _compiled(groups: tuple, combinator: str, n_spans_b: int, n_res_b: int, n_traces_b: int):
-    """groups: tuple of condition groups; members of a group OR together
-    (a tag may live in span attrs OR resource attrs OR a dedicated
-    column), groups combine with `combinator`. Trace-target conditions
-    must be single-member groups (applied after span->trace aggregation).
-    Operand rows index flattened (group, member) order."""
-    flat: list[tuple[int, Cond]] = []
-    span_groups: list[list[int]] = []  # per group: flat indices of non-trace members
-    trace_conds: list[tuple[int, Cond]] = []
-    pos = 0
-    for g in groups:
-        members = []
-        for c in g:
-            if c.target == T_TRACE:
-                trace_conds.append((pos, c))
-            else:
-                flat.append((pos, c))
-                members.append(len(flat) - 1)
-            pos += 1
-        if members:
-            span_groups.append(members)
+def _compiled(tree: CondTree | None, conds: tuple[Cond, ...], table_idxs: tuple[int, ...],
+              n_spans_b: int, n_res_b: int, n_traces_b: int):
+    """tree is a TRACE-level expression: leaves are ('cond', i) with a
+    trace-target cond or ('tracify', span_tree) aggregating a span-level
+    subtree; None matches everything."""
 
     @jax.jit
-    def run(cols, ops_i, ops_f, n_spans, n_traces):
+    def run(cols, ops_i, ops_f, table_list, n_spans, n_traces):
+        tables = dict(zip(table_idxs, table_list))
         valid_span = jnp.arange(n_spans_b, dtype=jnp.int32) < n_spans
-        if flat:
-            sub = tuple(c for _, c in flat)
-            idx = jnp.asarray([i for i, _ in flat], dtype=jnp.int32)
-            masks = _eval_conds(sub, cols, ops_i[idx], ops_f[idx], n_spans_b, n_res_b, valid_span)
-            gmasks = []
-            for members in span_groups:
-                gm = masks[members[0]]
-                for m in members[1:]:
-                    gm = gm | masks[m]
-                gmasks.append(gm)
-            span_mask = gmasks[0]
-            for gm in gmasks[1:]:
-                span_mask = (span_mask & gm) if combinator == "and" else (span_mask | gm)
-        else:
+        valid_trace = jnp.arange(n_traces_b, dtype=jnp.int32) < n_traces
+        span_masks: list = []  # union for reporting/counts
+
+        def ev_span(t):
+            if t[0] == "cond":
+                i = t[1]
+                return _cond_mask(conds[i], i, cols, ops_i, ops_f, tables,
+                                  n_spans_b, n_res_b, valid_span)
+            masks = [ev_span(ch) for ch in t[1:]]
+            out = masks[0]
+            for m in masks[1:]:
+                out = (out & m) if t[0] == "and" else (out | m)
+            return out
+
+        def tracify(span_mask):
+            sid = jnp.where(valid_span & span_mask, cols["span.trace_sid"], n_traces_b)
+            sid = jnp.clip(sid, 0, n_traces_b)
+            return (
+                jax.ops.segment_max(span_mask.astype(jnp.int32), sid,
+                                    num_segments=n_traces_b + 1)[:n_traces_b]
+                > 0
+            )
+
+        def ev_trace(t):
+            if t[0] == "tracify":
+                sm = ev_span(t[1])
+                span_masks.append(sm)
+                return tracify(sm)
+            if t[0] == "cond":
+                i = t[1]
+                c = conds[i]
+                return _cmp(c.op, cols[c.col], ops_i[i, 1], ops_i[i, 2],
+                            ops_f[i, 0], ops_f[i, 1], c.is_float, tables.get(i))
+            ms = [ev_trace(ch) for ch in t[1:]]
+            out = ms[0]
+            for m in ms[1:]:
+                out = (out & m) if t[0] == "and" else (out | m)
+            return out
+
+        if tree is None:
+            trace_mask = valid_trace
             span_mask = valid_span
+        else:
+            trace_mask = ev_trace(tree) & valid_trace
+            if span_masks:
+                span_mask = span_masks[0]
+                for m in span_masks[1:]:
+                    span_mask = span_mask | m
+            else:
+                span_mask = valid_span
+            # a span only counts if its trace survived trace-level conds
+            tsid = jnp.clip(cols["span.trace_sid"], 0, n_traces_b - 1)
+            span_mask = span_mask & trace_mask[tsid] & valid_span
 
         sid = jnp.where(valid_span & span_mask, cols["span.trace_sid"], n_traces_b)
         sid = jnp.clip(sid, 0, n_traces_b)
-        trace_mask = (
-            jax.ops.segment_max(
-                span_mask.astype(jnp.int32), sid, num_segments=n_traces_b + 1
-            )[:n_traces_b]
-            > 0
-        )
         span_count = jax.ops.segment_sum(
             span_mask.astype(jnp.int32), sid, num_segments=n_traces_b + 1
         )[:n_traces_b]
-
-        valid_trace = jnp.arange(n_traces_b, dtype=jnp.int32) < n_traces
-        trace_mask = trace_mask & valid_trace
-        for i, c in trace_conds:
-            tm = _cmp(c.op, cols[c.col], ops_i[i, 1], ops_i[i, 2], ops_f[i, 0], ops_f[i, 1], c.is_float)
-            trace_mask = trace_mask & tm & valid_trace
 
         return span_mask, trace_mask, span_count
 
     return run
 
 
-def eval_block(
-    groups,
-    combinator: str,
-    cols: dict[str, jnp.ndarray],
-    operands: Operands,
-    n_spans: int,
-    n_traces: int,
-    n_spans_b: int,
-    n_res_b: int,
-    n_traces_b: int,
-):
-    """Run the filter over staged (padded) device columns.
+def _groups_to_tree(groups) -> tuple[CondTree, tuple[Cond, ...]]:
+    """CNF condition groups (tuple of OR-tuples) -> expression tree."""
+    conds: list[Cond] = []
+    children = []
+    for g in groups:
+        if isinstance(g, Cond):
+            g = (g,)
+        ors = []
+        for c in g:
+            conds.append(c)
+            ors.append(("cond", len(conds) - 1))
+        children.append(ors[0] if len(ors) == 1 else ("or",) + tuple(ors))
+    tree = children[0] if len(children) == 1 else ("and",) + tuple(children)
+    return tree, tuple(conds)
 
-    `groups` is a tuple of condition groups (inner tuples OR, outer
-    `combinator`); a bare tuple of Cond is accepted and treated as
-    single-member groups. Returns (span_mask (n_spans_b,), trace_mask
-    (n_traces_b,), per-trace matched span count)."""
-    if groups and isinstance(groups[0], Cond):
-        groups = tuple((c,) for c in groups)
-    fn = _compiled(tuple(groups), combinator, n_spans_b, n_res_b, n_traces_b)
+
+def eval_block(
+    query,
+    combinator_or_cols,
+    *args,
+    **kwargs,
+):
+    """Two call forms:
+
+    eval_block((tree, conds), cols, operands, n_spans, n_traces,
+               n_spans_b, n_res_b, n_traces_b)               -- tree form
+    eval_block(groups, "and", cols, operands, ...)            -- CNF form
+
+    Returns (span_mask (n_spans_b,), trace_mask (n_traces_b,),
+    per-trace matched span count)."""
+    if isinstance(combinator_or_cols, str):
+        groups = query
+        if combinator_or_cols != "and":
+            tree, conds = _groups_to_tree([tuple(_flatten(groups))])  # single OR group
+        else:
+            tree, conds = _groups_to_tree(groups)
+        cols, operands, n_spans, n_traces, n_spans_b, n_res_b, n_traces_b = args
+    else:
+        tree, conds = query
+        cols = combinator_or_cols
+        operands, n_spans, n_traces, n_spans_b, n_res_b, n_traces_b = args
+    if tree is not None:
+        tree = normalize_tree(tree, conds)  # idempotent
+
+    from .device import bucket, pad_rows
+
+    tables = operands.tables or {}
+    table_idxs = tuple(sorted(tables))
+    table_list = [
+        jnp.asarray(pad_rows(np.asarray(tables[i], dtype=np.uint8), bucket(max(1, len(tables[i]))), 0))
+        for i in table_idxs
+    ]
+    fn = _compiled(tree, conds, table_idxs, n_spans_b, n_res_b, n_traces_b)
     return fn(
         cols,
         jnp.asarray(operands.ints),
         jnp.asarray(operands.floats),
+        table_list,
         jnp.int32(n_spans),
         jnp.int32(n_traces),
     )

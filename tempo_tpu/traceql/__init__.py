@@ -1,0 +1,10 @@
+from .ast import (
+    Comparison,
+    Field,
+    LogicalExpr,
+    ParseError,
+    SpansetFilter,
+    Static,
+)
+from .parser import parse
+from .plan import PlannedQuery, plan_query, plan_search_request

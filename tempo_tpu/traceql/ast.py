@@ -1,0 +1,73 @@
+"""TraceQL AST (typed), matching the language surface of the reference
+snapshot (pkg/traceql/ast.go + enum_*.go): spanset filters over span /
+resource attributes and the intrinsics name, duration, status, kind,
+with &&/||, comparison and regex operators, duration/status/kind
+literals. The snapshot's engine executes single-spanset filters
+(SURVEY.md 2.6); ours executes the same class, on device.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+from typing import Union
+
+
+class ParseError(ValueError):
+    pass
+
+
+class Scope(enum.Enum):
+    SPAN = "span"
+    RESOURCE = "resource"
+    EITHER = "either"  # bare `.attr`
+    INTRINSIC = "intrinsic"
+
+
+INTRINSICS = ("name", "duration", "status", "kind", "rootName", "rootServiceName", "traceDuration")
+
+STATUS_NAMES = {"unset": 0, "ok": 1, "error": 2}
+KIND_NAMES = {
+    "unspecified": 0,
+    "internal": 1,
+    "server": 2,
+    "client": 3,
+    "producer": 4,
+    "consumer": 5,
+}
+
+
+@dataclass(frozen=True)
+class Field:
+    scope: Scope
+    name: str
+
+
+@dataclass(frozen=True)
+class Static:
+    """A literal: str, int, float, bool, duration-nanos, status, kind."""
+
+    kind: str  # 'str','int','float','bool','duration','status','kind'
+    value: object
+
+
+@dataclass(frozen=True)
+class Comparison:
+    field: Field
+    op: str  # '=', '!=', '<', '<=', '>', '>=', '=~', '!~'
+    value: Static
+
+
+@dataclass(frozen=True)
+class LogicalExpr:
+    op: str  # '&&' or '||'
+    lhs: "Expr"
+    rhs: "Expr"
+
+
+Expr = Union[Comparison, LogicalExpr]
+
+
+@dataclass(frozen=True)
+class SpansetFilter:
+    expr: Expr | None  # None = `{}` (match all spans)

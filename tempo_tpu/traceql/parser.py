@@ -1,0 +1,206 @@
+"""TraceQL lexer + recursive-descent parser.
+
+Grammar subset (the executable class of the reference snapshot, whose
+goyacc grammar lives at pkg/traceql/expr.y; ours is hand-rolled, no
+parser generator needed at this size):
+
+    query      := '{' expr? '}'
+    expr       := or_expr
+    or_expr    := and_expr ( '||' and_expr )*
+    and_expr   := unary ( '&&' unary )*
+    unary      := '(' expr ')' | comparison
+    comparison := field op literal | literal op field | field
+    field      := 'span.' ident | 'resource.' ident | '.' ident
+                | 'name' | 'duration' | 'status' | 'kind' | ...
+    literal    := string | number | duration | bool | status | kind
+
+A bare field is an existence test. Duration literals: 10ns 5us 3ms 2s
+1m 1h (combinable like 1h30m).
+"""
+
+from __future__ import annotations
+
+import re
+
+from .ast import (
+    INTRINSICS,
+    KIND_NAMES,
+    STATUS_NAMES,
+    Comparison,
+    Field,
+    LogicalExpr,
+    ParseError,
+    Scope,
+    SpansetFilter,
+    Static,
+)
+
+_TOKEN_RE = re.compile(
+    r"""
+    (?P<ws>\s+)
+  | (?P<string>"(?:[^"\\]|\\.)*"|`[^`]*`)
+  | (?P<duration>\d+(?:\.\d+)?(?:ns|us|µs|ms|s|m|h)(?:\d+(?:\.\d+)?(?:ns|us|µs|ms|s|m|h))*)
+  | (?P<number>-?\d+(?:\.\d+)?)
+  | (?P<op>=~|!~|!=|<=|>=|&&|\|\||[{}()=<>.])
+  | (?P<ident>[a-zA-Z_][a-zA-Z0-9_./-]*)
+""",
+    re.VERBOSE,
+)
+
+_DUR_UNIT_NS = {"ns": 1, "us": 10**3, "µs": 10**3, "ms": 10**6, "s": 10**9, "m": 60 * 10**9, "h": 3600 * 10**9}
+_DUR_PART = re.compile(r"(\d+(?:\.\d+)?)(ns|us|µs|ms|s|m|h)")
+
+
+def _parse_duration_ns(text: str) -> int:
+    total = 0.0
+    for m in _DUR_PART.finditer(text):
+        total += float(m.group(1)) * _DUR_UNIT_NS[m.group(2)]
+    return int(total)
+
+
+def tokenize(src: str) -> list[tuple[str, str]]:
+    out = []
+    pos = 0
+    while pos < len(src):
+        m = _TOKEN_RE.match(src, pos)
+        if not m:
+            raise ParseError(f"unexpected character {src[pos]!r} at {pos}")
+        pos = m.end()
+        kind = m.lastgroup
+        if kind == "ws":
+            continue
+        out.append((kind, m.group()))
+    out.append(("eof", ""))
+    return out
+
+
+class _Parser:
+    def __init__(self, tokens: list[tuple[str, str]]):
+        self.toks = tokens
+        self.i = 0
+
+    def peek(self):
+        return self.toks[self.i]
+
+    def next(self):
+        t = self.toks[self.i]
+        self.i += 1
+        return t
+
+    def expect(self, text: str):
+        kind, val = self.next()
+        if val != text:
+            raise ParseError(f"expected {text!r}, got {val!r}")
+
+    # ---- grammar
+    def parse_query(self) -> SpansetFilter:
+        self.expect("{")
+        if self.peek()[1] == "}":
+            self.next()
+            self._expect_eof()
+            return SpansetFilter(expr=None)
+        expr = self.parse_or()
+        self.expect("}")
+        self._expect_eof()
+        return SpansetFilter(expr=expr)
+
+    def _expect_eof(self):
+        kind, val = self.peek()
+        if kind != "eof":
+            raise ParseError(
+                f"unsupported trailing content {val!r}: only single spanset "
+                "filters are executable (pipelines are not yet supported)"
+            )
+
+    def parse_or(self):
+        lhs = self.parse_and()
+        while self.peek()[1] == "||":
+            self.next()
+            lhs = LogicalExpr("||", lhs, self.parse_and())
+        return lhs
+
+    def parse_and(self):
+        lhs = self.parse_unary()
+        while self.peek()[1] == "&&":
+            self.next()
+            lhs = LogicalExpr("&&", lhs, self.parse_unary())
+        return lhs
+
+    def parse_unary(self):
+        if self.peek()[1] == "(":
+            self.next()
+            e = self.parse_or()
+            self.expect(")")
+            return e
+        return self.parse_comparison()
+
+    def parse_comparison(self) -> Comparison:
+        field = self.try_field()
+        if field is not None:
+            kind, val = self.peek()
+            if val in ("=", "!=", "<", "<=", ">", ">=", "=~", "!~"):
+                self.next()
+                lit = self.parse_literal(field)
+                return Comparison(field, val, lit)
+            return Comparison(field, "exists", Static("bool", True))
+        # literal op field (reversed operands)
+        lit = self.parse_literal(None)
+        kind, val = self.next()
+        if val not in ("=", "!=", "<", "<=", ">", ">=", "=~", "!~"):
+            raise ParseError(f"expected comparison operator, got {val!r}")
+        field = self.try_field()
+        if field is None:
+            raise ParseError("expected attribute field after literal comparison")
+        flip = {"<": ">", ">": "<", "<=": ">=", ">=": "<="}
+        return Comparison(field, flip.get(val, val), lit)
+
+    def try_field(self) -> Field | None:
+        """The lexer folds dots into idents, so `span.http.method` is one
+        token; `.attr` is the '.' operator followed by an ident."""
+        kind, val = self.peek()
+        if val == ".":
+            self.next()
+            k2, v2 = self.next()
+            if k2 != "ident":
+                raise ParseError(f"expected attribute name after '.', got {v2!r}")
+            return Field(Scope.EITHER, v2)
+        if kind == "ident":
+            if val.startswith("span.") and len(val) > 5:
+                self.next()
+                return Field(Scope.SPAN, val[5:])
+            if val.startswith("resource.") and len(val) > 9:
+                self.next()
+                return Field(Scope.RESOURCE, val[9:])
+            if val in INTRINSICS:
+                self.next()
+                return Field(Scope.INTRINSIC, val)
+            return None
+        return None
+
+    def parse_literal(self, field: Field | None) -> Static:
+        kind, val = self.next()
+        if kind == "string":
+            if val.startswith('"'):
+                s = re.sub(r"\\(.)", r"\1", val[1:-1])
+            else:
+                s = val[1:-1]
+            return Static("str", s)
+        if kind == "duration":
+            return Static("duration", _parse_duration_ns(val))
+        if kind == "number":
+            if "." in val:
+                return Static("float", float(val))
+            return Static("int", int(val))
+        if kind == "ident":
+            if val in ("true", "false"):
+                return Static("bool", val == "true")
+            if val in STATUS_NAMES and (field is None or field.name == "status"):
+                return Static("status", STATUS_NAMES[val])
+            if val in KIND_NAMES and (field is None or field.name == "kind"):
+                return Static("kind", KIND_NAMES[val])
+            raise ParseError(f"unexpected literal {val!r}")
+        raise ParseError(f"expected literal, got {val!r}")
+
+
+def parse(src: str) -> SpansetFilter:
+    return _Parser(tokenize(src)).parse_query()

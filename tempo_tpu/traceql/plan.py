@@ -1,0 +1,365 @@
+"""TraceQL planner: AST -> device condition tree for one block.
+
+The condition->column routing of the reference's
+vparquet/block_traceql.go:330-451, re-targeted at vtpu columns:
+intrinsics map to dedicated span/trace columns, well-known attrs to
+dedicated columns, everything else to the generic attr tables; an
+either-scope `.attr` ORs the span- and resource-side plans. String
+operands resolve through the block dictionary (a miss folds to a
+constant, which can prune the whole block); regexes evaluate host-side
+over the dictionary into a code table (one device gather per row).
+
+Durations compare exactly: nanos split into (us, ns-remainder) column
+pairs => two-lane integer compares, no f64 needed.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..block.dictionary import Dictionary
+from ..ops.filter import Cond
+from .ast import Comparison, Field, LogicalExpr, ParseError, Scope, SpansetFilter, Static
+
+_IMPOSSIBLE_CODE = -3  # operand code that matches no row (codes are >= -1)
+
+_WELL_KNOWN_SPAN = {"http.method": "span.http_method_id", "http.url": "span.http_url_id"}
+_WELL_KNOWN_SPAN_INT = {"http.status_code": "span.http_status"}
+_WELL_KNOWN_RES = {
+    "service.name": "res.service_id",
+    "k8s.cluster.name": "res.cluster_id",
+    "k8s.namespace.name": "res.namespace_id",
+    "k8s.pod.name": "res.pod_id",
+    "k8s.container.name": "res.container_id",
+}
+
+_OP_MAP = {"=": "eq", "!=": "ne_present", "<": "lt", "<=": "le", ">": "gt", ">=": "ge"}
+
+TRUE = ("true",)
+FALSE = ("false",)
+
+
+@dataclass
+class Plan:
+    """Accumulates conditions while folding constants."""
+
+    conds: list[Cond] = field(default_factory=list)
+    rows: list[tuple] = field(default_factory=list)
+    tables: dict[int, np.ndarray] = field(default_factory=dict)
+
+    def cond(self, c: Cond, key: int = 0, v0: int = 0, v1: int = 0, f0: float = 0.0,
+             f1: float = 0.0, table: np.ndarray | None = None):
+        self.conds.append(c)
+        self.rows.append((key, v0, v1, f0, f1))
+        i = len(self.conds) - 1
+        if table is not None:
+            self.tables[i] = table
+        return ("cond", i)
+
+
+def _fold(op: str, children: list):
+    """and/or with true/false constant folding."""
+    out = []
+    for ch in children:
+        if ch == TRUE:
+            if op == "or":
+                return TRUE
+            continue
+        if ch == FALSE:
+            if op == "and":
+                return FALSE
+            continue
+        out.append(ch)
+    if not out:
+        return TRUE if op == "and" else FALSE
+    if len(out) == 1:
+        return out[0]
+    return (op,) + tuple(out)
+
+
+def _regex_table(d: Dictionary, pattern: str) -> np.ndarray:
+    rx = re.compile(pattern)
+    return np.fromiter((1 if rx.search(s) else 0 for s in d.strings), dtype=np.uint8, count=len(d.strings))
+
+
+def _dur_pair_tree(p: Plan, target: str, us_col: str, lo_col: str, op: str, dur_ns: int):
+    """Exact duration compare via the (us, ns%1000) column pair."""
+    q, r = divmod(max(0, int(dur_ns)), 1000)
+    q = min(q, 2**31 - 1)
+
+    def c(col, cop, v):
+        return p.cond(Cond(target=target, col=col, op=cop), v0=v)
+
+    if op == "=":
+        return _fold("and", [c(us_col, "eq", q), c(lo_col, "eq", r)])
+    if op == "!=":
+        return _fold("or", [c(us_col, "ne", q), c(lo_col, "ne", r)])
+    if op in (">", ">="):
+        lo_op = "gt" if op == ">" else "ge"
+        return _fold("or", [c(us_col, "gt", q), _fold("and", [c(us_col, "eq", q), c(lo_col, lo_op, r)])])
+    if op in ("<", "<="):
+        lo_op = "lt" if op == "<" else "le"
+        return _fold("or", [c(us_col, "lt", q), _fold("and", [c(us_col, "eq", q), c(lo_col, lo_op, r)])])
+    raise ParseError(f"cannot {op} a duration")
+
+
+def _str_col_cond(p: Plan, d: Dictionary, target: str, col: str, op: str, value) -> tuple:
+    """String compare against a dedicated code column."""
+    if op in ("=~", "!~"):
+        table = _regex_table(d, str(value))
+        kind = "intable" if op == "=~" else "notintable"
+        return p.cond(Cond(target=target, col=col, op=kind), table=table)
+    code = d.lookup(str(value))
+    if op == "=":
+        if code < 0:
+            return FALSE
+        return p.cond(Cond(target=target, col=col, op="eq"), v0=code)
+    if op == "!=":
+        return p.cond(
+            Cond(target=target, col=col, op="ne_present"),
+            v0=code if code >= 0 else _IMPOSSIBLE_CODE,
+        )
+    # ordered string compares use the sorted-dictionary property:
+    # code order == lexicographic order
+    lo, hi = 0, len(d) - 1
+    import bisect
+
+    pos = bisect.bisect_left(d.strings, str(value))
+    exact = pos < len(d) and d.strings[pos] == str(value)
+    if op == "<":
+        return FALSE if pos == 0 else p.cond(Cond(target=target, col=col, op="range"), v0=0, v1=pos - 1)
+    if op == "<=":
+        end = pos if exact else pos - 1
+        return FALSE if end < 0 else p.cond(Cond(target=target, col=col, op="range"), v0=0, v1=end)
+    if op == ">":
+        start = pos + 1 if exact else pos
+        return FALSE if start > hi else p.cond(Cond(target=target, col=col, op="range"), v0=start, v1=hi)
+    if op == ">=":
+        return FALSE if pos > hi else p.cond(Cond(target=target, col=col, op="range"), v0=pos, v1=hi)
+    raise ParseError(f"unsupported string op {op}")
+
+
+def _attr_cond(p: Plan, d: Dictionary, table_target: str, key: str, op: str, lit: Static) -> tuple:
+    """Generic attr-table condition (sattr or rattr)."""
+    kcode = d.lookup(key)
+    if kcode < 0:
+        # key never appears in this block: != and exists-negative fold false
+        return FALSE
+    if op == "exists":
+        return p.cond(Cond(target=table_target, col="any", op="exists"), key=kcode)
+    if lit.kind == "str":
+        if op in ("=~", "!~"):
+            table = _regex_table(d, str(lit.value))
+            kind = "intable" if op == "=~" else "notintable"
+            return p.cond(Cond(target=table_target, col="str", op=kind), key=kcode, table=table)
+        code = d.lookup(str(lit.value))
+        if op == "=":
+            if code < 0:
+                return FALSE
+            return p.cond(Cond(target=table_target, col="str", op="eq"), key=kcode, v0=code)
+        if op == "!=":
+            return p.cond(
+                Cond(target=table_target, col="str", op="ne_present"),
+                key=kcode,
+                v0=code if code >= 0 else _IMPOSSIBLE_CODE,
+            )
+        raise ParseError(f"unsupported string op {op} on attribute")
+    if lit.kind == "bool":
+        if op not in ("=", "!="):
+            raise ParseError("booleans support = and != only")
+        mapped = "eq" if op == "=" else "ne"
+        return p.cond(Cond(target=table_target, col="bool", op=mapped), key=kcode, v0=1 if lit.value else 0)
+    if lit.kind in ("int", "duration"):
+        v = int(lit.value)
+        clamped = not (-(2**31) < v < 2**31)
+        mop = _OP_MAP[op] if op != "!=" else "ne"
+        int_c = p.cond(
+            Cond(target=table_target, col="int", op=mop, needs_verify=clamped),
+            key=kcode,
+            v0=int(np.clip(v, -(2**31) + 1, 2**31 - 1)),
+        )
+        # numbers also match float-typed attrs (TraceQL numeric compare)
+        flt_c = p.cond(
+            Cond(target=table_target, col="float", op=mop, is_float=True, needs_verify=True),
+            key=kcode,
+            f0=float(v),
+        )
+        return _fold("or", [int_c, flt_c])
+    if lit.kind == "float":
+        mop = _OP_MAP[op] if op != "!=" else "ne"
+        flt_c = p.cond(
+            Cond(target=table_target, col="float", op=mop, is_float=True, needs_verify=True),
+            key=kcode,
+            f0=float(lit.value),
+        )
+        int_c = p.cond(
+            Cond(target=table_target, col="int", op=mop, needs_verify=True),
+            key=kcode,
+            v0=int(np.clip(lit.value, -(2**31) + 1, 2**31 - 1)),
+        )
+        return _fold("or", [flt_c, int_c])
+    raise ParseError(f"unsupported literal kind {lit.kind}")
+
+
+def _plan_comparison(p: Plan, d: Dictionary, cmp: Comparison) -> tuple:
+    f, op, lit = cmp.field, cmp.op, cmp.value
+
+    if f.scope == Scope.INTRINSIC:
+        if f.name == "name":
+            if op == "exists":
+                return TRUE
+            return _str_col_cond(p, d, "span", "span.name_id", op, lit.value)
+        if f.name == "duration":
+            if lit.kind not in ("duration", "int", "float"):
+                raise ParseError("duration compares against a duration literal")
+            ns = int(lit.value if lit.kind != "float" else lit.value)
+            return _dur_pair_tree(p, "span", "span.dur_us", "span.dur_lo", op, ns)
+        if f.name == "traceDuration":
+            ns = int(lit.value)
+            return _dur_pair_tree(p, "trace", "trace.dur_us", "trace.dur_lo", op, ns)
+        if f.name == "status":
+            if lit.kind not in ("status", "int"):
+                raise ParseError("status compares against ok/error/unset")
+            mapped = _OP_MAP.get(op)
+            if mapped is None:
+                raise ParseError(f"unsupported status op {op}")
+            if mapped == "ne_present":
+                mapped = "ne"
+            return p.cond(Cond(target="span", col="span.status", op=mapped), v0=int(lit.value))
+        if f.name == "kind":
+            if lit.kind not in ("kind", "int"):
+                raise ParseError("kind compares against server/client/...")
+            mapped = _OP_MAP.get(op)
+            if mapped is None:
+                raise ParseError(f"unsupported kind op {op}")
+            if mapped == "ne_present":
+                mapped = "ne"
+            return p.cond(Cond(target="span", col="span.kind", op=mapped), v0=int(lit.value))
+        if f.name == "rootName":
+            return _str_col_cond(p, d, "trace", "trace.root_name_id", op, lit.value)
+        if f.name == "rootServiceName":
+            return _str_col_cond(p, d, "trace", "trace.root_service_id", op, lit.value)
+        raise ParseError(f"intrinsic {f.name} not supported")
+
+    alts = []
+    if f.scope in (Scope.SPAN, Scope.EITHER):
+        ded = _WELL_KNOWN_SPAN.get(f.name)
+        ded_int = _WELL_KNOWN_SPAN_INT.get(f.name)
+        if ded is not None and lit.kind == "str" and op != "exists":
+            alts.append(_str_col_cond(p, d, "span", ded, op, lit.value))
+        elif ded_int is not None and lit.kind in ("int", "float") and op != "exists":
+            mapped = _OP_MAP[op] if op != "!=" else "ne_present"
+            alts.append(
+                p.cond(Cond(target="span", col=ded_int, op=mapped), v0=int(lit.value))
+            )
+        else:
+            alts.append(_attr_cond(p, d, "sattr", f.name, op, lit))
+    if f.scope in (Scope.RESOURCE, Scope.EITHER):
+        ded = _WELL_KNOWN_RES.get(f.name)
+        if ded is not None and lit.kind == "str" and op != "exists":
+            alts.append(_str_col_cond(p, d, "res", ded, op, lit.value))
+        else:
+            alts.append(_attr_cond(p, d, "rattr", f.name, op, lit))
+    return _fold("or", alts)
+
+
+def _plan_expr(p: Plan, d: Dictionary, expr) -> tuple:
+    if isinstance(expr, LogicalExpr):
+        op = "and" if expr.op == "&&" else "or"
+        return _fold(op, [_plan_expr(p, d, expr.lhs), _plan_expr(p, d, expr.rhs)])
+    if isinstance(expr, Comparison):
+        return _plan_comparison(p, d, expr)
+    raise ParseError(f"cannot plan {expr!r}")
+
+
+@dataclass
+class PlannedQuery:
+    tree: tuple | None  # trace-level tree (see ops.filter); None => match-all
+    conds: tuple
+    rows: list
+    tables: dict[int, np.ndarray]
+    prune: bool = False  # statically false for this block
+    needs_verify: bool = False
+
+
+def _finish(p: Plan, children: list) -> PlannedQuery:
+    tree = _fold("and", children)
+    if tree == FALSE:
+        return PlannedQuery(None, (), [], {}, prune=True)
+    if tree == TRUE:
+        tree = None
+    nv = any(c.needs_verify for c in p.conds)
+    return PlannedQuery(tree, tuple(p.conds), p.rows, p.tables, needs_verify=nv)
+
+
+def plan_query(q: SpansetFilter, d: Dictionary) -> PlannedQuery:
+    """One TraceQL spanset filter: the whole expression must hold on a
+    single span (modulo trace intrinsics), so it normalizes into one
+    tracify group."""
+    p = Plan()
+    if q.expr is None:
+        return PlannedQuery(None, (), [], {})
+    return _finish(p, [_plan_expr(p, d, q.expr)])
+
+
+def plan_search_request(
+    d: Dictionary,
+    tags: dict[str, str],
+    query: str = "",
+    min_duration_ms: int = 0,
+    max_duration_ms: int = 0,
+    start_rel_ms: tuple[int, int] | None = None,
+) -> PlannedQuery:
+    """Tag-search / TraceQL request -> trace-level plan.
+
+    Tag semantics follow the reference's search (each tag matches
+    anywhere in the trace: per-tag tracify groups ANDed at trace level),
+    while a TraceQL `query` keeps single-span semantics."""
+    from .parser import parse
+
+    p = Plan()
+    children: list = []
+    if query:
+        q = parse(query)
+        if q.expr is not None:
+            children.append(_plan_expr(p, d, q.expr))
+    for key, value in tags.items():
+        lit = Static("str", value)
+        if key == "name":
+            f = Field(Scope.INTRINSIC, "name")
+        else:
+            f = Field(Scope.EITHER, key)
+        t = _plan_comparison(p, d, Comparison(f, "=", lit))
+        # bare-value convenience: numeric/bool tag values also match typed attrs
+        if key != "name":
+            extra = []
+            try:
+                iv = int(value)
+                extra.append(_plan_comparison(p, d, Comparison(f, "=", Static("int", iv))))
+            except ValueError:
+                pass
+            if value in ("true", "false"):
+                extra.append(
+                    _plan_comparison(p, d, Comparison(f, "=", Static("bool", value == "true")))
+                )
+            if extra:
+                t = _fold("or", [t] + extra)
+        if t == FALSE:
+            return PlannedQuery(None, (), [], {}, prune=True)
+        if t != TRUE:
+            children.append(("tracify", t))
+    if min_duration_ms or max_duration_ms:
+        lo = max(0, min_duration_ms * 1000 - 1) if min_duration_ms else 0
+        hi = min(2**31 - 1, max_duration_ms * 1000 + 1) if max_duration_ms else 2**31 - 1
+        children.append(
+            p.cond(Cond(target="trace", col="trace.dur_us", op="range", needs_verify=True), v0=lo, v1=hi)
+        )
+    if start_rel_ms is not None:
+        lo, hi = start_rel_ms
+        children.append(
+            p.cond(Cond(target="trace", col="trace.start_ms", op="range", needs_verify=True), v0=lo, v1=hi)
+        )
+    return _finish(p, children)

@@ -1,0 +1,219 @@
+"""TraceQL: parser unit tests + end-to-end execution against blocks."""
+
+import pytest
+
+from tempo_tpu.backend import MemBackend
+from tempo_tpu.db import TempoDB, TempoDBConfig
+from tempo_tpu.db.search import SearchRequest
+from tempo_tpu.traceql import ParseError, parse
+from tempo_tpu.traceql.ast import Comparison, LogicalExpr, Scope
+from tempo_tpu.util.testdata import make_traces
+
+TENANT = "t"
+
+
+# ----------------------------------------------------------------- parser
+
+
+def test_parse_basic():
+    q = parse('{ span.foo = "bar" }')
+    c = q.expr
+    assert isinstance(c, Comparison)
+    assert c.field.scope == Scope.SPAN and c.field.name == "foo"
+    assert c.op == "=" and c.value.value == "bar"
+
+
+def test_parse_scopes_and_intrinsics():
+    q = parse('{ resource.service.name = "x" && name = "y" && .cluster = "z" }')
+    e = q.expr
+    assert isinstance(e, LogicalExpr) and e.op == "&&"
+    # left-assoc: ((a && b) && c)
+    assert e.rhs.field.scope == Scope.EITHER and e.rhs.field.name == "cluster"
+    assert e.lhs.lhs.field.scope == Scope.RESOURCE
+    assert e.lhs.lhs.field.name == "service.name"
+    assert e.lhs.rhs.field.scope == Scope.INTRINSIC
+
+
+def test_parse_values():
+    q = parse("{ duration > 1h30m && span.count >= 100 && span.ratio < 0.5 && span.ok = true }")
+    comps = []
+
+    def walk(e):
+        if isinstance(e, LogicalExpr):
+            walk(e.lhs)
+            walk(e.rhs)
+        else:
+            comps.append(e)
+
+    walk(q.expr)
+    dur = comps[0]
+    assert dur.value.kind == "duration" and dur.value.value == 5400 * 10**9
+    assert comps[1].value.kind == "int" and comps[1].value.value == 100
+    assert comps[2].value.kind == "float"
+    assert comps[3].value.kind == "bool"
+
+
+def test_parse_status_kind_regex():
+    q = parse("{ status = error && kind = server }")
+    assert q.expr.lhs.value.kind == "status" and q.expr.lhs.value.value == 2
+    assert q.expr.rhs.value.kind == "kind" and q.expr.rhs.value.value == 2
+    q2 = parse('{ span.http.url =~ "api/.*" }')
+    assert q2.expr.op == "=~"
+
+
+def test_parse_parens_and_or():
+    q = parse('{ (span.a = "1" || span.b = "2") && name = "n" }')
+    assert isinstance(q.expr, LogicalExpr) and q.expr.op == "&&"
+    assert q.expr.lhs.op == "||"
+
+
+def test_parse_reversed_operands():
+    q = parse("{ 100 < span.count }")
+    assert q.expr.field.name == "count" and q.expr.op == ">"
+
+
+def test_parse_empty_and_exists():
+    assert parse("{}").expr is None
+    q = parse("{ span.foo }")
+    assert q.expr.op == "exists"
+
+
+def test_parse_errors():
+    for bad in ["span.x = 1", "{ span.x = }", "{ span.x ~ 1 }", "{", "{} | count()", '{ name = "x" } { }']:
+        with pytest.raises(ParseError):
+            parse(bad)
+
+
+# ----------------------------------------------------------- execution
+
+
+@pytest.fixture(scope="module")
+def db(tmp_path_factory):
+    d = TempoDB(TempoDBConfig(wal_path=str(tmp_path_factory.mktemp("wal"))), backend=MemBackend())
+    traces = make_traces(80, seed=21, n_spans=8)
+    d.write_block(TENANT, traces)
+    return d, traces
+
+
+def _expect(traces, pred):
+    return {tid.hex() for tid, t in traces if any(pred(res, sp) for res, _, sp in t.all_spans())}
+
+
+def _run(db, q):
+    return {r.trace_id for r in db.search(TENANT, SearchRequest(query=q, limit=1000)).traces}
+
+
+def test_query_service_name(db):
+    d, traces = db
+    got = _run(d, '{ resource.service.name = "db" }')
+    assert got == _expect(traces, lambda res, sp: res.service_name == "db")
+
+
+def test_query_span_attr_and_duration(db):
+    d, traces = db
+    got = _run(d, '{ span.http.method = "GET" && duration > 500ms }')
+    assert got == _expect(
+        traces,
+        lambda res, sp: sp.attrs.get("http.method") == "GET" and sp.duration_nanos > 500_000_000,
+    )
+    assert got  # non-trivial
+
+
+def test_query_duration_exact_boundary(db):
+    d, traces = db
+    # pick an actual span duration and query strictly-greater: that span
+    # must NOT match on its own duration
+    tid0, t0 = traces[0]
+    sp0 = next(t0.all_spans())[2]
+    ns = sp0.duration_nanos
+    got_gt = _run(d, f"{{ duration > {ns}ns }}")
+    expect_gt = _expect(traces, lambda res, sp: sp.duration_nanos > ns)
+    assert got_gt == expect_gt
+    got_ge = _run(d, f"{{ duration >= {ns}ns }}")
+    expect_ge = _expect(traces, lambda res, sp: sp.duration_nanos >= ns)
+    assert got_ge == expect_ge
+    assert tid0.hex() in got_ge
+
+
+def test_query_int_attr(db):
+    d, traces = db
+    got = _run(d, "{ span.http.status_code >= 500 }")
+    assert got == _expect(
+        traces,
+        lambda res, sp: isinstance(sp.attrs.get("http.status_code"), int)
+        and sp.attrs["http.status_code"] >= 500,
+    )
+
+
+def test_query_status_error(db):
+    d, traces = db
+    got = _run(d, "{ status = error }")
+    assert got == _expect(traces, lambda res, sp: sp.status_code == 2)
+
+
+def test_query_or_and_parens(db):
+    d, traces = db
+    got = _run(d, '{ (resource.service.name = "db" || resource.service.name = "auth") && kind = client }')
+    assert got == _expect(
+        traces, lambda res, sp: res.service_name in ("db", "auth") and sp.kind == 3
+    )
+
+
+def test_query_regex(db):
+    d, traces = db
+    got = _run(d, '{ name =~ "GET.*" }')
+    assert got == _expect(traces, lambda res, sp: sp.name.startswith("GET"))
+    got2 = _run(d, '{ name !~ "GET.*" }')
+    assert got2 == _expect(traces, lambda res, sp: not sp.name.startswith("GET"))
+
+
+def test_query_neq_semantics(db):
+    d, traces = db
+    # != requires the attribute to EXIST and differ (TraceQL nil-compare is false)
+    got = _run(d, '{ span.http.method != "GET" }')
+    assert got == _expect(
+        traces,
+        lambda res, sp: "http.method" in sp.attrs and sp.attrs["http.method"] != "GET",
+    )
+
+
+def test_query_bool_attr(db):
+    d, traces = db
+    got = _run(d, "{ span.cache.hit = true }")
+    assert got == _expect(traces, lambda res, sp: sp.attrs.get("cache.hit") is True)
+
+
+def test_query_either_scope(db):
+    d, traces = db
+    got = _run(d, '{ .k8s.namespace.name = "apps" }')
+    assert got == _expect(traces, lambda res, sp: res.attrs.get("k8s.namespace.name") == "apps")
+
+
+def test_query_same_span_semantics(db):
+    d, traces = db
+    # spanset AND: both conditions on the SAME span
+    got = _run(d, '{ span.http.method = "GET" && span.http.status_code = 500 }')
+    assert got == _expect(
+        traces,
+        lambda res, sp: sp.attrs.get("http.method") == "GET"
+        and sp.attrs.get("http.status_code") == 500,
+    )
+
+
+def test_tags_trace_level_semantics(db):
+    """Tag search (unlike TraceQL) matches tags anywhere in the trace."""
+    d, traces = db
+    resp = d.search(TENANT, SearchRequest(tags={"service.name": "db", "http.method": "GET"}, limit=1000))
+
+    def trace_pred(t):
+        has_db = any(res.service_name == "db" for res, _, _ in t.all_spans())
+        has_get = any(sp.attrs.get("http.method") == "GET" for _, _, sp in t.all_spans())
+        return has_db and has_get
+
+    assert {r.trace_id for r in resp.traces} == {tid.hex() for tid, t in traces if trace_pred(t)}
+
+
+def test_query_nonexistent_prunes(db):
+    d, traces = db
+    assert _run(d, '{ span.nope = "nothing" }') == set()
+    assert _run(d, '{ resource.service.name = "zzz-absent" }') == set()
