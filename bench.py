@@ -1,0 +1,99 @@
+"""Headline benchmark: TraceQL predicate-filter throughput, spans/sec/chip.
+
+Runs the production filter kernel (ops/filter.eval_block -- the same
+jitted program the query path executes) over a synthetic block shaped
+like the reference's representative block (BASELINE.md: ~600 MB, 150 K
+traces, 10.4 M spans), with a 3-condition query touching the span axis,
+the resource axis, and the generic span-attr table:
+
+    { resource.service.name = X && span.dur > Y && span.attr = Z }
+
+Baseline: the reference's best published number -- vParquet full-block
+search of 154,414 traces / 10.4 M spans in 0.18 s on a local SSD dev box
+(docs/design-proposals/2022-04 Parquet.md:233-241) = 57.8 M spans/s.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+BASELINE_SPANS_PER_SEC = 10.4e6 / 0.18  # reference vParquet search
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from tempo_tpu.ops.filter import (
+        Cond,
+        Operands,
+        T_RES,
+        T_SATTR,
+        T_SPAN,
+        eval_block,
+    )
+
+    rng = np.random.default_rng(42)
+    N_SPANS = 1 << 22  # 4.2 M spans (power of two: no pad waste)
+    N_TRACES = 1 << 17  # ~131 K traces
+    N_RES = 1 << 10
+    N_SATTR = N_SPANS * 2  # 2 generic attrs per span
+
+    cols = {
+        "span.trace_sid": rng.integers(0, N_TRACES, size=N_SPANS).astype(np.int32),
+        "span.dur_us": rng.integers(0, 1_000_000, size=N_SPANS).astype(np.int32),
+        "span.res_idx": rng.integers(0, N_RES, size=N_SPANS).astype(np.int32),
+        "res.service_id": rng.integers(0, 64, size=N_RES).astype(np.int32),
+        "sattr.span": np.sort(rng.integers(0, N_SPANS, size=N_SATTR)).astype(np.int32),
+        "sattr.key_id": rng.integers(0, 100, size=N_SATTR).astype(np.int32),
+        "sattr.vtype": np.zeros(N_SATTR, dtype=np.int32),  # all strings
+        "sattr.str_id": rng.integers(0, 5_000, size=N_SATTR).astype(np.int32),
+    }
+    dcols = {k: jax.device_put(jnp.asarray(v)) for k, v in cols.items()}
+
+    conds = (
+        Cond(target=T_RES, col="res.service_id", op="eq"),
+        Cond(target=T_SPAN, col="span.dur_us", op="ge"),
+        Cond(target=T_SATTR, col="str", op="eq"),
+    )
+    tree = ("and", ("cond", 0), ("cond", 1), ("cond", 2))
+
+    def run(svc: int, dur: int, key: int, val: int):
+        operands = Operands.build(
+            [(0, svc, 0, 0.0, 0.0), (0, dur, 0, 0.0, 0.0), (key, val, 0, 0.0, 0.0)]
+        )
+        return eval_block(
+            (tree, conds), dcols, operands, N_SPANS, N_TRACES, N_SPANS, N_RES, N_TRACES
+        )
+
+    # warmup / compile
+    out = run(1, 500_000, 3, 17)
+    jax.block_until_ready(out)
+
+    iters = 20
+    t0 = time.perf_counter()
+    for i in range(iters):
+        out = run(i % 64, 400_000 + i, i % 100, i % 5_000)
+    jax.block_until_ready(out)
+    dt = time.perf_counter() - t0
+
+    spans_per_sec = N_SPANS * iters / dt
+    print(
+        json.dumps(
+            {
+                "metric": "traceql_filter_spans_scanned_per_sec_per_chip",
+                "value": round(spans_per_sec, 1),
+                "unit": "spans/s",
+                "vs_baseline": round(spans_per_sec / BASELINE_SPANS_PER_SEC, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

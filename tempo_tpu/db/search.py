@@ -157,6 +157,18 @@ def search_block(
     )
     counts = np.asarray(counts)
     sids = np.nonzero(np.asarray(trace_mask)[: staged.n_traces])[0]
+    if planned.needs_verify and req.query and len(sids):
+        # device filter was conservative (clamped encodings / mixed OR):
+        # exact host re-check of each candidate (hosteval.py)
+        from ..traceql.hosteval import trace_matches
+        from ..traceql.parser import parse
+
+        q = parse(req.query)
+        traces = blk.materialize_traces([int(s) for s in sids])
+        sids = np.asarray(
+            [s for s, tr in zip(sids, traces) if tr is not None and trace_matches(q, tr)],
+            dtype=np.int64,
+        )
     results = _verify_and_build(blk, req, sids, counts)
     results.sort(key=lambda r: -r.start_time_unix_nano)
     resp.traces = results[: req.limit]

@@ -212,6 +212,15 @@ def normalize_tree(tree: CondTree, conds: tuple[Cond, ...]) -> CondTree:
             return ("tracify", t)
         if p == "trace":
             return t
+        if t[0] == "and":
+            # span-pure children must hold on the SAME span (single-spanset
+            # semantics): group them under ONE tracify, don't lift each
+            span_ch = [ch for ch in t[1:] if purity(ch) == "span"]
+            rest = [lift(ch) for ch in t[1:] if purity(ch) != "span"]
+            if span_ch:
+                sub = span_ch[0] if len(span_ch) == 1 else ("and",) + tuple(span_ch)
+                rest = [("tracify", sub)] + rest
+            return rest[0] if len(rest) == 1 else ("and",) + tuple(rest)
         return (t[0],) + tuple(lift(ch) for ch in t[1:])
 
     return lift(tree)

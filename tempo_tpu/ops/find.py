@@ -34,9 +34,9 @@ def _lex_eq(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     return jnp.all(a == b, axis=-1)
 
 
-@partial(jax.jit, static_argnames=("n_steps",))
-def _lookup_kernel(ids: jnp.ndarray, queries: jnp.ndarray, n_valid: jnp.ndarray, n_steps: int):
-    """ids: (T,4) sorted i32 codes (padded with +max rows), queries: (Q,4),
+def bisect_ids(ids: jnp.ndarray, queries: jnp.ndarray, n_valid, n_steps: int) -> jnp.ndarray:
+    """Core lockstep bisection (unjitted; shared with parallel/find.py).
+    ids: (T,4) sorted i32 codes (padded with +max rows), queries: (Q,4),
     n_valid: () number of real id rows. -> (Q,) int32 sid or -1."""
     T = ids.shape[0]
     Q = queries.shape[0]
@@ -56,6 +56,11 @@ def _lookup_kernel(ids: jnp.ndarray, queries: jnp.ndarray, n_valid: jnp.ndarray,
     found_ids = ids[jnp.clip(lo, 0, T - 1)]
     ok = (lo < n_valid) & _lex_eq(found_ids, queries)
     return jnp.where(ok, lo, -1)
+
+
+@partial(jax.jit, static_argnames=("n_steps",))
+def _lookup_kernel(ids: jnp.ndarray, queries: jnp.ndarray, n_valid: jnp.ndarray, n_steps: int):
+    return bisect_ids(ids, queries, n_valid, n_steps)
 
 
 def lookup_ids(id_codes: np.ndarray, query_codes: np.ndarray) -> np.ndarray:

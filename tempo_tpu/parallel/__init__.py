@@ -1,0 +1,29 @@
+"""Multi-chip execution: jax.sharding.Mesh + shard_map over ICI.
+
+The reference scales its read path with process-level fan-out (frontend
+sharders + querier worker pools + intra-process goroutine pools,
+SURVEY.md 2.10). Here the same axes map onto a device mesh:
+
+  dp  -- blocks across chips (the reference's per-block job fan-out,
+         modules/frontend/searchsharding.go + tempodb/pool)
+  sp  -- rows *within* a block across chips (the reference's
+         StartPage/TotalPages page sharding, the "sequence" axis)
+
+XLA collectives (pmax / psum / all_gather) replace the reference's
+result-merging combiners on the host.
+"""
+
+from .mesh import make_mesh
+from .find import sharded_find, stack_block_ids
+from .search import sharded_search
+from .bloom import sharded_bloom_union
+from .step import distributed_query_step
+
+__all__ = [
+    "make_mesh",
+    "sharded_find",
+    "stack_block_ids",
+    "sharded_search",
+    "sharded_bloom_union",
+    "distributed_query_step",
+]

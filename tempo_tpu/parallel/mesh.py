@@ -1,0 +1,48 @@
+"""Device mesh construction for the query/compaction axes."""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh
+
+
+def smap(f, mesh, in_specs, out_specs):
+    """shard_map with the varying-axes check off: our kernels mix
+    replicated operands (queries, predicate operands) with device-varying
+    shards inside fori_loops, which the strict vma check rejects."""
+    try:
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                             check_vma=False)
+    except (TypeError, AttributeError):
+        from jax.experimental.shard_map import shard_map as _sm
+
+        return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=False)
+
+
+def _factor(n: int) -> tuple[int, int]:
+    """(dp, sp) with dp*sp == n, dp the largest divisor <= sqrt(n)."""
+    dp = 1
+    d = 1
+    while d * d <= n:
+        if n % d == 0:
+            dp = d
+        d += 1
+    return dp, n // dp
+
+
+def make_mesh(n_devices: int | None = None, dp: int | None = None, sp: int | None = None) -> Mesh:
+    """2D mesh with axes ('dp', 'sp'): dp shards blocks, sp shards rows
+    within a block. Defaults to all visible devices, near-square split so
+    both axes are exercised (8 devices -> 2x4)."""
+    devices = jax.devices()
+    n = n_devices or len(devices)
+    if dp is None and sp is None:
+        dp, sp = _factor(n)
+    elif dp is None:
+        dp = n // sp
+    elif sp is None:
+        sp = n // dp
+    assert dp * sp == n, f"dp*sp ({dp}*{sp}) != n_devices ({n})"
+    import numpy as np
+
+    return Mesh(np.asarray(devices[:n]).reshape(dp, sp), ("dp", "sp"))

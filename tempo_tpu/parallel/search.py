@@ -1,0 +1,149 @@
+"""Sharded predicate search: blocks over dp, span rows over sp.
+
+The multi-chip analog of the reference's two-level search sharding --
+blocks to jobs (modules/frontend/searchsharding.go:266-310) and pages
+within a block (SearchOptions.StartPage/TotalPages) -- as one mesh
+program: the span axis is sharded over 'sp' (each chip filters its row
+slice), per-trace aggregation is a segment reduce + `psum` over 'sp'
+(the combiner collective), and independent blocks ride 'dp'.
+
+Mirrors ops/filter.py's trace-level tree semantics: span subtrees
+aggregate through ('tracify', t) nodes, trace-axis conds compare
+replicated (B, NT) columns, dictionary tables (regex/set predicates)
+ride along replicated. The generic-attr tables shard differently and
+stay on the per-block path (ops/filter.py). Operand values are traced,
+and the mesh programs are memoized, so different constants with the
+same structure share one compiled program.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from ..ops.filter import Cond, Operands, T_RES, T_SPAN, T_TRACE, _cmp, normalize_tree
+from .mesh import smap
+
+
+@lru_cache(maxsize=128)
+def make_sharded_search(mesh, tree, conds: tuple[Cond, ...], col_names: tuple[str, ...],
+                        B: int, S: int, R: int, NT: int, table_idxs: tuple[int, ...] = ()):
+    """Jitted mesh program over stacked blocks.
+
+    cols[name]: (B, S) span-axis int32 (trace_sid included), or (B, R)
+    res-axis, or (B, NT) trace-axis. n_spans: (B,). `tree` must be
+    trace-level (normalize_tree applied). Returns
+    (trace_mask (B, NT) bool, span_count (B, NT) int32), sharded over dp.
+    """
+
+    def local(ops_i, ops_f, n_spans_l, *arrays):
+        n_tab = len(table_idxs)
+        tables = dict(zip(table_idxs, arrays[:n_tab]))
+        cols = dict(zip(col_names, arrays[n_tab:]))
+        Sl = cols["span.trace_sid"].shape[1]
+        row0 = jax.lax.axis_index("sp") * Sl
+        valid = (jnp.arange(Sl, dtype=jnp.int32)[None, :] + row0) < n_spans_l[:, None]
+        span_masks: list = []
+
+        def cond_mask(i):
+            c = conds[i]
+            v0, v1 = ops_i[i, 1], ops_i[i, 2]
+            f0, f1 = ops_f[i, 0], ops_f[i, 1]
+            t = tables.get(i)
+            if c.target == T_SPAN:
+                return _cmp(c.op, cols[c.col], v0, v1, f0, f1, c.is_float, t) & valid
+            if c.target == T_RES:
+                rm = _cmp(c.op, cols[c.col], v0, v1, f0, f1, c.is_float, t)  # (Bl, R)
+                idx = jnp.clip(cols["span.res_idx"], 0, rm.shape[1] - 1)
+                rm_g = jnp.take_along_axis(rm, idx, axis=1)
+                return rm_g & (cols["span.res_idx"] >= 0) & valid
+            raise ValueError(f"sharded search: unsupported target {c.target}")
+
+        def ev_span(t):
+            if t[0] == "cond":
+                return cond_mask(t[1])
+            ms = [ev_span(ch) for ch in t[1:]]
+            out = ms[0]
+            for m in ms[1:]:
+                out = (out & m) if t[0] == "and" else (out | m)
+            return out
+
+        def seg_reduce(mask):
+            sid = jnp.clip(jnp.where(mask, cols["span.trace_sid"], NT), 0, NT)
+            local_c = jax.vmap(
+                lambda m, s: jax.ops.segment_sum(m.astype(jnp.int32), s,
+                                                 num_segments=NT + 1)[:NT]
+            )(mask, sid)
+            return jax.lax.psum(local_c, "sp")  # (Bl, NT)
+
+        def ev_trace(t):
+            if t[0] == "tracify":
+                sm = ev_span(t[1])
+                span_masks.append(sm)
+                return seg_reduce(sm) > 0
+            if t[0] == "cond":
+                i = t[1]
+                c = conds[i]
+                return _cmp(c.op, cols[c.col], ops_i[i, 1], ops_i[i, 2],
+                            ops_f[i, 0], ops_f[i, 1], c.is_float, tables.get(i))
+            ms = [ev_trace(ch) for ch in t[1:]]
+            out = ms[0]
+            for m in ms[1:]:
+                out = (out & m) if t[0] == "and" else (out | m)
+            return out
+
+        if tree is None:
+            span_mask = valid
+            count = seg_reduce(span_mask)
+            trace_mask = count > 0
+        else:
+            trace_mask = ev_trace(tree)
+            if span_masks:
+                span_mask = span_masks[0]
+                for m in span_masks[1:]:
+                    span_mask = span_mask | m
+            else:
+                span_mask = valid
+            count = seg_reduce(span_mask)
+        return trace_mask, jnp.where(trace_mask, count, 0)
+
+    in_specs = [P(), P(), P("dp")] + [P()] * len(table_idxs)
+    for n in col_names:
+        in_specs.append(P("dp", "sp") if n.startswith("span.") else P("dp"))
+    fn = smap(local, mesh, in_specs=tuple(in_specs), out_specs=(P("dp"), P("dp")))
+    return jax.jit(fn)
+
+
+def sharded_search(mesh, tree, conds, operands: Operands, cols: dict[str, np.ndarray],
+                   n_spans: np.ndarray, nt: int | None = None):
+    """Host entry. cols must already be stacked/padded:
+    span-axis (B, S) with S % sp == 0 and B % dp == 0; res/trace axis
+    (B, R)/(B, NT) replicated along sp. Returns (trace_mask, span_count)
+    as numpy, (B, NT)."""
+    names = tuple(sorted(cols))
+    NT = nt
+    if NT is None and any(n.startswith("trace.") for n in names):
+        NT = cols[[n for n in names if n.startswith("trace.")][0]].shape[1]
+    if NT is None:
+        NT = int(cols["span.trace_sid"].max(initial=0)) + 1
+        # pad to bucket for stable jit keys
+        from ..ops.device import bucket
+
+        NT = bucket(NT)
+    B, S = cols["span.trace_sid"].shape
+    R = next((cols[n].shape[1] for n in names if n.startswith("res.")), 1)
+    conds = tuple(conds)
+    if tree is not None:
+        tree = normalize_tree(tree, conds)
+    tables = operands.tables or {}
+    table_idxs = tuple(sorted(tables))
+    fn = make_sharded_search(mesh, tree, conds, names, B, S, R, NT, table_idxs)
+    table_arrays = [jnp.asarray(np.asarray(tables[i], dtype=np.uint8)) for i in table_idxs]
+    arrays = table_arrays + [jnp.asarray(cols[n]) for n in names]
+    tm, sc = fn(jnp.asarray(operands.ints), jnp.asarray(operands.floats),
+                jnp.asarray(n_spans, dtype=np.int32), *arrays)
+    return np.asarray(tm), np.asarray(sc)

@@ -1,0 +1,130 @@
+"""Exact host-side evaluation of a TraceQL spanset filter over a
+materialized wire-model trace.
+
+The device filter is allowed to over-match (clamped int32/f32 encodings,
+mixed OR trees -- ops/filter.py docstring); queries whose plan sets
+needs_verify re-check every surviving candidate here before it reaches
+the user, the same role the final proto-level Matches() check plays in
+the reference (pkg/model/object_decoder.go Matches).
+
+Semantics: `{ expr }` matches a trace iff some single span satisfies
+every span-level predicate, with trace intrinsics (traceDuration,
+rootName, rootServiceName) evaluated trace-wide.
+"""
+
+from __future__ import annotations
+
+import re
+
+from ..wire.model import Resource, Span, Trace
+from .ast import Comparison, Field, LogicalExpr, Scope, SpansetFilter, Static
+
+_STATUS_NAMES = {0: "unset", 1: "ok", 2: "error"}
+_KIND_NAMES = {0: "unspecified", 1: "internal", 2: "server", 3: "client", 4: "producer", 5: "consumer"}
+
+
+def _cmp_values(op: str, actual, want) -> bool:
+    if op == "exists":
+        return actual is not None
+    if actual is None:
+        return False
+    if isinstance(want, bool) or isinstance(actual, bool):
+        if not isinstance(actual, bool) or not isinstance(want, bool):
+            return op == "!="
+        return (actual == want) if op == "=" else (actual != want) if op == "!=" else False
+    if isinstance(want, str):
+        if not isinstance(actual, str):
+            return op == "!="
+        if op == "=~":
+            return re.search(want, actual) is not None
+        if op == "!~":
+            return re.search(want, actual) is None
+        if op == "=":
+            return actual == want
+        if op == "!=":
+            return actual != want
+        return False
+    # numeric
+    if isinstance(actual, str):
+        return op == "!="
+    try:
+        a, w = float(actual), float(want)
+    except (TypeError, ValueError):
+        return op == "!="
+    return {
+        "=": a == w, "!=": a != w, "<": a < w, "<=": a <= w, ">": a > w, ">=": a >= w,
+    }.get(op, False)
+
+
+def _trace_values(trace: Trace):
+    lo, hi = trace.time_range_nanos()
+    # root = first span (document order) with an empty parent id, falling
+    # back to the first span -- same rule as block/builder.py:267-274
+    root = None
+    first = None
+    for rs in trace.resource_spans:
+        for ss in rs.scope_spans:
+            for sp in ss.spans:
+                if first is None:
+                    first = (sp, rs.resource)
+                if root is None and not sp.parent_span_id.strip(b"\x00"):
+                    root = (sp, rs.resource)
+    pick = root or first
+    return {
+        "traceDuration": (hi or 0) - (lo or 0),
+        "rootName": pick[0].name if pick else "",
+        "rootServiceName": pick[1].service_name if pick else "",
+    }
+
+
+def _eval_cmp(cmp: Comparison, span: Span, res: Resource, tvals: dict) -> bool:
+    f, op, lit = cmp.field, cmp.op, cmp.value
+    want = lit.value if lit is not None else None
+    if f.scope == Scope.INTRINSIC:
+        if f.name == "name":
+            return _cmp_values(op, span.name, want)
+        if f.name == "duration":
+            return _cmp_values(op, span.duration_nanos, want)
+        if f.name == "status":
+            return _cmp_values(op, int(span.status_code), int(want))
+        if f.name == "kind":
+            return _cmp_values(op, int(span.kind), int(want))
+        if f.name == "traceDuration":
+            return _cmp_values(op, tvals["traceDuration"], want)
+        if f.name == "rootName":
+            return _cmp_values(op, tvals["rootName"], want)
+        if f.name == "rootServiceName":
+            return _cmp_values(op, tvals["rootServiceName"], want)
+        return False
+    if f.scope == Scope.SPAN:
+        return _cmp_values(op, span.attrs.get(f.name), want)
+    if f.scope == Scope.RESOURCE:
+        return _cmp_values(op, res.attrs.get(f.name), want)
+    # EITHER: span wins, falls back to resource (reference precedence,
+    # vparquet/block_traceql.go attribute scopes)
+    if f.name in span.attrs:
+        return _cmp_values(op, span.attrs.get(f.name), want)
+    return _cmp_values(op, res.attrs.get(f.name), want)
+
+
+def _eval_expr(expr, span: Span, res: Resource, tvals: dict) -> bool:
+    if isinstance(expr, LogicalExpr):
+        if expr.op == "&&":
+            return _eval_expr(expr.lhs, span, res, tvals) and _eval_expr(expr.rhs, span, res, tvals)
+        return _eval_expr(expr.lhs, span, res, tvals) or _eval_expr(expr.rhs, span, res, tvals)
+    if isinstance(expr, Comparison):
+        return _eval_cmp(expr, span, res, tvals)
+    raise TypeError(f"cannot evaluate {expr!r}")
+
+
+def trace_matches(q: SpansetFilter, trace: Trace) -> bool:
+    """True iff some span of the trace satisfies the spanset filter."""
+    if q.expr is None:
+        return True
+    tvals = _trace_values(trace)
+    for rs in trace.resource_spans:
+        for ss in rs.scope_spans:
+            for sp in ss.spans:
+                if _eval_expr(q.expr, sp, rs.resource, tvals):
+                    return True
+    return False

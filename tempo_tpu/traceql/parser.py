@@ -22,6 +22,16 @@ from __future__ import annotations
 
 import re
 
+_ESCAPE_CHARS = {"n": "\n", "t": "\t", "r": "\r", "f": "\f", "b": "\b", "0": "\0",
+                 "\\": "\\", '"': '"', "'": "'", "/": "/"}
+
+
+def _unescape(s: str) -> str:
+    """Go-style string escapes: \\n -> newline etc.; unknown escapes keep
+    the escaped character."""
+    return re.sub(r"\\(.)", lambda m: _ESCAPE_CHARS.get(m.group(1), m.group(1)), s)
+
+
 from .ast import (
     INTRINSICS,
     KIND_NAMES,
@@ -181,7 +191,7 @@ class _Parser:
         kind, val = self.next()
         if kind == "string":
             if val.startswith('"'):
-                s = re.sub(r"\\(.)", r"\1", val[1:-1])
+                s = _unescape(val[1:-1])
             else:
                 s = val[1:-1]
             return Static("str", s)

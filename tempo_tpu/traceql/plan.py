@@ -88,7 +88,18 @@ def _regex_table(d: Dictionary, pattern: str) -> np.ndarray:
 def _dur_pair_tree(p: Plan, target: str, us_col: str, lo_col: str, op: str, dur_ns: int):
     """Exact duration compare via the (us, ns%1000) column pair."""
     q, r = divmod(max(0, int(dur_ns)), 1000)
-    q = min(q, 2**31 - 1)
+    INT_MAX = 2**31 - 1
+    if q >= INT_MAX:
+        # the us column is clamped at INT_MAX (builder); operands at/past
+        # the clamp can't compare exactly on device -- match conservatively
+        # and let the host re-verify (needs_verify consumer, db/search.py)
+        if op in (">", ">=", "="):
+            # only clamped spans can possibly satisfy this
+            return p.cond(Cond(target=target, col=us_col, op="eq", needs_verify=True),
+                          v0=INT_MAX)
+        # <, <=, != : any span might satisfy it
+        return p.cond(Cond(target=target, col=us_col, op="range", needs_verify=True),
+                      v0=0, v1=INT_MAX)
 
     def c(col, cop, v):
         return p.cond(Cond(target=target, col=col, op=cop), v0=v)
@@ -215,7 +226,7 @@ def _plan_comparison(p: Plan, d: Dictionary, cmp: Comparison) -> tuple:
         if f.name == "duration":
             if lit.kind not in ("duration", "int", "float"):
                 raise ParseError("duration compares against a duration literal")
-            ns = int(lit.value if lit.kind != "float" else lit.value)
+            ns = int(lit.value)
             return _dur_pair_tree(p, "span", "span.dur_us", "span.dur_lo", op, ns)
         if f.name == "traceDuration":
             ns = int(lit.value)
@@ -261,6 +272,10 @@ def _plan_comparison(p: Plan, d: Dictionary, cmp: Comparison) -> tuple:
         ded = _WELL_KNOWN_RES.get(f.name)
         if ded is not None and lit.kind == "str" and op != "exists":
             alts.append(_str_col_cond(p, d, "res", ded, op, lit.value))
+        elif ded is not None and op == "exists":
+            # well-known res attrs live ONLY in dedicated columns
+            # (builder.py res_dedicated); -1 marks absent
+            alts.append(p.cond(Cond(target="res", col=ded, op="ge"), v0=0))
         else:
             alts.append(_attr_cond(p, d, "rattr", f.name, op, lit))
     return _fold("or", alts)
@@ -285,6 +300,29 @@ class PlannedQuery:
     needs_verify: bool = False
 
 
+def _mixed_or(tree, conds) -> bool:
+    """True if the tree contains an OR mixing span- and trace-level
+    children: the device evaluates those per-trace (over-matching the
+    same-span semantics), so candidates need exact host re-verification."""
+
+    def purity(t):
+        if t[0] == "tracify":
+            return "trace"
+        if t[0] == "cond":
+            return "trace" if conds[t[1]].target == "trace" else "span"
+        ks = {purity(ch) for ch in t[1:]}
+        return ks.pop() if len(ks) == 1 else "mixed"
+
+    def walk(t):
+        if t[0] in ("cond", "tracify"):
+            return False
+        if t[0] == "or" and purity(t) == "mixed":
+            return True
+        return any(walk(ch) for ch in t[1:])
+
+    return walk(tree)
+
+
 def _finish(p: Plan, children: list) -> PlannedQuery:
     tree = _fold("and", children)
     if tree == FALSE:
@@ -292,6 +330,8 @@ def _finish(p: Plan, children: list) -> PlannedQuery:
     if tree == TRUE:
         tree = None
     nv = any(c.needs_verify for c in p.conds)
+    if tree is not None and _mixed_or(tree, tuple(p.conds)):
+        nv = True
     return PlannedQuery(tree, tuple(p.conds), p.rows, p.tables, needs_verify=nv)
 
 
