@@ -1,0 +1,7 @@
+"""tempo-cli equivalent: offline block ops against a backend directory.
+
+Reference: cmd/tempo-cli (kong command tree, main.go:40-79) -- list/view
+blocks, query a backend directly without a running cluster.
+
+Usage: python -m tempo_tpu.cli <command> ... --backend.path DIR
+"""

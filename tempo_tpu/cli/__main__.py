@@ -1,0 +1,126 @@
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _open_db(path: str):
+    from ..db.tempodb import TempoDB, TempoDBConfig
+    import tempfile
+
+    db = TempoDB(
+        TempoDBConfig(
+            backend={"backend": "local", "path": path},
+            wal_path=tempfile.mkdtemp(prefix="tempo-cli-wal"),
+        )
+    )
+    db.poll_now()
+    return db
+
+
+def cmd_list_blocks(args):
+    db = _open_db(args.backend)
+    tenants = [args.tenant] if args.tenant else db.tenants()
+    for tenant in tenants:
+        for m in db.blocklist.metas(tenant):
+            print(
+                f"{tenant}\t{m.block_id}\tlevel={m.compaction_level}\t"
+                f"traces={m.total_traces}\tspans={m.total_spans}\t"
+                f"size={m.size_bytes}\tgroups={len(m.row_groups)}"
+            )
+    db.close()
+
+
+def cmd_view_block(args):
+    db = _open_db(args.backend)
+    for m in db.blocklist.metas(args.tenant):
+        if m.block_id == args.block_id:
+            print(json.dumps(json.loads(m.to_json()), indent=2))
+            db.close()
+            return
+    print(f"block {args.block_id} not found for tenant {args.tenant}", file=sys.stderr)
+    db.close()
+    sys.exit(1)
+
+
+def cmd_query_trace(args):
+    """The BASELINE config #1 path: trace-ID lookup over a local backend."""
+    from ..util.traceid import parse_trace_id
+    from ..wire import otlp_json
+
+    db = _open_db(args.backend)
+    tr = db.find_trace_by_id(args.tenant, parse_trace_id(args.trace_id))
+    db.close()
+    if tr is None:
+        print("trace not found", file=sys.stderr)
+        sys.exit(1)
+    print(otlp_json.dumps(tr))
+
+
+def cmd_search(args):
+    from ..db.search import SearchRequest
+
+    db = _open_db(args.backend)
+    tags = {}
+    for part in args.tags or []:
+        k, _, v = part.partition("=")
+        tags[k] = v
+    resp = db.search(args.tenant, SearchRequest(tags=tags, query=args.q or "", limit=args.limit))
+    db.close()
+    print(json.dumps({"traces": [t.to_dict() for t in resp.traces]}, indent=2))
+
+
+def cmd_gen(args):
+    """Generate a synthetic block (bench/test fixture)."""
+    from ..util.testdata import make_traces
+
+    db = _open_db(args.backend)
+    traces = make_traces(args.traces, seed=args.seed, n_spans=args.spans)
+    m = db.write_block(args.tenant, traces)
+    db.close()
+    print(f"wrote block {m.block_id}: {m.total_traces} traces, {m.total_spans} spans")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="tempo-tpu-cli")
+    ap.add_argument("--backend.path", dest="backend", default="./tempo-data")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("list-blocks", help="list blocks (all tenants or one)")
+    p.add_argument("tenant", nargs="?", default="")
+    p.set_defaults(fn=cmd_list_blocks)
+
+    p = sub.add_parser("view-block", help="dump one block's meta")
+    p.add_argument("tenant")
+    p.add_argument("block_id")
+    p.set_defaults(fn=cmd_view_block)
+
+    p = sub.add_parser("query", help="trace-ID lookup against the backend")
+    p.add_argument("tenant")
+    p.add_argument("trace_id")
+    p.set_defaults(fn=cmd_query_trace)
+
+    p = sub.add_parser("search", help="search the backend")
+    p.add_argument("tenant")
+    p.add_argument("--tags", nargs="*", help="k=v pairs")
+    p.add_argument("-q", help="TraceQL query")
+    p.add_argument("--limit", type=int, default=20)
+    p.set_defaults(fn=cmd_search)
+
+    p = sub.add_parser("gen", help="generate a synthetic block")
+    p.add_argument("tenant")
+    p.add_argument("--traces", type=int, default=100)
+    p.add_argument("--spans", type=int, default=10)
+    p.add_argument("--seed", type=int, default=0)
+    p.set_defaults(fn=cmd_gen)
+
+    args = ap.parse_args(argv)
+    try:
+        args.fn(args)
+    except BrokenPipeError:  # output piped into head etc.
+        sys.stderr.close()
+
+
+if __name__ == "__main__":
+    main()

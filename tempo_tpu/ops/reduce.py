@@ -1,0 +1,61 @@
+"""Segmented reduces for the metrics-generator: span-metrics as one
+fused device pass (BASELINE config #5).
+
+The reference updates per-series counters span by span
+(modules/generator/processor/spanmetrics/spanmetrics.go:79-96 +
+registry histogram.go); here a collection cycle's buffered spans fold
+into (calls, latency_sum, latency_histogram) with three segment reduces
+in one jitted program: series ids are the segments, the histogram
+scatter uses a combined (series, bucket) index.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .device import bucket as pow2
+
+
+@partial(jax.jit, static_argnames=("n_series_b", "n_buckets"))
+def _reduce_kernel(sid, dur, n_valid, edges, n_series_b: int, n_buckets: int):
+    """sid: (N,) int32 (pad: n_series_b), dur: (N,) f32, edges: (n_buckets-1,)
+    -> calls (S,), lat_sum (S,), hist (S, n_buckets)."""
+    valid = jnp.arange(sid.shape[0]) < n_valid
+    seg = jnp.where(valid, sid, n_series_b)
+    ones = valid.astype(jnp.int32)
+    calls = jax.ops.segment_sum(ones, seg, num_segments=n_series_b + 1)[:n_series_b]
+    lat_sum = jax.ops.segment_sum(jnp.where(valid, dur, 0.0), seg,
+                                  num_segments=n_series_b + 1)[:n_series_b]
+    bidx = jnp.searchsorted(edges, dur)  # 0..n_buckets-1
+    combo = jnp.where(valid, seg * n_buckets + bidx, n_series_b * n_buckets)
+    hist = jax.ops.segment_sum(ones, combo, num_segments=n_series_b * n_buckets + 1)[:-1]
+    return calls, lat_sum, hist.reshape(n_series_b, n_buckets)
+
+
+def span_metrics_reduce(sid: np.ndarray, dur_s: np.ndarray, n_series: int,
+                        bucket_edges: tuple) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """-> (calls (n_series,), latency_sum (n_series,),
+    histogram (n_series, len(edges)+1)) as numpy."""
+    n = sid.shape[0]
+    if n == 0 or n_series == 0:
+        nb = len(bucket_edges) + 1
+        return (np.zeros(n_series, np.int64), np.zeros(n_series, np.float64),
+                np.zeros((n_series, nb), np.int64))
+    nb = len(bucket_edges) + 1
+    Np = pow2(n)
+    Sb = pow2(n_series)
+    sid_p = np.full(Np, Sb, dtype=np.int32)
+    sid_p[:n] = sid
+    dur_p = np.zeros(Np, dtype=np.float32)
+    dur_p[:n] = dur_s
+    calls, lsum, hist = _reduce_kernel(
+        jnp.asarray(sid_p), jnp.asarray(dur_p), jnp.int32(n),
+        jnp.asarray(np.asarray(bucket_edges, np.float32)), Sb, nb
+    )
+    return (np.asarray(calls[:n_series]).astype(np.int64),
+            np.asarray(lsum[:n_series]).astype(np.float64),
+            np.asarray(hist[:n_series]).astype(np.int64))

@@ -1,0 +1,22 @@
+"""Consistent-hash ring + lifecycler: the L1 distribution substrate.
+
+The reference rides grafana/dskit's gossip ring (SURVEY.md 2.9,
+cmd/tempo/app/modules.go:288-316); here the same abstractions are
+re-built around a pluggable KV store: an in-memory KV for the
+single-binary / test topology (the reference's inmemory ring,
+cmd/tempo/main.go:186-194) and a file-backed KV for multi-process
+nodes sharing a host. Write sharding, shuffle sharding, and
+job-ownership hashing all hang off ring tokens exactly as in the
+reference (pkg/util/hash.go TokenFor, modules/compactor Owns).
+"""
+
+from .ring import InstanceState, InstanceDesc, Ring, InMemoryKV, Lifecycler, ReplicationSet
+
+__all__ = [
+    "InstanceState",
+    "InstanceDesc",
+    "Ring",
+    "InMemoryKV",
+    "Lifecycler",
+    "ReplicationSet",
+]

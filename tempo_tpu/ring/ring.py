@@ -1,0 +1,186 @@
+"""Token ring, replication sets, shuffle sharding, lifecycler.
+
+Reference anatomy (all via dskit in the reference):
+- ring tokens: each instance owns N random uint32 tokens; a key routes
+  to the first token clockwise and walks on for replicas
+  (ring.DoBatch semantics, modules/distributor/distributor.go:373).
+- shuffle sharding: per-tenant deterministic sub-ring
+  (modules/distributor/distributor.go:414, pkg/scheduler/queue).
+- lifecycler: instance join/heartbeat/leave; unhealthy instances are
+  skipped and eventually forgotten (modules/generator/generator.go:25-27).
+"""
+
+from __future__ import annotations
+
+import bisect
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from enum import Enum
+
+from ..util.hashing import fnv1a_32
+
+NUM_TOKENS = 128
+HEARTBEAT_TIMEOUT_S = 60.0
+
+
+class InstanceState(str, Enum):
+    JOINING = "JOINING"
+    ACTIVE = "ACTIVE"
+    LEAVING = "LEAVING"
+    LEFT = "LEFT"
+
+
+@dataclass
+class InstanceDesc:
+    instance_id: str
+    addr: str = ""  # opaque transport address (in-process: registry key)
+    state: InstanceState = InstanceState.JOINING
+    tokens: list[int] = field(default_factory=list)
+    heartbeat_ts: float = 0.0
+
+    def healthy(self, now: float | None = None, timeout: float = HEARTBEAT_TIMEOUT_S) -> bool:
+        now = now if now is not None else time.time()
+        return self.state == InstanceState.ACTIVE and (now - self.heartbeat_ts) < timeout
+
+
+class InMemoryKV:
+    """The single-binary ring store (reference: dskit inmemory KV,
+    cmd/tempo/main.go:186-194). Thread-safe; watchers are synchronous."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._data: dict[str, dict[str, InstanceDesc]] = {}
+
+    def update(self, ring_key: str, desc: InstanceDesc) -> None:
+        with self._lock:
+            self._data.setdefault(ring_key, {})[desc.instance_id] = desc
+
+    def remove(self, ring_key: str, instance_id: str) -> None:
+        with self._lock:
+            self._data.get(ring_key, {}).pop(instance_id, None)
+
+    def get_all(self, ring_key: str) -> dict[str, InstanceDesc]:
+        with self._lock:
+            return dict(self._data.get(ring_key, {}))
+
+
+@dataclass
+class ReplicationSet:
+    instances: list[InstanceDesc]
+    max_errors: int  # quorum slack: len//2 for odd RF
+
+
+class Ring:
+    """Read-side view over one ring key of a KV."""
+
+    def __init__(self, kv: InMemoryKV, ring_key: str, replication_factor: int = 1,
+                 heartbeat_timeout: float = HEARTBEAT_TIMEOUT_S):
+        self.kv = kv
+        self.ring_key = ring_key
+        self.rf = replication_factor
+        self.heartbeat_timeout = heartbeat_timeout
+        # token-map cache keyed on the healthy-instance id set (the hot
+        # ingest path calls get() once per trace)
+        self._cache_key: tuple | None = None
+        self._cache: tuple[list[int], list[InstanceDesc]] | None = None
+
+    # ------------------------------------------------------------ views
+    def instances(self) -> list[InstanceDesc]:
+        return sorted(self.kv.get_all(self.ring_key).values(), key=lambda d: d.instance_id)
+
+    def healthy_instances(self, now: float | None = None) -> list[InstanceDesc]:
+        return [d for d in self.instances() if d.healthy(now, self.heartbeat_timeout)]
+
+    def _token_map(self, descs: list[InstanceDesc]) -> tuple[list[int], list[InstanceDesc]]:
+        pairs: list[tuple[int, InstanceDesc]] = []
+        for d in descs:
+            for t in d.tokens:
+                pairs.append((t, d))
+        pairs.sort(key=lambda p: p[0])
+        return [p[0] for p in pairs], [p[1] for p in pairs]
+
+    # ------------------------------------------------------------ routing
+    def get(self, token: int, now: float | None = None,
+            instances: list[InstanceDesc] | None = None) -> ReplicationSet:
+        """Replication set for a key token: walk clockwise collecting RF
+        distinct healthy instances."""
+        descs = instances if instances is not None else self.healthy_instances(now)
+        if not descs:
+            return ReplicationSet([], 0)
+        key = tuple(d.instance_id for d in descs)
+        if key == self._cache_key and self._cache is not None:
+            tokens, owners = self._cache
+        else:
+            tokens, owners = self._token_map(descs)
+            self._cache_key, self._cache = key, (tokens, owners)
+        out: list[InstanceDesc] = []
+        seen: set[str] = set()
+        i = bisect.bisect_right(tokens, token) % len(tokens)
+        for _ in range(len(tokens)):
+            d = owners[i]
+            if d.instance_id not in seen:
+                out.append(d)
+                seen.add(d.instance_id)
+                if len(out) >= self.rf:
+                    break
+            i = (i + 1) % len(tokens)
+        return ReplicationSet(out, max_errors=max(0, (len(out) - 1) // 2))
+
+    def shuffle_shard(self, tenant: str, size: int) -> list[InstanceDesc]:
+        """Deterministic per-tenant sub-ring (reference: dskit shuffle
+        sharding used for generators + queriers). size<=0 => all."""
+        descs = self.healthy_instances()
+        if size <= 0 or size >= len(descs):
+            return descs
+        rng = random.Random(fnv1a_32(tenant.encode()))
+        return rng.sample(descs, size)
+
+    def owns(self, instance_id: str, job_hash: str) -> bool:
+        """Ring-sharded job ownership: the instance owning the token of
+        fnv32(job_hash) owns the job (modules/compactor/compactor.go:187)."""
+        rs = self.get(fnv1a_32(job_hash.encode()))
+        return bool(rs.instances) and rs.instances[0].instance_id == instance_id
+
+
+class Lifecycler:
+    """Joins an instance into a ring and heartbeats it."""
+
+    def __init__(self, kv: InMemoryKV, ring_key: str, instance_id: str, addr: str = "",
+                 num_tokens: int = NUM_TOKENS, heartbeat_period: float = 5.0):
+        self.kv = kv
+        self.ring_key = ring_key
+        rng = random.Random(fnv1a_32(f"{ring_key}/{instance_id}".encode()))
+        self.desc = InstanceDesc(
+            instance_id=instance_id,
+            addr=addr or instance_id,
+            tokens=sorted(rng.randrange(0, 2**32) for _ in range(num_tokens)),
+        )
+        self.heartbeat_period = heartbeat_period
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def join(self, state: InstanceState = InstanceState.ACTIVE) -> None:
+        self.desc.state = state
+        self.desc.heartbeat_ts = time.time()
+        self.kv.update(self.ring_key, self.desc)
+
+    def heartbeat(self) -> None:
+        self.desc.heartbeat_ts = time.time()
+        self.kv.update(self.ring_key, self.desc)
+
+    def start(self) -> None:
+        self.join()
+
+        def loop():
+            while not self._stop.wait(self.heartbeat_period):
+                self.heartbeat()
+
+        self._thread = threading.Thread(target=loop, daemon=True, name=f"lifecycler-{self.ring_key}")
+        self._thread.start()
+
+    def leave(self) -> None:
+        self._stop.set()
+        self.desc.state = InstanceState.LEFT
+        self.kv.remove(self.ring_key, self.desc.instance_id)

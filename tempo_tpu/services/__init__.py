@@ -1,0 +1,4 @@
+"""L5 service modules: distributor, ingester, querier, frontend,
+compactor, overrides, metrics-generator -- the role layer over TempoDB
+(reference: modules/*, SURVEY.md 2.2). One process hosts any subset of
+roles (single-binary `all` target) or one role per process."""

@@ -1,0 +1,371 @@
+"""App runtime: config root, module wiring, HTTP server, targets.
+
+Reference: cmd/tempo/app -- module DAG (modules.go:360-414), single
+binary running any role or `all` (config.go Target), HTTP API routes
+(pkg/api/http.go:56-60). The single-binary target wires every module
+in-process over an in-memory ring, exactly the topology the reference
+uses for tests (cmd/tempo/main.go:186-194).
+
+Run: python -m tempo_tpu.services.app --target=all --storage.path=DIR
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import threading
+import time
+from dataclasses import dataclass, field
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+from ..db.search import SearchRequest
+from ..db.tempodb import TempoDB, TempoDBConfig
+from ..db.wal import WAL
+from ..ring.ring import InMemoryKV, Lifecycler, Ring
+from ..util.traceid import parse_trace_id
+from ..wire import otlp_json
+from ..wire.model import Trace
+from .compactor import Compactor
+from .distributor import Distributor, PushError
+from .frontend import Frontend, TooManyRequests
+from .ingester import Ingester, IngesterConfig
+from .overrides import Overrides
+from .querier import Querier
+
+DEFAULT_TENANT = "single-tenant"
+TENANT_HEADER = "X-Scope-OrgID"  # reference: shared orgid header
+
+INGESTER_RING = "ingester-ring"
+
+
+@dataclass
+class AppConfig:
+    target: str = "all"  # all | distributor | ingester | querier | ...
+    http_port: int = 3200
+    storage_path: str = "./tempo-data"
+    wal_path: str = ""
+    overrides_path: str = ""
+    multitenancy: bool = False
+    instance_id: str = "tempo-1"
+    replication_factor: int = 1
+    ingester: IngesterConfig = field(default_factory=IngesterConfig)
+    compaction_cycle_s: float = 30.0
+    enable_generator: bool = True
+
+
+class App:
+    """All modules of one process, wired per target."""
+
+    VALID_TARGETS = ("all", "ingester", "querier", "query-frontend", "compactor",
+                     "metrics-generator")
+
+    def __init__(self, cfg: AppConfig):
+        if cfg.target == "distributor":
+            raise ValueError(
+                "standalone distributor needs a remote ingester transport; "
+                "run -target=all (single binary)"
+            )
+        if cfg.target not in self.VALID_TARGETS:
+            raise ValueError(f"unknown target {cfg.target!r}; one of {self.VALID_TARGETS}")
+        self.cfg = cfg
+
+        def has(role: str) -> bool:
+            return cfg.target in ("all", role)
+
+        wal_path = cfg.wal_path or os.path.join(cfg.storage_path, "wal")
+        self.db = TempoDB(
+            TempoDBConfig(
+                backend={"backend": "local", "path": cfg.storage_path},
+                wal_path=os.path.join(cfg.storage_path, "db-wal"),
+            )
+        )
+        self.db.poll_now()
+        self.overrides = Overrides(path=cfg.overrides_path)
+        self.kv = InMemoryKV()
+        self.ring = Ring(self.kv, INGESTER_RING, replication_factor=cfg.replication_factor)
+
+        # in-process client registry: addr -> ingester
+        self._clients: dict[str, object] = {}
+
+        self.ingester = self.lifecycler = None
+        if has("ingester"):
+            self.ingester = Ingester(WAL(wal_path), self.db, self.overrides, cfg.ingester)
+            self.ingester.replay_wal()
+            self.lifecycler = Lifecycler(self.kv, INGESTER_RING, cfg.instance_id)
+            self._clients[self.lifecycler.desc.addr] = self.ingester
+
+        self.generator = None
+        gen_forward = None
+        if cfg.enable_generator and (has("metrics-generator") or cfg.target == "all"):
+            from .generator import MetricsGenerator
+
+            self.generator = MetricsGenerator(self.overrides)
+            gen_forward = self.generator.push
+
+        self.distributor = None
+        if cfg.target == "all":
+            self.distributor = Distributor(
+                self.ring, self._clients.__getitem__, self.overrides,
+                generator_forward=gen_forward,
+            )
+
+        self.querier = self.frontend = None
+        if has("querier") or has("query-frontend"):
+            ingester_ring = self.ring if self._clients else None
+            self.querier = Querier(self.db, ingester_ring, self._clients.__getitem__)
+            self.frontend = Frontend(self.querier)
+
+        self.compactor = None
+        if has("compactor"):
+            self.compactor = Compactor(self.db, self.ring, cfg.instance_id,
+                                       cycle_s=cfg.compaction_cycle_s)
+        self._started = False
+        self.http_server: ThreadingHTTPServer | None = None
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self) -> None:
+        if self.lifecycler:
+            self.lifecycler.start()
+        if self.ingester:
+            self.ingester.start_sweeper()
+        if self.compactor:
+            self.compactor.start()
+        self.db.enable_polling()
+        self._started = True
+
+    def stop(self) -> None:
+        if self.compactor:
+            self.compactor.stop()
+        if self.ingester:
+            self.ingester.stop()
+        if self.frontend:
+            self.frontend.stop()
+        if self.lifecycler:
+            self.lifecycler.leave()
+        self.db.close()
+        if self.http_server:
+            self.http_server.shutdown()
+
+    def ready(self) -> bool:
+        if not self._started:
+            return False
+        if self.ingester is not None:
+            return bool(self.ring.healthy_instances())
+        return True
+
+    # ------------------------------------------------------------ tenant
+    def tenant_of(self, headers) -> str:
+        if not self.cfg.multitenancy:
+            return DEFAULT_TENANT
+        t = headers.get(TENANT_HEADER, "")
+        if not t:
+            raise PushError(401, f"missing {TENANT_HEADER} header")
+        return t
+
+    # ------------------------------------------------------------ http
+    def serve_http(self, port: int | None = None, background: bool = False):
+        handler = _make_handler(self)
+        self.http_server = ThreadingHTTPServer(("127.0.0.1", port or self.cfg.http_port), handler)
+        if background:
+            t = threading.Thread(target=self.http_server.serve_forever, daemon=True)
+            t.start()
+            return self.http_server
+        self.http_server.serve_forever()
+
+
+def _make_handler(app: App):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *a):  # quiet
+            pass
+
+        def _send(self, code: int, body: bytes | str, ctype="application/json"):
+            if isinstance(body, str):
+                body = body.encode()
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _err(self, code: int, msg: str):
+            self._send(code, json.dumps({"error": msg}))
+
+        # ----------------------------------------------------------- GET
+        def do_GET(self):
+            u = urlparse(self.path)
+            q = {k: v[0] for k, v in parse_qs(u.query).items()}
+            try:
+                # operational endpoints never need a tenant (probes/scrapes
+                # carry no X-Scope-OrgID)
+                if u.path == "/api/echo":
+                    return self._send(200, "echo", "text/plain")
+                if u.path == "/ready":
+                    return self._send(200 if app.ready() else 503, "ready" if app.ready() else "starting", "text/plain")
+                if u.path == "/metrics":
+                    return self._send(200, _metrics_text(app), "text/plain")
+                if u.path == "/status/config":
+                    return self._send(200, json.dumps(_config_dict(app.cfg), indent=2))
+                if app.querier is None:
+                    return self._err(404, f"target {app.cfg.target} serves no query API")
+                tenant = app.tenant_of(self.headers)
+                m = re.fullmatch(r"/api/traces/([0-9a-fA-F]+)", u.path)
+                if m:
+                    return self._trace_by_id(tenant, m.group(1), q)
+                if u.path == "/api/search":
+                    return self._search(tenant, q)
+                if u.path == "/api/search/tags":
+                    tags = app.querier.search_tags(tenant)
+                    return self._send(200, json.dumps({"tagNames": tags}))
+                m = re.fullmatch(r"/api/search/tag/([^/]+)/values", u.path)
+                if m:
+                    vals = app.querier.search_tag_values(tenant, m.group(1))
+                    return self._send(200, json.dumps({"tagValues": vals}))
+                return self._err(404, f"no route {u.path}")
+            except PushError as e:
+                return self._err(e.status, str(e))
+            except TooManyRequests as e:
+                return self._err(429, str(e))
+            except Exception as e:
+                return self._err(500, f"{type(e).__name__}: {e}")
+
+        def _trace_by_id(self, tenant: str, hex_id: str, q: dict):
+            tid = parse_trace_id(hex_id)
+            start = int(q.get("start", 0))
+            end = int(q.get("end", 0))
+            tr = app.frontend.find_trace_by_id(tenant, tid, start, end)
+            if tr is None:
+                return self._err(404, "trace not found")
+            return self._send(200, otlp_json.dumps(tr))
+
+        def _search(self, tenant: str, q: dict):
+            tags = {}
+            if "tags" in q:  # logfmt-ish k=v space separated
+                for part in q["tags"].split():
+                    if "=" in part:
+                        k, v = part.split("=", 1)
+                        tags[k] = v.strip('"')
+            req = SearchRequest(
+                tags=tags,
+                query=q.get("q", ""),
+                min_duration_ms=int(float(q["minDuration"]) * 1000) if "minDuration" in q else 0,
+                max_duration_ms=int(float(q["maxDuration"]) * 1000) if "maxDuration" in q else 0,
+                start=int(q.get("start", 0)),
+                end=int(q.get("end", 0)),
+                limit=int(q.get("limit", 20)),
+            )
+            resp = app.frontend.search(tenant, req)
+            return self._send(
+                200,
+                json.dumps(
+                    {
+                        "traces": [t.to_dict() for t in resp.traces],
+                        "metrics": {
+                            "inspectedBytes": str(resp.inspected_bytes),
+                            "inspectedSpans": str(resp.inspected_spans),
+                        },
+                    }
+                ),
+            )
+
+        # ---------------------------------------------------------- POST
+        def do_POST(self):
+            u = urlparse(self.path)
+            ln = int(self.headers.get("Content-Length", 0))
+            body = self.rfile.read(ln) if ln else b""
+            try:
+                if u.path == "/v1/traces":  # OTLP HTTP ingest
+                    if app.distributor is None:
+                        return self._err(404, f"target {app.cfg.target} does not ingest")
+                    tenant = app.tenant_of(self.headers)
+                    ctype = self.headers.get("Content-Type", "")
+                    if "json" in ctype:
+                        tr = otlp_json.loads(body)
+                    else:
+                        from ..wire import otlp_pb
+
+                        tr = otlp_pb.decode_trace(body)
+                    app.distributor.push(tenant, tr.resource_spans)
+                    return self._send(200, "{}")
+                if u.path == "/flush":
+                    if app.ingester:
+                        app.ingester.flush_all()
+                    return self._send(204, "")
+                if u.path == "/shutdown":
+                    if app.ingester:
+                        app.ingester.flush_all()
+                    threading.Thread(target=app.stop, daemon=True).start()
+                    return self._send(204, "")
+                return self._err(404, f"no route {u.path}")
+            except PushError as e:
+                return self._err(e.status, str(e))
+            except Exception as e:
+                return self._err(500, f"{type(e).__name__}: {e}")
+
+    return Handler
+
+
+def _metrics_text(app: App) -> str:
+    lines = []
+    if app.distributor:
+        d = app.distributor.stats
+        lines += [
+            f"tempo_distributor_spans_received_total {d.spans_received}",
+            f"tempo_distributor_bytes_received_total {d.bytes_received}",
+            f"tempo_distributor_push_failures_total {d.push_failures}",
+        ]
+    if app.ingester:
+        lines += [
+            f"tempo_ingester_blocks_flushed_total "
+            f"{sum(i.blocks_flushed for i in app.ingester.instances.values())}",
+            f"tempo_ingester_live_traces "
+            f"{sum(len(i.live) for i in app.ingester.instances.values())}",
+        ]
+    if app.compactor:
+        lines += [
+            f"tempo_compactor_runs_total {app.compactor.stats.runs}",
+            f"tempo_compactor_blocks_compacted_total {app.compactor.stats.blocks_compacted}",
+        ]
+    if app.querier:
+        lines.append(f"tempo_querier_traces_found_total {app.querier.stats.traces_found}")
+    if app.generator is not None:
+        lines.extend(app.generator.metrics_text())
+    return "\n".join(lines) + "\n"
+
+
+def _config_dict(cfg: AppConfig) -> dict:
+    from dataclasses import asdict
+
+    return asdict(cfg)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="tempo-tpu")
+    ap.add_argument("--target", default="all")
+    ap.add_argument("--http.port", dest="port", type=int, default=3200)
+    ap.add_argument("--storage.path", dest="storage", default="./tempo-data")
+    ap.add_argument("--overrides.path", dest="overrides", default="")
+    ap.add_argument("--multitenancy", action="store_true")
+    args = ap.parse_args(argv)
+    cfg = AppConfig(
+        target=args.target,
+        http_port=args.port,
+        storage_path=args.storage,
+        overrides_path=args.overrides,
+        multitenancy=args.multitenancy,
+    )
+    app = App(cfg)
+    app.start()
+    print(f"tempo-tpu target={cfg.target} listening on :{cfg.http_port}")
+    try:
+        app.serve_http()
+    except KeyboardInterrupt:
+        app.stop()
+
+
+if __name__ == "__main__":
+    main()

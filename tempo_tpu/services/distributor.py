@@ -1,0 +1,146 @@
+"""Distributor: receive trace batches, rebatch by trace id, rate-limit,
+replicate to ingesters via the ring.
+
+Reference: modules/distributor/distributor.go -- PushBatches (:277),
+requestsByTraceID (:451-525, hot loop 1), sendToIngestersViaBytes
+(:357-408, ring.DoBatch with quorum). The transport boundary is a
+client registry mapping instance addr -> Pusher; in-process for the
+single binary, HTTP for multi-process.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import defaultdict
+from dataclasses import dataclass, field
+
+from ..ring.ring import Ring
+from ..util.hashing import ring_token
+from ..wire.model import ResourceSpans, ScopeSpans, Trace
+from ..wire.segment import segment_for_write
+from .overrides import Overrides, RateLimiter
+
+
+class PushError(Exception):
+    def __init__(self, status: int, msg: str):
+        super().__init__(msg)
+        self.status = status  # 429 rate-limited / 400 too large / 500
+
+
+@dataclass
+class DistributorStats:
+    spans_received: int = 0
+    bytes_received: int = 0
+    traces_pushed: int = 0
+    push_failures: int = 0
+    spans_refused_rate: int = 0
+    traces_refused_size: int = 0
+
+
+class Distributor:
+    def __init__(self, ring: Ring, client_for, overrides: Overrides,
+                 generator_forward=None):
+        """client_for(addr) -> object with push_segments(tenant, batch);
+        generator_forward(tenant, traces) optional metrics-generator tap."""
+        self.ring = ring
+        self.client_for = client_for
+        self.overrides = overrides
+        self.limiter = RateLimiter(overrides)
+        self.generator_forward = generator_forward
+        self.stats = DistributorStats()
+
+    # ---------------------------------------------------------------- push
+    def push(self, tenant: str, batches: list[ResourceSpans]) -> None:
+        """One OTLP export request worth of ResourceSpans."""
+        now = time.time()
+        n_spans = sum(len(ss.spans) for rs in batches for ss in rs.scope_spans)
+        nbytes = sum(
+            len(sp.name) + 64 + sum(len(k) + 16 for k in sp.attrs)
+            for rs in batches
+            for ss in rs.scope_spans
+            for sp in ss.spans
+        )
+        self.stats.spans_received += n_spans
+        self.stats.bytes_received += nbytes
+        if not self.limiter.allow(tenant, nbytes, now):
+            self.stats.spans_refused_rate += n_spans
+            raise PushError(429, f"tenant {tenant} over ingestion rate limit")
+
+        per_trace = self._requests_by_trace_id(batches)
+        if not per_trace:
+            return
+
+        max_trace = self.overrides.for_tenant(tenant).max_bytes_per_trace
+        lim_filtered = {}
+        for tid, tr in per_trace.items():
+            seg = None
+            lo, hi = tr.time_range_nanos()
+            seg = segment_for_write(tr, (lo or 0) // 10**9, ((hi or 0) + 10**9 - 1) // 10**9)
+            if max_trace and len(seg) > max_trace:
+                self.stats.traces_refused_size += 1
+                continue
+            lim_filtered[tid] = ((lo or 0) // 10**9, ((hi or 0) + 10**9 - 1) // 10**9, seg)
+        if not lim_filtered:
+            return
+
+        # group traces by replica instance (ring.DoBatch analog);
+        # snapshot the healthy set once for the whole batch
+        healthy = self.ring.healthy_instances()
+        by_instance: dict[str, list] = defaultdict(list)
+        quorum_need: dict[bytes, int] = {}
+        for tid, (s, e, seg) in lim_filtered.items():
+            rs = self.ring.get(ring_token(tenant, tid), instances=healthy)
+            if not rs.instances:
+                raise PushError(500, "no healthy ingesters in the ring")
+            quorum_need[tid] = len(rs.instances) - rs.max_errors
+            for inst in rs.instances:
+                by_instance[inst.addr].append((tid, s, e, seg))
+
+        ok_count: dict[bytes, int] = defaultdict(int)
+        errors = []
+        for addr, batch in by_instance.items():
+            try:
+                self.client_for(addr).push_segments(tenant, batch)
+                for tid, *_ in batch:
+                    ok_count[tid] += 1
+            except Exception as e:  # replica failure: quorum decides below
+                errors.append(e)
+        failed = [tid for tid, need in quorum_need.items() if ok_count[tid] < need]
+        if failed:
+            self.stats.push_failures += len(failed)
+            # surface the ingester's own status (429 backpressure / 400 too
+            # large) instead of flattening everything to 500
+            push_errs = [e for e in errors if isinstance(e, PushError)]
+            if push_errs:
+                raise PushError(push_errs[0].status, str(push_errs[0]))
+            raise PushError(500, f"{len(failed)} traces failed quorum write: {errors[:1]}")
+        self.stats.traces_pushed += len(lim_filtered)
+
+        if self.generator_forward is not None:
+            try:
+                self.generator_forward(tenant, list(per_trace.values()))
+            except Exception:
+                pass  # metrics tap must never fail ingest
+
+    # ------------------------------------------------------------ rebatch
+    @staticmethod
+    def _requests_by_trace_id(batches: list[ResourceSpans]) -> dict[bytes, Trace]:
+        """Regroup spans by trace id keeping resource/scope structure
+        (requestsByTraceID, distributor.go:451-525)."""
+        out: dict[bytes, Trace] = {}
+        for rs in batches:
+            for ss in rs.scope_spans:
+                groups: dict[bytes, list] = defaultdict(list)
+                for sp in ss.spans:
+                    groups[sp.trace_id].append(sp)
+                for tid, spans in groups.items():
+                    tr = out.get(tid)
+                    if tr is None:
+                        tr = out[tid] = Trace()
+                    tr.resource_spans.append(
+                        ResourceSpans(
+                            resource=rs.resource,
+                            scope_spans=[ScopeSpans(scope=ss.scope, spans=spans)],
+                        )
+                    )
+        return out

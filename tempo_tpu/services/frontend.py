@@ -1,0 +1,210 @@
+"""Query frontend: per-tenant fair queue, job sharding, retry, combine.
+
+Reference: modules/frontend -- trace-by-ID pipeline (deduper->sharder->
+retry, frontend.go:96-183), search sharder (searchsharding.go:69-247:
+time range -> block list -> per-block row-group jobs of
+~targetBytesPerRequest, bounded concurrency, early exit at limit), and
+the per-tenant queue queriers pull from (v1/frontend.go, pkg/scheduler/
+queue). Here queriers pull jobs from the queue with worker threads --
+the same decoupling, in-process.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+
+from ..db.search import SearchRequest, SearchResponse
+from .querier import Querier
+
+TARGET_BYTES_PER_JOB = 10 * 1024 * 1024  # searchsharding.go:25-28
+DEFAULT_CONCURRENT_JOBS = 50
+MAX_RETRIES = 3
+
+
+class TooManyRequests(Exception):
+    pass
+
+
+class RequestQueue:
+    """Per-tenant fair FIFO: tenants round-robin, jobs FIFO within a
+    tenant (pkg/scheduler/queue/queue.go)."""
+
+    def __init__(self, max_per_tenant: int = 2000):
+        self.lock = threading.Lock()
+        self.cv = threading.Condition(self.lock)
+        self.queues: dict[str, deque] = {}
+        self.order: deque[str] = deque()
+        self.max_per_tenant = max_per_tenant
+        self.closed = False
+
+    def enqueue(self, tenant: str, job) -> None:
+        with self.cv:
+            q = self.queues.get(tenant)
+            if q is None:
+                q = self.queues[tenant] = deque()
+                self.order.append(tenant)
+            if len(q) >= self.max_per_tenant:
+                raise TooManyRequests(f"tenant {tenant} queue full")  # 429
+            q.append(job)
+            self.cv.notify()
+
+    def dequeue(self, timeout: float = 0.5):
+        with self.cv:
+            while True:
+                for _ in range(len(self.order)):
+                    tenant = self.order[0]
+                    self.order.rotate(-1)
+                    q = self.queues.get(tenant)
+                    if q:
+                        return tenant, q.popleft()
+                if self.closed:
+                    return None
+                if not self.cv.wait(timeout):
+                    return None
+
+    def close(self):
+        with self.cv:
+            self.closed = True
+            self.cv.notify_all()
+
+
+@dataclass
+class _Job:
+    fn: object
+    args: tuple
+    result: object = None
+    error: Exception | None = None
+    done: threading.Event = field(default_factory=threading.Event)
+    tries: int = 0
+
+
+class Frontend:
+    """Owns the queue + sharding logic; queriers attach as workers."""
+
+    def __init__(self, querier: Querier, n_workers: int = 8,
+                 concurrent_jobs: int = DEFAULT_CONCURRENT_JOBS,
+                 target_bytes_per_job: int = TARGET_BYTES_PER_JOB):
+        self.querier = querier
+        self.queue = RequestQueue()
+        self.concurrent_jobs = concurrent_jobs
+        self.target_bytes_per_job = target_bytes_per_job
+        self._workers = [
+            threading.Thread(target=self._worker, daemon=True, name=f"frontend-worker-{i}")
+            for i in range(n_workers)
+        ]
+        for w in self._workers:
+            w.start()
+
+    def _worker(self):
+        while True:
+            item = self.queue.dequeue(timeout=1.0)
+            if item is None:
+                if self.queue.closed:
+                    return
+                continue
+            tenant, job = item
+            try:
+                job.result = job.fn(*job.args)
+            except Exception as e:  # retry transient failures (retry.go)
+                job.tries += 1
+                if job.tries < MAX_RETRIES:
+                    try:
+                        self.queue.enqueue(tenant, job)
+                        continue
+                    except TooManyRequests:
+                        pass
+                job.error = e
+            job.done.set()
+
+    def _run_jobs(self, tenant: str, jobs: list[_Job], early_exit=None,
+                  timeout: float = 60.0) -> None:
+        """Enqueue with bounded in-flight jobs; early_exit() True stops
+        dispatching (searchsharding.go early exit at limit)."""
+        pending = list(jobs)
+        inflight: list[_Job] = []
+        while pending or inflight:
+            while pending and len(inflight) < self.concurrent_jobs:
+                if early_exit is not None and early_exit():
+                    for j in pending:
+                        j.done.set()
+                    pending = []
+                    break
+                j = pending.pop(0)
+                self.queue.enqueue(tenant, j)
+                inflight.append(j)
+            if not inflight:
+                break
+            j = inflight.pop(0)
+            if not j.done.wait(timeout):
+                j.error = TimeoutError("query job timed out")
+                j.done.set()
+
+    # ----------------------------------------------------------- trace by id
+    def find_trace_by_id(self, tenant: str, trace_id: bytes,
+                         time_start: int = 0, time_end: int = 0):
+        """The ingester leg + backend leg both run through the queue
+        (tracebyidsharding.go shards the block space; our backend leg
+        already fans out per block inside TempoDB.find)."""
+        jobs = [
+            _Job(self.querier.find_trace_by_id, (tenant, trace_id, time_start, time_end, True)),
+        ]
+        self._run_jobs(tenant, jobs)
+        j = jobs[0]
+        if j.error:
+            raise j.error
+        return j.result
+
+    # ---------------------------------------------------------------- search
+    def search(self, tenant: str, req: SearchRequest) -> SearchResponse:
+        """Sharded search: ingester job + per-(block, row-group-chunk)
+        backend jobs, bounded concurrency, early exit at limit."""
+        limit = req.limit or 20
+        resp = SearchResponse()
+        lock = threading.Lock()
+
+        metas = [
+            m for m in self.querier.db.blocklist.metas(tenant)
+            if m.overlaps_time(req.start, req.end)
+        ]
+        jobs: list[_Job] = [_Job(self.querier.search_recent, (tenant, req))]
+        for m in metas:
+            for groups in self._group_chunks(m):
+                jobs.append(_Job(self.querier.search_block_shard, (tenant, m, req, groups)))
+
+        def early():
+            with lock:
+                return len(resp.traces) >= limit
+
+        # collect results as jobs complete, merging under the limit
+        collector_done = threading.Event()
+
+        def collect():
+            for j in jobs:
+                j.done.wait()
+                if j.error is None and j.result is not None:
+                    with lock:
+                        resp.merge(j.result, limit)
+            collector_done.set()
+
+        t = threading.Thread(target=collect, daemon=True)
+        t.start()
+        self._run_jobs(tenant, jobs, early_exit=early)
+        collector_done.wait(timeout=60.0)
+        resp.traces.sort(key=lambda r: -r.start_time_unix_nano)
+        resp.traces = resp.traces[:limit]
+        return resp
+
+    def _group_chunks(self, meta) -> list[list[int]]:
+        """Split a block's row groups into jobs of ~target_bytes_per_job
+        (searchsharding.go:266-310 page-range jobs)."""
+        n_groups = max(1, len(meta.row_groups) or 1)
+        size = meta.size_bytes or 0
+        per_group = max(1, size // n_groups)
+        per_job = max(1, int(self.target_bytes_per_job // per_group))
+        return [list(range(i, min(i + per_job, n_groups))) for i in range(0, n_groups, per_job)]
+
+    def stop(self):
+        self.queue.close()

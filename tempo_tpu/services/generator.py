@@ -1,0 +1,244 @@
+"""Metrics-generator: span-metrics + service-graphs processors over an
+active-series registry.
+
+Reference: modules/generator -- spanmetrics (spanmetrics.go:79-96: RED
+counters/histograms per (service, span_name, kind, status)),
+servicegraphs (servicegraphs.go:62-80: client/server span pairing via
+an expiring edge store), registry with staleness + max-active-series
+(registry/registry.go).
+
+TPU-first: spans buffer into flat column arrays and aggregate with ONE
+jitted segmented reduce per collection cycle (ops/reduce.py) -- the
+BASELINE config #5 "span-metrics aggregation as TPU reduce" -- instead
+of the reference's per-span map updates.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..wire.model import SpanKind, StatusCode, Trace
+
+# seconds histogram buckets (reference spanmetrics defaults)
+LATENCY_BUCKETS = (0.002, 0.004, 0.008, 0.016, 0.032, 0.064, 0.128, 0.256,
+                   0.512, 1.024, 2.048, 4.096, 8.192, 16.384)
+
+
+@dataclass
+class SeriesKey:
+    service: str
+    span_name: str
+    kind: int
+    status: int
+
+    def labels(self) -> str:
+        return (
+            f'service="{self.service}",span_name="{self.span_name}",'
+            f'span_kind="{SpanKind(self.kind).name}",status_code="{StatusCode(self.status).name}"'
+        )
+
+
+class SpanMetricsProcessor:
+    """Buffers spans as columns; a device segmented-reduce folds them
+    into per-series counts/sums/bucket increments on collect()."""
+
+    def __init__(self, max_active_series: int = 0):
+        self.lock = threading.Lock()
+        self.keys: dict[tuple, int] = {}  # series key -> sid
+        self.key_list: list[SeriesKey] = []
+        self.max_active_series = max_active_series
+        self.dropped_series = 0
+        # pending span columns
+        self._sid: list[int] = []
+        self._dur_s: list[float] = []
+        # aggregated state
+        self.calls = np.zeros(0, dtype=np.int64)
+        self.lat_sum = np.zeros(0, dtype=np.float64)
+        self.lat_count = np.zeros(0, dtype=np.int64)
+        self.lat_buckets = np.zeros((0, len(LATENCY_BUCKETS) + 1), dtype=np.int64)
+        self.last_update: dict[int, float] = {}
+
+    def push(self, tenant_unused: str, traces: list[Trace]) -> None:
+        with self.lock:
+            for tr in traces:
+                for res, _, sp in tr.all_spans():
+                    k = (res.service_name, sp.name, int(sp.kind), int(sp.status_code))
+                    sid = self.keys.get(k)
+                    if sid is None:
+                        if self.max_active_series and len(self.key_list) >= self.max_active_series:
+                            self.dropped_series += 1
+                            continue
+                        sid = self.keys[k] = len(self.key_list)
+                        self.key_list.append(SeriesKey(*k))
+                    self._sid.append(sid)
+                    self._dur_s.append(max(0, sp.duration_nanos) / 1e9)
+                    self.last_update[sid] = time.time()
+
+    def collect(self) -> None:
+        """Fold pending spans into series state with the device reduce."""
+        with self.lock:
+            if not self._sid:
+                return
+            sid = np.asarray(self._sid, dtype=np.int32)
+            dur = np.asarray(self._dur_s, dtype=np.float32)
+            self._sid, self._dur_s = [], []
+            n_series = len(self.key_list)
+        from ..ops.reduce import span_metrics_reduce
+
+        calls, lsum, buckets = span_metrics_reduce(sid, dur, n_series, LATENCY_BUCKETS)
+        with self.lock:
+            if len(self.calls) < n_series:
+                pad = n_series - len(self.calls)
+                self.calls = np.concatenate([self.calls, np.zeros(pad, np.int64)])
+                self.lat_sum = np.concatenate([self.lat_sum, np.zeros(pad, np.float64)])
+                self.lat_count = np.concatenate([self.lat_count, np.zeros(pad, np.int64)])
+                self.lat_buckets = np.concatenate(
+                    [self.lat_buckets, np.zeros((pad, self.lat_buckets.shape[1]), np.int64)]
+                )
+            self.calls[:n_series] += calls[:n_series]
+            self.lat_sum[:n_series] += lsum[:n_series]
+            self.lat_count[:n_series] += calls[:n_series]
+            self.lat_buckets[:n_series] += buckets[:n_series]
+
+    def evict_stale(self, max_idle_s: float, now: float | None = None) -> int:
+        """Staleness eviction (registry.go): series with no updates for
+        max_idle_s stop being exported; their key slots are freed for
+        reuse so long-running processes don't grow without bound."""
+        now = now or time.time()
+        with self.lock:
+            stale = [s for s, ts in self.last_update.items() if now - ts > max_idle_s]
+            for s in stale:
+                del self.last_update[s]
+                key = self.key_list[s]
+                self.keys.pop((key.service, key.span_name, key.kind, key.status), None)
+            return len(stale)
+
+    def metrics_text(self) -> list[str]:
+        self.collect()
+        out = []
+        with self.lock:
+            for sid, key in enumerate(self.key_list):
+                if sid >= len(self.calls) or self.calls[sid] == 0:
+                    continue
+                if sid not in self.last_update:
+                    continue  # evicted as stale
+                lab = key.labels()
+                out.append(f"traces_spanmetrics_calls_total{{{lab}}} {int(self.calls[sid])}")
+                out.append(
+                    f"traces_spanmetrics_latency_sum{{{lab}}} {self.lat_sum[sid]:.6f}"
+                )
+                out.append(
+                    f"traces_spanmetrics_latency_count{{{lab}}} {int(self.lat_count[sid])}"
+                )
+                cum = 0
+                for bi, edge in enumerate(LATENCY_BUCKETS):
+                    cum += int(self.lat_buckets[sid, bi])
+                    out.append(
+                        f'traces_spanmetrics_latency_bucket{{{lab},le="{edge}"}} {cum}'
+                    )
+                cum += int(self.lat_buckets[sid, -1])
+                out.append(f'traces_spanmetrics_latency_bucket{{{lab},le="+Inf"}} {cum}')
+        return out
+
+
+@dataclass
+class _Edge:
+    client_service: str = ""
+    server_service: str = ""
+    t: float = 0.0
+
+
+class ServiceGraphsProcessor:
+    """Pairs client/server spans by (trace_id, span_id/parent_id) through
+    an expiring edge store (servicegraphs store/store.go)."""
+
+    def __init__(self, wait_s: float = 10.0, max_items: int = 10_000):
+        self.lock = threading.Lock()
+        self.wait_s = wait_s
+        self.max_items = max_items
+        self.pending: dict[tuple, _Edge] = {}
+        self.counts: dict[tuple[str, str], int] = defaultdict(int)
+        self.expired = 0
+
+    def push(self, tenant_unused: str, traces: list[Trace]) -> None:
+        now = time.time()
+        with self.lock:
+            for tr in traces:
+                for res, _, sp in tr.all_spans():
+                    if sp.kind == SpanKind.CLIENT:
+                        key = (sp.trace_id, sp.span_id)
+                        e = self.pending.setdefault(key, _Edge(t=now))
+                        e.client_service = res.service_name
+                    elif sp.kind == SpanKind.SERVER:
+                        key = (sp.trace_id, sp.parent_span_id)
+                        e = self.pending.setdefault(key, _Edge(t=now))
+                        e.server_service = res.service_name
+                    else:
+                        continue
+                    if e.client_service and e.server_service:
+                        self.counts[(e.client_service, e.server_service)] += 1
+                        del self.pending[key]
+            self._expire(now)
+
+    def _expire(self, now: float) -> None:
+        if len(self.pending) > self.max_items:
+            cutoff = now - self.wait_s
+            for k in [k for k, e in self.pending.items() if e.t < cutoff]:
+                del self.pending[k]
+                self.expired += 1
+
+    def metrics_text(self) -> list[str]:
+        with self.lock:
+            return [
+                f'traces_service_graph_request_total{{client="{c}",server="{s}"}} {n}'
+                for (c, s), n in sorted(self.counts.items())
+            ]
+
+
+class MetricsGenerator:
+    """Per-tenant processor sets, fed by the distributor tap
+    (modules/generator/generator.go)."""
+
+    def __init__(self, overrides, processors: tuple[str, ...] = ("span-metrics", "service-graphs"),
+                 stale_series_s: float = 300.0):
+        self.overrides = overrides
+        self.default_processors = processors
+        self.stale_series_s = stale_series_s
+        self.lock = threading.Lock()
+        self.tenants: dict[str, dict[str, object]] = {}
+
+    def _procs(self, tenant: str) -> dict[str, object]:
+        with self.lock:
+            procs = self.tenants.get(tenant)
+            if procs is None:
+                lim = self.overrides.for_tenant(tenant)
+                enabled = lim.metrics_generator_processors or self.default_processors
+                procs = {}
+                if "span-metrics" in enabled:
+                    procs["span-metrics"] = SpanMetricsProcessor(
+                        lim.metrics_generator_max_active_series
+                    )
+                if "service-graphs" in enabled:
+                    procs["service-graphs"] = ServiceGraphsProcessor()
+                self.tenants[tenant] = procs
+            return procs
+
+    def push(self, tenant: str, traces: list[Trace]) -> None:
+        for p in self._procs(tenant).values():
+            p.push(tenant, traces)
+
+    def metrics_text(self) -> list[str]:
+        out = []
+        with self.lock:
+            items = list(self.tenants.items())
+        for tenant, procs in items:
+            for p in procs.values():
+                if isinstance(p, SpanMetricsProcessor):
+                    p.evict_stale(self.stale_series_s)
+                out.extend(p.metrics_text())
+        return out

@@ -1,0 +1,329 @@
+"""Ingester: per-tenant instances buffering live traces, WAL-backed,
+cutting columnar blocks and flushing them to the backend.
+
+Reference: modules/ingester -- PushBytesV2 (ingester.go:208), instance
+lifecycle (instance.go:238-348), flush state machine (flush.go:185-332),
+WAL replay on start (ingester.go:326-400).
+
+Differences by design: pushes append to the WAL head block immediately
+(durability at ack time instead of at trace-cut time), and block
+completion writes the columnar block straight through the shared
+TempoDB facade (the single-binary collapses the ingester-local staging
+backend; the flush queue + retry structure is kept for the multi-process
+topology).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+
+from ..db.search import SearchRequest, SearchResponse, SearchResult
+from ..db.tempodb import TempoDB
+from ..db.wal import WAL, WALBlock
+from ..wire.combine import combine_traces, sort_trace
+from ..wire.model import Trace
+from ..wire.segment import segment_to_trace
+from .distributor import PushError
+
+
+@dataclass
+class LiveTrace:
+    trace_id: bytes
+    segments: list[bytes] = field(default_factory=list)
+    nbytes: int = 0
+    last_append: float = 0.0
+    start_s: int = 0
+    end_s: int = 0
+
+
+@dataclass
+class IngesterConfig:
+    max_trace_idle_s: float = 10.0
+    max_block_age_s: float = 120.0
+    max_block_bytes: int = 64 * 1024 * 1024
+    flush_check_period_s: float = 2.0
+
+
+class Instance:
+    """One tenant inside one ingester (modules/ingester/instance.go)."""
+
+    def __init__(self, tenant: str, wal: WAL, db: TempoDB, overrides, cfg: IngesterConfig):
+        self.tenant = tenant
+        self.wal = wal
+        self.db = db
+        self.overrides = overrides
+        self.cfg = cfg
+        self.lock = threading.RLock()
+        self.live: dict[bytes, LiveTrace] = {}
+        self.head: WALBlock = wal.new_block(tenant)
+        self.head_created = time.time()
+        # traces cut from the live map, waiting to go into the next block
+        self.cut: dict[bytes, LiveTrace] = {}
+        self.blocks_flushed = 0
+
+    # ---------------------------------------------------------------- push
+    def push_segments(self, batch: list[tuple[bytes, int, int, bytes]]) -> None:
+        """batch: [(trace_id, start_s, end_s, segment)]"""
+        lim = self.overrides.for_tenant(self.tenant)
+        now = time.time()
+        with self.lock:
+            # phase 1: validate the WHOLE batch before touching any state,
+            # so a limit error never leaves a half-applied batch behind
+            # (a retried batch would duplicate spans otherwise)
+            new_tids = {tid for tid, *_ in batch if tid not in self.live}
+            if lim.max_traces_per_user and len(self.live) + len(new_tids) > lim.max_traces_per_user:
+                raise PushError(429, f"tenant {self.tenant}: max live traces reached")
+            if lim.max_bytes_per_trace:
+                incoming: dict[bytes, int] = {}
+                for tid, _, _, seg in batch:
+                    incoming[tid] = incoming.get(tid, 0) + len(seg)
+                for tid, add in incoming.items():
+                    base = self.live[tid].nbytes if tid in self.live else 0
+                    if base + add > lim.max_bytes_per_trace:
+                        raise PushError(400, "trace too large")
+            # phase 2: apply
+            for tid, s, e, seg in batch:
+                lt = self.live.get(tid)
+                if lt is None:
+                    lt = self.live[tid] = LiveTrace(tid, start_s=s, end_s=e)
+                lt.segments.append(seg)
+                lt.nbytes += len(seg)
+                lt.last_append = now
+                lt.start_s = min(lt.start_s or s, s)
+                lt.end_s = max(lt.end_s, e)
+                self.head.append(tid, s, e, seg)
+            self.head.flush()
+
+    # ------------------------------------------------------------ lifecycle
+    def cut_complete_traces(self, force: bool = False, now: float | None = None) -> int:
+        """Idle live traces move to the cut set (instance.go:238-262)."""
+        now = now or time.time()
+        n = 0
+        with self.lock:
+            for tid in list(self.live):
+                lt = self.live[tid]
+                if force or (now - lt.last_append) >= self.cfg.max_trace_idle_s:
+                    prev = self.cut.get(tid)
+                    if prev:  # late spans for an already-cut trace merge in
+                        prev.segments.extend(lt.segments)
+                        prev.nbytes += lt.nbytes
+                        prev.start_s = min(prev.start_s, lt.start_s)
+                        prev.end_s = max(prev.end_s, lt.end_s)
+                    else:
+                        self.cut[tid] = lt
+                    del self.live[tid]
+                    n += 1
+        return n
+
+    def cut_block_if_ready(self, force: bool = False, now: float | None = None):
+        """Cut set -> columnar block in the backend; WAL head rotates
+        (instance.go:266-289 + CompleteBlock)."""
+        now = now or time.time()
+        with self.lock:
+            if not self.cut:
+                # nothing to write; an aged head with no live traces but
+                # stale bytes (e.g. traces cut+flushed by a previous block,
+                # replay leftovers) rotates so the old file can be dropped
+                if (force or (now - self.head_created) > self.cfg.max_block_age_s) \
+                        and not self.live and self.head.size_bytes() > 0:
+                    old = self.head
+                    self.head = self.wal.new_block(self.tenant)
+                    self.head_created = now
+                    old.clear()
+                return None
+            age = now - self.head_created
+            size = self.head.size_bytes()
+            if not (force or age >= self.cfg.max_block_age_s or size >= self.cfg.max_block_bytes):
+                return None
+            traces = []
+            cut_snapshot = dict(self.cut)
+            for tid, lt in self.cut.items():
+                parts = [segment_to_trace(s) for s in lt.segments]
+                traces.append((tid, sort_trace(combine_traces(parts)) if len(parts) > 1 else parts[0]))
+            self.cut.clear()
+            # live traces staying behind move to the NEW head's WAL file so
+            # the old file can be deleted after the block lands
+            old_head = self.head
+            self.head = self.wal.new_block(self.tenant)
+            self.head_created = now
+            for lt in self.live.values():
+                for seg in lt.segments:
+                    self.head.append(lt.trace_id, lt.start_s, lt.end_s, seg)
+            self.head.flush()
+        try:
+            meta = self.db.write_block(self.tenant, traces)
+        except Exception:
+            # block write failed: restore the cut set for the next retry;
+            # the old WAL file stays on disk as the checkpoint
+            with self.lock:
+                for tid, lt in cut_snapshot.items():
+                    self.cut.setdefault(tid, lt)
+            raise
+        self.blocks_flushed += 1
+        old_head.clear()  # checkpoint advanced: block is durable in backend
+        return meta
+
+    # ---------------------------------------------------------------- read
+    def find_trace_by_id(self, trace_id: bytes) -> Trace | None:
+        with self.lock:
+            segs = []
+            for src in (self.live.get(trace_id), self.cut.get(trace_id)):
+                if src is not None:
+                    segs.extend(src.segments)
+        if not segs:
+            return None
+        return sort_trace(combine_traces([segment_to_trace(s) for s in segs]))
+
+    def search_live(self, req: SearchRequest) -> SearchResponse:
+        """Linear scan of live + cut traces (the reference's live-trace
+        search leg, instance_search.go); N is bounded by live-trace
+        limits so the host loop is fine."""
+        from ..traceql.hosteval import trace_matches
+        from ..traceql.parser import parse
+
+        q = parse(req.query) if req.query else None
+        resp = SearchResponse()
+        with self.lock:
+            items = list(self.live.values()) + list(self.cut.values())
+        for lt in items:
+            if req.start and lt.end_s < req.start:
+                continue
+            if req.end and lt.start_s > req.end:
+                continue
+            tr = sort_trace(combine_traces([segment_to_trace(s) for s in lt.segments]))
+            if q is not None and not trace_matches(q, tr):
+                continue
+            if req.tags and not _tags_match(tr, req.tags):
+                continue
+            lo, hi = tr.time_range_nanos()
+            dur_ms = max(0, ((hi or 0) - (lo or 0)) // 1_000_000)
+            if req.min_duration_ms and dur_ms < req.min_duration_ms:
+                continue
+            if req.max_duration_ms and dur_ms > req.max_duration_ms:
+                continue
+            root = next(iter(tr.all_spans()), None)
+            resp.traces.append(
+                SearchResult(
+                    trace_id=lt.trace_id.hex(),
+                    root_service_name=root[0].service_name if root else "",
+                    root_trace_name=root[2].name if root else "",
+                    start_time_unix_nano=lo or 0,
+                    duration_ms=dur_ms,
+                )
+            )
+            if len(resp.traces) >= (req.limit or 20):
+                break
+        return resp
+
+
+def _tags_match(tr: Trace, tags: dict[str, str]) -> bool:
+    for k, v in tags.items():
+        hit = False
+        for res, _, sp in tr.all_spans():
+            if k == "name":
+                hit = sp.name == v
+            else:
+                av = sp.attrs.get(k, res.attrs.get(k))
+                hit = av is not None and str(av).lower() == v.lower()
+            if hit:
+                break
+        if not hit:
+            return False
+    return True
+
+
+class Ingester:
+    """All tenants of one ingester process (modules/ingester/ingester.go)."""
+
+    def __init__(self, wal: WAL, db: TempoDB, overrides, cfg: IngesterConfig | None = None):
+        self.wal = wal
+        self.db = db
+        self.overrides = overrides
+        self.cfg = cfg or IngesterConfig()
+        self.instances: dict[str, Instance] = {}
+        self.lock = threading.Lock()
+        self._stop = threading.Event()
+        self._sweeper: threading.Thread | None = None
+        self.replayed_blocks = 0
+
+    def instance(self, tenant: str) -> Instance:
+        with self.lock:
+            inst = self.instances.get(tenant)
+            if inst is None:
+                inst = self.instances[tenant] = Instance(
+                    tenant, self.wal, self.db, self.overrides, self.cfg
+                )
+            return inst
+
+    # --------------------------------------------------------------- push
+    def push_segments(self, tenant: str, batch) -> None:
+        self.instance(tenant).push_segments(batch)
+
+    # --------------------------------------------------------------- read
+    def find_trace_by_id(self, tenant: str, trace_id: bytes) -> Trace | None:
+        with self.lock:
+            inst = self.instances.get(tenant)
+        return inst.find_trace_by_id(trace_id) if inst else None
+
+    def search(self, tenant: str, req: SearchRequest) -> SearchResponse:
+        with self.lock:
+            inst = self.instances.get(tenant)
+        return inst.search_live(req) if inst else SearchResponse()
+
+    # ---------------------------------------------------------- lifecycle
+    def replay_wal(self) -> int:
+        """Startup: WAL files -> live state of fresh instances, then an
+        immediate cut (ingester.go:326-400 replays into blocks)."""
+        n = 0
+        for rb in self.wal.rescan_blocks():
+            if not rb.records:
+                try:
+                    self.wal.delete_block_file(rb.block_id, rb.tenant)
+                except OSError:
+                    pass
+                continue
+            inst = self.instance(rb.tenant)
+            with inst.lock:
+                for rec in rb.records:
+                    lt = inst.live.setdefault(rec.trace_id, LiveTrace(rec.trace_id))
+                    lt.segments.append(rec.segment)
+                    lt.nbytes += len(rec.segment)
+                    lt.start_s = min(lt.start_s or rec.start_s, rec.start_s)
+                    lt.end_s = max(lt.end_s, rec.end_s)
+                    lt.last_append = 0.0  # replayed = instantly idle
+            n += len(rb.records)
+            # records now tracked by the instance's new head after next cut;
+            # the old file is superseded once a cut block lands
+            inst.cut_complete_traces(force=True)
+            inst.cut_block_if_ready(force=True)
+            try:
+                self.wal.delete_block_file(rb.block_id, rb.tenant)
+            except OSError:
+                pass
+            self.replayed_blocks += 1
+        return n
+
+    def sweep_all(self, force: bool = False) -> None:
+        with self.lock:
+            insts = list(self.instances.values())
+        for inst in insts:
+            inst.cut_complete_traces(force=force)
+            inst.cut_block_if_ready(force=force)
+
+    def start_sweeper(self) -> None:
+        def loop():
+            while not self._stop.wait(self.cfg.flush_check_period_s):
+                self.sweep_all()
+
+        self._sweeper = threading.Thread(target=loop, daemon=True, name="ingester-sweep")
+        self._sweeper.start()
+
+    def flush_all(self) -> None:
+        """Graceful drain (/shutdown handler, flush.go:91-115)."""
+        self.sweep_all(force=True)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.flush_all()

@@ -1,0 +1,98 @@
+"""Per-tenant runtime limits (reference: modules/overrides/limits.go).
+
+Defaults mirror limits.go:90-108; a per-tenant overrides file (YAML)
+hot-reloads on a period, same as the reference's runtime-config watcher.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass, field, fields, replace
+
+
+@dataclass(frozen=True)
+class Limits:
+    # ingest (limits.go:92-99)
+    ingestion_rate_limit_bytes: int = 15 * 1024 * 1024
+    ingestion_burst_size_bytes: int = 20 * 1024 * 1024
+    max_traces_per_user: int = 10_000
+    max_bytes_per_trace: int = 5 * 1024 * 1024
+    # query
+    max_bytes_per_tag_values_query: int = 5 * 1024 * 1024
+    max_search_duration_s: int = 0  # 0 = unlimited
+    # storage
+    block_retention_s: int = 0  # 0 = use compactor default
+    # generator
+    metrics_generator_processors: tuple[str, ...] = ()
+    metrics_generator_max_active_series: int = 0
+    metrics_generator_ring_size: int = 0  # shuffle-shard size; 0 = all
+
+
+@dataclass
+class Overrides:
+    """Defaults + per-tenant overlay, optionally file-backed."""
+
+    defaults: Limits = field(default_factory=Limits)
+    per_tenant: dict[str, Limits] = field(default_factory=dict)
+    path: str = ""
+    reload_period_s: float = 10.0
+
+    def __post_init__(self):
+        self._lock = threading.Lock()
+        self._mtime = 0.0
+        if self.path:
+            self.reload()
+
+    def for_tenant(self, tenant: str) -> Limits:
+        with self._lock:
+            return self.per_tenant.get(tenant, self.defaults)
+
+    # ------------------------------------------------------------ reload
+    def reload(self) -> None:
+        """Read the overrides file if it changed (reference reloads every
+        10s; callers drive the period)."""
+        if not self.path or not os.path.exists(self.path):
+            return
+        mtime = os.path.getmtime(self.path)
+        if mtime == self._mtime:
+            return
+        import yaml
+
+        with open(self.path) as f:
+            data = yaml.safe_load(f) or {}
+        valid = {f.name for f in fields(Limits)}
+        per_tenant = {}
+        for tenant, vals in (data.get("overrides") or {}).items():
+            kw = {k: v for k, v in (vals or {}).items() if k in valid}
+            if "metrics_generator_processors" in kw:
+                kw["metrics_generator_processors"] = tuple(kw["metrics_generator_processors"])
+            per_tenant[tenant] = replace(self.defaults, **kw)
+        with self._lock:
+            self.per_tenant = per_tenant
+            self._mtime = mtime
+
+
+class RateLimiter:
+    """Token-bucket per tenant (reference: distributor rate limit,
+    modules/distributor/distributor.go:312-319)."""
+
+    def __init__(self, overrides: Overrides):
+        self.overrides = overrides
+        self._lock = threading.Lock()
+        self._buckets: dict[str, tuple[float, float]] = {}  # tenant -> (tokens, last_ts)
+
+    def allow(self, tenant: str, nbytes: int, now: float) -> bool:
+        lim = self.overrides.for_tenant(tenant)
+        rate = lim.ingestion_rate_limit_bytes
+        burst = lim.ingestion_burst_size_bytes
+        if rate <= 0:
+            return True
+        with self._lock:
+            tokens, last = self._buckets.get(tenant, (float(burst), now))
+            tokens = min(float(burst), tokens + (now - last) * rate)
+            if tokens >= nbytes:
+                self._buckets[tenant] = (tokens - nbytes, now)
+                return True
+            self._buckets[tenant] = (tokens, now)
+            return False

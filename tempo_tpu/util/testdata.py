@@ -84,7 +84,10 @@ def make_trace(
     return t
 
 
-def make_traces(n: int, seed: int = 0, n_spans: int = 8) -> list[tuple[bytes, Trace]]:
+def make_traces(
+    n: int, seed: int = 0, n_spans: int = 8,
+    base_time_ns: int = 1_700_000_000_000_000_000,
+) -> list[tuple[bytes, Trace]]:
     """n distinct traces, sorted by trace id (block-build friendly)."""
     rng = random.Random(seed)
     out = []
@@ -94,6 +97,6 @@ def make_traces(n: int, seed: int = 0, n_spans: int = 8) -> list[tuple[bytes, Tr
         if tid in seen:
             continue
         seen.add(tid)
-        out.append((tid, make_trace(rng, trace_id=tid, n_spans=n_spans)))
+        out.append((tid, make_trace(rng, trace_id=tid, n_spans=n_spans, base_time_ns=base_time_ns)))
     out.sort(key=lambda p: p[0])
     return out

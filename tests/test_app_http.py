@@ -1,0 +1,122 @@
+"""Single-binary HTTP API e2e: OTLP ingest -> query/search/tags/metrics.
+
+The analog of the reference's TestAllInOne (integration/e2e/e2e_test.go:40):
+push real OTLP over HTTP, assert metrics counters, query by id, search,
+force flush, query again from the backend.
+"""
+
+import json
+import socket
+import urllib.request
+
+import pytest
+
+from tempo_tpu.services.app import App, AppConfig
+from tempo_tpu.services.ingester import IngesterConfig
+from tempo_tpu.util.testdata import make_traces
+from tempo_tpu.wire import otlp_json
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+@pytest.fixture(scope="module")
+def server(tmp_path_factory):
+    root = tmp_path_factory.mktemp("tempo-data")
+    cfg = AppConfig(
+        storage_path=str(root),
+        http_port=_free_port(),
+        compaction_cycle_s=9999,
+        ingester=IngesterConfig(max_trace_idle_s=0.0, max_block_age_s=0.0,
+                                flush_check_period_s=9999),
+    )
+    app = App(cfg)
+    app.start()
+    app.serve_http(background=True)
+    yield app, f"http://127.0.0.1:{cfg.http_port}"
+    app.stop()
+
+
+def _get(base, path, expect=200):
+    try:
+        with urllib.request.urlopen(base + path) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        assert e.code == expect, (e.code, e.read())
+        return e.code, e.read()
+
+
+def _post(base, path, body, ctype="application/json"):
+    req = urllib.request.Request(base + path, data=body, headers={"Content-Type": ctype})
+    with urllib.request.urlopen(req) as r:
+        return r.status, r.read()
+
+
+def test_http_e2e(server):
+    app, base = server
+    st, body = _get(base, "/api/echo")
+    assert st == 200 and body == b"echo"
+    st, _ = _get(base, "/ready")
+    assert st == 200
+
+    traces = make_traces(12, seed=42, n_spans=5)
+    for _, tr in traces:
+        st, _ = _post(base, "/v1/traces", otlp_json.dumps(tr).encode())
+        assert st == 200
+
+    # metrics counted the spans
+    st, body = _get(base, "/metrics")
+    total = sum(t.span_count() for _, t in traces)
+    assert f"tempo_distributor_spans_received_total {total}" in body.decode()
+
+    # query by id from live ingester
+    tid, tr = traces[0]
+    st, body = _get(base, f"/api/traces/{tid.hex()}")
+    assert st == 200
+    got = otlp_json.loads(body)
+    assert got.span_count() == tr.span_count()
+
+    # flush to backend blocks, then query again
+    st, _ = _post(base, "/flush", b"")
+    assert st == 204
+    app.db.poll_now()
+    tid, tr = traces[1]
+    st, body = _get(base, f"/api/traces/{tid.hex()}")
+    assert st == 200
+    assert otlp_json.loads(body).span_count() == tr.span_count()
+
+    # 404 for a missing trace
+    st, _ = _get(base, "/api/traces/" + "00" * 16, expect=404)
+    assert st == 404
+
+    # search by tag + TraceQL
+    expect_db = {
+        tid.hex()
+        for tid, t in traces
+        if any(r.service_name == "db" for r, _, _ in t.all_spans())
+    }
+    st, body = _get(base, "/api/search?tags=service.name%3Ddb&limit=100")
+    assert st == 200
+    got_ids = {t["traceID"] for t in json.loads(body)["traces"]}
+    assert got_ids == expect_db
+
+    q = urllib.parse.quote('{ resource.service.name = "db" }')
+    st, body = _get(base, f"/api/search?q={q}&limit=100")
+    assert {t["traceID"] for t in json.loads(body)["traces"]} == expect_db
+
+    # tag discovery
+    st, body = _get(base, "/api/search/tags")
+    tags = json.loads(body)["tagNames"]
+    assert "service.name" in tags
+    st, body = _get(base, "/api/search/tag/service.name/values")
+    vals = json.loads(body)["tagValues"]
+    assert "db" in vals
+
+    # span-metrics from the generator tap
+    st, body = _get(base, "/metrics")
+    assert "traces_spanmetrics_calls_total" in body.decode()
