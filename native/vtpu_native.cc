@@ -1,0 +1,194 @@
+// Native runtime layer: the host-side hot loops around the TPU compute
+// path (SURVEY.md 2.10 "native components"): batch hashing for ring
+// tokens + bloom positions, bloom filter insertion, WAL record framing,
+// and multi-threaded zstd (de)compression feeding column chunks.
+//
+// The reference leans on optimized Go libraries for these (klauspost
+// compression, willf/bloom, segmentio/parquet-go page codecs); here the
+// equivalents are C++ behind a C ABI consumed through ctypes
+// (tempo_tpu/native/__init__.py), with pure-Python fallbacks when the
+// shared library is absent.
+//
+// Build: make -C native   (g++ -O3 -shared -fPIC, links libzstd)
+
+#include <algorithm>
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+#include <zstd.h>
+
+extern "C" {
+
+// ---------------------------------------------------------------- hashing
+
+// fnv1a32 over (tenant || trace_id) per row: ring tokens for a batch of
+// trace ids (pkg/util/hash.go TokenFor analog).
+void vtpu_ring_tokens(const uint8_t* tenant, int tenant_len,
+                      const uint8_t* ids, int id_len, int n,
+                      uint32_t* out) {
+  for (int i = 0; i < n; i++) {
+    uint32_t h = 2166136261u;
+    for (int j = 0; j < tenant_len; j++) {
+      h ^= tenant[j];
+      h *= 16777619u;
+    }
+    const uint8_t* id = ids + (size_t)i * id_len;
+    for (int j = 0; j < id_len; j++) {
+      h ^= id[j];
+      h *= 16777619u;
+    }
+    out[i] = h;
+  }
+}
+
+// splitmix64: the bloom position generator (util/hashing.py bloom_hashes)
+static inline uint64_t splitmix64(uint64_t x) {
+  x += 0x9E3779B97F4A7C15ull;
+  x = (x ^ (x >> 30)) * 0xBF58476D1CE4E5B9ull;
+  x = (x ^ (x >> 27)) * 0x94D049BB133111EBull;
+  return x ^ (x >> 31);
+}
+
+static inline uint64_t fnv1a64(const uint8_t* p, int n) {
+  uint64_t h = 14695981039346656037ull;
+  for (int i = 0; i < n; i++) {
+    h ^= p[i];
+    h *= 1099511628211ull;
+  }
+  return h;
+}
+
+// ------------------------------------------------------------------ bloom
+
+static inline uint32_t fnv1a32(const uint8_t* p, int n) {
+  uint32_t h = 2166136261u;
+  for (int i = 0; i < n; i++) {
+    h ^= p[i];
+    h *= 16777619u;
+  }
+  return h;
+}
+
+// Batch-insert n trace ids into a sharded bloom filter. Bit-for-bit the
+// same scheme as the Python side (block/bloom.py + util/hashing.py):
+// shard = fnv1a32(id) % n_shards; Kirsch-Mitzenmacher double hashing
+// h_i = h1 + i*(splitmix64(h1)|1) over fnv1a64(id).
+void vtpu_bloom_add_batch(uint32_t* words, int n_shards, int words_per_shard,
+                          int shard_bits, int k,
+                          const uint8_t* ids, int id_len, int n) {
+  for (int i = 0; i < n; i++) {
+    const uint8_t* id = ids + (size_t)i * id_len;
+    int shard = (int)(fnv1a32(id, id_len) % (uint32_t)n_shards);
+    uint32_t* w = words + (size_t)shard * words_per_shard;
+    uint64_t h1 = fnv1a64(id, id_len);
+    uint64_t h2 = splitmix64(h1) | 1ull;
+    for (int j = 0; j < k; j++) {
+      uint32_t pos = (uint32_t)((h1 + (uint64_t)j * h2) % (uint64_t)shard_bits);
+      w[pos >> 5] |= (1u << (pos & 31));
+    }
+  }
+}
+
+// ------------------------------------------------------------- wal frames
+
+// Scan uvarint-framed records: data = repeated [uvarint len][body].
+// Fills offsets/lengths (body position/size); returns count, or -count-1
+// if a torn tail starts at offsets[count] (replay truncates there).
+int vtpu_varint_frames(const uint8_t* data, int64_t n,
+                       int64_t* offsets, int64_t* lengths, int max_frames) {
+  int64_t pos = 0;
+  int count = 0;
+  while (pos < n && count < max_frames) {
+    int64_t start = pos;
+    uint64_t len = 0;
+    int shift = 0;
+    bool ok = false;
+    while (pos < n && shift < 64) {
+      uint8_t b = data[pos++];
+      len |= (uint64_t)(b & 0x7F) << shift;
+      if (!(b & 0x80)) {
+        ok = true;
+        break;
+      }
+      shift += 7;
+    }
+    if (!ok || pos + (int64_t)len > n) {
+      offsets[count] = start;  // torn tail marker
+      return -count - 1;
+    }
+    offsets[count] = pos;
+    lengths[count] = (int64_t)len;
+    pos += (int64_t)len;
+    count++;
+  }
+  return count;
+}
+
+// ------------------------------------------------------------------- zstd
+
+// Compress n chunks in parallel. in_offsets[i]..+in_lens[i] index into
+// src; outputs go to dst at out_offsets (caller sizes dst with
+// ZSTD_compressBound per chunk via vtpu_zstd_bound). Returns 0 on
+// success; out_lens gets per-chunk compressed sizes.
+int64_t vtpu_zstd_bound(int64_t n) { return (int64_t)ZSTD_compressBound((size_t)n); }
+
+int vtpu_zstd_compress_batch(const uint8_t* src, const int64_t* in_offsets,
+                             const int64_t* in_lens, uint8_t* dst,
+                             const int64_t* out_offsets, int64_t* out_lens,
+                             int n_chunks, int level, int n_threads) {
+  std::atomic<int> next(0), failed(0);
+  auto work = [&]() {
+    ZSTD_CCtx* ctx = ZSTD_createCCtx();
+    for (;;) {
+      int i = next.fetch_add(1);
+      if (i >= n_chunks) break;
+      size_t r = ZSTD_compressCCtx(ctx, dst + out_offsets[i],
+                                   (size_t)(vtpu_zstd_bound(in_lens[i])),
+                                   src + in_offsets[i], (size_t)in_lens[i], level);
+      if (ZSTD_isError(r)) {
+        failed.store(1);
+        break;
+      }
+      out_lens[i] = (int64_t)r;
+    }
+    ZSTD_freeCCtx(ctx);
+  };
+  int nt = std::max(1, std::min(n_threads, n_chunks));
+  std::vector<std::thread> ts;
+  for (int t = 0; t < nt; t++) ts.emplace_back(work);
+  for (auto& t : ts) t.join();
+  return failed.load();
+}
+
+// Decompress n chunks in parallel into caller-provided slots (exact
+// decompressed sizes known from the column footer).
+int vtpu_zstd_decompress_batch(const uint8_t* src, const int64_t* in_offsets,
+                               const int64_t* in_lens, uint8_t* dst,
+                               const int64_t* out_offsets, const int64_t* out_lens,
+                               int n_chunks, int n_threads) {
+  std::atomic<int> next(0), failed(0);
+  auto work = [&]() {
+    ZSTD_DCtx* ctx = ZSTD_createDCtx();
+    for (;;) {
+      int i = next.fetch_add(1);
+      if (i >= n_chunks) break;
+      size_t r = ZSTD_decompressDCtx(ctx, dst + out_offsets[i], (size_t)out_lens[i],
+                                     src + in_offsets[i], (size_t)in_lens[i]);
+      if (ZSTD_isError(r) || (int64_t)r != out_lens[i]) {
+        failed.store(1);
+        break;
+      }
+    }
+    ZSTD_freeDCtx(ctx);
+  };
+  int nt = std::max(1, std::min(n_threads, n_chunks));
+  std::vector<std::thread> ts;
+  for (int t = 0; t < nt; t++) ts.emplace_back(work);
+  for (auto& t : ts) t.join();
+  return failed.load();
+}
+
+}  // extern "C"

@@ -60,6 +60,13 @@ class ShardedBloom:
             self.words[shard, pos // WORD_BITS] |= np.uint32(1 << (pos % WORD_BITS))
 
     def add_many(self, trace_ids: list[bytes]) -> None:
+        # native batch insert (native/vtpu_native.cc) when every id is the
+        # canonical 16 bytes; bit-identical to the Python loop
+        if trace_ids and all(len(t) == 16 for t in trace_ids):
+            from ..native import bloom_add_batch
+
+            if bloom_add_batch(self, trace_ids, _K):
+                return
         for tid in trace_ids:
             self.add(tid)
 

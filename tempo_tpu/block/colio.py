@@ -57,28 +57,15 @@ def pack_columns(
     given axis' row groups; others are stored as a single chunk."""
     axes = axes or {}
     col_axis = col_axis or {}
-    parts: list[bytes] = []
     footer: dict = {"cols": {}, "axes": {k: v.offsets for k, v in axes.items()}}
-    offset = 0
-    comp = zstandard.ZstdCompressor(level=level)
 
-    def store(raw: bytes) -> list:
-        nonlocal offset
-        codec = CODEC_RAW
-        data = raw
-        if len(raw) >= _MIN_COMPRESS:
-            z = comp.compress(raw)
-            if len(z) < len(raw):
-                data, codec = z, CODEC_ZSTD
-        parts.append(data)
-        rec = [offset, len(data), len(raw), codec]
-        offset += len(data)
-        return rec
-
+    # phase 1: collect every raw chunk in output order
+    raws: list[bytes] = []
+    col_chunk_idx: dict[str, list[int]] = {}
     for name, arr in cols.items():
         arr = np.ascontiguousarray(arr)
         axis = col_axis.get(name)
-        chunks = []
+        idxs = []
         if axis is not None:
             ax = axes[axis]
             if ax.n_rows != arr.shape[0]:
@@ -87,15 +74,46 @@ def pack_columns(
                 )
             for g in range(ax.n_groups):
                 lo, hi = ax.offsets[g], ax.offsets[g + 1]
-                chunks.append(store(arr[lo:hi].tobytes()))
+                idxs.append(len(raws))
+                raws.append(arr[lo:hi].tobytes())
         else:
-            chunks.append(store(arr.tobytes()))
+            idxs.append(len(raws))
+            raws.append(arr.tobytes())
+        col_chunk_idx[name] = idxs
         footer["cols"][name] = {
             "dtype": str(arr.dtype),
             "shape": list(arr.shape),
             "axis": axis,
-            "chunks": chunks,
+            "chunks": None,  # filled below
         }
+
+    # phase 2: compress all compressible chunks in one threaded native
+    # batch (native/vtpu_native.cc); per-chunk python zstd as fallback
+    to_compress = [i for i, r in enumerate(raws) if len(r) >= _MIN_COMPRESS]
+    compressed: dict[int, bytes] = {}
+    if to_compress:
+        from ..native import zstd_compress_chunks
+
+        outs = zstd_compress_chunks([raws[i] for i in to_compress], level)
+        if outs is None:
+            comp = zstandard.ZstdCompressor(level=level)
+            outs = [comp.compress(raws[i]) for i in to_compress]
+        compressed = dict(zip(to_compress, outs))
+
+    parts: list[bytes] = []
+    offset = 0
+    recs: list[list] = []
+    for i, raw in enumerate(raws):
+        z = compressed.get(i)
+        if z is not None and len(z) < len(raw):
+            data, codec = z, CODEC_ZSTD
+        else:
+            data, codec = raw, CODEC_RAW
+        parts.append(data)
+        recs.append([offset, len(data), len(raw), codec])
+        offset += len(data)
+    for name, idxs in col_chunk_idx.items():
+        footer["cols"][name]["chunks"] = [recs[i] for i in idxs]
 
     fbytes = json.dumps(footer, separators=(",", ":")).encode("utf-8")
     parts.append(fbytes)
@@ -141,9 +159,34 @@ class ColumnPack:
             return self._dctx.decompress(data, max_output_size=raw_len)
         return data
 
+    def _chunks(self, recs: list[list]) -> bytes:
+        """Fetch + decode many chunks; zstd chunks decompress as one
+        threaded native batch when >1 (native/vtpu_native.cc)."""
+        zst = [(i, rec) for i, rec in enumerate(recs) if rec[3] == CODEC_ZSTD]
+        if len(zst) > 1:
+            from ..native import available, zstd_decompress_chunks
+
+            if not available():  # don't double-read chunks just to fall back
+                return b"".join(self._chunk(rec) for rec in recs)
+            outs = zstd_decompress_chunks(
+                [self._read_range(rec[0], rec[1]) for _, rec in zst],
+                [rec[2] for _, rec in zst],
+            )
+            if outs is not None:
+                self.bytes_read += sum(rec[1] for _, rec in zst)
+                dec = dict(zip((i for i, _ in zst), outs))
+                out = []
+                for i, rec in enumerate(recs):
+                    if i in dec:
+                        out.append(dec[i])
+                    else:
+                        out.append(self._chunk(rec))
+                return b"".join(out)
+        return b"".join(self._chunk(rec) for rec in recs)
+
     def read(self, name: str) -> np.ndarray:
         meta = self._cols[name]
-        raw = b"".join(self._chunk(rec) for rec in meta["chunks"])
+        raw = self._chunks(meta["chunks"])
         return np.frombuffer(raw, dtype=np.dtype(meta["dtype"])).reshape(meta["shape"])
 
     def read_groups(self, name: str, groups: list[int]) -> np.ndarray:
@@ -152,7 +195,7 @@ class ColumnPack:
         meta = self._cols[name]
         if meta["axis"] is None:
             raise ValueError(f"column {name} is not axis-chunked")
-        raw = b"".join(self._chunk(meta["chunks"][g]) for g in groups)
+        raw = self._chunks([meta["chunks"][g] for g in groups])
         shape = [-1] + meta["shape"][1:]
         return np.frombuffer(raw, dtype=np.dtype(meta["dtype"])).reshape(shape)
 

@@ -81,6 +81,31 @@ class WALBlock:
         """-> (records, clean). clean=False if a torn tail was dropped."""
         with open(path, "rb") as f:
             data = f.read()
+
+        # native frame scan (native/vtpu_native.cc) when available
+        from ..native import varint_frames
+
+        frames = varint_frames(data)
+        if frames is not None:
+            offs, lens, clean, torn_at = frames
+            out = []
+            for i, (off, ln) in enumerate(zip(offs, lens)):
+                off, ln = int(off), int(ln)
+                if ln < 16 + _REC_HDR.size:
+                    # framed but impossibly small: torn at this frame's
+                    # header, i.e. right after the previous frame's body
+                    # (no assumption about the varint's own encoding)
+                    clean = False
+                    torn_at = int(offs[i - 1] + lens[i - 1]) if i > 0 else 0
+                    break
+                tid = data[off : off + 16]
+                s, e = _REC_HDR.unpack_from(data, off + 16)
+                out.append(WALRecord(tid, s, e, data[off + 16 + _REC_HDR.size : off + ln]))
+            if not clean:
+                with open(path, "ab") as f:
+                    f.truncate(torn_at)
+            return out, clean
+
         out: list[WALRecord] = []
         pos = 0
         clean = True

@@ -1,0 +1,175 @@
+"""ctypes bindings for the native runtime layer (native/vtpu_native.cc).
+
+Loads native/libvtpu_native.so (built by `make -C native`; auto-built
+once if the toolchain is present), exposing batch hashing, bloom
+insertion, WAL frame scanning and threaded zstd codecs. Every entry
+point has a pure-Python fallback so the framework runs without the
+shared library -- `available()` reports which path is active.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+
+import numpy as np
+
+_LIB = None
+_TRIED = False
+
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native")
+_SO = os.path.join(_NATIVE_DIR, "libvtpu_native.so")
+
+
+def _load():
+    global _LIB, _TRIED
+    if _TRIED:
+        return _LIB
+    _TRIED = True
+    if not os.path.exists(_SO):
+        try:  # one silent build attempt; fallbacks cover failure
+            subprocess.run(["make", "-C", _NATIVE_DIR], capture_output=True, timeout=120)
+        except Exception:
+            pass
+    if os.path.exists(_SO):
+        try:
+            lib = ctypes.CDLL(_SO)
+            lib.vtpu_ring_tokens.argtypes = [
+                ctypes.c_char_p, ctypes.c_int,
+                ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_void_p,
+            ]
+            lib.vtpu_bloom_add_batch.argtypes = [
+                ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+                ctypes.c_int, ctypes.c_void_p, ctypes.c_int, ctypes.c_int,
+            ]
+            lib.vtpu_varint_frames.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64,
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int,
+            ]
+            lib.vtpu_varint_frames.restype = ctypes.c_int
+            lib.vtpu_zstd_bound.argtypes = [ctypes.c_int64]
+            lib.vtpu_zstd_bound.restype = ctypes.c_int64
+            lib.vtpu_zstd_compress_batch.argtypes = [ctypes.c_void_p] + [ctypes.c_void_p] * 2 + [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ]
+            lib.vtpu_zstd_compress_batch.restype = ctypes.c_int
+            lib.vtpu_zstd_decompress_batch.argtypes = [ctypes.c_void_p] + [ctypes.c_void_p] * 2 + [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_int, ctypes.c_int,
+            ]
+            lib.vtpu_zstd_decompress_batch.restype = ctypes.c_int
+            _LIB = lib
+        except OSError:
+            _LIB = None
+    return _LIB
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+# -------------------------------------------------------------- ring tokens
+def ring_tokens(tenant: str, trace_ids: list[bytes]) -> np.ndarray:
+    """Batch TokenFor: (n,) uint32. Identical to util.hashing.ring_token
+    per id; the native fast path requires uniform 16-byte ids (the wire
+    canonical form) so both paths hash exactly the same bytes."""
+    lib = _load()
+    n = len(trace_ids)
+    if lib is None or n == 0 or any(len(t) != 16 for t in trace_ids):
+        from ..util.hashing import ring_token
+
+        return np.asarray([ring_token(tenant, t) for t in trace_ids], dtype=np.uint32)
+    ids = np.frombuffer(b"".join(trace_ids), dtype=np.uint8)
+    out = np.zeros(n, dtype=np.uint32)
+    tb = tenant.encode()
+    lib.vtpu_ring_tokens(tb, len(tb), ids.ctypes.data, 16, n, out.ctypes.data)
+    return out
+
+
+# -------------------------------------------------------------------- bloom
+def bloom_add_batch(bloom, trace_ids: list[bytes], k: int) -> bool:
+    """Insert ids into a block.bloom.ShardedBloom natively (k = the
+    bloom's hash count, passed by the caller so both sides stay in
+    sync). Returns False if the caller must fall back to add_many."""
+    lib = _load()
+    if lib is None or not trace_ids:
+        return False
+    ids = np.frombuffer(b"".join(trace_ids), dtype=np.uint8)
+    lib.vtpu_bloom_add_batch(
+        bloom.words.ctypes.data, bloom.n_shards, bloom.words.shape[1],
+        bloom.shard_bits, k, ids.ctypes.data, 16, len(trace_ids),
+    )
+    return True
+
+
+# --------------------------------------------------------------- wal frames
+def varint_frames(data: bytes) -> tuple[np.ndarray, np.ndarray, bool, int] | None:
+    """Scan uvarint frames: (body_offsets, body_lengths, clean, torn_at)
+    -- torn_at is the file offset of the torn frame's header when not
+    clean (len(data) otherwise). None when the native path is missing."""
+    lib = _load()
+    if lib is None:
+        return None
+    buf = np.frombuffer(data, dtype=np.uint8)
+    cap = max(16, len(data) // 2 + 1)
+    offs = np.zeros(cap, dtype=np.int64)
+    lens = np.zeros(cap, dtype=np.int64)
+    r = lib.vtpu_varint_frames(buf.ctypes.data if len(buf) else None, len(data),
+                               offs.ctypes.data, lens.ctypes.data, cap)
+    clean = r >= 0
+    count = r if clean else (-r - 1)
+    torn_at = len(data) if clean else int(offs[count])
+    return offs[:count], lens[:count], clean, torn_at
+
+
+# --------------------------------------------------------------------- zstd
+_N_THREADS = max(2, (os.cpu_count() or 4) // 2)
+
+
+def zstd_compress_chunks(chunks: list[bytes], level: int = 3) -> list[bytes] | None:
+    lib = _load()
+    if lib is None or not chunks:
+        return None
+    n = len(chunks)
+    src = np.frombuffer(b"".join(chunks), dtype=np.uint8)
+    in_lens = np.asarray([len(c) for c in chunks], dtype=np.int64)
+    in_offs = np.zeros(n, dtype=np.int64)
+    np.cumsum(in_lens[:-1], out=in_offs[1:]) if n > 1 else None
+    bounds = np.asarray([lib.vtpu_zstd_bound(int(l)) for l in in_lens], dtype=np.int64)
+    out_offs = np.zeros(n, dtype=np.int64)
+    np.cumsum(bounds[:-1], out=out_offs[1:]) if n > 1 else None
+    dst = np.zeros(int(bounds.sum()), dtype=np.uint8)
+    out_lens = np.zeros(n, dtype=np.int64)
+    rc = lib.vtpu_zstd_compress_batch(
+        src.ctypes.data if len(src) else None, in_offs.ctypes.data, in_lens.ctypes.data,
+        dst.ctypes.data, out_offs.ctypes.data, out_lens.ctypes.data,
+        n, level, _N_THREADS,
+    )
+    if rc != 0:
+        return None
+    return [dst[out_offs[i]: out_offs[i] + out_lens[i]].tobytes() for i in range(n)]
+
+
+def zstd_decompress_chunks(chunks: list[bytes], out_sizes: list[int]) -> list[bytes] | None:
+    lib = _load()
+    if lib is None or not chunks:
+        return None
+    n = len(chunks)
+    src = np.frombuffer(b"".join(chunks), dtype=np.uint8)
+    in_lens = np.asarray([len(c) for c in chunks], dtype=np.int64)
+    in_offs = np.zeros(n, dtype=np.int64)
+    np.cumsum(in_lens[:-1], out=in_offs[1:]) if n > 1 else None
+    out_lens = np.asarray(out_sizes, dtype=np.int64)
+    out_offs = np.zeros(n, dtype=np.int64)
+    np.cumsum(out_lens[:-1], out=out_offs[1:]) if n > 1 else None
+    dst = np.zeros(int(out_lens.sum()), dtype=np.uint8)
+    rc = lib.vtpu_zstd_decompress_batch(
+        src.ctypes.data if len(src) else None, in_offs.ctypes.data, in_lens.ctypes.data,
+        dst.ctypes.data, out_offs.ctypes.data, out_lens.ctypes.data,
+        n, _N_THREADS,
+    )
+    if rc != 0:
+        return None
+    return [dst[out_offs[i]: out_offs[i] + out_lens[i]].tobytes() for i in range(n)]
