@@ -39,6 +39,7 @@ DEFAULT_TENANT = "single-tenant"
 TENANT_HEADER = "X-Scope-OrgID"  # reference: shared orgid header
 
 INGESTER_RING = "ingester-ring"
+COMPACTOR_RING = "compactor-ring"
 
 
 @dataclass
@@ -54,19 +55,25 @@ class AppConfig:
     ingester: IngesterConfig = field(default_factory=IngesterConfig)
     compaction_cycle_s: float = 30.0
     enable_generator: bool = True
+    # multi-process topology: shared ring-KV directory + the address other
+    # processes reach this one at (http://host:port). Empty = single binary
+    # with an in-memory ring.
+    kv_dir: str = ""
+    advertise_addr: str = ""
+    http_host: str = ""  # default: loopback, or 0.0.0.0 when advertising non-loopback
 
 
 class App:
     """All modules of one process, wired per target."""
 
-    VALID_TARGETS = ("all", "ingester", "querier", "query-frontend", "compactor",
-                     "metrics-generator")
+    VALID_TARGETS = ("all", "distributor", "ingester", "querier", "query-frontend",
+                     "compactor", "metrics-generator")
 
     def __init__(self, cfg: AppConfig):
-        if cfg.target == "distributor":
+        if cfg.target == "distributor" and not cfg.kv_dir:
             raise ValueError(
-                "standalone distributor needs a remote ingester transport; "
-                "run -target=all (single binary)"
+                "standalone distributor needs a shared ring (--kv.dir) to "
+                "reach remote ingesters; or run -target=all (single binary)"
             )
         if cfg.target not in self.VALID_TARGETS:
             raise ValueError(f"unknown target {cfg.target!r}; one of {self.VALID_TARGETS}")
@@ -75,7 +82,16 @@ class App:
         def has(role: str) -> bool:
             return cfg.target in ("all", role)
 
-        wal_path = cfg.wal_path or os.path.join(cfg.storage_path, "wal")
+        if cfg.kv_dir and cfg.target in ("all", "ingester") and not cfg.advertise_addr.startswith(
+            ("http://", "https://")
+        ):
+            raise ValueError(
+                "an ingester joining a shared ring (--kv.dir) must advertise an "
+                "http(s):// address (--advertise.addr) for peers to reach it"
+            )
+        # per-instance WAL dir: ingesters sharing --storage.path must never
+        # replay (and delete) each other's live WAL files
+        wal_path = cfg.wal_path or os.path.join(cfg.storage_path, "wal", cfg.instance_id)
         self.db = TempoDB(
             TempoDBConfig(
                 backend={"backend": "local", "path": cfg.storage_path},
@@ -84,17 +100,26 @@ class App:
         )
         self.db.poll_now()
         self.overrides = Overrides(path=cfg.overrides_path)
-        self.kv = InMemoryKV()
+        if cfg.kv_dir:
+            from ..transport import FileKV
+
+            self.kv = FileKV(cfg.kv_dir)
+        else:
+            self.kv = InMemoryKV()
         self.ring = Ring(self.kv, INGESTER_RING, replication_factor=cfg.replication_factor)
 
-        # in-process client registry: addr -> ingester
+        # addr -> client: in-process registry + HTTP for remote addrs
+        from ..transport import client_registry
+
         self._clients: dict[str, object] = {}
+        self.client_for = client_registry(self._clients)
 
         self.ingester = self.lifecycler = None
         if has("ingester"):
             self.ingester = Ingester(WAL(wal_path), self.db, self.overrides, cfg.ingester)
             self.ingester.replay_wal()
-            self.lifecycler = Lifecycler(self.kv, INGESTER_RING, cfg.instance_id)
+            self.lifecycler = Lifecycler(self.kv, INGESTER_RING, cfg.instance_id,
+                                         addr=cfg.advertise_addr)
             self._clients[self.lifecycler.desc.addr] = self.ingester
 
         self.generator = None
@@ -106,21 +131,29 @@ class App:
             gen_forward = self.generator.push
 
         self.distributor = None
-        if cfg.target == "all":
+        if has("distributor"):
             self.distributor = Distributor(
-                self.ring, self._clients.__getitem__, self.overrides,
+                self.ring, self.client_for, self.overrides,
                 generator_forward=gen_forward,
             )
 
         self.querier = self.frontend = None
         if has("querier") or has("query-frontend"):
-            ingester_ring = self.ring if self._clients else None
-            self.querier = Querier(self.db, ingester_ring, self._clients.__getitem__)
+            # with a shared KV the ring may hold remote ingesters even when
+            # this process hosts none
+            ingester_ring = self.ring if (self._clients or cfg.kv_dir) else None
+            self.querier = Querier(self.db, ingester_ring, self.client_for)
             self.frontend = Frontend(self.querier)
 
-        self.compactor = None
+        self.compactor = self.compactor_lifecycler = None
         if has("compactor"):
-            self.compactor = Compactor(self.db, self.ring, cfg.instance_id,
+            # compactors own jobs via their OWN ring (the reference's
+            # compactor ring, modules/compactor/compactor.go:36-38) -- an
+            # ingester-ring membership test would never match a standalone
+            # compactor process
+            self.compactor_lifecycler = Lifecycler(self.kv, COMPACTOR_RING, cfg.instance_id)
+            comp_ring = Ring(self.kv, COMPACTOR_RING)
+            self.compactor = Compactor(self.db, comp_ring, cfg.instance_id,
                                        cycle_s=cfg.compaction_cycle_s)
         self._started = False
         self.http_server: ThreadingHTTPServer | None = None
@@ -129,6 +162,8 @@ class App:
     def start(self) -> None:
         if self.lifecycler:
             self.lifecycler.start()
+        if self.compactor_lifecycler:
+            self.compactor_lifecycler.start()
         if self.ingester:
             self.ingester.start_sweeper()
         if self.compactor:
@@ -145,6 +180,8 @@ class App:
             self.frontend.stop()
         if self.lifecycler:
             self.lifecycler.leave()
+        if self.compactor_lifecycler:
+            self.compactor_lifecycler.leave()
         self.db.close()
         if self.http_server:
             self.http_server.shutdown()
@@ -168,7 +205,14 @@ class App:
     # ------------------------------------------------------------ http
     def serve_http(self, port: int | None = None, background: bool = False):
         handler = _make_handler(self)
-        self.http_server = ThreadingHTTPServer(("127.0.0.1", port or self.cfg.http_port), handler)
+        host = self.cfg.http_host
+        if not host:
+            # a non-loopback advertise addr implies peers connect from other
+            # hosts: bind all interfaces, else stay loopback-only
+            adv = self.cfg.advertise_addr
+            local = ("127.0.0.1" in adv) or ("localhost" in adv) or not adv
+            host = "127.0.0.1" if local else "0.0.0.0"
+        self.http_server = ThreadingHTTPServer((host, port or self.cfg.http_port), handler)
         if background:
             t = threading.Thread(target=self.http_server.serve_forever, daemon=True)
             t.start()
@@ -278,6 +322,11 @@ def _make_handler(app: App):
             ln = int(self.headers.get("Content-Length", 0))
             body = self.rfile.read(ln) if ln else b""
             try:
+                if u.path.startswith("/internal/"):
+                    from ..transport.client import handle_internal
+
+                    code, out = handle_internal(app, u.path, json.loads(body or b"{}"))
+                    return self._send(code, json.dumps(out))
                 if u.path == "/v1/traces":  # OTLP HTTP ingest
                     if app.distributor is None:
                         return self._err(404, f"target {app.cfg.target} does not ingest")
@@ -350,6 +399,12 @@ def main(argv=None):
     ap.add_argument("--storage.path", dest="storage", default="./tempo-data")
     ap.add_argument("--overrides.path", dest="overrides", default="")
     ap.add_argument("--multitenancy", action="store_true")
+    ap.add_argument("--kv.dir", dest="kv_dir", default="",
+                    help="shared ring-KV directory for multi-process topologies")
+    ap.add_argument("--advertise.addr", dest="advertise", default="",
+                    help="address other processes reach this one at (http://host:port)")
+    ap.add_argument("--instance.id", dest="instance_id", default="")
+    ap.add_argument("--replication.factor", dest="rf", type=int, default=1)
     args = ap.parse_args(argv)
     cfg = AppConfig(
         target=args.target,
@@ -357,6 +412,10 @@ def main(argv=None):
         storage_path=args.storage,
         overrides_path=args.overrides,
         multitenancy=args.multitenancy,
+        kv_dir=args.kv_dir,
+        advertise_addr=args.advertise or f"http://127.0.0.1:{args.port}",
+        instance_id=args.instance_id or f"tempo-{args.port}",
+        replication_factor=args.rf,
     )
     app = App(cfg)
     app.start()

@@ -37,7 +37,13 @@ class Querier:
     def _ingester_clients(self):
         if self.ring is None:
             return []
-        return [self.client_for(d.addr) for d in self.ring.healthy_instances()]
+        out = []
+        for d in self.ring.healthy_instances():
+            try:
+                out.append(self.client_for(d.addr))
+            except KeyError:
+                continue  # unresolvable addr degrades that leg, not the query
+        return out
 
     # ----------------------------------------------------------- trace by id
     def find_trace_by_id(self, tenant: str, trace_id: bytes,

@@ -1,0 +1,15 @@
+"""Inter-process transport: the data-plane boundary between roles.
+
+The reference speaks gRPC (gogo codec + snappy) between distributor,
+ingesters and queriers, with memberlist gossip for ring state
+(SURVEY.md 2.10, 5.8). Here the same boundaries are HTTP+JSON/base64
+internal endpoints (transport/http_internal.py) and a shared-directory
+ring KV (transport/filekv.py) for multi-process topologies on one host
+or a shared filesystem; the in-memory KV + in-process client registry
+remain the single-binary fast path.
+"""
+
+from .client import HTTPIngesterClient, client_registry
+from .filekv import FileKV
+
+__all__ = ["HTTPIngesterClient", "client_registry", "FileKV"]

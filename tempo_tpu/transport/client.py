@@ -1,0 +1,162 @@
+"""Ingester clients over the role boundary.
+
+`client_registry` resolves an instance addr to a client: in-process
+objects for the single binary, HTTPIngesterClient for `http://...`
+addrs (the reference's gRPC ingester client seam,
+modules/distributor/distributor.go:148-153 factory).
+
+Wire format: JSON with base64 segments. Deliberately simple -- the
+payload is already compact proto-wire segment bytes; framing overhead
+is the base64 33%, acceptable for the multi-process topology this
+serves (same-host or LAN).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import urllib.error
+import urllib.request
+
+from ..db.search import SearchRequest, SearchResponse, SearchResult
+from ..wire import otlp_json
+from ..wire.model import Trace
+
+
+class TransportError(Exception):
+    def __init__(self, status: int, msg: str):
+        super().__init__(msg)
+        self.status = status
+
+
+class HTTPIngesterClient:
+    def __init__(self, addr: str, timeout: float = 10.0):
+        self.addr = addr.rstrip("/")
+        self.timeout = timeout
+
+    def _post(self, path: str, payload: dict) -> dict:
+        req = urllib.request.Request(
+            self.addr + path,
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as r:
+                body = r.read()
+                return json.loads(body) if body else {}
+        except urllib.error.HTTPError as e:
+            try:
+                msg = json.loads(e.read()).get("error", "")
+            except Exception:
+                msg = str(e)
+            # re-raise ingester-side limit errors with their real status
+            from ..services.distributor import PushError
+
+            raise PushError(e.code, msg) if e.code in (400, 429) else TransportError(e.code, msg)
+
+    # ------------------------------------------------- Pusher (write path)
+    def push_segments(self, tenant: str, batch) -> None:
+        self._post(
+            "/internal/push",
+            {
+                "tenant": tenant,
+                "batch": [
+                    [tid.hex(), s, e, base64.b64encode(seg).decode()]
+                    for tid, s, e, seg in batch
+                ],
+            },
+        )
+
+    # ------------------------------------------------ Querier (read path)
+    def find_trace_by_id(self, tenant: str, trace_id: bytes) -> Trace | None:
+        out = self._post("/internal/find", {"tenant": tenant, "trace_id": trace_id.hex()})
+        if not out.get("trace"):
+            return None
+        return otlp_json.loads(out["trace"])
+
+    def search(self, tenant: str, req: SearchRequest) -> SearchResponse:
+        out = self._post(
+            "/internal/search",
+            {
+                "tenant": tenant,
+                "req": {
+                    "tags": req.tags,
+                    "query": req.query,
+                    "min_duration_ms": req.min_duration_ms,
+                    "max_duration_ms": req.max_duration_ms,
+                    "start": req.start,
+                    "end": req.end,
+                    "limit": req.limit,
+                },
+            },
+        )
+        resp = SearchResponse()
+        resp.inspected_bytes = out.get("inspectedBytes", 0)
+        resp.inspected_spans = out.get("inspectedSpans", 0)
+        for t in out.get("traces", []):
+            resp.traces.append(
+                SearchResult(
+                    trace_id=t["traceID"],
+                    root_service_name=t.get("rootServiceName", ""),
+                    root_trace_name=t.get("rootTraceName", ""),
+                    start_time_unix_nano=int(t.get("startTimeUnixNano", "0")),
+                    duration_ms=t.get("durationMs", 0),
+                )
+            )
+        return resp
+
+
+def client_registry(local: dict):
+    """addr -> client resolver: in-process objects first, HTTP for the rest."""
+    cache: dict[str, HTTPIngesterClient] = {}
+
+    def resolve(addr: str):
+        if addr in local:
+            return local[addr]
+        if addr.startswith("http://") or addr.startswith("https://"):
+            c = cache.get(addr)
+            if c is None:
+                c = cache[addr] = HTTPIngesterClient(addr)
+            return c
+        raise KeyError(f"unknown instance addr {addr!r}")
+
+    return resolve
+
+
+# ----------------------------------------------------------- server side
+
+
+def handle_internal(app, path: str, payload: dict):
+    """Dispatch one internal-API request against this process's ingester.
+    Returns (status, dict)."""
+    if app.ingester is None:
+        return 404, {"error": f"target {app.cfg.target} hosts no ingester"}
+    tenant = payload.get("tenant", "")
+    if path == "/internal/push":
+        batch = [
+            (bytes.fromhex(tid), s, e, base64.b64decode(seg))
+            for tid, s, e, seg in payload.get("batch", [])
+        ]
+        app.ingester.push_segments(tenant, batch)
+        return 200, {}
+    if path == "/internal/find":
+        tr = app.ingester.find_trace_by_id(tenant, bytes.fromhex(payload["trace_id"]))
+        return 200, {"trace": otlp_json.dumps(tr) if tr is not None else None}
+    if path == "/internal/search":
+        r = payload.get("req", {})
+        req = SearchRequest(
+            tags=r.get("tags", {}),
+            query=r.get("query", ""),
+            min_duration_ms=r.get("min_duration_ms", 0),
+            max_duration_ms=r.get("max_duration_ms", 0),
+            start=r.get("start", 0),
+            end=r.get("end", 0),
+            limit=r.get("limit", 20),
+        )
+        resp = app.ingester.search(tenant, req)
+        return 200, {
+            "traces": [t.to_dict() for t in resp.traces],
+            "inspectedBytes": resp.inspected_bytes,
+            "inspectedSpans": resp.inspected_spans,
+        }
+    return 404, {"error": f"no internal route {path}"}

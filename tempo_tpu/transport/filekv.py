@@ -1,0 +1,85 @@
+"""File-backed ring KV: one JSON file per instance under
+<dir>/<ring_key>/, written atomically. Any process sharing the
+directory (host-local or network filesystem) sees the same ring --
+the multi-process stand-in for the reference's memberlist gossip KV
+(cmd/tempo/app/modules.go:288-316).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import time
+
+from ..ring.ring import InstanceDesc, InstanceState
+
+
+class FileKV:
+    def __init__(self, dirpath: str, cache_ttl_s: float = 1.0):
+        self.dir = dirpath
+        os.makedirs(dirpath, exist_ok=True)
+        # get_all sits on the per-push / per-query hot path; descriptors
+        # only change on heartbeats, so a short TTL absorbs the file IO
+        self.cache_ttl_s = cache_ttl_s
+        self._cache: dict[str, tuple[float, dict[str, InstanceDesc]]] = {}
+
+    def _ring_dir(self, ring_key: str) -> str:
+        d = os.path.join(self.dir, ring_key)
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    def update(self, ring_key: str, desc: InstanceDesc) -> None:
+        d = self._ring_dir(ring_key)
+        payload = json.dumps(
+            {
+                "instance_id": desc.instance_id,
+                "addr": desc.addr,
+                "state": desc.state.value,
+                "tokens": desc.tokens,
+                "heartbeat_ts": desc.heartbeat_ts,
+            }
+        ).encode()
+        fd, tmp = tempfile.mkstemp(dir=d, prefix=".tmp-")
+        try:
+            os.write(fd, payload)
+            os.close(fd)
+            os.replace(tmp, os.path.join(d, desc.instance_id + ".json"))
+            self._cache.pop(ring_key, None)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+
+    def remove(self, ring_key: str, instance_id: str) -> None:
+        try:
+            os.unlink(os.path.join(self._ring_dir(ring_key), instance_id + ".json"))
+        except FileNotFoundError:
+            pass
+        self._cache.pop(ring_key, None)
+
+    def get_all(self, ring_key: str) -> dict[str, InstanceDesc]:
+        hit = self._cache.get(ring_key)
+        if hit is not None and time.monotonic() - hit[0] < self.cache_ttl_s:
+            return dict(hit[1])
+        out: dict[str, InstanceDesc] = {}
+        d = self._ring_dir(ring_key)
+        for name in os.listdir(d):
+            if not name.endswith(".json"):
+                continue
+            try:
+                with open(os.path.join(d, name)) as f:
+                    j = json.load(f)
+                out[j["instance_id"]] = InstanceDesc(
+                    instance_id=j["instance_id"],
+                    addr=j.get("addr", ""),
+                    state=InstanceState(j.get("state", "ACTIVE")),
+                    tokens=j.get("tokens", []),
+                    heartbeat_ts=j.get("heartbeat_ts", 0.0),
+                )
+            except (OSError, ValueError, KeyError):
+                continue  # torn write or foreign file: skip
+        self._cache[ring_key] = (time.monotonic(), dict(out))
+        return out

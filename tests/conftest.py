@@ -27,3 +27,7 @@ def rng():
     import random
 
     return random.Random(1234)
+
+
+def pytest_configure(config):
+    config.addinivalue_line("markers", "slow: multi-process / long-running e2e tests")
