@@ -18,9 +18,35 @@ def open_backend(cfg: dict) -> RawBackend:
         return LocalBackend(cfg.get("path", "./tempo-data"))
     if kind in ("mem", "memory"):
         return MemBackend()
-    if kind in ("gcs", "s3", "azure"):
+    if kind in ("s3", "gcs"):
+        from .s3 import S3Backend
+
+        endpoint = cfg.get("endpoint") or (
+            "https://storage.googleapis.com" if kind == "gcs" else "https://s3.amazonaws.com"
+        )
+        inner = S3Backend(
+            endpoint=endpoint,
+            bucket=cfg["bucket"],
+            access_key=cfg.get("access_key", ""),
+            secret_key=cfg.get("secret_key", ""),
+            region=cfg.get("region", "us-east-1"),
+            prefix=cfg.get("prefix", ""),
+        )
+        return _wrap(inner, cfg)
+    if kind == "azure":
         raise NotImplementedError(
-            f"backend {kind!r} requires cloud SDKs not present in this build; "
-            "use 'local' (works for all single-host and test deployments)"
+            "azure backend not implemented; use s3/gcs (S3-compatible REST) or local"
         )
     raise ValueError(f"unknown backend {kind!r}")
+
+
+def _wrap(inner: RawBackend, cfg: dict) -> RawBackend:
+    """Optional cache + hedging interposers (reference: backend/cache
+    wrapper + hedged requests on every object backend)."""
+    from .cache import CachedBackend, HedgedBackend
+
+    if cfg.get("hedge_requests_after_s"):
+        inner = HedgedBackend(inner, hedge_after_s=float(cfg["hedge_requests_after_s"]))
+    if cfg.get("cache", True) and cfg.get("cache_max_bytes", 1) != 0:
+        inner = CachedBackend(inner, max_bytes=int(cfg.get("cache_max_bytes", 256 << 20)))
+    return inner

@@ -1,0 +1,188 @@
+"""Caching + hedging backend wrappers.
+
+CachedBackend interposes an LRU on reads keyed tenant:block:name[:off:len]
+(the reference's cache interposer, tempodb/backend/cache/cache.go:22-113)
+with the same policy seam as tempodb's shouldCache: only hot control
+objects (blooms, dictionary, footers / small ranges) are cached, never
+bulk column data.
+
+HedgedBackend launches a backup read if the primary hasn't answered
+within a delay -- first result wins (the reference hedges every object
+backend via cristalhq/hedgedhttp).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, wait
+
+from .base import RawBackend
+
+# NEVER meta.json: it is the one mutable object (deleted by another
+# process's compactor); caching it would pin dead blocks on the blocklist
+_CACHEABLE_NAMES = ("bloom-", "dictionary")
+MAX_CACHED_RANGE = 1 << 20  # ranges above 1 MiB are bulk column reads
+
+
+class CachedBackend(RawBackend):
+    def __init__(self, inner: RawBackend, max_bytes: int = 256 * 1024 * 1024):
+        self.inner = inner
+        self.max_bytes = max_bytes
+        self._lock = threading.Lock()
+        self._lru: OrderedDict[tuple, bytes] = OrderedDict()
+        self._bytes = 0
+        self.hits = 0
+        self.misses = 0
+
+    # ------------------------------------------------------------- cache
+    @staticmethod
+    def _cacheable(name: str, length: int | None = None) -> bool:
+        if length is not None and length > MAX_CACHED_RANGE:
+            return False
+        return any(t in name for t in _CACHEABLE_NAMES) or (length is not None)
+
+    def _get(self, key: tuple) -> bytes | None:
+        with self._lock:
+            data = self._lru.get(key)
+            if data is not None:
+                self._lru.move_to_end(key)
+                self.hits += 1
+            else:
+                self.misses += 1
+            return data
+
+    def _put(self, key: tuple, data: bytes) -> None:
+        if len(data) > self.max_bytes:
+            return
+        with self._lock:
+            old = self._lru.pop(key, None)
+            if old is not None:
+                self._bytes -= len(old)
+            self._lru[key] = data
+            self._bytes += len(data)
+            while self._bytes > self.max_bytes and self._lru:
+                _, evicted = self._lru.popitem(last=False)
+                self._bytes -= len(evicted)
+
+    def _invalidate_block(self, tenant: str, block_id: str) -> None:
+        with self._lock:
+            for k in [k for k in self._lru if k[0] == tenant and k[1] == block_id]:
+                self._bytes -= len(self._lru.pop(k))
+
+    # ------------------------------------------------------------ passthru
+    def write(self, tenant, block_id, name, data):
+        self.inner.write(tenant, block_id, name, data)
+        self._invalidate_block(tenant, block_id)
+
+    def write_tenant_object(self, tenant, name, data):
+        self.inner.write_tenant_object(tenant, name, data)
+
+    def read(self, tenant, block_id, name):
+        key = (tenant, block_id, name)
+        if self._cacheable(name):
+            data = self._get(key)
+            if data is not None:
+                return data
+        data = self.inner.read(tenant, block_id, name)
+        if self._cacheable(name):
+            self._put(key, data)
+        return data
+
+    def read_range(self, tenant, block_id, name, offset, length):
+        key = (tenant, block_id, name, offset, length)
+        if self._cacheable(name, length):
+            data = self._get(key)
+            if data is not None:
+                return data
+        data = self.inner.read_range(tenant, block_id, name, offset, length)
+        if self._cacheable(name, length):
+            self._put(key, data)
+        return data
+
+    def read_tenant_object(self, tenant, name):
+        return self.inner.read_tenant_object(tenant, name)
+
+    def tenants(self):
+        return self.inner.tenants()
+
+    def blocks(self, tenant):
+        return self.inner.blocks(tenant)
+
+    def delete_block(self, tenant, block_id):
+        self.inner.delete_block(tenant, block_id)
+        self._invalidate_block(tenant, block_id)
+
+    def delete_tenant_object(self, tenant, name):
+        self.inner.delete_tenant_object(tenant, name)
+
+    def _delete_object(self, tenant, block_id, name):
+        self.inner._delete_object(tenant, block_id, name)
+        self._invalidate_block(tenant, block_id)
+
+    def mark_compacted(self, tenant, block_id):
+        self.inner.mark_compacted(tenant, block_id)
+        self._invalidate_block(tenant, block_id)
+
+
+class HedgedBackend(RawBackend):
+    """Issues a backup read when the primary is slow; first reply wins."""
+
+    def __init__(self, inner: RawBackend, hedge_after_s: float = 0.5, workers: int = 16):
+        self.inner = inner
+        self.hedge_after_s = hedge_after_s
+        self.pool = ThreadPoolExecutor(max_workers=workers, thread_name_prefix="hedge")
+        self.hedged_requests = 0
+
+    def _hedged(self, fn, *args):
+        f1 = self.pool.submit(fn, *args)
+        done, _ = wait([f1], timeout=self.hedge_after_s, return_when=FIRST_COMPLETED)
+        if done:
+            return f1.result()
+        self.hedged_requests += 1
+        futures = {f1, self.pool.submit(fn, *args)}
+        last_err: Exception | None = None
+        while futures:
+            done, futures = wait(futures, return_when=FIRST_COMPLETED)
+            # any success among the completed set wins, even if another
+            # completed leg errored in the same instant
+            for f in done:
+                try:
+                    return f.result()
+                except Exception as e:
+                    last_err = e
+        raise last_err
+
+    def read(self, tenant, block_id, name):
+        return self._hedged(self.inner.read, tenant, block_id, name)
+
+    def read_range(self, tenant, block_id, name, offset, length):
+        return self._hedged(self.inner.read_range, tenant, block_id, name, offset, length)
+
+    def read_tenant_object(self, tenant, name):
+        return self._hedged(self.inner.read_tenant_object, tenant, name)
+
+    # writes/lists/deletes pass through unhedged
+    def write(self, tenant, block_id, name, data):
+        self.inner.write(tenant, block_id, name, data)
+
+    def write_tenant_object(self, tenant, name, data):
+        self.inner.write_tenant_object(tenant, name, data)
+
+    def tenants(self):
+        return self.inner.tenants()
+
+    def blocks(self, tenant):
+        return self.inner.blocks(tenant)
+
+    def delete_block(self, tenant, block_id):
+        self.inner.delete_block(tenant, block_id)
+
+    def delete_tenant_object(self, tenant, name):
+        self.inner.delete_tenant_object(tenant, name)
+
+    def _delete_object(self, tenant, block_id, name):
+        self.inner._delete_object(tenant, block_id, name)
+
+    def mark_compacted(self, tenant, block_id):
+        self.inner.mark_compacted(tenant, block_id)

@@ -1,0 +1,208 @@
+"""S3-compatible object-store backend over plain HTTP with AWS SigV4.
+
+The role of the reference's minio-based backend (tempodb/backend/s3),
+implemented against the public S3 REST API directly (PUT/GET/DELETE
+object, ranged GET, ListObjectsV2) so it needs no SDK: works with AWS
+S3, MinIO, and GCS's S3-interoperability endpoint (the `gcs` backend
+selection routes here with storage.googleapis.com + HMAC keys).
+Path-style addressing for MinIO compatibility. SigV4 is implemented
+from the published algorithm (hmac/sha256 canonical requests).
+"""
+
+from __future__ import annotations
+
+import datetime
+import hashlib
+import hmac
+import urllib.error
+import urllib.parse
+import urllib.request
+import xml.etree.ElementTree as ET
+
+from .base import BackendError, DoesNotExist, RawBackend, block_object_path
+
+_EMPTY_SHA = hashlib.sha256(b"").hexdigest()
+
+
+class SigV4:
+    def __init__(self, access_key: str, secret_key: str, region: str, service: str = "s3"):
+        self.access_key = access_key
+        self.secret_key = secret_key
+        self.region = region
+        self.service = service
+
+    def sign(self, method: str, url: str, payload_sha: str, now=None) -> dict[str, str]:
+        u = urllib.parse.urlsplit(url)
+        now = now or datetime.datetime.now(datetime.timezone.utc)
+        amz_date = now.strftime("%Y%m%dT%H%M%SZ")
+        datestamp = now.strftime("%Y%m%d")
+        canonical_query = "&".join(
+            sorted(
+                f"{urllib.parse.quote(k, safe='')}={urllib.parse.quote(v, safe='')}"
+                for k, v in urllib.parse.parse_qsl(u.query, keep_blank_values=True)
+            )
+        )
+        headers = {"host": u.netloc, "x-amz-content-sha256": payload_sha, "x-amz-date": amz_date}
+        signed_headers = ";".join(sorted(headers))
+        canonical_headers = "".join(f"{k}:{headers[k]}\n" for k in sorted(headers))
+        # u.path is already percent-encoded by the caller (_url); re-quoting
+        # would double-encode and break the signature for keys with spaces etc.
+        canonical = "\n".join(
+            [method, u.path or "/", canonical_query,
+             canonical_headers, signed_headers, payload_sha]
+        )
+        scope = f"{datestamp}/{self.region}/{self.service}/aws4_request"
+        to_sign = "\n".join(
+            ["AWS4-HMAC-SHA256", amz_date, scope,
+             hashlib.sha256(canonical.encode()).hexdigest()]
+        )
+
+        def _hmac(key: bytes, msg: str) -> bytes:
+            return hmac.new(key, msg.encode(), hashlib.sha256).digest()
+
+        k = _hmac(("AWS4" + self.secret_key).encode(), datestamp)
+        k = _hmac(k, self.region)
+        k = _hmac(k, self.service)
+        k = _hmac(k, "aws4_request")
+        sig = hmac.new(k, to_sign.encode(), hashlib.sha256).hexdigest()
+        return {
+            "x-amz-content-sha256": payload_sha,
+            "x-amz-date": amz_date,
+            "Authorization": (
+                f"AWS4-HMAC-SHA256 Credential={self.access_key}/{scope}, "
+                f"SignedHeaders={signed_headers}, Signature={sig}"
+            ),
+        }
+
+
+class S3Backend(RawBackend):
+    def __init__(self, endpoint: str, bucket: str, access_key: str = "",
+                 secret_key: str = "", region: str = "us-east-1", prefix: str = "",
+                 timeout: float = 30.0):
+        self.endpoint = endpoint.rstrip("/")
+        self.bucket = bucket
+        self.prefix = prefix.strip("/")
+        self.signer = SigV4(access_key, secret_key, region) if access_key else None
+        self.timeout = timeout
+
+    # ------------------------------------------------------------- http
+    def _key(self, path: str) -> str:
+        return f"{self.prefix}/{path}" if self.prefix else path
+
+    def _url(self, key: str = "", query: str = "") -> str:
+        base = f"{self.endpoint}/{self.bucket}"
+        if key:
+            base += "/" + urllib.parse.quote(key)
+        if query:
+            base += "?" + query
+        return base
+
+    def _request(self, method: str, url: str, data: bytes | None = None,
+                 range_hdr: str | None = None) -> tuple[int, bytes]:
+        payload_sha = hashlib.sha256(data).hexdigest() if data else _EMPTY_SHA
+        headers = {}
+        if self.signer:
+            headers.update(self.signer.sign(method, url, payload_sha))
+        if range_hdr:
+            headers["Range"] = range_hdr
+        req = urllib.request.Request(url, data=data, headers=headers, method=method)
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as r:
+                return r.status, r.read()
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                raise DoesNotExist(url)
+            raise BackendError(f"s3 {method} {url}: {e.code} {e.read()[:200]!r}")
+        except urllib.error.URLError as e:
+            raise BackendError(f"s3 {method} {url}: {e}")
+
+    # ------------------------------------------------------------ write
+    def write(self, tenant: str, block_id: str, name: str, data: bytes) -> None:
+        self._request("PUT", self._url(self._key(block_object_path(tenant, block_id, name))), data)
+
+    def write_tenant_object(self, tenant: str, name: str, data: bytes) -> None:
+        self._request("PUT", self._url(self._key(f"{tenant}/{name}")), data)
+
+    # ------------------------------------------------------------- read
+    def read(self, tenant: str, block_id: str, name: str) -> bytes:
+        return self._request("GET", self._url(self._key(block_object_path(tenant, block_id, name))))[1]
+
+    def read_range(self, tenant: str, block_id: str, name: str, offset: int, length: int) -> bytes:
+        _, body = self._request(
+            "GET",
+            self._url(self._key(block_object_path(tenant, block_id, name))),
+            range_hdr=f"bytes={offset}-{offset + length - 1}",
+        )
+        return body
+
+    def read_tenant_object(self, tenant: str, name: str) -> bytes:
+        return self._request("GET", self._url(self._key(f"{tenant}/{name}")))[1]
+
+    # ------------------------------------------------------------- list
+    def _list_prefixes(self, prefix: str) -> list[str]:
+        """ListObjectsV2 common prefixes directly under `prefix`."""
+        out = []
+        token = ""
+        while True:
+            q = {
+                "list-type": "2",
+                "delimiter": "/",
+                "prefix": prefix,
+            }
+            if token:
+                q["continuation-token"] = token
+            query = urllib.parse.urlencode(sorted(q.items()))
+            _, body = self._request("GET", self._url(query=query))
+            root = ET.fromstring(body)
+            ns = root.tag.split("}")[0] + "}" if root.tag.startswith("{") else ""
+            for cp in root.findall(f"{ns}CommonPrefixes/{ns}Prefix"):
+                p = cp.text or ""
+                p = p[len(prefix):].strip("/")
+                if p:
+                    out.append(p)
+            trunc = root.findtext(f"{ns}IsTruncated") == "true"
+            token = root.findtext(f"{ns}NextContinuationToken") or ""
+            if not trunc or not token:
+                return out
+
+    def tenants(self) -> list[str]:
+        return self._list_prefixes(f"{self.prefix}/" if self.prefix else "")
+
+    def blocks(self, tenant: str) -> list[str]:
+        return self._list_prefixes(self._key(f"{tenant}/") )
+
+    # ----------------------------------------------------------- delete
+    def _delete_object(self, tenant: str, block_id: str, name: str) -> None:
+        try:
+            self._request("DELETE", self._url(self._key(block_object_path(tenant, block_id, name))))
+        except DoesNotExist:
+            pass
+
+    def delete_block(self, tenant: str, block_id: str) -> None:
+        # enumerate the block's objects then delete each
+        prefix = self._key(f"{tenant}/{block_id}/")
+        token = ""
+        while True:
+            q = {"list-type": "2", "prefix": prefix}
+            if token:
+                q["continuation-token"] = token
+            query = urllib.parse.urlencode(sorted(q.items()))
+            _, body = self._request("GET", self._url(query=query))
+            root = ET.fromstring(body)
+            ns = root.tag.split("}")[0] + "}" if root.tag.startswith("{") else ""
+            keys = [k.text for k in root.findall(f"{ns}Contents/{ns}Key") if k.text]
+            for key in keys:
+                try:
+                    self._request("DELETE", self._url(key))
+                except DoesNotExist:
+                    pass
+            trunc = root.findtext(f"{ns}IsTruncated") == "true"
+            token = root.findtext(f"{ns}NextContinuationToken") or ""
+            if not trunc or not token:
+                return
+
+    def delete_tenant_object(self, tenant: str, name: str) -> None:
+        try:
+            self._request("DELETE", self._url(self._key(f"{tenant}/{name}")))
+        except DoesNotExist:
+            pass

@@ -1,0 +1,75 @@
+"""Stateless search-one-block-shard handler (tempo-serverless analog).
+
+The reference ships a Lambda/Cloud Run handler that searches one shard
+of one backend block per invocation (cmd/tempo-serverless/handler.go:49,
+once-initialised reader). Same contract here: a JSON event naming the
+backend, tenant, block and row-group range; the process holds a cached
+backend + block-reader so warm invocations skip setup.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+
+from .backend import open_backend
+from .block.meta import BlockMeta
+from .block.reader import BackendBlock
+from .db.search import SearchRequest, search_block
+
+_lock = threading.Lock()
+_backends: dict = {}
+_blocks: OrderedDict = OrderedDict()
+_MAX_CACHED_BLOCKS = 64  # LRU cap: warm workers touch many blocks over time
+
+
+def _backend(cfg: dict):
+    key = tuple(sorted((k, str(v)) for k, v in cfg.items()))
+    with _lock:
+        b = _backends.get(key)
+        if b is None:
+            b = _backends[key] = open_backend(cfg)
+        return b
+
+
+def handler(event: dict) -> dict:
+    """event: {backend: {...}, tenant, block_id, groups: [lo, hi) | null,
+    search: {tags, query, minDurationMs, maxDurationMs, start, end, limit}}
+    -> {traces: [...], metrics: {...}}"""
+    backend = _backend(event["backend"])
+    tenant = event["tenant"]
+    block_id = event["block_id"]
+    with _lock:
+        blk = _blocks.get((tenant, block_id))
+        if blk is not None:
+            _blocks.move_to_end((tenant, block_id))
+    if blk is None:
+        from .backend.base import meta_name
+
+        meta = BlockMeta.from_json(backend.read(tenant, block_id, meta_name()))
+        blk = BackendBlock(backend, meta)
+        with _lock:
+            _blocks[(tenant, block_id)] = blk
+            while len(_blocks) > _MAX_CACHED_BLOCKS:
+                _blocks.popitem(last=False)
+
+    s = event.get("search", {})
+    req = SearchRequest(
+        tags=s.get("tags", {}),
+        query=s.get("query", ""),
+        min_duration_ms=s.get("minDurationMs", 0),
+        max_duration_ms=s.get("maxDurationMs", 0),
+        start=s.get("start", 0),
+        end=s.get("end", 0),
+        limit=s.get("limit", 20),
+    )
+    groups = event.get("groups")
+    groups_range = list(range(groups[0], groups[1])) if groups else None
+    resp = search_block(blk, req, groups_range=groups_range)
+    return {
+        "traces": [t.to_dict() for t in resp.traces],
+        "metrics": {
+            "inspectedBytes": resp.inspected_bytes,
+            "inspectedSpans": resp.inspected_spans,
+        },
+    }

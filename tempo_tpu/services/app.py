@@ -61,6 +61,9 @@ class AppConfig:
     kv_dir: str = ""
     advertise_addr: str = ""
     http_host: str = ""  # default: loopback, or 0.0.0.0 when advertising non-loopback
+    # shared secret for /internal/* and remote /flush//shutdown when the
+    # server is reachable beyond loopback
+    internal_token: str = ""
 
 
 class App:
@@ -112,12 +115,13 @@ class App:
         from ..transport import client_registry
 
         self._clients: dict[str, object] = {}
-        self.client_for = client_registry(self._clients)
+        self.client_for = client_registry(self._clients, token=cfg.internal_token)
 
         self.ingester = self.lifecycler = None
         if has("ingester"):
             self.ingester = Ingester(WAL(wal_path), self.db, self.overrides, cfg.ingester)
             self.ingester.replay_wal()
+            self._warn_orphan_wals(os.path.dirname(wal_path), cfg.instance_id)
             self.lifecycler = Lifecycler(self.kv, INGESTER_RING, cfg.instance_id,
                                          addr=cfg.advertise_addr)
             self._clients[self.lifecycler.desc.addr] = self.ingester
@@ -193,6 +197,26 @@ class App:
             return bool(self.ring.healthy_instances())
         return True
 
+    @staticmethod
+    def _warn_orphan_wals(wal_root: str, instance_id: str) -> None:
+        """WAL dirs are per --instance.id; a renamed instance would silently
+        strand its predecessor's unflushed data, so surface any sibling
+        WAL dir that still holds files."""
+        import logging
+
+        try:
+            entries = os.listdir(wal_root)
+        except OSError:
+            return
+        for name in entries:
+            p = os.path.join(wal_root, name)
+            if name != instance_id and os.path.isdir(p) and os.listdir(p):
+                logging.getLogger("tempo_tpu").warning(
+                    "orphaned WAL dir %s holds unreplayed files from instance %r; "
+                    "restart with --instance.id %s to replay it",
+                    p, name, name,
+                )
+
     # ------------------------------------------------------------ tenant
     def tenant_of(self, headers) -> str:
         if not self.cfg.multitenancy:
@@ -238,6 +262,14 @@ def _make_handler(app: App):
 
         def _err(self, code: int, msg: str):
             self._send(code, json.dumps({"error": msg}))
+
+        def _authorized_internal(self) -> bool:
+            """Operational + internal endpoints: loopback peers are always
+            trusted; remote peers must present the shared token."""
+            if self.client_address[0] in ("127.0.0.1", "::1"):
+                return True
+            tok = app.cfg.internal_token
+            return bool(tok) and self.headers.get("X-Tempo-Internal-Token", "") == tok
 
         # ----------------------------------------------------------- GET
         def do_GET(self):
@@ -323,6 +355,8 @@ def _make_handler(app: App):
             body = self.rfile.read(ln) if ln else b""
             try:
                 if u.path.startswith("/internal/"):
+                    if not self._authorized_internal():
+                        return self._err(401, "missing or wrong internal token")
                     from ..transport.client import handle_internal
 
                     code, out = handle_internal(app, u.path, json.loads(body or b"{}"))
@@ -341,10 +375,14 @@ def _make_handler(app: App):
                     app.distributor.push(tenant, tr.resource_spans)
                     return self._send(200, "{}")
                 if u.path == "/flush":
+                    if not self._authorized_internal():
+                        return self._err(401, "missing or wrong internal token")
                     if app.ingester:
                         app.ingester.flush_all()
                     return self._send(204, "")
                 if u.path == "/shutdown":
+                    if not self._authorized_internal():
+                        return self._err(401, "missing or wrong internal token")
                     if app.ingester:
                         app.ingester.flush_all()
                     threading.Thread(target=app.stop, daemon=True).start()
@@ -405,6 +443,8 @@ def main(argv=None):
                     help="address other processes reach this one at (http://host:port)")
     ap.add_argument("--instance.id", dest="instance_id", default="")
     ap.add_argument("--replication.factor", dest="rf", type=int, default=1)
+    ap.add_argument("--internal.token", dest="internal_token", default="",
+                    help="shared secret for /internal/* when bound beyond loopback")
     args = ap.parse_args(argv)
     cfg = AppConfig(
         target=args.target,
@@ -416,6 +456,7 @@ def main(argv=None):
         advertise_addr=args.advertise or f"http://127.0.0.1:{args.port}",
         instance_id=args.instance_id or f"tempo-{args.port}",
         replication_factor=args.rf,
+        internal_token=args.internal_token,
     )
     app = App(cfg)
     app.start()

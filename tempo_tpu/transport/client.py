@@ -30,15 +30,19 @@ class TransportError(Exception):
 
 
 class HTTPIngesterClient:
-    def __init__(self, addr: str, timeout: float = 10.0):
+    def __init__(self, addr: str, timeout: float = 10.0, token: str = ""):
         self.addr = addr.rstrip("/")
         self.timeout = timeout
+        self.token = token
 
     def _post(self, path: str, payload: dict) -> dict:
+        headers = {"Content-Type": "application/json"}
+        if self.token:
+            headers["X-Tempo-Internal-Token"] = self.token
         req = urllib.request.Request(
             self.addr + path,
             data=json.dumps(payload).encode(),
-            headers={"Content-Type": "application/json"},
+            headers=headers,
         )
         try:
             with urllib.request.urlopen(req, timeout=self.timeout) as r:
@@ -106,7 +110,7 @@ class HTTPIngesterClient:
         return resp
 
 
-def client_registry(local: dict):
+def client_registry(local: dict, token: str = ""):
     """addr -> client resolver: in-process objects first, HTTP for the rest."""
     cache: dict[str, HTTPIngesterClient] = {}
 
@@ -116,7 +120,7 @@ def client_registry(local: dict):
         if addr.startswith("http://") or addr.startswith("https://"):
             c = cache.get(addr)
             if c is None:
-                c = cache[addr] = HTTPIngesterClient(addr)
+                c = cache[addr] = HTTPIngesterClient(addr, token=token)
             return c
         raise KeyError(f"unknown instance addr {addr!r}")
 

@@ -1,0 +1,142 @@
+"""tempo-vulture equivalent: black-box write/read consistency checker.
+
+The reference's vulture (cmd/tempo-vulture) runs beside a cluster,
+pushes known traces, reads them back by id and via search, and emits
+404 / missing-span metrics that alerting watches (SURVEY.md 2.1, 4.7).
+
+Run: python -m tempo_tpu.vulture --push-url http://host:3200 \
+        --query-url http://host:3200 --cycles 10 --interval 5
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import random
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+from dataclasses import dataclass, field
+
+from .util.testdata import make_trace, make_trace_id
+from .wire import otlp_json
+
+
+@dataclass
+class VultureMetrics:
+    requests: int = 0
+    notfound_byid: int = 0
+    missing_spans: int = 0
+    notfound_search: int = 0
+    errors: int = 0
+
+    def lines(self) -> list[str]:
+        return [
+            f"tempo_vulture_trace_total {self.requests}",
+            f"tempo_vulture_notfound_byid_total {self.notfound_byid}",
+            f"tempo_vulture_missing_spans_total {self.missing_spans}",
+            f"tempo_vulture_notfound_search_total {self.notfound_search}",
+            f"tempo_vulture_error_total {self.errors}",
+        ]
+
+
+class Vulture:
+    def __init__(self, push_url: str, query_url: str, tenant_header: str | None = None,
+                 read_back_delay_s: float = 1.0, seed: int | None = None):
+        self.push_url = push_url.rstrip("/")
+        self.query_url = query_url.rstrip("/")
+        self.tenant_header = tenant_header
+        self.read_back_delay_s = read_back_delay_s
+        self.rng = random.Random(seed)
+        self.metrics = VultureMetrics()
+
+    def _headers(self):
+        h = {"Content-Type": "application/json"}
+        if self.tenant_header:
+            h["X-Scope-OrgID"] = self.tenant_header
+        return h
+
+    def cycle(self) -> bool:
+        """One write->read->search round. True if fully consistent."""
+        self.metrics.requests += 1
+        tid = make_trace_id(self.rng)
+        tr = make_trace(self.rng, trace_id=tid, n_spans=4,
+                        base_time_ns=time.time_ns())
+        ok = True
+        try:
+            req = urllib.request.Request(
+                self.push_url + "/v1/traces",
+                data=otlp_json.dumps(tr).encode(), headers=self._headers(),
+            )
+            urllib.request.urlopen(req, timeout=10)
+        except (urllib.error.URLError, OSError):
+            self.metrics.errors += 1
+            return False
+
+        time.sleep(self.read_back_delay_s)
+
+        try:
+            with urllib.request.urlopen(
+                urllib.request.Request(
+                    f"{self.query_url}/api/traces/{tid.hex()}", headers=self._headers()
+                ),
+                timeout=10,
+            ) as r:
+                got = otlp_json.loads(r.read())
+            if got.span_count() < tr.span_count():
+                self.metrics.missing_spans += tr.span_count() - got.span_count()
+                ok = False
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                self.metrics.notfound_byid += 1
+                ok = False
+            else:
+                self.metrics.errors += 1
+                return False
+        except (urllib.error.URLError, OSError):
+            self.metrics.errors += 1
+            return False
+
+        # search leg: the trace must be findable by its root service name
+        svc = next(iter(tr.all_spans()))[0].service_name
+        try:
+            q = urllib.parse.quote(f"service.name={svc}")
+            with urllib.request.urlopen(
+                urllib.request.Request(
+                    f"{self.query_url}/api/search?tags={q}&limit=200", headers=self._headers()
+                ),
+                timeout=10,
+            ) as r:
+                hits = {t["traceID"] for t in json.loads(r.read())["traces"]}
+            if tid.hex() not in hits:
+                self.metrics.notfound_search += 1
+                ok = False
+        except (urllib.error.URLError, OSError):
+            self.metrics.errors += 1
+            return False
+        return ok
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="tempo-tpu-vulture")
+    ap.add_argument("--push-url", default="http://127.0.0.1:3200")
+    ap.add_argument("--query-url", default="http://127.0.0.1:3200")
+    ap.add_argument("--tenant", default="")
+    ap.add_argument("--cycles", type=int, default=0, help="0 = forever")
+    ap.add_argument("--interval", type=float, default=5.0)
+    ap.add_argument("--read-back-delay", type=float, default=1.0)
+    args = ap.parse_args(argv)
+    v = Vulture(args.push_url, args.query_url, args.tenant or None,
+                read_back_delay_s=args.read_back_delay)
+    n = 0
+    while args.cycles == 0 or n < args.cycles:
+        v.cycle()
+        n += 1
+        print("\n".join(v.metrics.lines()), flush=True)
+        if args.cycles == 0 or n < args.cycles:
+            time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    main()
