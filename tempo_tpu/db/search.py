@@ -21,6 +21,7 @@ from ..traceql.plan import plan_search_request
 from ..util.distinct import DistinctStringCollector
 
 DEFAULT_LIMIT = 20
+_STREAM_MIN_GROUPS = 8  # blocks larger than this stream chunks through device
 
 _INTRINSIC_NAME = "name"
 _WELL_KNOWN_RES = {
@@ -143,20 +144,36 @@ def search_block(
     planned = _plan_for_block(blk, req)
     if planned.prune:
         return resp
-    staged = stage_block(blk, required_columns(planned.conds), groups=groups_range)
     operands = Operands.build(planned.rows, planned.tables or None)
-    _, trace_mask, counts = eval_block(
-        (planned.tree, planned.conds),
-        staged.cols,
-        operands,
-        staged.n_spans,
-        staged.n_traces,
-        staged.n_spans_b,
-        staged.n_res_b,
-        staged.n_traces_b,
+    needed = required_columns(planned.conds)
+    span_ax = blk.pack.axes.get("span")
+    n_groups = len(groups_range) if groups_range is not None else (
+        span_ax.n_groups if span_ax else 1
     )
-    counts = np.asarray(counts)
-    sids = np.nonzero(np.asarray(trace_mask)[: staged.n_traces])[0]
+    if n_groups > _STREAM_MIN_GROUPS:
+        # large scan: stream row-group chunks, prefetching the next chunk's
+        # IO while the device filters the current one (ops/stream.py)
+        from ..ops.stream import eval_block_streamed
+
+        trace_mask, counts, n_spans_seen = eval_block_streamed(
+            blk, needed, (planned.tree, planned.conds), operands, groups=groups_range
+        )
+        sids = np.nonzero(trace_mask)[0]
+    else:
+        staged = stage_block(blk, needed, groups=groups_range)
+        _, trace_mask, counts = eval_block(
+            (planned.tree, planned.conds),
+            staged.cols,
+            operands,
+            staged.n_spans,
+            staged.n_traces,
+            staged.n_spans_b,
+            staged.n_res_b,
+            staged.n_traces_b,
+        )
+        counts = np.asarray(counts)
+        n_spans_seen = staged.n_spans
+        sids = np.nonzero(np.asarray(trace_mask)[: staged.n_traces])[0]
     if planned.needs_verify and req.query and len(sids):
         # device filter was conservative (clamped encodings / mixed OR):
         # exact host re-check of each candidate (hosteval.py)
@@ -172,7 +189,7 @@ def search_block(
     results = _verify_and_build(blk, req, sids, counts)
     results.sort(key=lambda r: -r.start_time_unix_nano)
     resp.traces = results[: req.limit]
-    resp.inspected_spans = staged.n_spans
+    resp.inspected_spans = n_spans_seen
     resp.inspected_bytes = blk.pack.bytes_read
     return resp
 

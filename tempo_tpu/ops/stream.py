@@ -1,0 +1,134 @@
+"""Pipelined row-group streaming: overlap host IO with device compute.
+
+The long-context story (SURVEY.md 5.7): a block's span axis is the
+"sequence", row groups are its chunks. Like ring attention streams KV
+blocks through device memory while the next block prefetches, the
+streamed search pipeline stages row-group chunk N+1 (backend range
+reads + decompression + padding) on a background thread while the
+filter kernel evaluates chunk N on device -- the role of the
+reference's prefetch iterators (vparquet/prefetch_iterator.go,
+v2/iterator_prefetch.go), with the device as the consumer.
+
+Chunks share one padded shape bucket, so every chunk reuses the same
+compiled program (ops/filter's lru-cached jit).
+
+Cross-chunk correctness: a trace's spans can straddle chunk boundaries,
+so evaluating the FULL trace-level tree per chunk and OR-ing masks
+would drop traces whose AND-of-tracify legs hit in different chunks.
+Instead each trace-level LEAF (a tracify subtree or a trace-axis cond)
+aggregates across chunks first -- tracify leaves OR their per-chunk
+trace hits, trace-cond leaves are chunk-invariant -- and the boolean
+skeleton combines the aggregated leaf vectors on host.
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+from ..block.reader import BackendBlock
+from .filter import Operands, eval_block, normalize_tree
+from .stage import stage_block
+
+DEFAULT_GROUPS_PER_CHUNK = 4
+
+_prefetch_pool = ThreadPoolExecutor(max_workers=2, thread_name_prefix="stream-prefetch")
+
+
+def _chunks(n: int, per: int) -> list[list[int]]:
+    return [list(range(i, min(i + per, n))) for i in range(0, n, per)]
+
+
+def _split_leaves(tree):
+    """Trace-level tree -> (skeleton, leaves). Leaves are tracify
+    subtrees or trace-cond nodes; skeleton nodes are ('and'|'or', ...)
+    over ('leaf', j)."""
+    leaves: list = []
+
+    def walk(t):
+        if t[0] in ("tracify", "cond"):
+            leaves.append(t)
+            return ("leaf", len(leaves) - 1)
+        return (t[0],) + tuple(walk(ch) for ch in t[1:])
+
+    return walk(tree), leaves
+
+
+def eval_block_streamed(
+    blk: BackendBlock,
+    needed: list[str],
+    tree_conds,
+    operands: Operands,
+    groups: list[int] | None = None,
+    groups_per_chunk: int = DEFAULT_GROUPS_PER_CHUNK,
+):
+    """Evaluate a condition tree over a block by streaming row-group
+    chunks through the device. Returns (trace_mask (n_traces,),
+    span_count (n_traces,), n_spans_seen) as numpy."""
+    tree, conds = tree_conds
+    if tree is not None:
+        tree = normalize_tree(tree, conds)
+        skeleton, leaves = _split_leaves(tree)
+        # union-of-span-subtrees tree for per-trace matched-span counts
+        span_subs = [lf[1] for lf in leaves if lf[0] == "tracify"]
+        if span_subs:
+            count_tree = ("tracify", span_subs[0] if len(span_subs) == 1
+                          else ("or",) + tuple(span_subs))
+        else:
+            count_tree = None
+    else:
+        skeleton, leaves, count_tree = None, [], None
+
+    span_ax = blk.pack.axes.get("span")
+    all_groups = groups if groups is not None else list(
+        range(span_ax.n_groups if span_ax else 1)
+    )
+    chunk_groups = [[all_groups[i] for i in c]
+                    for c in _chunks(len(all_groups), groups_per_chunk)]
+
+    n_traces = blk.meta.total_traces
+    leaf_hits = [np.zeros(n_traces, dtype=bool) for _ in leaves]
+    counts = np.zeros(n_traces, dtype=np.int64)
+    n_spans_seen = 0
+
+    def run_tree(t, staged):
+        _, tm, sc = eval_block(
+            (t, conds), staged.cols, operands,
+            staged.n_spans, staged.n_traces,
+            staged.n_spans_b, staged.n_res_b, staged.n_traces_b,
+        )
+        return np.asarray(tm)[:n_traces], np.asarray(sc)[:n_traces]
+
+    nxt = _prefetch_pool.submit(stage_block, blk, needed, chunk_groups[0])
+    for ci in range(len(chunk_groups)):
+        staged = nxt.result()
+        if ci + 1 < len(chunk_groups):
+            nxt = _prefetch_pool.submit(stage_block, blk, needed, chunk_groups[ci + 1])
+        if tree is None:
+            tm, sc = run_tree(None, staged)
+            counts += sc
+        else:
+            for j, leaf in enumerate(leaves):
+                if leaf[0] == "cond" and ci > 0:
+                    continue  # trace-axis conds are chunk-invariant
+                tm, _ = run_tree(leaf, staged)
+                leaf_hits[j] |= tm
+            _, sc = run_tree(count_tree, staged)
+            counts += sc
+        n_spans_seen += staged.n_spans
+
+    if tree is None:
+        trace_mask = counts > 0
+    else:
+        def ev(sk):
+            if sk[0] == "leaf":
+                return leaf_hits[sk[1]]
+            vals = [ev(ch) for ch in sk[1:]]
+            out = vals[0]
+            for v in vals[1:]:
+                out = (out & v) if sk[0] == "and" else (out | v)
+            return out
+
+        trace_mask = ev(skeleton)
+    return trace_mask, np.where(trace_mask, counts, 0), n_spans_seen

@@ -23,8 +23,12 @@ _blocks: OrderedDict = OrderedDict()
 _MAX_CACHED_BLOCKS = 64  # LRU cap: warm workers touch many blocks over time
 
 
+def _backend_key(cfg: dict) -> tuple:
+    return tuple(sorted((k, str(v)) for k, v in cfg.items()))
+
+
 def _backend(cfg: dict):
-    key = tuple(sorted((k, str(v)) for k, v in cfg.items()))
+    key = _backend_key(cfg)
     with _lock:
         b = _backends.get(key)
         if b is None:
@@ -39,17 +43,20 @@ def handler(event: dict) -> dict:
     backend = _backend(event["backend"])
     tenant = event["tenant"]
     block_id = event["block_id"]
+    # keyed by backend too: a warm worker may serve events naming
+    # different buckets for the same (tenant, block id)
+    cache_key = (_backend_key(event["backend"]), tenant, block_id)
     with _lock:
-        blk = _blocks.get((tenant, block_id))
+        blk = _blocks.get(cache_key)
         if blk is not None:
-            _blocks.move_to_end((tenant, block_id))
+            _blocks.move_to_end(cache_key)
     if blk is None:
         from .backend.base import meta_name
 
         meta = BlockMeta.from_json(backend.read(tenant, block_id, meta_name()))
         blk = BackendBlock(backend, meta)
         with _lock:
-            _blocks[(tenant, block_id)] = blk
+            _blocks[cache_key] = blk
             while len(_blocks) > _MAX_CACHED_BLOCKS:
                 _blocks.popitem(last=False)
 

@@ -50,7 +50,7 @@ class AppConfig:
     wal_path: str = ""
     overrides_path: str = ""
     multitenancy: bool = False
-    instance_id: str = "tempo-1"
+    instance_id: str = ""  # empty = derive tempo-<http_port>
     replication_factor: int = 1
     ingester: IngesterConfig = field(default_factory=IngesterConfig)
     compaction_cycle_s: float = 30.0
@@ -80,6 +80,8 @@ class App:
             )
         if cfg.target not in self.VALID_TARGETS:
             raise ValueError(f"unknown target {cfg.target!r}; one of {self.VALID_TARGETS}")
+        if not cfg.instance_id:
+            cfg.instance_id = f"tempo-{cfg.http_port}"
         self.cfg = cfg
 
         def has(role: str) -> bool:
@@ -94,6 +96,7 @@ class App:
             )
         # per-instance WAL dir: ingesters sharing --storage.path must never
         # replay (and delete) each other's live WAL files
+        default_wal_layout = not cfg.wal_path
         wal_path = cfg.wal_path or os.path.join(cfg.storage_path, "wal", cfg.instance_id)
         self.db = TempoDB(
             TempoDBConfig(
@@ -121,7 +124,10 @@ class App:
         if has("ingester"):
             self.ingester = Ingester(WAL(wal_path), self.db, self.overrides, cfg.ingester)
             self.ingester.replay_wal()
-            self._warn_orphan_wals(os.path.dirname(wal_path), cfg.instance_id)
+            if default_wal_layout:
+                # only the per-instance layout has meaningful siblings; an
+                # explicit --wal.path may live beside unrelated directories
+                self._warn_orphan_wals(os.path.dirname(wal_path), cfg.instance_id)
             self.lifecycler = Lifecycler(self.kv, INGESTER_RING, cfg.instance_id,
                                          addr=cfg.advertise_addr)
             self._clients[self.lifecycler.desc.addr] = self.ingester
@@ -430,8 +436,28 @@ def _config_dict(cfg: AppConfig) -> dict:
     return asdict(cfg)
 
 
+def load_config_file(path: str) -> dict:
+    """YAML config root. Precedence: YAML supplies the base, explicitly
+    set command-line flags override it (no env-var layer). Keys mirror
+    AppConfig fields; unknown keys are rejected so typos fail loudly
+    like the reference's strict YAML."""
+    import yaml
+    from dataclasses import fields as dc_fields
+
+    with open(path) as f:
+        data = yaml.safe_load(f) or {}
+    valid = {f.name for f in dc_fields(AppConfig)}
+    unknown = set(data) - valid - {"ingester"}
+    if unknown:
+        raise ValueError(f"unknown config keys: {sorted(unknown)}")
+    if "ingester" in data:
+        data["ingester"] = IngesterConfig(**(data["ingester"] or {}))
+    return data
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(prog="tempo-tpu")
+    ap.add_argument("--config.file", dest="config_file", default="")
     ap.add_argument("--target", default="all")
     ap.add_argument("--http.port", dest="port", type=int, default=3200)
     ap.add_argument("--storage.path", dest="storage", default="./tempo-data")
@@ -446,18 +472,23 @@ def main(argv=None):
     ap.add_argument("--internal.token", dest="internal_token", default="",
                     help="shared secret for /internal/* when bound beyond loopback")
     args = ap.parse_args(argv)
-    cfg = AppConfig(
-        target=args.target,
-        http_port=args.port,
-        storage_path=args.storage,
-        overrides_path=args.overrides,
-        multitenancy=args.multitenancy,
-        kv_dir=args.kv_dir,
-        advertise_addr=args.advertise or f"http://127.0.0.1:{args.port}",
-        instance_id=args.instance_id or f"tempo-{args.port}",
-        replication_factor=args.rf,
-        internal_token=args.internal_token,
-    )
+    base = load_config_file(args.config_file) if args.config_file else {}
+    flag_vals = {
+        "target": args.target if args.target != "all" else None,
+        "http_port": args.port if args.port != 3200 else None,
+        "storage_path": args.storage if args.storage != "./tempo-data" else None,
+        "overrides_path": args.overrides or None,
+        "multitenancy": args.multitenancy or None,
+        "kv_dir": args.kv_dir or None,
+        "advertise_addr": args.advertise or None,
+        "instance_id": args.instance_id or None,
+        "replication_factor": args.rf if args.rf != 1 else None,
+        "internal_token": args.internal_token or None,
+    }
+    base.update({k: v for k, v in flag_vals.items() if v is not None})
+    cfg = AppConfig(**base)
+    if not cfg.advertise_addr:
+        cfg.advertise_addr = f"http://127.0.0.1:{cfg.http_port}"
     app = App(cfg)
     app.start()
     print(f"tempo-tpu target={cfg.target} listening on :{cfg.http_port}")
