@@ -33,7 +33,13 @@ from .stage import stage_block
 
 DEFAULT_GROUPS_PER_CHUNK = 4
 
-_prefetch_pool = ThreadPoolExecutor(max_workers=2, thread_name_prefix="stream-prefetch")
+import os as _os
+
+# sized for concurrent streamed searches (the frontend dispatches many
+# jobs at once); each pipeline keeps at most one prefetch in flight
+_prefetch_pool = ThreadPoolExecutor(
+    max_workers=max(4, (_os.cpu_count() or 8) // 2), thread_name_prefix="stream-prefetch"
+)
 
 
 def _chunks(n: int, per: int) -> list[list[int]]:
@@ -100,23 +106,30 @@ def eval_block_streamed(
         )
         return np.asarray(tm)[:n_traces], np.asarray(sc)[:n_traces]
 
+    single_tracify = sum(1 for lf in leaves if lf[0] == "tracify") == 1
     nxt = _prefetch_pool.submit(stage_block, blk, needed, chunk_groups[0])
-    for ci in range(len(chunk_groups)):
-        staged = nxt.result()
-        if ci + 1 < len(chunk_groups):
-            nxt = _prefetch_pool.submit(stage_block, blk, needed, chunk_groups[ci + 1])
-        if tree is None:
-            tm, sc = run_tree(None, staged)
-            counts += sc
-        else:
-            for j, leaf in enumerate(leaves):
-                if leaf[0] == "cond" and ci > 0:
-                    continue  # trace-axis conds are chunk-invariant
-                tm, _ = run_tree(leaf, staged)
-                leaf_hits[j] |= tm
-            _, sc = run_tree(count_tree, staged)
-            counts += sc
-        n_spans_seen += staged.n_spans
+    try:
+        for ci in range(len(chunk_groups)):
+            staged = nxt.result()
+            if ci + 1 < len(chunk_groups):
+                nxt = _prefetch_pool.submit(stage_block, blk, needed, chunk_groups[ci + 1])
+            if tree is None:
+                tm, sc = run_tree(None, staged)
+                counts += sc
+            else:
+                for j, leaf in enumerate(leaves):
+                    if leaf[0] == "cond" and ci > 0:
+                        continue  # trace-axis conds are chunk-invariant
+                    tm, sc = run_tree(leaf, staged)
+                    leaf_hits[j] |= tm
+                    if single_tracify and leaf[0] == "tracify":
+                        counts += sc  # the union IS this leaf: no extra pass
+                if not single_tracify:
+                    _, sc = run_tree(count_tree, staged)
+                    counts += sc
+            n_spans_seen += staged.n_spans
+    finally:
+        nxt.cancel()  # abandoned prefetch on error mustn't leak device work
 
     if tree is None:
         trace_mask = counts > 0

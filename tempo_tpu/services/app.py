@@ -298,6 +298,15 @@ def _make_handler(app: App):
                 m = re.fullmatch(r"/api/traces/([0-9a-fA-F]+)", u.path)
                 if m:
                     return self._trace_by_id(tenant, m.group(1), q)
+                m = re.fullmatch(r"/jaeger/api/traces/([0-9a-fA-F]+)", u.path)
+                if m:  # tempo-query shim: Jaeger UI JSON
+                    from ..util.traceid import parse_trace_id
+                    from ..wire.jaeger import trace_to_jaeger
+
+                    tr = app.frontend.find_trace_by_id(tenant, parse_trace_id(m.group(1)))
+                    if tr is None:
+                        return self._err(404, "trace not found")
+                    return self._send(200, json.dumps(trace_to_jaeger(tr)))
                 if u.path == "/api/search":
                     return self._search(tenant, q)
                 if u.path == "/api/search/tags":
@@ -380,6 +389,14 @@ def _make_handler(app: App):
                         tr = otlp_pb.decode_trace(body)
                     app.distributor.push(tenant, tr.resource_spans)
                     return self._send(200, "{}")
+                if u.path == "/api/v2/spans":  # Zipkin v2 JSON ingest
+                    if app.distributor is None:
+                        return self._err(404, f"target {app.cfg.target} does not ingest")
+                    from ..wire import zipkin
+
+                    tenant = app.tenant_of(self.headers)
+                    app.distributor.push(tenant, zipkin.decode_spans(body))
+                    return self._send(202, "")
                 if u.path == "/flush":
                     if not self._authorized_internal():
                         return self._err(401, "missing or wrong internal token")
@@ -457,33 +474,35 @@ def load_config_file(path: str) -> dict:
 
 def main(argv=None):
     ap = argparse.ArgumentParser(prog="tempo-tpu")
+    # None defaults = "flag not given"; a flag the user set ALWAYS overrides
+    # the config file, even when set to the built-in default value
     ap.add_argument("--config.file", dest="config_file", default="")
-    ap.add_argument("--target", default="all")
-    ap.add_argument("--http.port", dest="port", type=int, default=3200)
-    ap.add_argument("--storage.path", dest="storage", default="./tempo-data")
-    ap.add_argument("--overrides.path", dest="overrides", default="")
-    ap.add_argument("--multitenancy", action="store_true")
-    ap.add_argument("--kv.dir", dest="kv_dir", default="",
+    ap.add_argument("--target", default=None)
+    ap.add_argument("--http.port", dest="port", type=int, default=None)
+    ap.add_argument("--storage.path", dest="storage", default=None)
+    ap.add_argument("--overrides.path", dest="overrides", default=None)
+    ap.add_argument("--multitenancy", action="store_const", const=True, default=None)
+    ap.add_argument("--kv.dir", dest="kv_dir", default=None,
                     help="shared ring-KV directory for multi-process topologies")
-    ap.add_argument("--advertise.addr", dest="advertise", default="",
+    ap.add_argument("--advertise.addr", dest="advertise", default=None,
                     help="address other processes reach this one at (http://host:port)")
-    ap.add_argument("--instance.id", dest="instance_id", default="")
-    ap.add_argument("--replication.factor", dest="rf", type=int, default=1)
-    ap.add_argument("--internal.token", dest="internal_token", default="",
+    ap.add_argument("--instance.id", dest="instance_id", default=None)
+    ap.add_argument("--replication.factor", dest="rf", type=int, default=None)
+    ap.add_argument("--internal.token", dest="internal_token", default=None,
                     help="shared secret for /internal/* when bound beyond loopback")
     args = ap.parse_args(argv)
     base = load_config_file(args.config_file) if args.config_file else {}
     flag_vals = {
-        "target": args.target if args.target != "all" else None,
-        "http_port": args.port if args.port != 3200 else None,
-        "storage_path": args.storage if args.storage != "./tempo-data" else None,
-        "overrides_path": args.overrides or None,
-        "multitenancy": args.multitenancy or None,
-        "kv_dir": args.kv_dir or None,
-        "advertise_addr": args.advertise or None,
-        "instance_id": args.instance_id or None,
-        "replication_factor": args.rf if args.rf != 1 else None,
-        "internal_token": args.internal_token or None,
+        "target": args.target,
+        "http_port": args.port,
+        "storage_path": args.storage,
+        "overrides_path": args.overrides,
+        "multitenancy": args.multitenancy,
+        "kv_dir": args.kv_dir,
+        "advertise_addr": args.advertise,
+        "instance_id": args.instance_id,
+        "replication_factor": args.rf,
+        "internal_token": args.internal_token,
     }
     base.update({k: v for k, v in flag_vals.items() if v is not None})
     cfg = AppConfig(**base)

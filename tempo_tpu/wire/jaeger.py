@@ -1,0 +1,78 @@
+"""Jaeger UI JSON encoding of a trace (tempo-query analog).
+
+The reference ships tempo-query, a Jaeger storage-plugin shim that lets
+the Jaeger UI read traces from Tempo (cmd/tempo-query). Here the same
+capability is the /jaeger/api/traces/{id} endpoint encoding the wire
+model in the Jaeger HTTP API's JSON shape ({data:[{traceID, spans,
+processes}]}, public API format).
+"""
+
+from __future__ import annotations
+
+from .model import SpanKind, StatusCode, Trace
+
+_KIND_TAG = {
+    SpanKind.CLIENT: "client",
+    SpanKind.SERVER: "server",
+    SpanKind.PRODUCER: "producer",
+    SpanKind.CONSUMER: "consumer",
+}
+
+
+def _tag(key: str, value) -> dict:
+    if isinstance(value, bool):
+        return {"key": key, "type": "bool", "value": value}
+    if isinstance(value, int):
+        return {"key": key, "type": "int64", "value": value}
+    if isinstance(value, float):
+        return {"key": key, "type": "float64", "value": value}
+    return {"key": key, "type": "string", "value": str(value)}
+
+
+def trace_to_jaeger(tr: Trace) -> dict:
+    """-> the Jaeger HTTP API response body for one trace."""
+    tid_hex = tr.trace_id().hex()
+    processes: dict[str, dict] = {}
+    proc_ids: dict[tuple, str] = {}
+    spans = []
+    for res, scope, sp in tr.all_spans():
+        pkey = tuple(sorted((k, str(v)) for k, v in res.attrs.items()))
+        pid = proc_ids.get(pkey)
+        if pid is None:
+            pid = proc_ids[pkey] = f"p{len(proc_ids) + 1}"
+            processes[pid] = {
+                "serviceName": res.service_name,
+                "tags": [_tag(k, v) for k, v in res.attrs.items() if k != "service.name"],
+            }
+        tags = [_tag(k, v) for k, v in sp.attrs.items()]
+        if sp.kind in _KIND_TAG:
+            tags.append(_tag("span.kind", _KIND_TAG[sp.kind]))
+        if sp.status_code == StatusCode.ERROR:
+            tags.append(_tag("error", True))
+        refs = []
+        if sp.parent_span_id.strip(b"\x00"):
+            refs.append(
+                {"refType": "CHILD_OF", "traceID": tid_hex,
+                 "spanID": sp.parent_span_id.hex()}
+            )
+        spans.append(
+            {
+                "traceID": tid_hex,
+                "spanID": sp.span_id.hex(),
+                "operationName": sp.name,
+                "references": refs,
+                "startTime": sp.start_unix_nano // 1000,  # jaeger: microseconds
+                "duration": max(0, sp.duration_nanos) // 1000,
+                "tags": tags,
+                "logs": [
+                    {
+                        "timestamp": ev.time_unix_nano // 1000,
+                        "fields": [_tag("event", ev.name)]
+                        + [_tag(k, v) for k, v in ev.attrs.items()],
+                    }
+                    for ev in sp.events
+                ],
+                "processID": pid,
+            }
+        )
+    return {"data": [{"traceID": tid_hex, "spans": spans, "processes": processes}]}

@@ -120,3 +120,62 @@ def test_http_e2e(server):
     # span-metrics from the generator tap
     st, body = _get(base, "/metrics")
     assert "traces_spanmetrics_calls_total" in body.decode()
+
+
+def test_zipkin_ingest(server):
+    """Zipkin v2 JSON spans round-trip through the distributor."""
+    app, base = server
+    zipkin_payload = json.dumps([
+        {
+            "traceId": "0af7651916cd43dd8448eb211c80319c",
+            "id": "b7ad6b7169203331",
+            "name": "get /api",
+            "timestamp": 1700000001000000,
+            "duration": 207000,
+            "kind": "SERVER",
+            "localEndpoint": {"serviceName": "zip-frontend"},
+            "tags": {"http.method": "GET", "http.status_code": "200"},
+        },
+        {
+            "traceId": "0af7651916cd43dd8448eb211c80319c",
+            "id": "d2f9288a2904503d",
+            "parentId": "b7ad6b7169203331",
+            "name": "query db",
+            "timestamp": 1700000001010000,
+            "duration": 50000,
+            "kind": "CLIENT",
+            "localEndpoint": {"serviceName": "zip-frontend"},
+            "remoteEndpoint": {"serviceName": "zip-db"},
+        },
+    ]).encode()
+    st, _ = _post(base, "/api/v2/spans", zipkin_payload)
+    assert st == 202
+    st, body = _get(base, "/api/traces/0af7651916cd43dd8448eb211c80319c")
+    assert st == 200
+    got = otlp_json.loads(body)
+    assert got.span_count() == 2
+    spans = {sp.name: (res, sp) for res, _, sp in got.all_spans()}
+    assert spans["get /api"][0].service_name == "zip-frontend"
+    assert spans["get /api"][1].attrs["http.status_code"] == 200
+    assert spans["query db"][1].attrs["peer.service"] == "zip-db"
+    # findable via TraceQL on the converted attrs
+    q = urllib.parse.quote('{ span.http.method = "GET" && resource.service.name = "zip-frontend" }')
+    st, body = _get(base, f"/api/search?q={q}&limit=10")
+    assert "0af7651916cd43dd8448eb211c80319c" in {t["traceID"] for t in json.loads(body)["traces"]}
+
+
+def test_jaeger_query_shim(server):
+    """The tempo-query analog renders Jaeger UI JSON."""
+    app, base = server
+    traces = make_traces(1, seed=123, n_spans=3)
+    tid, tr = traces[0]
+    _post(base, "/v1/traces", otlp_json.dumps(tr).encode())
+    st, body = _get(base, f"/jaeger/api/traces/{tid.hex()}")
+    assert st == 200
+    j = json.loads(body)
+    assert j["data"][0]["traceID"] == tid.hex()
+    assert len(j["data"][0]["spans"]) == 3
+    assert j["data"][0]["processes"]
+    sp = j["data"][0]["spans"][0]
+    assert {"traceID", "spanID", "operationName", "startTime", "duration",
+            "tags", "processID"} <= set(sp)
