@@ -34,9 +34,16 @@ def open_backend(cfg: dict) -> RawBackend:
         )
         return _wrap(inner, cfg)
     if kind == "azure":
-        raise NotImplementedError(
-            "azure backend not implemented; use s3/gcs (S3-compatible REST) or local"
+        from .azure import AzureBackend
+
+        inner = AzureBackend(
+            account=cfg["account"],
+            container=cfg["container"],
+            key=cfg.get("key", ""),
+            endpoint=cfg.get("endpoint", ""),
+            prefix=cfg.get("prefix", ""),
         )
+        return _wrap(inner, cfg)
     raise ValueError(f"unknown backend {kind!r}")
 
 

@@ -1,0 +1,181 @@
+"""Azure Blob Storage backend over the public REST API with SharedKey
+auth (no SDK) -- the role of the reference's azure backend
+(tempodb/backend/azure). Works against Azure and Azurite.
+
+Operations used: Put Blob (BlockBlob), Get Blob (with Range), Delete
+Blob, List Blobs (flat + delimiter). SharedKey signing follows the
+published authorization scheme (HMAC-SHA256 over the canonicalized
+string-to-sign).
+"""
+
+from __future__ import annotations
+
+import base64
+import datetime
+import hashlib
+import hmac
+import urllib.error
+import urllib.parse
+import urllib.request
+import xml.etree.ElementTree as ET
+
+from .base import BackendError, DoesNotExist, RawBackend, block_object_path
+
+_API_VERSION = "2021-08-06"
+
+
+class AzureBackend(RawBackend):
+    def __init__(self, account: str, container: str, key: str = "",
+                 endpoint: str = "", prefix: str = "", timeout: float = 30.0):
+        """endpoint default: https://<account>.blob.core.windows.net; for
+        Azurite pass e.g. http://127.0.0.1:10000/<account>."""
+        self.account = account
+        self.container = container
+        self.key = base64.b64decode(key) if key else b""
+        self.endpoint = (endpoint or f"https://{account}.blob.core.windows.net").rstrip("/")
+        self.prefix = prefix.strip("/")
+        self.timeout = timeout
+
+    # ---------------------------------------------------------------- auth
+    def _sign(self, method: str, url: str, headers: dict, content_len: str,
+              content_type: str) -> str:
+        u = urllib.parse.urlsplit(url)
+        canon_headers = "".join(
+            f"{k}:{headers[k]}\n" for k in sorted(h for h in headers if h.startswith("x-ms-"))
+        )
+        # canonicalized resource: /account/<path>; an azurite-style endpoint
+        # already carries the account as the first path segment
+        if u.path.startswith(f"/{self.account}/"):
+            canon_res = u.path
+        else:
+            canon_res = f"/{self.account}{u.path}"
+        for k, v in sorted(urllib.parse.parse_qsl(u.query)):
+            canon_res += f"\n{k}:{v}"
+        # string-to-sign, 2015-04-05+ scheme: VERB, Content-Encoding,
+        # Content-Language, Content-Length (empty when 0), Content-MD5,
+        # Content-Type, Date, If-*, Range
+        to_sign = "\n".join([
+            method, "", "", content_len, "", content_type, "", "", "", "", "",
+            headers.get("x-ms-range", ""),
+        ]) + "\n" + canon_headers + canon_res
+        sig = base64.b64encode(hmac.new(self.key, to_sign.encode(), hashlib.sha256).digest()).decode()
+        return f"SharedKey {self.account}:{sig}"
+
+    def _request(self, method: str, url: str, data: bytes | None = None,
+                 extra: dict | None = None) -> tuple[int, bytes]:
+        headers = {
+            "x-ms-date": datetime.datetime.now(datetime.timezone.utc).strftime(
+                "%a, %d %b %Y %H:%M:%S GMT"
+            ),
+            "x-ms-version": _API_VERSION,
+        }
+        content_type = ""
+        if data is not None:
+            # pin the type urllib would otherwise inject unsigned
+            content_type = "application/octet-stream"
+            headers["Content-Type"] = content_type
+        if extra:
+            headers.update(extra)
+        content_len = str(len(data)) if data else ""
+        if self.key:
+            headers["Authorization"] = self._sign(method, url, headers, content_len, content_type)
+        req = urllib.request.Request(url, data=data, headers=headers, method=method)
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as r:
+                return r.status, r.read()
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                raise DoesNotExist(url)
+            raise BackendError(f"azure {method} {url}: {e.code} {e.read()[:200]!r}")
+        except urllib.error.URLError as e:
+            raise BackendError(f"azure {method} {url}: {e}")
+
+    # ------------------------------------------------------------- helpers
+    def _key_path(self, path: str) -> str:
+        return f"{self.prefix}/{path}" if self.prefix else path
+
+    def _blob_url(self, key: str) -> str:
+        return f"{self.endpoint}/{self.container}/{urllib.parse.quote(key)}"
+
+    def _container_url(self, query: dict) -> str:
+        return f"{self.endpoint}/{self.container}?" + urllib.parse.urlencode(sorted(query.items()))
+
+    # -------------------------------------------------------------- write
+    def write(self, tenant, block_id, name, data):
+        self._request("PUT", self._blob_url(self._key_path(block_object_path(tenant, block_id, name))),
+                      data, {"x-ms-blob-type": "BlockBlob"})
+
+    def write_tenant_object(self, tenant, name, data):
+        self._request("PUT", self._blob_url(self._key_path(f"{tenant}/{name}")),
+                      data, {"x-ms-blob-type": "BlockBlob"})
+
+    # --------------------------------------------------------------- read
+    def read(self, tenant, block_id, name):
+        return self._request("GET", self._blob_url(self._key_path(block_object_path(tenant, block_id, name))))[1]
+
+    def read_range(self, tenant, block_id, name, offset, length):
+        return self._request(
+            "GET",
+            self._blob_url(self._key_path(block_object_path(tenant, block_id, name))),
+            extra={"x-ms-range": f"bytes={offset}-{offset + length - 1}"},
+        )[1]
+
+    def read_tenant_object(self, tenant, name):
+        return self._request("GET", self._blob_url(self._key_path(f"{tenant}/{name}")))[1]
+
+    # --------------------------------------------------------------- list
+    def _list_prefixes(self, prefix: str) -> list[str]:
+        out, marker = [], ""
+        while True:
+            q = {"restype": "container", "comp": "list", "delimiter": "/", "prefix": prefix}
+            if marker:
+                q["marker"] = marker
+            _, body = self._request("GET", self._container_url(q))
+            root = ET.fromstring(body)
+            for bp in root.iter("BlobPrefix"):
+                name = bp.findtext("Name") or ""
+                name = name[len(prefix):].strip("/")
+                if name:
+                    out.append(name)
+            marker = root.findtext("NextMarker") or ""
+            if not marker:
+                return out
+
+    def tenants(self):
+        return self._list_prefixes(f"{self.prefix}/" if self.prefix else "")
+
+    def blocks(self, tenant):
+        return self._list_prefixes(self._key_path(f"{tenant}/"))
+
+    # ------------------------------------------------------------- delete
+    def _delete_object(self, tenant, block_id, name):
+        try:
+            self._request("DELETE", self._blob_url(self._key_path(block_object_path(tenant, block_id, name))))
+        except DoesNotExist:
+            pass
+
+    def delete_block(self, tenant, block_id):
+        prefix = self._key_path(f"{tenant}/{block_id}/")
+        marker = ""
+        while True:
+            q = {"restype": "container", "comp": "list", "prefix": prefix}
+            if marker:
+                q["marker"] = marker
+            _, body = self._request("GET", self._container_url(q))
+            root = ET.fromstring(body)
+            for b in root.iter("Blob"):
+                name = b.findtext("Name")
+                if name:
+                    try:
+                        self._request("DELETE", self._blob_url(name))
+                    except DoesNotExist:
+                        pass
+            marker = root.findtext("NextMarker") or ""
+            if not marker:
+                return
+
+    def delete_tenant_object(self, tenant, name):
+        try:
+            self._request("DELETE", self._blob_url(self._key_path(f"{tenant}/{name}")))
+        except DoesNotExist:
+            pass
