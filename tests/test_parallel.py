@@ -187,3 +187,35 @@ def test_distributed_query_step_one_jit(mesh):
     for k in range(K):
         expected_union |= blooms[k]
     np.testing.assert_array_equal(np.asarray(bu), expected_union)
+
+
+def test_graft_dryrun_multichip_entry():
+    """Run the exact entry the driver invokes (__graft_entry__.dryrun_multichip)
+    on the virtual 8-device CPU mesh, so a driver-side failure reproduces here."""
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+    try:
+        import __graft_entry__ as graft
+
+        graft.dryrun_multichip(8)
+    finally:
+        sys.path.pop(0)
+
+
+def test_graft_entry_compiles():
+    import sys
+    from pathlib import Path
+
+    import jax
+
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+    try:
+        import __graft_entry__ as graft
+
+        fn, args = graft.entry()
+        sids, mask, counts = jax.jit(fn)(*args)
+        assert sids.shape[0] == args[1].shape[0]
+    finally:
+        sys.path.pop(0)
