@@ -274,7 +274,12 @@ class BlockBuilder:
         self.tr_root_name.append(root_name if root_name is not None else (first_name or 0))
 
     # ------------------------------------------------------------------
-    def finalize(self) -> FinalizedBlock:
+    def finalize(self, bloom: ShardedBloom | None = None) -> FinalizedBlock:
+        """Assemble columns + meta. `bloom` (optional) is a precomputed
+        filter covering every added trace id — compaction passes the
+        device OR-union of the input blocks' filters (ops/bloom_ops.py)
+        instead of re-inserting every id, the analog of the reference
+        rebuilding blooms during merge (vparquet/compactor.go:61-80)."""
         n_spans = len(self.sp_trace_sid)
         n_traces = len(self.tr_ids)
         dictionary, remap = self.dictb.finalize()
@@ -369,11 +374,12 @@ class BlockBuilder:
         m.dict_size = len(dictionary)
         m.row_groups = row_groups
 
-        if self.estimated_traces:
-            bloom = ShardedBloom.for_estimated_items(max(self.estimated_traces, n_traces))
-        else:
-            bloom = ShardedBloom.for_estimated_items(max(n_traces, 1))
-        bloom.add_many(self.tr_ids)
+        if bloom is None:
+            if self.estimated_traces:
+                bloom = ShardedBloom.for_estimated_items(max(self.estimated_traces, n_traces))
+            else:
+                bloom = ShardedBloom.for_estimated_items(max(n_traces, 1))
+            bloom.add_many(self.tr_ids)
         m.bloom_shards = bloom.n_shards
         m.bloom_shard_bits = bloom.shard_bits
 

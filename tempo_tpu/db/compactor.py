@@ -100,6 +100,45 @@ class CompactionResult:
     spans_out: int = 0
 
 
+def _union_input_blooms(blocks: list[BackendBlock]):
+    """Device OR-union of the inputs' bloom filters when geometries match
+    (the north-star sketch union, ops/bloom_ops.py). Valid because the
+    output block's trace-id set is exactly the union of the inputs';
+    duplicate ids merge but never vanish. Returns None on geometry
+    mismatch (caller re-inserts ids instead)."""
+    geos = {(b.meta.bloom_shards, b.meta.bloom_shard_bits) for b in blocks}
+    if len(geos) != 1:
+        return None
+    n_shards, bits = geos.pop()
+    if not n_shards:
+        return None
+    # capacity check: the union holds the SUM of the inputs' id sets in the
+    # inputs' geometry. Only union while that stays within the geometry's
+    # design load (~bits_per_item at the target fp rate), else the filter
+    # saturates across compaction levels -- rebuild sized for the merged
+    # count instead (like the reference's compactor bloom rebuild).
+    import math
+
+    import numpy as np
+
+    from ..block.bloom import DEFAULT_FP_RATE
+
+    bits_per_item = max(1.0, -math.log(DEFAULT_FP_RATE) / (math.log(2) ** 2))
+    total_ids = sum(b.meta.total_traces for b in blocks)
+    if total_ids * bits_per_item > n_shards * bits:
+        return None
+
+    from ..block.bloom import ShardedBloom
+    from ..ops.bloom_ops import union_blooms
+
+    sbs = []
+    for b in blocks:
+        sb = ShardedBloom(n_shards, bits)
+        sb.words = np.stack([b.bloom_shard(i) for i in range(n_shards)])
+        sbs.append(sb)
+    return union_blooms(sbs)
+
+
 def compact(backend: RawBackend, job: CompactionJob, cfg: CompactorConfig) -> CompactionResult:
     """Merge the job's blocks into one output block (wire-level merge;
     the columnar fast path lands in compact_columnar)."""
@@ -140,7 +179,7 @@ def compact(backend: RawBackend, job: CompactionJob, cfg: CompactorConfig) -> Co
         builder.add_trace(tid, combined)
         result.traces_out += 1
 
-    fin = builder.finalize()
+    fin = builder.finalize(bloom=_union_input_blooms(blocks))
     result.spans_out = fin.meta.total_spans
     meta = write_block(backend, fin)
     result.new_blocks = [meta]

@@ -14,8 +14,9 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
+from ..block import schema as S
 from ..block.reader import BackendBlock
-from ..ops.filter import Operands, eval_block, required_columns
+from ..ops.filter import Operands, T_RES, T_SPAN, T_TRACE, eval_block, required_columns
 from ..ops.stage import stage_block
 from ..traceql.plan import plan_search_request
 from ..util.distinct import DistinctStringCollector
@@ -174,24 +175,155 @@ def search_block(
         counts = np.asarray(counts)
         n_spans_seen = staged.n_spans
         sids = np.nonzero(np.asarray(trace_mask)[: staged.n_traces])[0]
-    if planned.needs_verify and req.query and len(sids):
-        # device filter was conservative (clamped encodings / mixed OR):
-        # exact host re-check of each candidate (hosteval.py)
-        from ..traceql.hosteval import trace_matches
-        from ..traceql.parser import parse
-
-        q = parse(req.query)
-        traces = blk.materialize_traces([int(s) for s in sids])
-        sids = np.asarray(
-            [s for s, tr in zip(sids, traces) if tr is not None and trace_matches(q, tr)],
-            dtype=np.int64,
-        )
+    # device filter may be conservative (clamped encodings / mixed OR):
+    # exact host re-check of each candidate (hosteval.py)
+    sids = _verify_candidates(blk, req, sids, planned.needs_verify)
     results = _verify_and_build(blk, req, sids, counts)
     results.sort(key=lambda r: -r.start_time_unix_nano)
     resp.traces = results[: req.limit]
     resp.inspected_spans = n_spans_seen
     resp.inspected_bytes = blk.pack.bytes_read
     return resp
+
+
+# ---- stacked multi-block device search (parallel/search.py)
+
+_DEVICE_SEARCH_MAX_BYTES = 512 << 20  # stacked-column budget before falling back
+
+
+def _verify_candidates(blk: BackendBlock, req: SearchRequest, sids, needs_verify: bool):
+    """Exact host re-check of TraceQL candidates when the device filter
+    was conservative (same step as search_block's verify leg)."""
+    if not (needs_verify and req.query and len(sids)):
+        return sids
+    from ..traceql.hosteval import trace_matches
+    from ..traceql.parser import parse
+
+    q = parse(req.query)
+    traces = blk.materialize_traces([int(s) for s in sids])
+    return np.asarray(
+        [s for s, tr in zip(sids, traces) if tr is not None and trace_matches(q, tr)],
+        dtype=np.int64,
+    )
+
+
+def search_blocks_device(
+    blocks: list[BackendBlock],
+    req: SearchRequest,
+    mesh,
+    default_limit: int = DEFAULT_LIMIT,
+    pool=None,
+) -> SearchResponse | None:
+    """Search many blocks as ONE stacked mesh program: blocks shard over
+    'dp', span rows over 'sp', per-block operands resolved through each
+    block's dictionary (parallel/search.py). The multi-chip analog of the
+    reference's per-block job fan-out (modules/frontend/searchsharding.go
+    + tempodb/pool). Returns None when the query needs the per-block
+    generic-attr path or the stacked columns exceed the device budget --
+    the caller falls back to per-block search_block."""
+    resp = SearchResponse()
+    live: list[tuple[BackendBlock, object]] = []
+    for blk in blocks:
+        if not blk.meta.overlaps_time(req.start, req.end):
+            continue
+        p = _plan_for_block(blk, req)
+        if p.prune:
+            continue
+        if any(c.target not in (T_SPAN, T_RES, T_TRACE) for c in p.conds):
+            return None  # generic-attr tables stay on the per-block path
+        live.append((blk, p))
+    if not live:
+        return resp
+
+    # identical plan structure -> one compiled mesh program per group
+    groups: dict[tuple, list[tuple[BackendBlock, object]]] = {}
+    for blk, p in live:
+        groups.setdefault((p.tree, p.conds), []).append((blk, p))
+
+    limit = req.limit or default_limit
+    results: list[SearchResult] = []
+    for (tree, conds), items in groups.items():
+        got = _search_group_device(items, tree, conds, req, mesh, resp, pool)
+        if got is None:
+            return None
+        results.extend(got)
+    results.sort(key=lambda r: -r.start_time_unix_nano)
+    # replicated partials hit in several blocks: dedupe by trace id, same
+    # as the per-block path's SearchResponse.merge
+    seen: set[str] = set()
+    deduped = []
+    for r in results:
+        if r.trace_id not in seen:
+            seen.add(r.trace_id)
+            deduped.append(r)
+    resp.traces = deduped[:limit]
+    return resp
+
+
+def _search_group_device(items, tree, conds, req: SearchRequest, mesh, resp: SearchResponse,
+                         pool=None):
+    from ..ops.device import PAD_I32, bucket
+    from ..parallel.search import sharded_search
+
+    dp, sp = mesh.shape["dp"], mesh.shape["sp"]
+    needed = required_columns(conds)
+    span_cols = [n for n in needed if n.startswith("span.")]
+    B = len(items)
+    Bp = ((B + dp - 1) // dp) * dp
+    s_max = max(blk.pack.axes[S.AX_SPAN].n_rows for blk, _ in items)
+    S_b = sp * bucket(max(1, -(-max(s_max, 1) // sp)))
+    if Bp * S_b * 4 * max(1, len(span_cols)) > _DEVICE_SEARCH_MAX_BYTES:
+        return None
+    NT_b = bucket(max(max(blk.meta.total_traces for blk, _ in items), 1))
+
+    host: dict[str, np.ndarray] = {}
+
+    def read_block_cols(blk):
+        return {n: blk.pack.read(n) for n in needed}
+
+    if pool is not None:  # overlap per-block column IO, like the host path
+        per_block = list(pool.map(read_block_cols, [blk for blk, _ in items]))
+    else:
+        per_block = [read_block_cols(blk) for blk, _ in items]
+    n_res_per = [
+        max((a.shape[0] for n, a in cols.items() if n.startswith("res.")), default=1)
+        for cols in per_block
+    ]
+    R_b = bucket(max(max(n_res_per), 1))
+    for n in needed:
+        if n.startswith("span."):
+            shape, fill = (Bp, S_b), PAD_I32
+        elif n.startswith("res."):
+            shape, fill = (Bp, R_b), PAD_I32
+        elif n.startswith("trace."):
+            shape, fill = (Bp, NT_b), PAD_I32
+        else:
+            return None  # attr tables never reach here (guarded above)
+        first = per_block[0][n]
+        if first.dtype not in (np.int32, np.float32):
+            return None
+        out = np.full(shape, fill if first.dtype == np.int32 else np.float32(0), dtype=first.dtype)
+        for bi, cols in enumerate(per_block):
+            a = cols[n]
+            out[bi, : a.shape[0]] = a
+        host[n] = out
+
+    n_spans = np.zeros((Bp,), dtype=np.int32)
+    for bi, (blk, _) in enumerate(items):
+        n_spans[bi] = blk.pack.axes[S.AX_SPAN].n_rows
+    operands = [Operands.build(p.rows, p.tables or None) for _, p in items]
+    operands += [Operands.build([(0, 0, 0, 0.0, 0.0)] * len(conds))] * (Bp - B)
+    tm, sc = sharded_search(mesh, tree, conds, operands, host, n_spans, nt=NT_b)
+
+    results: list[SearchResult] = []
+    for bi, (blk, p) in enumerate(items):
+        nt = blk.meta.total_traces
+        sids = np.nonzero(tm[bi][:nt])[0]
+        sids = _verify_candidates(blk, req, sids, p.needs_verify)
+        results.extend(_verify_and_build(blk, req, sids, sc[bi]))
+        resp.inspected_spans += int(n_spans[bi])
+        resp.inspected_bytes += blk.pack.bytes_read
+    return results
 
 
 # ---- tag name/value discovery (reference: /api/search/tags endpoints)

@@ -15,6 +15,8 @@ import time
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 
+import numpy as np
+
 from ..backend import open_backend
 from ..backend.base import RawBackend
 from ..block.builder import build_block_from_traces
@@ -38,6 +40,8 @@ class TempoDBConfig:
     blocklist_poll_s: float = 15.0
     block_cache_blocks: int = 64
     search_default_limit: int = 20
+    device_find: bool = True  # batched/sharded device Find (ops/find, parallel/find)
+    device_search: bool = True  # stacked multi-block device search (parallel/search)
     compaction: comp.CompactorConfig = field(default_factory=comp.CompactorConfig)
 
 
@@ -54,8 +58,20 @@ class TempoDB:
         self._cache_lock = threading.Lock()
         self._poll_thread: threading.Thread | None = None
         self._stop = threading.Event()
+        self._mesh = None
         # compaction ownership + dedupe hooks, overridden by the service layer
         self.owns_job = lambda job_hash: True
+
+    @property
+    def mesh(self):
+        """Device mesh for the sharded Find/search paths (all visible
+        chips; a single chip yields a 1x1 mesh so the same mesh program
+        the multi-chip dryrun validates also serves single-chip)."""
+        if self._mesh is None:
+            from ..parallel import make_mesh
+
+            self._mesh = make_mesh()
+        return self._mesh
 
     # ------------------------------------------------------------ blocks
     def open_block(self, meta: BlockMeta) -> BackendBlock:
@@ -92,13 +108,43 @@ class TempoDB:
         ]
         if not candidates:
             return None
-        results = list(
-            self.pool.map(lambda m: self.open_block(m).find_trace_by_id(trace_id), candidates)
-        )
-        found = [t for t in results if t is not None]
+        if self.cfg.device_find:
+            found = self._device_find(candidates, trace_id)
+        else:
+            results = list(
+                self.pool.map(lambda m: self.open_block(m).find_trace_by_id(trace_id), candidates)
+            )
+            found = [t for t in results if t is not None]
         if not found:
             return None
         return combine_traces(found)
+
+    def _device_find(self, candidates: list[BlockMeta], trace_id: bytes) -> list[Trace]:
+        """Device Find: host bloom gate (one ranged read per block), then
+        ONE batched bisection kernel over every surviving block's sorted
+        id index — sharded over the mesh when >1 chip is attached. Each
+        block reports its own hit row so partial traces combine, the
+        device analog of the reference's per-block fan-out + combiner
+        (tempodb/tempodb.go:271-352)."""
+        from ..block import schema as S
+        from ..ops.find import lookup_ids_blocks
+        from ..parallel.find import sharded_find_rows
+
+        blocks = [self.open_block(m) for m in candidates]
+        gates = list(self.pool.map(lambda b: b.bloom_test(trace_id), blocks))
+        blocks = [b for b, ok in zip(blocks, gates) if ok]
+        if not blocks:
+            return []
+        codes = list(self.pool.map(lambda b: b.trace_index["trace.id_codes"], blocks))
+        query = np.asarray(
+            [S.trace_id_to_codes(trace_id.rjust(16, b"\x00"))], dtype=np.int32
+        )
+        if self.mesh.devices.size > 1:
+            sids = sharded_find_rows(self.mesh, codes, query)
+        else:
+            sids = lookup_ids_blocks(codes, query)
+        hits = [(blk, int(sid)) for blk, sid in zip(blocks, sids[:, 0]) if sid >= 0]
+        return list(self.pool.map(lambda h: h[0].materialize_traces([h[1]])[0], hits))
 
     # ------------------------------------------------------------ search
     def search(self, tenant: str, req: SearchRequest) -> SearchResponse:
@@ -106,6 +152,15 @@ class TempoDB:
         resp = SearchResponse()
         if not metas:
             return resp
+        if self.cfg.device_search and len(metas) > 1:
+            from .search import search_blocks_device
+
+            got = search_blocks_device(
+                [self.open_block(m) for m in metas], req, self.mesh,
+                default_limit=self.cfg.search_default_limit, pool=self.pool,
+            )
+            if got is not None:  # None -> generic-attr / oversize fallback
+                return got
         for r in self.pool.map(lambda m: search_block(self.open_block(m), req), metas):
             resp.merge(r, req.limit or self.cfg.search_default_limit)
             if len(resp.traces) >= (req.limit or self.cfg.search_default_limit):

@@ -63,6 +63,40 @@ def _lookup_kernel(ids: jnp.ndarray, queries: jnp.ndarray, n_valid: jnp.ndarray,
     return bisect_ids(ids, queries, n_valid, n_steps)
 
 
+@partial(jax.jit, static_argnames=("n_steps",))
+def _lookup_blocks_kernel(ids: jnp.ndarray, queries: jnp.ndarray, n_valid: jnp.ndarray,
+                          n_steps: int):
+    """ids: (B, T, 4) stacked per-block indexes -> (B, Q) sids. One fused
+    program bisects every candidate block at once: the single-chip unit
+    of the multi-block Find (parallel/find.py shards the B axis)."""
+    return jax.vmap(lambda a, nv: bisect_ids(a, queries, nv, n_steps))(ids, n_valid)
+
+
+def lookup_ids_blocks(id_code_arrays: list[np.ndarray], query_codes: np.ndarray) -> np.ndarray:
+    """Batched multi-block lookup on one chip: Q query ids against B
+    per-block sorted id-code arrays. Returns (B, Q) int32 row-in-block
+    (-1 miss). Every block reporting its own hit row (rather than electing
+    one winner) is what lets callers combine partial traces, matching the
+    reference's Find fan-out + combiner (tempodb/tempodb.go:271-352)."""
+    B = len(id_code_arrays)
+    q = query_codes.shape[0]
+    if B == 0 or q == 0:
+        return np.full((B, q), -1, dtype=np.int32)
+    T = bucket(max(max(a.shape[0] for a in id_code_arrays), 1))
+    ids = np.full((B, T, 4), np.int32(2**31 - 1), dtype=np.int32)
+    n_valid = np.zeros((B,), dtype=np.int32)
+    for i, a in enumerate(id_code_arrays):
+        ids[i, : a.shape[0]] = a
+        n_valid[i] = a.shape[0]
+    qb = bucket(q)
+    queries = pad_rows(np.asarray(query_codes, dtype=np.int32), qb, PAD_I32)
+    n_steps = int(T).bit_length()
+    out = _lookup_blocks_kernel(
+        jnp.asarray(ids), jnp.asarray(queries), jnp.asarray(n_valid), n_steps
+    )
+    return np.asarray(out)[:, :q]
+
+
 def lookup_ids(id_codes: np.ndarray, query_codes: np.ndarray) -> np.ndarray:
     """Host wrapper: pad to buckets, run the kernel, return (Q,) sids (-1 miss)."""
     n = id_codes.shape[0]

@@ -14,7 +14,7 @@ result-merging combiners on the host.
 """
 
 from .mesh import make_mesh
-from .find import sharded_find, stack_block_ids
+from .find import sharded_find, sharded_find_rows, stack_block_ids
 from .search import sharded_search
 from .bloom import sharded_bloom_union
 from .step import distributed_query_step
@@ -22,6 +22,7 @@ from .step import distributed_query_step
 __all__ = [
     "make_mesh",
     "sharded_find",
+    "sharded_find_rows",
     "stack_block_ids",
     "sharded_search",
     "sharded_bloom_union",

@@ -67,6 +67,41 @@ def make_sharded_find(mesh, B: int, T: int, Q: int):
     return jax.jit(fn)
 
 
+@lru_cache(maxsize=64)
+def make_sharded_find_rows(mesh, B: int, T: int, Q: int):
+    """Like make_sharded_find but each block reports its OWN hit row:
+    returns (B, Q) int32 sids (-1 miss), block axis sharded over the
+    flattened mesh. This is the service-path Find: every block holding
+    the id contributes a partial trace for the host combiner
+    (wire/combine.py), matching the reference's Find + combiner
+    (tempodb/tempodb.go:271-352) instead of electing one winner."""
+    n_steps = int(T).bit_length()
+
+    def local(ids_l, n_valid_l, queries):
+        return jax.vmap(lambda a, nv: bisect_ids(a, queries, nv, n_steps))(ids_l, n_valid_l)
+
+    fn = smap(local, mesh,
+        in_specs=(P(("dp", "sp")), P(("dp", "sp")), P()),
+        out_specs=P(("dp", "sp")),
+    )
+    return jax.jit(fn)
+
+
+def sharded_find_rows(mesh, id_code_arrays: list[np.ndarray], query_codes: np.ndarray) -> np.ndarray:
+    """Host entry for the per-block-rows Find. Returns (B, Q) int32
+    row-in-block (-1 miss), B = len(id_code_arrays)."""
+    n = mesh.devices.size
+    q = query_codes.shape[0]
+    if not id_code_arrays or q == 0:
+        return np.full((len(id_code_arrays), q), -1, dtype=np.int32)
+    ids, n_valid, T = stack_block_ids(id_code_arrays, n)
+    Qb = bucket(q)
+    queries = pad_rows(np.asarray(query_codes, np.int32), Qb, np.int32(-(2**31)))
+    fn = make_sharded_find_rows(mesh, ids.shape[0], T, Qb)
+    out = np.asarray(fn(jnp.asarray(ids), jnp.asarray(n_valid), jnp.asarray(queries)))
+    return out[: len(id_code_arrays), :q]
+
+
 def stack_block_ids(id_code_arrays: list[np.ndarray], n_shards: int) -> tuple[np.ndarray, np.ndarray, int]:
     """Stack per-block sorted id-code arrays (Ti, 4) into (B, T, 4) padded
     for an n_shards-way mesh: T = common power-of-two bucket, B padded to a

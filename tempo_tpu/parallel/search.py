@@ -7,13 +7,18 @@ program: the span axis is sharded over 'sp' (each chip filters its row
 slice), per-trace aggregation is a segment reduce + `psum` over 'sp'
 (the combiner collective), and independent blocks ride 'dp'.
 
+Operands are PER BLOCK: every block resolves strings through its own
+dictionary, so the same query yields different int codes (and different
+regex-match tables) per block. ops_i/ops_f/tables carry a leading block
+axis sharded over 'dp'; condition compares broadcast the per-block
+operand over that block's rows. Operand values are traced, and the mesh
+programs are memoized, so different constants with the same structure
+share one compiled program.
+
 Mirrors ops/filter.py's trace-level tree semantics: span subtrees
 aggregate through ('tracify', t) nodes, trace-axis conds compare
-replicated (B, NT) columns, dictionary tables (regex/set predicates)
-ride along replicated. The generic-attr tables shard differently and
-stay on the per-block path (ops/filter.py). Operand values are traced,
-and the mesh programs are memoized, so different constants with the
-same structure share one compiled program.
+per-block (B, NT) columns. The generic-attr tables shard differently and
+stay on the per-block path (ops/filter.py).
 """
 
 from __future__ import annotations
@@ -25,8 +30,42 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
-from ..ops.filter import Cond, Operands, T_RES, T_SPAN, T_TRACE, _cmp, normalize_tree
+from ..ops.device import bucket
+from ..ops.filter import Cond, Operands, T_RES, T_SPAN, T_TRACE, normalize_tree
 from .mesh import smap
+
+
+def _cmp_b(op: str, x, v0, v1, f0, f1, is_float: bool, table):
+    """Per-block compare: x (Bl, N); v0/v1/f0/f1 (Bl,) per-block operands;
+    table (Bl, L) per-block dictionary-match table."""
+    a = (f0 if is_float else v0)[:, None]
+    b = (f1 if is_float else v1)[:, None]
+    if op == "eq":
+        return x == a
+    if op == "ne":
+        return x != a
+    if op == "ne_present":
+        return (x != a) & (x >= 0)
+    if op == "ne_clamped":
+        return (x != a) | (x == 2**31 - 1) | (x == -(2**31) + 1)
+    if op == "lt":
+        return x < a
+    if op == "le":
+        return x <= a
+    if op == "gt":
+        return x > a
+    if op == "ge":
+        return x >= a
+    if op == "range":
+        return (x >= a) & (x <= b)
+    if op == "exists":
+        return jnp.ones_like(x, dtype=bool)
+    if op in ("intable", "notintable"):
+        hit = jnp.take_along_axis(table, jnp.clip(x, 0, table.shape[1] - 1), axis=1) > 0
+        if op == "notintable":
+            hit = ~hit
+        return hit & (x >= 0)
+    raise ValueError(f"unknown op {op}")
 
 
 @lru_cache(maxsize=128)
@@ -34,10 +73,12 @@ def make_sharded_search(mesh, tree, conds: tuple[Cond, ...], col_names: tuple[st
                         B: int, S: int, R: int, NT: int, table_idxs: tuple[int, ...] = ()):
     """Jitted mesh program over stacked blocks.
 
-    cols[name]: (B, S) span-axis int32 (trace_sid included), or (B, R)
-    res-axis, or (B, NT) trace-axis. n_spans: (B,). `tree` must be
-    trace-level (normalize_tree applied). Returns
-    (trace_mask (B, NT) bool, span_count (B, NT) int32), sharded over dp.
+    ops_i: (B, C, 3) int32, ops_f: (B, C, 2) f32, tables: (B, L) u8 --
+    all sharded over dp. cols[name]: (B, S) span-axis int32
+    (trace_sid included), or (B, R) res-axis, or (B, NT) trace-axis.
+    n_spans: (B,). `tree` must be trace-level (normalize_tree applied).
+    Returns (trace_mask (B, NT) bool, span_count (B, NT) int32),
+    sharded over dp.
     """
 
     def local(ops_i, ops_f, n_spans_l, *arrays):
@@ -49,15 +90,17 @@ def make_sharded_search(mesh, tree, conds: tuple[Cond, ...], col_names: tuple[st
         valid = (jnp.arange(Sl, dtype=jnp.int32)[None, :] + row0) < n_spans_l[:, None]
         span_masks: list = []
 
+        def cond_cmp(i, x):
+            c = conds[i]
+            return _cmp_b(c.op, x, ops_i[:, i, 1], ops_i[:, i, 2],
+                          ops_f[:, i, 0], ops_f[:, i, 1], c.is_float, tables.get(i))
+
         def cond_mask(i):
             c = conds[i]
-            v0, v1 = ops_i[i, 1], ops_i[i, 2]
-            f0, f1 = ops_f[i, 0], ops_f[i, 1]
-            t = tables.get(i)
             if c.target == T_SPAN:
-                return _cmp(c.op, cols[c.col], v0, v1, f0, f1, c.is_float, t) & valid
+                return cond_cmp(i, cols[c.col]) & valid
             if c.target == T_RES:
-                rm = _cmp(c.op, cols[c.col], v0, v1, f0, f1, c.is_float, t)  # (Bl, R)
+                rm = cond_cmp(i, cols[c.col])  # (Bl, R)
                 idx = jnp.clip(cols["span.res_idx"], 0, rm.shape[1] - 1)
                 rm_g = jnp.take_along_axis(rm, idx, axis=1)
                 return rm_g & (cols["span.res_idx"] >= 0) & valid
@@ -86,10 +129,7 @@ def make_sharded_search(mesh, tree, conds: tuple[Cond, ...], col_names: tuple[st
                 span_masks.append(sm)
                 return seg_reduce(sm) > 0
             if t[0] == "cond":
-                i = t[1]
-                c = conds[i]
-                return _cmp(c.op, cols[c.col], ops_i[i, 1], ops_i[i, 2],
-                            ops_f[i, 0], ops_f[i, 1], c.is_float, tables.get(i))
+                return cond_cmp(t[1], cols[conds[t[1]].col])
             ms = [ev_trace(ch) for ch in t[1:]]
             out = ms[0]
             for m in ms[1:]:
@@ -111,39 +151,65 @@ def make_sharded_search(mesh, tree, conds: tuple[Cond, ...], col_names: tuple[st
             count = seg_reduce(span_mask)
         return trace_mask, jnp.where(trace_mask, count, 0)
 
-    in_specs = [P(), P(), P("dp")] + [P()] * len(table_idxs)
+    in_specs = [P("dp"), P("dp"), P("dp")] + [P("dp")] * len(table_idxs)
     for n in col_names:
         in_specs.append(P("dp", "sp") if n.startswith("span.") else P("dp"))
     fn = smap(local, mesh, in_specs=tuple(in_specs), out_specs=(P("dp"), P("dp")))
     return jax.jit(fn)
 
 
-def sharded_search(mesh, tree, conds, operands: Operands, cols: dict[str, np.ndarray],
+def _stack_operands(operands, B: int, n_conds: int):
+    """Accept one Operands (replicated to every block) or a per-block
+    list (padded with zero rows to B). Returns (ints (B,C,3),
+    floats (B,C,2), tables {i: (B, L) u8})."""
+    if isinstance(operands, Operands):
+        ints = np.broadcast_to(operands.ints[None], (B,) + operands.ints.shape).copy()
+        floats = np.broadcast_to(operands.floats[None], (B,) + operands.floats.shape).copy()
+        tabs = {}
+        for i, t in (operands.tables or {}).items():
+            t8 = np.asarray(t, dtype=np.uint8)
+            tabs[i] = np.broadcast_to(t8[None], (B,) + t8.shape).copy()
+        return ints, floats, tabs
+    ints = np.zeros((B, n_conds, 3), dtype=np.int32)
+    floats = np.zeros((B, n_conds, 2), dtype=np.float32)
+    idxs = set()
+    for o in operands:
+        idxs.update(o.tables or {})
+    tabs = {}
+    for i in sorted(idxs):
+        L = bucket(max(max(len(o.tables[i]) for o in operands if o.tables and i in o.tables), 1))
+        tabs[i] = np.zeros((B, L), dtype=np.uint8)
+    for bi, o in enumerate(operands):
+        ints[bi, : o.ints.shape[0]] = o.ints
+        floats[bi, : o.floats.shape[0]] = o.floats
+        for i, t in (o.tables or {}).items():
+            tabs[i][bi, : len(t)] = np.asarray(t, dtype=np.uint8)
+    return ints, floats, tabs
+
+
+def sharded_search(mesh, tree, conds, operands, cols: dict[str, np.ndarray],
                    n_spans: np.ndarray, nt: int | None = None):
-    """Host entry. cols must already be stacked/padded:
-    span-axis (B, S) with S % sp == 0 and B % dp == 0; res/trace axis
-    (B, R)/(B, NT) replicated along sp. Returns (trace_mask, span_count)
-    as numpy, (B, NT)."""
+    """Host entry. `operands`: one Operands (same codes for every block:
+    the synthetic-bench path) or a list of per-block Operands (the
+    service path -- per-block dictionary codes). cols must already be
+    stacked/padded: span-axis (B, S) with S % sp == 0 and B % dp == 0;
+    res/trace axis (B, R)/(B, NT) replicated along sp. Returns
+    (trace_mask, span_count) as numpy, (B, NT)."""
     names = tuple(sorted(cols))
     NT = nt
     if NT is None and any(n.startswith("trace.") for n in names):
         NT = cols[[n for n in names if n.startswith("trace.")][0]].shape[1]
     if NT is None:
-        NT = int(cols["span.trace_sid"].max(initial=0)) + 1
-        # pad to bucket for stable jit keys
-        from ..ops.device import bucket
-
-        NT = bucket(NT)
+        NT = bucket(int(cols["span.trace_sid"].max(initial=0)) + 1)
     B, S = cols["span.trace_sid"].shape
     R = next((cols[n].shape[1] for n in names if n.startswith("res.")), 1)
     conds = tuple(conds)
     if tree is not None:
         tree = normalize_tree(tree, conds)
-    tables = operands.tables or {}
-    table_idxs = tuple(sorted(tables))
+    ints, floats, tabs = _stack_operands(operands, B, len(conds))
+    table_idxs = tuple(sorted(tabs))
     fn = make_sharded_search(mesh, tree, conds, names, B, S, R, NT, table_idxs)
-    table_arrays = [jnp.asarray(np.asarray(tables[i], dtype=np.uint8)) for i in table_idxs]
-    arrays = table_arrays + [jnp.asarray(cols[n]) for n in names]
-    tm, sc = fn(jnp.asarray(operands.ints), jnp.asarray(operands.floats),
+    arrays = [jnp.asarray(tabs[i]) for i in table_idxs] + [jnp.asarray(cols[n]) for n in names]
+    tm, sc = fn(jnp.asarray(ints), jnp.asarray(floats),
                 jnp.asarray(n_spans, dtype=np.int32), *arrays)
     return np.asarray(tm), np.asarray(sc)

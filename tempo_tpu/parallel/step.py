@@ -35,8 +35,14 @@ def distributed_query_step(mesh, tree, conds, col_names: tuple[str, ...],
     union_fn = make_sharded_union(mesh, K, NS, W)
 
     def step(ids, n_valid, queries, ops_i, ops_f, n_spans, col_arrays, blooms):
+        import jax.numpy as jnp
+
         hits = find_fn(ids, n_valid, queries)
-        tm, sc = search_fn(ops_i, ops_f, n_spans, *col_arrays)
+        # search operands are per-block (B, C, ...); the composed step takes
+        # one operand set and replicates it across blocks
+        ops_bi = jnp.broadcast_to(ops_i[None], (B,) + ops_i.shape)
+        ops_bf = jnp.broadcast_to(ops_f[None], (B,) + ops_f.shape)
+        tm, sc = search_fn(ops_bi, ops_bf, n_spans, *col_arrays)
         bu = union_fn(blooms)
         return hits, tm, sc, bu
 

@@ -295,3 +295,81 @@ def test_streamed_search_cross_chunk_and(tmp_path):
     resp = search_block(blk, SearchRequest(tags={"a": "v", "b": "v"}, limit=10))
     assert {r.trace_id for r in resp.traces} == {tid.hex()}
     db.close()
+
+
+def test_device_paths_run_mesh_programs(tmp_path):
+    """The service-layer Find and search run the sharded mesh programs
+    (the same kernels the driver dryrun validates) and match the host
+    fallback path exactly."""
+    from tempo_tpu.parallel import find as pf
+    from tempo_tpu.parallel import search as ps
+
+    db = _db(tmp_path)
+    for seed in (21, 22, 23):
+        db.write_block(TENANT, make_traces(8, seed=seed))
+    assert db.mesh.devices.size == 8  # conftest forces the virtual mesh
+
+    fi = pf.make_sharded_find_rows.cache_info()
+    f_before = fi.hits + fi.misses
+    si = ps.make_sharded_search.cache_info()
+    s_before = si.hits + si.misses
+
+    tid, t = make_traces(8, seed=22)[3]
+    got = db.find_trace_by_id(TENANT, tid)
+    assert got is not None and got.span_count() == t.span_count()
+    fi = pf.make_sharded_find_rows.cache_info()
+    assert fi.hits + fi.misses > f_before, "find did not run the mesh program"
+
+    req = SearchRequest(tags={"service.name": "auth"}, limit=100)
+    resp = db.search(TENANT, req)
+    si = ps.make_sharded_search.cache_info()
+    assert si.hits + si.misses > s_before, "search did not run the mesh program"
+
+    db.cfg.device_find = False
+    db.cfg.device_search = False
+    resp_host = db.search(TENANT, req)
+    assert sorted(r.trace_id for r in resp.traces) == sorted(
+        r.trace_id for r in resp_host.traces
+    )
+    got_host = db.find_trace_by_id(TENANT, tid)
+    assert got_host.span_count() == got.span_count()
+
+
+def test_device_find_combines_partials(tmp_path):
+    """Device Find returns per-block hit rows so replicated partial
+    traces still combine (not a single elected winner)."""
+    db = _db(tmp_path)
+    tid = b"\x43" * 16
+    t1 = make_trace(41, trace_id=tid, n_spans=4)
+    t2 = make_trace(42, trace_id=tid, n_spans=5)
+    db.write_block(TENANT, sorted(make_traces(3, seed=43) + [(tid, t1)], key=lambda p: p[0]))
+    db.write_block(TENANT, sorted(make_traces(3, seed=44) + [(tid, t2)], key=lambda p: p[0]))
+    assert db.cfg.device_find
+    got = db.find_trace_by_id(TENANT, tid)
+    assert got.span_count() == 9
+
+
+def test_compaction_unions_blooms_on_device(tmp_path, monkeypatch):
+    """Compaction must produce the output bloom via the device OR-union
+    when input geometries match -- never by re-inserting ids."""
+    from tempo_tpu.block.bloom import ShardedBloom
+
+    db = _db(tmp_path)
+    a = make_traces(8, seed=31)
+    b = make_traces(8, seed=32)
+    db.write_block(TENANT, a)
+    db.write_block(TENANT, b)
+    m1, m2 = db.blocklist.metas(TENANT)
+    assert (m1.bloom_shards, m1.bloom_shard_bits) == (m2.bloom_shards, m2.bloom_shard_bits)
+
+    def no_rebuild(self, ids):
+        raise AssertionError("bloom rebuilt key-by-key; union path not taken")
+
+    monkeypatch.setattr(ShardedBloom, "add_many", no_rebuild)
+    results = db.compact_once(TENANT)
+    assert results and results[0].new_blocks
+    (out,) = db.blocklist.metas(TENANT)
+    assert (out.bloom_shards, out.bloom_shard_bits) == (m1.bloom_shards, m1.bloom_shard_bits)
+    blk = db.open_block(out)
+    for tid, _ in a + b:
+        assert blk.bloom_test(tid)
