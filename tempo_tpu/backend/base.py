@@ -40,12 +40,42 @@ def meta_name(compacted: bool = False) -> str:
     return COMPACTED_META_NAME if compacted else META_NAME
 
 
+class Appender:
+    """Incremental object writer (reference: backend.AppendTracker,
+    tempodb/backend/raw.go). Default buffers parts and issues one write
+    on close; backends with native append (local files) override
+    open_append for true streamed flushes."""
+
+    def __init__(self, backend: "RawBackend", tenant: str, block_id: str, name: str):
+        self._backend = backend
+        self._tenant = tenant
+        self._block_id = block_id
+        self._name = name
+        self._parts: list[bytes] = []
+        self.bytes_written = 0
+
+    def append(self, data: bytes) -> None:
+        self._parts.append(data)
+        self.bytes_written += len(data)
+
+    def close(self) -> None:
+        self._backend.write(self._tenant, self._block_id, self._name, b"".join(self._parts))
+        self._parts = []
+
+    def abort(self) -> None:
+        """Discard everything appended so far; nothing is written."""
+        self._parts = []
+
+
 class RawBackend(abc.ABC):
     """Reader+writer+compactor over raw named objects."""
 
     # ---- write
     @abc.abstractmethod
     def write(self, tenant: str, block_id: str, name: str, data: bytes) -> None: ...
+
+    def open_append(self, tenant: str, block_id: str, name: str) -> Appender:
+        return Appender(self, tenant, block_id, name)
 
     @abc.abstractmethod
     def write_tenant_object(self, tenant: str, name: str, data: bytes) -> None: ...

@@ -75,6 +75,10 @@ class CachedBackend(RawBackend):
         self.inner.write(tenant, block_id, name, data)
         self._invalidate_block(tenant, block_id)
 
+    def open_append(self, tenant, block_id, name):
+        self._invalidate_block(tenant, block_id)
+        return self.inner.open_append(tenant, block_id, name)
+
     def write_tenant_object(self, tenant, name, data):
         self.inner.write_tenant_object(tenant, name, data)
 
@@ -165,6 +169,9 @@ class HedgedBackend(RawBackend):
     # writes/lists/deletes pass through unhedged
     def write(self, tenant, block_id, name, data):
         self.inner.write(tenant, block_id, name, data)
+
+    def open_append(self, tenant, block_id, name):
+        return self.inner.open_append(tenant, block_id, name)
 
     def write_tenant_object(self, tenant, name, data):
         self.inner.write_tenant_object(tenant, name, data)

@@ -12,9 +12,46 @@ from __future__ import annotations
 import os
 import tempfile
 
-from .base import COMPACTED_META_NAME, META_NAME, DoesNotExist, RawBackend
+from .base import COMPACTED_META_NAME, META_NAME, Appender, DoesNotExist, RawBackend
 
 _TENANT_OBJECT_DIR = "__tenant__"
+
+
+class _FileAppender(Appender):
+    """True incremental append: parts stream to a temp file, atomically
+    renamed into place on close (keeps the crash-safety of write())."""
+
+    def __init__(self, backend: "LocalBackend", tenant: str, block_id: str, name: str):
+        super().__init__(backend, tenant, block_id, name)
+        path = backend._obj_path(tenant, block_id, name)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        fd, self._tmp = tempfile.mkstemp(dir=os.path.dirname(path), prefix=".tmp-")
+        self._f = os.fdopen(fd, "wb")
+        self._path = path
+
+    def append(self, data: bytes) -> None:
+        self._f.write(data)
+        self.bytes_written += len(data)
+
+    def close(self) -> None:
+        try:
+            self._f.close()
+            os.replace(self._tmp, self._path)
+        except BaseException:
+            try:
+                os.unlink(self._tmp)
+            except OSError:
+                pass
+            raise
+
+    def abort(self) -> None:
+        try:
+            self._f.close()
+        finally:
+            try:
+                os.unlink(self._tmp)
+            except OSError:
+                pass
 
 
 class LocalBackend(RawBackend):
@@ -50,6 +87,9 @@ class LocalBackend(RawBackend):
     # ---- write
     def write(self, tenant: str, block_id: str, name: str, data: bytes) -> None:
         self._write_file(self._obj_path(tenant, block_id, name), data)
+
+    def open_append(self, tenant: str, block_id: str, name: str) -> Appender:
+        return _FileAppender(self, tenant, block_id, name)
 
     def write_tenant_object(self, tenant: str, name: str, data: bytes) -> None:
         self._write_file(os.path.join(self.path, tenant, _TENANT_OBJECT_DIR, name), data)

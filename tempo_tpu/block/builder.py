@@ -19,7 +19,7 @@ from ..wire.model import Trace
 from ..wire.otlp_json import _value_from_json, _value_to_json
 from . import schema as S
 from .bloom import ShardedBloom
-from .colio import AxisChunks, pack_columns
+from .colio import AxisChunks, pack_columns_stream
 from .dictionary import DictBuilder, Dictionary, apply_remap
 from .meta import BlockMeta, RowGroupStats
 
@@ -386,75 +386,87 @@ class BlockBuilder:
         return FinalizedBlock(m, cols, axes, col_axis, dictionary, bloom)
 
     def _compute_row_groups(self, cols, start_ms, dur_us):
-        n_spans = len(self.sp_trace_sid)
-        bounds = list(range(0, n_spans, self.row_group_spans)) + [n_spans]
-        if len(bounds) < 2:
-            bounds = [0, 0]
-        span_ax = AxisChunks(bounds)
+        return compute_row_groups(cols, start_ms, dur_us, self.row_group_spans)
 
-        def child_axis(owner: np.ndarray) -> AxisChunks:
-            offs = np.searchsorted(owner, bounds, side="left")
-            offs[0], offs[-1] = 0, len(owner)
-            return AxisChunks([int(x) for x in offs])
 
-        axes = {
-            S.AX_SPAN: span_ax,
-            S.AX_SATTR: child_axis(cols["sattr.span"]),
-            S.AX_EVENT: child_axis(cols["ev.span"]),
-            S.AX_LINK: child_axis(cols["ln.span"]),
-        }
-        axes[S.AX_EVATTR] = AxisChunks(
-            [int(x) for x in np.searchsorted(cols["evattr.ev"], axes[S.AX_EVENT].offsets)]
-        )
-        axes[S.AX_LNATTR] = AxisChunks(
-            [int(x) for x in np.searchsorted(cols["lnattr.ln"], axes[S.AX_LINK].offsets)]
-        )
+def compute_row_groups(cols, start_ms, dur_us, row_group_spans):
+    """Row-group boundaries + per-group pruning stats from assembled
+    columns (shared by the builder and the columnar compactor)."""
+    n_spans = len(cols["span.trace_sid"])
+    bounds = list(range(0, n_spans, row_group_spans)) + [n_spans]
+    if len(bounds) < 2:
+        bounds = [0, 0]
+    span_ax = AxisChunks(bounds)
 
-        col_axis: dict[str, str] = {}
-        for name in cols:
-            pref = name.split(".", 1)[0]
-            ax = {
-                "span": S.AX_SPAN,
-                "sattr": S.AX_SATTR,
-                "ev": S.AX_EVENT,
-                "evattr": S.AX_EVATTR,
-                "ln": S.AX_LINK,
-                "lnattr": S.AX_LNATTR,
-            }.get(pref)
-            if ax is not None:
-                col_axis[name] = ax
+    def child_axis(owner: np.ndarray) -> AxisChunks:
+        offs = np.searchsorted(owner, bounds, side="left")
+        offs[0], offs[-1] = 0, len(owner)
+        return AxisChunks([int(x) for x in offs])
 
-        trace_sid = cols["span.trace_sid"]
-        row_groups = []
-        for g in range(span_ax.n_groups):
-            lo, hi = bounds[g], bounds[g + 1]
-            if hi <= lo:
-                row_groups.append(RowGroupStats(lo, hi, 0, 0, 0, 0, 0))
-                continue
-            row_groups.append(
-                RowGroupStats(
-                    span_lo=lo,
-                    span_hi=hi,
-                    trace_lo=int(trace_sid[lo]),
-                    trace_hi=int(trace_sid[hi - 1]) + 1,
-                    start_ms_min=int(start_ms[lo:hi].min()),
-                    start_ms_max=int(start_ms[lo:hi].max()),
-                    dur_us_max=int(dur_us[lo:hi].max()),
-                )
+    axes = {
+        S.AX_SPAN: span_ax,
+        S.AX_SATTR: child_axis(cols["sattr.span"]),
+        S.AX_EVENT: child_axis(cols["ev.span"]),
+        S.AX_LINK: child_axis(cols["ln.span"]),
+    }
+    axes[S.AX_EVATTR] = AxisChunks(
+        [int(x) for x in np.searchsorted(cols["evattr.ev"], axes[S.AX_EVENT].offsets)]
+    )
+    axes[S.AX_LNATTR] = AxisChunks(
+        [int(x) for x in np.searchsorted(cols["lnattr.ln"], axes[S.AX_LINK].offsets)]
+    )
+
+    col_axis: dict[str, str] = {}
+    for name in cols:
+        pref = name.split(".", 1)[0]
+        ax = {
+            "span": S.AX_SPAN,
+            "sattr": S.AX_SATTR,
+            "ev": S.AX_EVENT,
+            "evattr": S.AX_EVATTR,
+            "ln": S.AX_LINK,
+            "lnattr": S.AX_LNATTR,
+        }.get(pref)
+        if ax is not None:
+            col_axis[name] = ax
+
+    trace_sid = cols["span.trace_sid"]
+    row_groups = []
+    for g in range(span_ax.n_groups):
+        lo, hi = bounds[g], bounds[g + 1]
+        if hi <= lo:
+            row_groups.append(RowGroupStats(lo, hi, 0, 0, 0, 0, 0))
+            continue
+        row_groups.append(
+            RowGroupStats(
+                span_lo=lo,
+                span_hi=hi,
+                trace_lo=int(trace_sid[lo]),
+                trace_hi=int(trace_sid[hi - 1]) + 1,
+                start_ms_min=int(start_ms[lo:hi].min()),
+                start_ms_max=int(start_ms[lo:hi].max()),
+                dur_us_max=int(dur_us[lo:hi].max()),
             )
-        return axes, col_axis, row_groups
+        )
+    return axes, col_axis, row_groups
 
 
 def write_block(backend: RawBackend, fin: FinalizedBlock) -> BlockMeta:
     """Write all block objects; meta.json last so pollers never see a
     partial block (reference writes meta last for the same reason)."""
     m = fin.meta
-    data = pack_columns(fin.cols, fin.axes, fin.col_axis)
-    backend.write(m.tenant_id, m.block_id, DATA_NAME, data)
+    app = backend.open_append(m.tenant_id, m.block_id, DATA_NAME)
+    try:
+        for part in pack_columns_stream(fin.cols, fin.axes, fin.col_axis):
+            app.append(part)
+        app.close()
+    except BaseException:
+        app.abort()
+        raise
     backend.write(m.tenant_id, m.block_id, DICT_NAME, fin.dictionary.to_bytes())
     for i in range(fin.bloom.n_shards):
         backend.write(m.tenant_id, m.block_id, f"{BLOOM_PREFIX}{i}", fin.bloom.shard_bytes(i))
-    m.size_bytes = len(data)
+    m.size_bytes = app.bytes_written
     backend.write(m.tenant_id, m.block_id, "meta.json", m.to_json())
     return m
 

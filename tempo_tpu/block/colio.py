@@ -47,25 +47,27 @@ class AxisChunks:
         return self.offsets[-1]
 
 
-def pack_columns(
+def pack_columns_stream(
     cols: dict[str, np.ndarray],
     axes: dict[str, AxisChunks] | None = None,
     col_axis: dict[str, str] | None = None,
     level: int = 3,
-) -> bytes:
-    """Serialize columns. Columns named in col_axis are chunked along the
-    given axis' row groups; others are stored as a single chunk."""
+):
+    """Yield the serialized pack as byte parts, ONE COLUMN AT A TIME
+    (chunks of a column compress as one threaded native batch, then the
+    footer+tail last). Peak memory is a single column's chunks, so the
+    streamed-flush write path (backend appender) never buffers the whole
+    block -- the role of the reference's incremental backend.Append
+    tracker (v2/streaming_block.go:13-90)."""
     axes = axes or {}
     col_axis = col_axis or {}
     footer: dict = {"cols": {}, "axes": {k: v.offsets for k, v in axes.items()}}
+    offset = 0
 
-    # phase 1: collect every raw chunk in output order
-    raws: list[bytes] = []
-    col_chunk_idx: dict[str, list[int]] = {}
     for name, arr in cols.items():
         arr = np.ascontiguousarray(arr)
         axis = col_axis.get(name)
-        idxs = []
+        raws: list[bytes] = []
         if axis is not None:
             ax = axes[axis]
             if ax.n_rows != arr.shape[0]:
@@ -74,51 +76,54 @@ def pack_columns(
                 )
             for g in range(ax.n_groups):
                 lo, hi = ax.offsets[g], ax.offsets[g + 1]
-                idxs.append(len(raws))
                 raws.append(arr[lo:hi].tobytes())
         else:
-            idxs.append(len(raws))
             raws.append(arr.tobytes())
-        col_chunk_idx[name] = idxs
+
+        # compress this column's compressible chunks in one threaded
+        # native batch (native/vtpu_native.cc); python zstd as fallback
+        to_compress = [i for i, r in enumerate(raws) if len(r) >= _MIN_COMPRESS]
+        compressed: dict[int, bytes] = {}
+        if to_compress:
+            from ..native import zstd_compress_chunks
+
+            outs = zstd_compress_chunks([raws[i] for i in to_compress], level)
+            if outs is None:
+                comp = zstandard.ZstdCompressor(level=level)
+                outs = [comp.compress(raws[i]) for i in to_compress]
+            compressed = dict(zip(to_compress, outs))
+
+        recs: list[list] = []
+        for i, raw in enumerate(raws):
+            z = compressed.get(i)
+            if z is not None and len(z) < len(raw):
+                data, codec = z, CODEC_ZSTD
+            else:
+                data, codec = raw, CODEC_RAW
+            recs.append([offset, len(data), len(raw), codec])
+            offset += len(data)
+            yield data
         footer["cols"][name] = {
             "dtype": str(arr.dtype),
             "shape": list(arr.shape),
             "axis": axis,
-            "chunks": None,  # filled below
+            "chunks": recs,
         }
 
-    # phase 2: compress all compressible chunks in one threaded native
-    # batch (native/vtpu_native.cc); per-chunk python zstd as fallback
-    to_compress = [i for i, r in enumerate(raws) if len(r) >= _MIN_COMPRESS]
-    compressed: dict[int, bytes] = {}
-    if to_compress:
-        from ..native import zstd_compress_chunks
-
-        outs = zstd_compress_chunks([raws[i] for i in to_compress], level)
-        if outs is None:
-            comp = zstandard.ZstdCompressor(level=level)
-            outs = [comp.compress(raws[i]) for i in to_compress]
-        compressed = dict(zip(to_compress, outs))
-
-    parts: list[bytes] = []
-    offset = 0
-    recs: list[list] = []
-    for i, raw in enumerate(raws):
-        z = compressed.get(i)
-        if z is not None and len(z) < len(raw):
-            data, codec = z, CODEC_ZSTD
-        else:
-            data, codec = raw, CODEC_RAW
-        parts.append(data)
-        recs.append([offset, len(data), len(raw), codec])
-        offset += len(data)
-    for name, idxs in col_chunk_idx.items():
-        footer["cols"][name]["chunks"] = [recs[i] for i in idxs]
-
     fbytes = json.dumps(footer, separators=(",", ":")).encode("utf-8")
-    parts.append(fbytes)
-    parts.append(_TAIL.pack(len(fbytes), MAGIC))
-    return b"".join(parts)
+    yield fbytes
+    yield _TAIL.pack(len(fbytes), MAGIC)
+
+
+def pack_columns(
+    cols: dict[str, np.ndarray],
+    axes: dict[str, AxisChunks] | None = None,
+    col_axis: dict[str, str] | None = None,
+    level: int = 3,
+) -> bytes:
+    """Serialize columns. Columns named in col_axis are chunked along the
+    given axis' row groups; others are stored as a single chunk."""
+    return b"".join(pack_columns_stream(cols, axes, col_axis, level))
 
 
 class ColumnPack:

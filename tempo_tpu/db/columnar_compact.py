@@ -1,0 +1,373 @@
+"""Columnar compaction: numpy-level K-way merge of vtpu blocks.
+
+The fast path the reference takes at parquet.Row level (no proto decode,
+vparquet/compactor.go:23-80) re-expressed for the vtpu SoA layout: blocks
+are id-sorted, so the merge order is one lexsort over the stacked 128-bit
+trace ids; maximal runs of consecutive traces from one block move as
+COLUMN SLICES -- span rows, attr rows, events, links all come along via
+their sorted owner columns with two searchsorteds per table. No wire
+model anywhere on the unique-id path. Only colliding ids (replicated
+partial traces) are materialized, combined with span dedupe
+(wire/combine.py, the reference's combiner.go analog), and re-flattened
+through a one-trace builder.
+
+Output blocks cut at a size target estimated from input bytes/trace
+(reference: tempodb/compactor.go:21-30 flush/size targets) and stream to
+the backend through the appender (v2/streaming_block.go role): one
+column's chunks in memory at a time, never the serialized block.
+
+Dictionaries merge as a sorted string union; every code column remaps
+through one gather. The output bloom is the device OR-union of the
+inputs' filters when a single output block is cut and the union stays
+within design capacity (ops/bloom_ops.py), else rebuilt batch-native.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..backend.base import RawBackend
+from ..block import schema as S
+from ..block.bloom import ShardedBloom
+from ..block.builder import BlockBuilder, FinalizedBlock, compute_row_groups, write_block
+from ..block.dictionary import Dictionary, apply_remap
+from ..block.meta import BlockMeta
+from ..block.reader import BackendBlock
+from ..wire.combine import combine_traces
+from .compactor import (
+    CompactionJob,
+    CompactionResult,
+    CompactorConfig,
+    _union_input_blooms,
+)
+
+
+class UnsupportedColumnar(Exception):
+    """Inputs this merge can't handle columnar-ly; caller falls back to
+    the wire-level merge."""
+
+
+# dict-code columns (remapped into the merged dictionary at load)
+_DICT_COLS = frozenset(
+    {
+        "span.name_id", "span.service_id", "span.http_method_id", "span.http_url_id",
+        "span.trace_state_id", "span.status_msg_id",
+        "trace.root_service_id", "trace.root_name_id",
+        "scope.name_id", "scope.version_id",
+        "ev.name_id", "ln.state_id",
+    }
+    | set(S.WELL_KNOWN_RES_ATTRS.values())
+    | {f"{p}.key_id" for p in ("sattr", "rattr", "evattr", "lnattr")}
+    | {f"{p}.str_id" for p in ("sattr", "rattr", "evattr", "lnattr")}
+)
+
+
+class _Source:
+    """One input block (or one combined collision trace) as raw columns."""
+
+    def __init__(self, cols: dict[str, np.ndarray], strings: list[str]):
+        self.cols = cols
+        self.strings = strings
+        self.span_off = cols["trace.span_off"]
+
+    @classmethod
+    def from_block(cls, blk: BackendBlock) -> "_Source":
+        return cls(blk.pack.read_all(), blk.dictionary.strings)
+
+    def remap_codes(self, code_of: dict[str, int]) -> None:
+        remap = np.fromiter((code_of[s] for s in self.strings), dtype=np.int32,
+                            count=len(self.strings))
+        for name in self.cols:
+            if name in _DICT_COLS:
+                self.cols[name] = apply_remap(self.cols[name], remap)
+
+    def child_range(self, owner_col: str, lo: int, hi: int) -> tuple[int, int]:
+        owner = self.cols[owner_col]
+        return (int(np.searchsorted(owner, lo, "left")),
+                int(np.searchsorted(owner, hi, "left")))
+
+
+def _merge_order(sources: list[_Source]):
+    """Global id-sorted order over all source traces. Returns
+    (src_idx, sid, same_as_prev) arrays; same_as_prev marks duplicate-id
+    entries (collisions)."""
+    ids = [np.ascontiguousarray(s.cols["trace.id"]).reshape(-1, 16) for s in sources]
+    n = sum(len(x) for x in ids)
+    if n == 0:
+        z = np.empty(0, dtype=np.int32)
+        return z, z, np.empty(0, dtype=bool)
+    all_ids = np.concatenate(ids)
+    u = all_ids.view(">u8").astype(np.uint64).reshape(-1, 2)
+    src = np.concatenate([np.full(len(x), i, np.int32) for i, x in enumerate(ids)])
+    sid = np.concatenate([np.arange(len(x), dtype=np.int32) for x in ids])
+    order = np.lexsort((src, u[:, 1], u[:, 0]))
+    ou = u[order]
+    same = np.zeros(n, dtype=bool)
+    same[1:] = (ou[1:] == ou[:-1]).all(axis=1)
+    return src[order], sid[order], same
+
+
+def _combine_collision(sources: list[_Source], blocks: list[BackendBlock],
+                       members: list[tuple[int, int]], tenant: str) -> _Source:
+    """Materialize + combine one duplicated trace id, re-flatten through a
+    one-trace builder into a columnar source of its own."""
+    tid = sources[members[0][0]].cols["trace.id"][members[0][1]].tobytes()
+    traces = [blocks[b].materialize_traces([sid])[0] for b, sid in members]
+    combined = combine_traces(traces)
+    b = BlockBuilder(tenant)
+    b.add_trace(tid, combined)
+    fin = b.finalize()
+    return _Source(fin.cols, fin.dictionary.strings)
+
+
+class _Chunk:
+    __slots__ = ("src", "sid_lo", "sid_hi", "span_lo", "span_hi",
+                 "sa", "ev", "ln", "ea", "la")
+
+    def __init__(self, src: int, s: _Source, sid_lo: int, sid_hi: int):
+        self.src = src
+        self.sid_lo, self.sid_hi = sid_lo, sid_hi
+        self.span_lo = int(s.span_off[sid_lo])
+        self.span_hi = int(s.span_off[sid_hi])
+        self.sa = s.child_range("sattr.span", self.span_lo, self.span_hi)
+        self.ev = s.child_range("ev.span", self.span_lo, self.span_hi)
+        self.ln = s.child_range("ln.span", self.span_lo, self.span_hi)
+        self.ea = s.child_range("evattr.ev", self.ev[0], self.ev[1])
+        self.la = s.child_range("lnattr.ln", self.ln[0], self.ln[1])
+
+
+def _assemble(tenant: str, sources: list[_Source], chunks: list[_Chunk],
+              merged: Dictionary, level: int, row_group_spans: int,
+              bloom: ShardedBloom | None) -> FinalizedBlock:
+    names = list(sources[chunks[0].src].cols)
+    # per-source table bases in first-use order, subset to the res/scope
+    # rows this output block's spans actually reference (size cuts split a
+    # source across outputs; carrying whole tables would duplicate and
+    # accumulate dead rows across compaction levels)
+    src_order: list[int] = []
+    for c in chunks:
+        if c.src not in src_order:
+            src_order.append(c.src)
+    ref_res: dict[int, list[np.ndarray]] = {si: [] for si in src_order}
+    ref_scope: dict[int, list[np.ndarray]] = {si: [] for si in src_order}
+    for c in chunks:
+        s = sources[c.src]
+        ref_res[c.src].append(s.cols["span.res_idx"][c.span_lo: c.span_hi])
+        ref_scope[c.src].append(s.cols["span.scope_idx"][c.span_lo: c.span_hi])
+    used_res: dict[int, np.ndarray] = {}
+    used_scope: dict[int, np.ndarray] = {}
+    res_base: dict[int, int] = {}
+    scope_base: dict[int, int] = {}
+    rb = sb = 0
+    for si in src_order:
+        ur = np.unique(np.concatenate(ref_res[si])) if ref_res[si] else np.empty(0, np.int32)
+        us = np.unique(np.concatenate(ref_scope[si])) if ref_scope[si] else np.empty(0, np.int32)
+        used_res[si] = ur[ur >= 0]
+        used_scope[si] = us[us >= 0]
+        res_base[si], scope_base[si] = rb, sb
+        rb += used_res[si].shape[0]
+        sb += used_scope[si].shape[0]
+
+    def _translate(si: int, old: np.ndarray, used: dict[int, np.ndarray],
+                   base: dict[int, int]) -> np.ndarray:
+        new = np.searchsorted(used[si], old).astype(np.int32) + base[si]
+        return np.where(old >= 0, new, old).astype(np.int32)
+
+    # running output bases per chunk
+    trace_base = np.zeros(len(chunks), dtype=np.int64)
+    span_base = np.zeros(len(chunks), dtype=np.int64)
+    ev_base = np.zeros(len(chunks), dtype=np.int64)
+    ln_base = np.zeros(len(chunks), dtype=np.int64)
+    t = sp = ev = ln = 0
+    for i, c in enumerate(chunks):
+        trace_base[i], span_base[i], ev_base[i], ln_base[i] = t, sp, ev, ln
+        t += c.sid_hi - c.sid_lo
+        sp += c.span_hi - c.span_lo
+        ev += c.ev[1] - c.ev[0]
+        ln += c.ln[1] - c.ln[0]
+
+    def cat(parts: list[np.ndarray], like: np.ndarray) -> np.ndarray:
+        if not parts:
+            return np.empty((0,) + like.shape[1:], dtype=like.dtype)
+        return np.concatenate(parts)
+
+    cols: dict[str, np.ndarray] = {}
+    for n in names:
+        pref = n.split(".", 1)[0]
+        like = sources[chunks[0].src].cols[n]
+        if n in ("span.trace_sid", "span.start_ms", "trace.span_off",
+                 "trace.start_ms", "trace.end_ms"):
+            continue  # recomputed below
+        if pref == "span":
+            parts = []
+            for i, c in enumerate(chunks):
+                a = sources[c.src].cols[n][c.span_lo: c.span_hi]
+                if n == "span.res_idx":
+                    a = _translate(c.src, a, used_res, res_base)
+                elif n == "span.scope_idx":
+                    a = _translate(c.src, a, used_scope, scope_base)
+                parts.append(a)
+            cols[n] = cat(parts, like)
+        elif pref == "trace":
+            cols[n] = cat(
+                [sources[c.src].cols[n][c.sid_lo: c.sid_hi] for c in chunks], like
+            )
+        elif pref in ("sattr", "ev", "ln", "evattr", "lnattr"):
+            rng = {"sattr": "sa", "ev": "ev", "ln": "ln", "evattr": "ea", "lnattr": "la"}[pref]
+            parts = []
+            for i, c in enumerate(chunks):
+                lo, hi = getattr(c, rng)
+                a = sources[c.src].cols[n][lo:hi]
+                if n in ("sattr.span", "ev.span", "ln.span"):
+                    a = a - c.span_lo + span_base[i]
+                elif n == "evattr.ev":
+                    a = a - c.ev[0] + ev_base[i]
+                elif n == "lnattr.ln":
+                    a = a - c.ln[0] + ln_base[i]
+                parts.append(a)
+            cols[n] = cat(parts, like).astype(like.dtype, copy=False)
+        elif pref in ("res", "scope"):
+            used = used_res if pref == "res" else used_scope
+            cols[n] = cat([sources[si].cols[n][used[si]] for si in src_order], like)
+        elif pref == "rattr":
+            parts = []
+            for si in src_order:
+                owner = sources[si].cols["rattr.res"]
+                keep = np.isin(owner, used_res[si])
+                a = sources[si].cols[n][keep]
+                if n == "rattr.res":
+                    a = _translate(si, a, used_res, res_base)
+                parts.append(a)
+            cols[n] = cat(parts, like)
+        else:
+            raise UnsupportedColumnar(f"unknown column family: {n}")
+
+    # recomputed columns
+    n_traces = int(trace_base[-1] + (chunks[-1].sid_hi - chunks[-1].sid_lo))
+    span_counts_parts = []
+    for c in chunks:
+        so = sources[c.src].span_off
+        span_counts_parts.append(so[c.sid_lo + 1: c.sid_hi + 1] - so[c.sid_lo: c.sid_hi])
+    span_counts = cat(span_counts_parts, np.empty(0, np.int32))
+    cols["trace.span_off"] = np.concatenate(
+        [[0], np.cumsum(span_counts.astype(np.int64))]
+    ).astype(np.int32)
+    cols["span.trace_sid"] = np.repeat(
+        np.arange(n_traces, dtype=np.int32), span_counts
+    )
+
+    start_ns = cols["span.start_ns"].astype(np.int64)
+    base_ns = int(start_ns.min()) if start_ns.size else 0
+    cols["span.start_ms"] = ((start_ns - base_ns) // 1_000_000).astype(np.int32)
+    tr_start = cols["trace.start_ns"].astype(np.int64)
+    tr_end = cols["trace.end_ns"].astype(np.int64)
+    cols["trace.start_ms"] = ((tr_start - base_ns) // 1_000_000).astype(np.int32)
+    cols["trace.end_ms"] = ((tr_end - base_ns) // 1_000_000).astype(np.int32)
+
+    axes, col_axis, row_groups = compute_row_groups(
+        cols, cols["span.start_ms"], cols["span.dur_us"], row_group_spans
+    )
+
+    m = BlockMeta.new(tenant)
+    m.compaction_level = level
+    m.total_traces = n_traces
+    m.total_spans = int(cols["span.trace_sid"].shape[0])
+    ids = cols["trace.id"]
+    m.min_id = ids[0].tobytes().hex() if n_traces else ""
+    m.max_id = ids[-1].tobytes().hex() if n_traces else ""
+    m.start_time_unix_nano = base_ns
+    m.end_time_unix_nano = int(cols["span.end_ns"].max()) if cols["span.end_ns"].size else 0
+    m.dict_size = len(merged)
+    m.row_groups = row_groups
+
+    if bloom is None:
+        bloom = ShardedBloom.for_estimated_items(max(n_traces, 1))
+        bloom.add_many([ids[i].tobytes() for i in range(n_traces)])
+    m.bloom_shards = bloom.n_shards
+    m.bloom_shard_bits = bloom.shard_bits
+    return FinalizedBlock(m, cols, axes, col_axis, merged, bloom)
+
+
+def compact_columnar(backend: RawBackend, job: CompactionJob, cfg: CompactorConfig) -> CompactionResult:
+    tenant = job.tenant
+    blocks = [BackendBlock(backend, m) for m in job.blocks]
+    sources = [_Source.from_block(b) for b in blocks]
+    names = set(sources[0].cols)
+    if any(set(s.cols) != names for s in sources[1:]):
+        raise UnsupportedColumnar("input blocks have differing column sets")
+    out_level = max(m.compaction_level for m in job.blocks) + 1
+
+    src_arr, sid_arr, same = _merge_order(sources)
+    n = len(src_arr)
+    dup = same.copy()
+    if n:
+        dup[:-1] |= same[1:]
+
+    # collision groups become one-trace sources appended after the blocks
+    runs: list[tuple[int, int, int]] = []  # (src, sid_lo, sid_hi)
+    i = 0
+    while i < n:
+        if dup[i]:
+            j = i + 1
+            while j < n and same[j]:
+                j += 1
+            members = [(int(src_arr[k]), int(sid_arr[k])) for k in range(i, j)]
+            sources.append(_combine_collision(sources, blocks, members, tenant))
+            runs.append((len(sources) - 1, 0, 1))
+            i = j
+        else:
+            b, lo = int(src_arr[i]), int(sid_arr[i])
+            hi = lo + 1
+            j = i + 1
+            while j < n and not dup[j] and src_arr[j] == b and sid_arr[j] == hi:
+                hi += 1
+                j += 1
+            runs.append((b, lo, hi))
+            i = j
+    if not runs:
+        for m in job.blocks:
+            backend.mark_compacted(tenant, m.block_id)
+        return CompactionResult(compacted_ids=[m.block_id for m in job.blocks])
+
+    # merged dictionary + one remap gather per source
+    merged_strings = sorted(set().union(*[set(s.strings) for s in sources]))
+    code_of = {s: i for i, s in enumerate(merged_strings)}
+    merged = Dictionary(merged_strings)
+    for s in sources:
+        s.remap_codes(code_of)
+
+    # size-target output cuts, estimated from input bytes/trace
+    total_in = sum(m.size_bytes for m in job.blocks)
+    total_traces_in = max(1, sum(m.total_traces for m in job.blocks))
+    bpt = max(1.0, total_in / total_traces_in)
+    target = cfg.target_block_bytes or cfg.max_block_bytes
+    cap_traces = max(1, int(target / bpt))
+
+    result = CompactionResult()
+    chunk_lists: list[list[_Chunk]] = [[]]
+    acc = 0
+    for src, lo, hi in runs:
+        while hi - lo > 0:
+            room = cap_traces - acc
+            take = min(hi - lo, max(1, room))
+            chunk_lists[-1].append(_Chunk(src, sources[src], lo, lo + take))
+            lo += take
+            acc += take
+            if acc >= cap_traces:
+                chunk_lists.append([])
+                acc = 0
+    chunk_lists = [cl for cl in chunk_lists if cl]
+
+    single_out = len(chunk_lists) == 1
+    for cl in chunk_lists:
+        bloom = _union_input_blooms(blocks) if single_out else None
+        fin = _assemble(tenant, sources, cl, merged, out_level, cfg.row_group_spans, bloom)
+        meta = write_block(backend, fin)
+        result.new_blocks.append(meta)
+        result.traces_out += fin.meta.total_traces
+        result.spans_out += fin.meta.total_spans
+
+    result.compacted_ids = [m.block_id for m in job.blocks]
+    for m in job.blocks:
+        backend.mark_compacted(tenant, m.block_id)
+    return result

@@ -59,6 +59,8 @@ class CompactorConfig:
     retention_s: int = 14 * 24 * 3600
     compacted_retention_s: int = 3600
     row_group_spans: int = 1 << 16
+    columnar: bool = True  # numpy-level merge fast path (columnar_compact.py)
+    target_block_bytes: int = 0  # output size cut; 0 -> max_block_bytes
 
 
 def select_jobs(tenant: str, metas: list[BlockMeta], cfg: CompactorConfig, now: float | None = None) -> list[CompactionJob]:
@@ -140,8 +142,23 @@ def _union_input_blooms(blocks: list[BackendBlock]):
 
 
 def compact(backend: RawBackend, job: CompactionJob, cfg: CompactorConfig) -> CompactionResult:
-    """Merge the job's blocks into one output block (wire-level merge;
-    the columnar fast path lands in compact_columnar)."""
+    """Run one compaction job: the columnar numpy-level merge
+    (columnar_compact.py) by default, falling back to the wire-level
+    merge only when the inputs aren't columnar-mergeable."""
+    if cfg.columnar:
+        from .columnar_compact import UnsupportedColumnar, compact_columnar
+
+        try:
+            return compact_columnar(backend, job, cfg)
+        except UnsupportedColumnar:
+            pass
+    return _compact_wire(backend, job, cfg)
+
+
+def _compact_wire(backend: RawBackend, job: CompactionJob, cfg: CompactorConfig) -> CompactionResult:
+    """Wire-model merge: every trace decodes to the wire model and
+    re-encodes through the builder. Correct for any inputs; used as the
+    columnar fast path's fallback."""
     blocks = [BackendBlock(backend, m) for m in job.blocks]
     out_level = max(m.compaction_level for m in job.blocks) + 1
     builder = BlockBuilder(

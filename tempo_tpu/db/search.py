@@ -222,11 +222,15 @@ def search_blocks_device(
     generic-attr path or the stacked columns exceed the device budget --
     the caller falls back to per-block search_block."""
     resp = SearchResponse()
+    in_range = [b for b in blocks if b.meta.overlaps_time(req.start, req.end)]
+    # plan fan-out pulls each block's dictionary + footer: overlap the IO
+    plans = (
+        list(pool.map(lambda b: _plan_for_block(b, req), in_range))
+        if pool is not None
+        else [_plan_for_block(b, req) for b in in_range]
+    )
     live: list[tuple[BackendBlock, object]] = []
-    for blk in blocks:
-        if not blk.meta.overlaps_time(req.start, req.end):
-            continue
-        p = _plan_for_block(blk, req)
+    for blk, p in zip(in_range, plans):
         if p.prune:
             continue
         if any(c.target not in (T_SPAN, T_RES, T_TRACE) for c in p.conds):
