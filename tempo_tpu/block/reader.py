@@ -117,9 +117,17 @@ class BackendBlock:
         )
 
     def bloom_shard(self, shard: int) -> np.ndarray:
+        cache = getattr(self, "_bloom_cache", None)
+        if cache is None:
+            cache = self._bloom_cache = {}
+        hit = cache.get(shard)
+        if hit is not None:
+            return hit
         data = self.backend.read(self.meta.tenant_id, self.meta.block_id, f"{BLOOM_PREFIX}{shard}")
         self.bytes_read += len(data)
-        return ShardedBloom.shard_from_bytes(data)
+        words = ShardedBloom.shard_from_bytes(data)
+        cache[shard] = words  # blocks are immutable; shards are ~100 KiB
+        return words
 
     @cached_property
     def trace_index(self) -> dict[str, np.ndarray]:

@@ -120,48 +120,108 @@ def _combine_collision(sources: list[_Source], blocks: list[BackendBlock],
     return _Source(fin.cols, fin.dictionary.strings)
 
 
-class _Chunk:
-    __slots__ = ("src", "sid_lo", "sid_hi", "span_lo", "span_hi",
-                 "sa", "ev", "ln", "ea", "la")
-
-    def __init__(self, src: int, s: _Source, sid_lo: int, sid_hi: int):
-        self.src = src
-        self.sid_lo, self.sid_hi = sid_lo, sid_hi
-        self.span_lo = int(s.span_off[sid_lo])
-        self.span_hi = int(s.span_off[sid_hi])
-        self.sa = s.child_range("sattr.span", self.span_lo, self.span_hi)
-        self.ev = s.child_range("ev.span", self.span_lo, self.span_hi)
-        self.ln = s.child_range("ln.span", self.span_lo, self.span_hi)
-        self.ea = s.child_range("evattr.ev", self.ev[0], self.ev[1])
-        self.la = s.child_range("lnattr.ln", self.ln[0], self.ln[1])
+def _ranges_to_idx(los: np.ndarray, his: np.ndarray) -> np.ndarray:
+    """Vectorized multi-range arange: concatenate(arange(lo, hi) for each
+    range) without a Python loop."""
+    lens = his - los
+    total = int(lens.sum())
+    if total == 0:
+        return np.empty(0, dtype=np.int64)
+    starts = np.cumsum(lens) - lens
+    return np.repeat(los - starts, lens) + np.arange(total, dtype=np.int64)
 
 
-def _assemble(tenant: str, sources: list[_Source], chunks: list[_Chunk],
+def _assemble(tenant: str, sources: list[_Source], chunks: list[tuple[int, int, int]],
               merged: Dictionary, level: int, row_group_spans: int,
               bloom: ShardedBloom | None) -> FinalizedBlock:
-    names = list(sources[chunks[0].src].cols)
-    # per-source table bases in first-use order, subset to the res/scope
-    # rows this output block's spans actually reference (size cuts split a
-    # source across outputs; carrying whole tables would duplicate and
-    # accumulate dead rows across compaction levels)
+    """Assemble one output block from (src, sid_lo, sid_hi) chunks.
+
+    Everything is per-SOURCE vectorized: each axis of each source
+    contributes via exactly one gather + one scatter per column, so cost
+    does not degrade when the merge interleaves finely (many tiny runs,
+    the 1000-small-blocks compaction shape)."""
+    names = list(sources[chunks[0][0]].cols)
+    csrc = np.asarray([c[0] for c in chunks], dtype=np.int32)
+    clo = np.asarray([c[1] for c in chunks], dtype=np.int64)
+    chi = np.asarray([c[2] for c in chunks], dtype=np.int64)
     src_order: list[int] = []
-    for c in chunks:
-        if c.src not in src_order:
-            src_order.append(c.src)
-    ref_res: dict[int, list[np.ndarray]] = {si: [] for si in src_order}
-    ref_scope: dict[int, list[np.ndarray]] = {si: [] for si in src_order}
-    for c in chunks:
-        s = sources[c.src]
-        ref_res[c.src].append(s.cols["span.res_idx"][c.span_lo: c.span_hi])
-        ref_scope[c.src].append(s.cols["span.scope_idx"][c.span_lo: c.span_hi])
+    for s in csrc:
+        if int(s) not in src_order:
+            src_order.append(int(s))
+    by_src = {si: np.nonzero(csrc == si)[0] for si in src_order}
+
+    # per-chunk row ranges along every axis (one vectorized searchsorted
+    # per source per child axis)
+    span_lo = np.zeros(len(chunks), np.int64)
+    span_hi = np.zeros(len(chunks), np.int64)
+    child_axes = {  # axis -> (owner col, parent range arrays)
+        "sattr": "sattr.span", "ev": "ev.span", "ln": "ln.span",
+        "evattr": "evattr.ev", "lnattr": "lnattr.ln",
+    }
+    ax_lo = {a: np.zeros(len(chunks), np.int64) for a in child_axes}
+    ax_hi = {a: np.zeros(len(chunks), np.int64) for a in child_axes}
+    for si in src_order:
+        s = sources[si]
+        ii = by_src[si]
+        span_lo[ii] = s.span_off[clo[ii]]
+        span_hi[ii] = s.span_off[chi[ii]]
+        for a in ("sattr", "ev", "ln"):
+            owner = s.cols[child_axes[a]]
+            ax_lo[a][ii] = np.searchsorted(owner, span_lo[ii], "left")
+            ax_hi[a][ii] = np.searchsorted(owner, span_hi[ii], "left")
+        for a, parent in (("evattr", "ev"), ("lnattr", "ln")):
+            owner = s.cols[child_axes[a]]
+            ax_lo[a][ii] = np.searchsorted(owner, ax_lo[parent][ii], "left")
+            ax_hi[a][ii] = np.searchsorted(owner, ax_hi[parent][ii], "left")
+
+    # per-chunk output bases per axis
+    def bases(lens: np.ndarray) -> tuple[np.ndarray, int]:
+        cs = np.cumsum(lens)
+        return cs - lens, int(cs[-1]) if len(lens) else 0
+
+    tr_b, n_traces = bases(chi - clo)
+    sp_b, n_spans = bases(span_hi - span_lo)
+    ax_b = {}
+    ax_n = {}
+    for a in child_axes:
+        ax_b[a], ax_n[a] = bases(ax_hi[a] - ax_lo[a])
+
+    # per (source, axis) gather/scatter indexes
+    gather: dict[tuple[int, str], tuple[np.ndarray, np.ndarray]] = {}
+    axis_ranges = {"trace": (clo, chi, tr_b), "span": (span_lo, span_hi, sp_b)}
+    for a in child_axes:
+        axis_ranges[a] = (ax_lo[a], ax_hi[a], ax_b[a])
+    for si in src_order:
+        ii = by_src[si]
+        for a, (alo, ahi, ab) in axis_ranges.items():
+            src_idx = _ranges_to_idx(alo[ii], ahi[ii])
+            dst_idx = _ranges_to_idx(ab[ii], ab[ii] + (ahi[ii] - alo[ii]))
+            gather[(si, a)] = (src_idx, dst_idx)
+
+    # owner-column rebase offsets: dest parent base - src parent lo, per row
+    owner_off: dict[tuple[int, str], np.ndarray] = {}
+    parent_of = {"sattr": (sp_b, span_lo), "ev": (sp_b, span_lo), "ln": (sp_b, span_lo),
+                 "evattr": (ax_b["ev"], ax_lo["ev"]), "lnattr": (ax_b["ln"], ax_lo["ln"])}
+    for si in src_order:
+        ii = by_src[si]
+        for a, (pb, plo) in parent_of.items():
+            owner_off[(si, a)] = np.repeat(pb[ii] - plo[ii], (ax_hi[a] - ax_lo[a])[ii])
+
+    # res/scope subsetting: only rows this block's spans reference
+    span_resvals: dict[int, np.ndarray] = {}
+    span_scopevals: dict[int, np.ndarray] = {}
     used_res: dict[int, np.ndarray] = {}
     used_scope: dict[int, np.ndarray] = {}
     res_base: dict[int, int] = {}
     scope_base: dict[int, int] = {}
     rb = sb = 0
     for si in src_order:
-        ur = np.unique(np.concatenate(ref_res[si])) if ref_res[si] else np.empty(0, np.int32)
-        us = np.unique(np.concatenate(ref_scope[si])) if ref_scope[si] else np.empty(0, np.int32)
+        src_idx, _ = gather[(si, "span")]
+        rv = sources[si].cols["span.res_idx"][src_idx]
+        sv = sources[si].cols["span.scope_idx"][src_idx]
+        span_resvals[si], span_scopevals[si] = rv, sv
+        ur = np.unique(rv)
+        us = np.unique(sv)
         used_res[si] = ur[ur >= 0]
         used_scope[si] = us[us >= 0]
         res_base[si], scope_base[si] = rb, sb
@@ -173,62 +233,32 @@ def _assemble(tenant: str, sources: list[_Source], chunks: list[_Chunk],
         new = np.searchsorted(used[si], old).astype(np.int32) + base[si]
         return np.where(old >= 0, new, old).astype(np.int32)
 
-    # running output bases per chunk
-    trace_base = np.zeros(len(chunks), dtype=np.int64)
-    span_base = np.zeros(len(chunks), dtype=np.int64)
-    ev_base = np.zeros(len(chunks), dtype=np.int64)
-    ln_base = np.zeros(len(chunks), dtype=np.int64)
-    t = sp = ev = ln = 0
-    for i, c in enumerate(chunks):
-        trace_base[i], span_base[i], ev_base[i], ln_base[i] = t, sp, ev, ln
-        t += c.sid_hi - c.sid_lo
-        sp += c.span_hi - c.span_lo
-        ev += c.ev[1] - c.ev[0]
-        ln += c.ln[1] - c.ln[0]
-
-    def cat(parts: list[np.ndarray], like: np.ndarray) -> np.ndarray:
-        if not parts:
-            return np.empty((0,) + like.shape[1:], dtype=like.dtype)
-        return np.concatenate(parts)
+    axis_rows = {"trace": n_traces, "span": n_spans, **ax_n}
 
     cols: dict[str, np.ndarray] = {}
     for n in names:
         pref = n.split(".", 1)[0]
-        like = sources[chunks[0].src].cols[n]
+        like = sources[src_order[0]].cols[n]
         if n in ("span.trace_sid", "span.start_ms", "trace.span_off",
                  "trace.start_ms", "trace.end_ms"):
             continue  # recomputed below
-        if pref == "span":
-            parts = []
-            for i, c in enumerate(chunks):
-                a = sources[c.src].cols[n][c.span_lo: c.span_hi]
+        if pref in axis_rows:
+            out = np.empty((axis_rows[pref],) + like.shape[1:], dtype=like.dtype)
+            for si in src_order:
+                src_idx, dst_idx = gather[(si, pref)]
+                vals = sources[si].cols[n][src_idx]
                 if n == "span.res_idx":
-                    a = _translate(c.src, a, used_res, res_base)
+                    vals = _translate(si, span_resvals[si], used_res, res_base)
                 elif n == "span.scope_idx":
-                    a = _translate(c.src, a, used_scope, scope_base)
-                parts.append(a)
-            cols[n] = cat(parts, like)
-        elif pref == "trace":
-            cols[n] = cat(
-                [sources[c.src].cols[n][c.sid_lo: c.sid_hi] for c in chunks], like
-            )
-        elif pref in ("sattr", "ev", "ln", "evattr", "lnattr"):
-            rng = {"sattr": "sa", "ev": "ev", "ln": "ln", "evattr": "ea", "lnattr": "la"}[pref]
-            parts = []
-            for i, c in enumerate(chunks):
-                lo, hi = getattr(c, rng)
-                a = sources[c.src].cols[n][lo:hi]
-                if n in ("sattr.span", "ev.span", "ln.span"):
-                    a = a - c.span_lo + span_base[i]
-                elif n == "evattr.ev":
-                    a = a - c.ev[0] + ev_base[i]
-                elif n == "lnattr.ln":
-                    a = a - c.ln[0] + ln_base[i]
-                parts.append(a)
-            cols[n] = cat(parts, like).astype(like.dtype, copy=False)
+                    vals = _translate(si, span_scopevals[si], used_scope, scope_base)
+                elif n in ("sattr.span", "ev.span", "ln.span", "evattr.ev", "lnattr.ln"):
+                    vals = (vals + owner_off[(si, pref)]).astype(like.dtype)
+                out[dst_idx] = vals
+            cols[n] = out
         elif pref in ("res", "scope"):
             used = used_res if pref == "res" else used_scope
-            cols[n] = cat([sources[si].cols[n][used[si]] for si in src_order], like)
+            parts = [sources[si].cols[n][used[si]] for si in src_order]
+            cols[n] = np.concatenate(parts) if parts else like[:0]
         elif pref == "rattr":
             parts = []
             for si in src_order:
@@ -238,19 +268,18 @@ def _assemble(tenant: str, sources: list[_Source], chunks: list[_Chunk],
                 if n == "rattr.res":
                     a = _translate(si, a, used_res, res_base)
                 parts.append(a)
-            cols[n] = cat(parts, like)
+            cols[n] = np.concatenate(parts) if parts else like[:0]
         else:
             raise UnsupportedColumnar(f"unknown column family: {n}")
 
     # recomputed columns
-    n_traces = int(trace_base[-1] + (chunks[-1].sid_hi - chunks[-1].sid_lo))
-    span_counts_parts = []
-    for c in chunks:
-        so = sources[c.src].span_off
-        span_counts_parts.append(so[c.sid_lo + 1: c.sid_hi + 1] - so[c.sid_lo: c.sid_hi])
-    span_counts = cat(span_counts_parts, np.empty(0, np.int32))
+    span_counts = np.empty(n_traces, dtype=np.int64)
+    for si in src_order:
+        src_idx, dst_idx = gather[(si, "trace")]
+        so = sources[si].span_off.astype(np.int64)
+        span_counts[dst_idx] = so[src_idx + 1] - so[src_idx]
     cols["trace.span_off"] = np.concatenate(
-        [[0], np.cumsum(span_counts.astype(np.int64))]
+        [[0], np.cumsum(span_counts)]
     ).astype(np.int32)
     cols["span.trace_sid"] = np.repeat(
         np.arange(n_traces, dtype=np.int32), span_counts
@@ -271,7 +300,7 @@ def _assemble(tenant: str, sources: list[_Source], chunks: list[_Chunk],
     m = BlockMeta.new(tenant)
     m.compaction_level = level
     m.total_traces = n_traces
-    m.total_spans = int(cols["span.trace_sid"].shape[0])
+    m.total_spans = n_spans
     ids = cols["trace.id"]
     m.min_id = ids[0].tobytes().hex() if n_traces else ""
     m.max_id = ids[-1].tobytes().hex() if n_traces else ""
@@ -344,13 +373,13 @@ def compact_columnar(backend: RawBackend, job: CompactionJob, cfg: CompactorConf
     cap_traces = max(1, int(target / bpt))
 
     result = CompactionResult()
-    chunk_lists: list[list[_Chunk]] = [[]]
+    chunk_lists: list[list[tuple[int, int, int]]] = [[]]
     acc = 0
     for src, lo, hi in runs:
         while hi - lo > 0:
             room = cap_traces - acc
             take = min(hi - lo, max(1, room))
-            chunk_lists[-1].append(_Chunk(src, sources[src], lo, lo + take))
+            chunk_lists[-1].append((src, lo, lo + take))
             lo += take
             acc += take
             if acc >= cap_traces:

@@ -22,7 +22,11 @@ from ..traceql.plan import plan_search_request
 from ..util.distinct import DistinctStringCollector
 
 DEFAULT_LIMIT = 20
-_STREAM_MIN_GROUPS = 8  # blocks larger than this stream chunks through device
+# stream row-group chunks only when the staged columns would exceed this
+# (bounds device memory); below it a single staged eval wins -- one kernel
+# dispatch + one result transfer instead of one per chunk, which matters
+# when host<->device latency is high
+_STREAM_MIN_STAGE_BYTES = 512 << 20
 
 _INTRINSIC_NAME = "name"
 _WELL_KNOWN_RES = {
@@ -148,10 +152,14 @@ def search_block(
     operands = Operands.build(planned.rows, planned.tables or None)
     needed = required_columns(planned.conds)
     span_ax = blk.pack.axes.get("span")
-    n_groups = len(groups_range) if groups_range is not None else (
-        span_ax.n_groups if span_ax else 1
-    )
-    if n_groups > _STREAM_MIN_GROUPS:
+    if groups_range is not None:
+        n_rows = sum(
+            span_ax.offsets[g + 1] - span_ax.offsets[g] for g in groups_range
+        ) if span_ax else 0
+    else:
+        n_rows = span_ax.n_rows if span_ax else 0
+    n_span_cols = max(1, sum(1 for n in needed if n.startswith(("span.", "sattr."))))
+    if n_rows * 4 * n_span_cols > _STREAM_MIN_STAGE_BYTES:
         # large scan: stream row-group chunks, prefetching the next chunk's
         # IO while the device filters the current one (ops/stream.py)
         from ..ops.stream import eval_block_streamed

@@ -127,7 +127,7 @@ class TempoDB:
         device analog of the reference's per-block fan-out + combiner
         (tempodb/tempodb.go:271-352)."""
         from ..block import schema as S
-        from ..ops.find import lookup_ids_blocks
+        from ..ops.find import lookup_ids_blocks_cached
         from ..parallel.find import sharded_find_rows
 
         blocks = [self.open_block(m) for m in candidates]
@@ -135,14 +135,21 @@ class TempoDB:
         blocks = [b for b, ok in zip(blocks, gates) if ok]
         if not blocks:
             return []
-        codes = list(self.pool.map(lambda b: b.trace_index["trace.id_codes"], blocks))
         query = np.asarray(
             [S.trace_id_to_codes(trace_id.rjust(16, b"\x00"))], dtype=np.int32
         )
         if self.mesh.devices.size > 1:
+            codes = list(self.pool.map(lambda b: b.trace_index["trace.id_codes"], blocks))
             sids = sharded_find_rows(self.mesh, codes, query)
+        elif len(blocks) > 1:
+            # device-cached per-block id indexes; one transfer for results
+            list(self.pool.map(lambda b: b.trace_index, blocks))  # parallel IO
+            sids = lookup_ids_blocks_cached(blocks, query)
         else:
-            sids = lookup_ids_blocks(codes, query)
+            # a lone id in one block: a host bisect is O(log n) with zero
+            # device round trips -- the device kernel's value is BATCHED
+            # lookups (many ids / many blocks) and mesh sharding
+            sids = np.asarray([[blocks[0].find_trace_sid(trace_id)]], dtype=np.int32)
         hits = [(blk, int(sid)) for blk, sid in zip(blocks, sids[:, 0]) if sid >= 0]
         return list(self.pool.map(lambda h: h[0].materialize_traces([h[1]])[0], hits))
 

@@ -4,9 +4,12 @@ Reads only the columns a condition set needs (ops.filter.required_columns),
 optionally only a row-group range (the unit of search-job sharding,
 mirroring the reference's StartPage/TotalPages jobs,
 modules/frontend/searchsharding.go), pads every axis to its power-of-two
-bucket, and uploads. StagedBlock caches the device arrays so repeated
-queries against a hot block skip both IO and transfer.
-"""
+bucket, and uploads. Staged device arrays are cached on the (immutable)
+block object keyed by (column set, group range), so repeated queries
+against a hot block skip IO, decompression, AND the host->device
+transfer -- the device-memory analog of the reference's page cache +
+memcached layers, and the biggest win when the host<->device link has
+high latency."""
 
 from __future__ import annotations
 
@@ -18,6 +21,9 @@ import numpy as np
 from ..block import schema as S
 from ..block.reader import BackendBlock
 from .device import PAD_I32, bucket, pad_rows
+
+_CACHE_MAX_ENTRIES = 32  # per block
+_CACHE_MAX_ENTRY_BYTES = 256 << 20
 
 _AXIS_OF = {
     "span": S.AX_SPAN,
@@ -44,9 +50,17 @@ def stage_block(
     blk: BackendBlock,
     needed: list[str],
     groups: list[int] | None = None,
+    cache: bool = True,
 ) -> StagedBlock:
     """Load `needed` columns (padded, on device). If `groups` is given,
-    span/sattr-axis columns cover only those contiguous row groups."""
+    span/sattr-axis columns cover only those contiguous row groups.
+    Results cache on the block object (blocks are immutable)."""
+    key = (tuple(needed), tuple(groups) if groups is not None else None)
+    store: dict | None = getattr(blk, "_staged_cache", None) if cache else None
+    if store is not None:
+        hit = store.get(key)
+        if hit is not None:
+            return hit
     pack = blk.pack
     span_ax = pack.axes[S.AX_SPAN]
     if groups is None:
@@ -89,11 +103,7 @@ def stage_block(
     for name, arr in host.items():
         pref = name.split(".", 1)[0]
         if pref == "span":
-            if name == "span.trace_sid" or name == "span.res_idx":
-                fill = PAD_I32
-            else:
-                fill = PAD_I32
-            arr = pad_rows(arr, n_spans_b, fill)
+            arr = pad_rows(arr, n_spans_b, PAD_I32)
         elif pref == "sattr":
             if name == "sattr.span":
                 # rebase owner to staged-local rows; pads clip safely since
@@ -110,4 +120,13 @@ def stage_block(
             else:
                 continue  # host-only trace columns are not staged
         staged.cols[name] = jnp.asarray(arr)
+    if cache:
+        nbytes = sum(a.nbytes for a in staged.cols.values())
+        if nbytes <= _CACHE_MAX_ENTRY_BYTES:
+            if store is None:
+                store = {}
+                blk._staged_cache = store
+            if len(store) >= _CACHE_MAX_ENTRIES:
+                store.pop(next(iter(store)))
+            store[key] = staged
     return staged

@@ -94,8 +94,12 @@ def eval_block_streamed(
                     for c in _chunks(len(all_groups), groups_per_chunk)]
 
     n_traces = blk.meta.total_traces
-    leaf_hits = [np.zeros(n_traces, dtype=bool) for _ in leaves]
-    counts = np.zeros(n_traces, dtype=np.int64)
+    # accumulate ON DEVICE: per-chunk results stay resident and fold with
+    # async device ops; the host syncs exactly once at the end. Pulling
+    # each chunk's mask back would cost a device->host round trip per
+    # chunk, which dominates when the interconnect has high latency.
+    leaf_hits: list = [None for _ in leaves]
+    counts_dev = None
     n_spans_seen = 0
 
     def run_tree(t, staged):
@@ -104,7 +108,7 @@ def eval_block_streamed(
             staged.n_spans, staged.n_traces,
             staged.n_spans_b, staged.n_res_b, staged.n_traces_b,
         )
-        return np.asarray(tm)[:n_traces], np.asarray(sc)[:n_traces]
+        return tm, sc  # device arrays, padded (n_traces_b,)
 
     single_tracify = sum(1 for lf in leaves if lf[0] == "tracify") == 1
     nxt = _prefetch_pool.submit(stage_block, blk, needed, chunk_groups[0])
@@ -115,28 +119,38 @@ def eval_block_streamed(
                 nxt = _prefetch_pool.submit(stage_block, blk, needed, chunk_groups[ci + 1])
             if tree is None:
                 tm, sc = run_tree(None, staged)
-                counts += sc
+                counts_dev = sc if counts_dev is None else counts_dev + sc
             else:
                 for j, leaf in enumerate(leaves):
                     if leaf[0] == "cond" and ci > 0:
                         continue  # trace-axis conds are chunk-invariant
                     tm, sc = run_tree(leaf, staged)
-                    leaf_hits[j] |= tm
+                    leaf_hits[j] = tm if leaf_hits[j] is None else leaf_hits[j] | tm
                     if single_tracify and leaf[0] == "tracify":
-                        counts += sc  # the union IS this leaf: no extra pass
+                        counts_dev = sc if counts_dev is None else counts_dev + sc
                 if not single_tracify:
                     _, sc = run_tree(count_tree, staged)
-                    counts += sc
+                    counts_dev = sc if counts_dev is None else counts_dev + sc
             n_spans_seen += staged.n_spans
     finally:
         nxt.cancel()  # abandoned prefetch on error mustn't leak device work
 
+    counts = (
+        np.asarray(counts_dev)[:n_traces].astype(np.int64)
+        if counts_dev is not None
+        else np.zeros(n_traces, dtype=np.int64)
+    )
     if tree is None:
         trace_mask = counts > 0
     else:
+        hits_np = [
+            np.asarray(h)[:n_traces] if h is not None else np.zeros(n_traces, bool)
+            for h in leaf_hits
+        ]
+
         def ev(sk):
             if sk[0] == "leaf":
-                return leaf_hits[sk[1]]
+                return hits_np[sk[1]]
             vals = [ev(ch) for ch in sk[1:]]
             out = vals[0]
             for v in vals[1:]:

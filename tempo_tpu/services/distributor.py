@@ -54,32 +54,34 @@ class Distributor:
         """One OTLP export request worth of ResourceSpans."""
         now = time.time()
         n_spans = sum(len(ss.spans) for rs in batches for ss in rs.scope_spans)
-        nbytes = sum(
-            len(sp.name) + 64 + sum(len(k) + 16 for k in sp.attrs)
-            for rs in batches
-            for ss in rs.scope_spans
-            for sp in ss.spans
-        )
         self.stats.spans_received += n_spans
-        self.stats.bytes_received += nbytes
-        if not self.limiter.allow(tenant, nbytes, now):
-            self.stats.spans_refused_rate += n_spans
-            raise PushError(429, f"tenant {tenant} over ingestion rate limit")
 
         per_trace = self._requests_by_trace_id(batches)
         if not per_trace:
             return
 
+        # serialize first so the limiter and bytes_received see REAL wire
+        # bytes, not a guess (reference limits on actual request size,
+        # distributor.go:312-319)
         max_trace = self.overrides.for_tenant(tenant).max_bytes_per_trace
-        lim_filtered = {}
+        segs = {}
+        nbytes = 0
         for tid, tr in per_trace.items():
-            seg = None
             lo, hi = tr.time_range_nanos()
             seg = segment_for_write(tr, (lo or 0) // 10**9, ((hi or 0) + 10**9 - 1) // 10**9)
+            nbytes += len(seg)
+            segs[tid] = ((lo or 0) // 10**9, ((hi or 0) + 10**9 - 1) // 10**9, seg)
+        self.stats.bytes_received += nbytes
+        if not self.limiter.allow(tenant, nbytes, now):
+            self.stats.spans_refused_rate += n_spans
+            raise PushError(429, f"tenant {tenant} over ingestion rate limit")
+
+        lim_filtered = {}
+        for tid, (s, e, seg) in segs.items():
             if max_trace and len(seg) > max_trace:
                 self.stats.traces_refused_size += 1
                 continue
-            lim_filtered[tid] = ((lo or 0) // 10**9, ((hi or 0) + 10**9 - 1) // 10**9, seg)
+            lim_filtered[tid] = (s, e, seg)
         if not lim_filtered:
             return
 

@@ -28,6 +28,16 @@ class TooManyRequests(Exception):
     pass
 
 
+def _retryable(e: Exception) -> bool:
+    """Transient (IO / backend / timeout) errors retry; deterministic
+    failures (parse errors, bad values) fail fast."""
+    from ..backend.base import BackendError, DoesNotExist
+
+    if isinstance(e, DoesNotExist):
+        return False  # deterministic: the object is gone
+    return isinstance(e, (OSError, TimeoutError, ConnectionError, BackendError))
+
+
 class RequestQueue:
     """Per-tenant fair FIFO: tenants round-robin, jobs FIFO within a
     tenant (pkg/scheduler/queue/queue.go)."""
@@ -108,9 +118,12 @@ class Frontend:
             tenant, job = item
             try:
                 job.result = job.fn(*job.args)
-            except Exception as e:  # retry transient failures (retry.go)
+            except Exception as e:
+                # retry only transient failures (reference retries 5xx
+                # only, modules/frontend/retry.go); a parse error or bad
+                # argument fails identically every try
                 job.tries += 1
-                if job.tries < MAX_RETRIES:
+                if _retryable(e) and job.tries < MAX_RETRIES:
                     try:
                         self.queue.enqueue(tenant, job)
                         continue

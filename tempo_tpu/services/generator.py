@@ -51,6 +51,7 @@ class SpanMetricsProcessor:
         self.lock = threading.Lock()
         self.keys: dict[tuple, int] = {}  # series key -> sid
         self.key_list: list[SeriesKey] = []
+        self.free_sids: list[int] = []  # evicted slots, reused on new series
         self.max_active_series = max_active_series
         self.dropped_series = 0
         # pending span columns
@@ -70,11 +71,17 @@ class SpanMetricsProcessor:
                     k = (res.service_name, sp.name, int(sp.kind), int(sp.status_code))
                     sid = self.keys.get(k)
                     if sid is None:
-                        if self.max_active_series and len(self.key_list) >= self.max_active_series:
+                        active = len(self.key_list) - len(self.free_sids)
+                        if self.max_active_series and active >= self.max_active_series:
                             self.dropped_series += 1
                             continue
-                        sid = self.keys[k] = len(self.key_list)
-                        self.key_list.append(SeriesKey(*k))
+                        if self.free_sids:  # reuse an evicted slot
+                            sid = self.free_sids.pop()
+                            self.key_list[sid] = SeriesKey(*k)
+                            self.keys[k] = sid
+                        else:
+                            sid = self.keys[k] = len(self.key_list)
+                            self.key_list.append(SeriesKey(*k))
                     self._sid.append(sid)
                     self._dur_s.append(max(0, sp.duration_nanos) / 1e9)
                     self.last_update[sid] = time.time()
@@ -116,6 +123,14 @@ class SpanMetricsProcessor:
                 del self.last_update[s]
                 key = self.key_list[s]
                 self.keys.pop((key.service, key.span_name, key.kind, key.status), None)
+                # zero the counter rows so a reused slot starts fresh,
+                # then free the sid for the next new series
+                if s < len(self.calls):
+                    self.calls[s] = 0
+                    self.lat_sum[s] = 0.0
+                    self.lat_count[s] = 0
+                    self.lat_buckets[s, :] = 0
+                self.free_sids.append(s)
             return len(stale)
 
     def metrics_text(self) -> list[str]:

@@ -156,10 +156,19 @@ class Instance:
             meta = self.db.write_block(self.tenant, traces)
         except Exception:
             # block write failed: restore the cut set for the next retry;
-            # the old WAL file stays on disk as the checkpoint
+            # the old WAL file stays on disk as the checkpoint. MERGE into
+            # any entry cut for the same id since the snapshot (setdefault
+            # would silently drop the snapshot's segments).
             with self.lock:
                 for tid, lt in cut_snapshot.items():
-                    self.cut.setdefault(tid, lt)
+                    cur = self.cut.get(tid)
+                    if cur is None:
+                        self.cut[tid] = lt
+                    elif cur is not lt:
+                        cur.segments = lt.segments + cur.segments
+                        cur.nbytes += lt.nbytes
+                        cur.start_s = min(cur.start_s or lt.start_s, lt.start_s)
+                        cur.end_s = max(cur.end_s, lt.end_s)
             raise
         self.blocks_flushed += 1
         old_head.clear()  # checkpoint advanced: block is durable in backend

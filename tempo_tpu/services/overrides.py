@@ -41,8 +41,29 @@ class Overrides:
     def __post_init__(self):
         self._lock = threading.Lock()
         self._mtime = 0.0
+        self._stop = threading.Event()
+        self._reloader: threading.Thread | None = None
         if self.path:
             self.reload()
+
+    def start_reloader(self) -> None:
+        """Hot reload every reload_period_s (reference reloads the
+        runtime-config file every 10s, modules/overrides/overrides.go)."""
+        if self._reloader is not None or not self.path:
+            return
+
+        def loop():
+            while not self._stop.wait(self.reload_period_s):
+                try:
+                    self.reload()
+                except Exception:  # noqa: BLE001 - keep last good overrides
+                    pass
+
+        self._reloader = threading.Thread(target=loop, daemon=True, name="overrides-reload")
+        self._reloader.start()
+
+    def stop(self) -> None:
+        self._stop.set()
 
     def for_tenant(self, tenant: str) -> Limits:
         with self._lock:
