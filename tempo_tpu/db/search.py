@@ -1,15 +1,25 @@
-"""Search execution: tag / TraceQL queries -> device filter plan -> results.
+"""Search execution: tag / TraceQL queries -> filter plan -> results.
 
 The per-block pipeline (analog of vparquet/block_search.go:78-116 +
 block_traceql.go Fetch): the traceql planner resolves strings through
 the block dictionary (a miss prunes the whole block -- the dictionary IS
 the page-dictionary pre-filter of parquetquery predicates.go:38-89) and
-emits a trace-level condition tree; ops.filter evaluates it over staged
-columns; surviving trace candidates are exactly re-verified host-side
-for time/duration (device encodings are conservative)."""
+emits a trace-level condition tree; the filter evaluates it over the
+block's columns; the top `limit` candidates BY TRACE START TIME are
+selected before any host materialization (ops/select.py), and only
+those are exactly re-verified (device encodings are conservative).
+
+Two execution engines share the plan + verify contract:
+  - device (ops/filter + ops/stage): staged padded columns, jit kernel,
+    on-device top-k -- ONE small fetch per query. The production path
+    for hot (cached/pinned) blocks; cost is O(limit), not O(matches).
+  - host (ops/hostfilter): vectorized numpy over raw columns, for cold
+    one-shot scans where upload + dispatch round trips exceed the scan.
+"""
 
 from __future__ import annotations
 
+from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -17,6 +27,8 @@ import numpy as np
 from ..block import schema as S
 from ..block.reader import BackendBlock
 from ..ops.filter import Operands, T_RES, T_SPAN, T_TRACE, eval_block, required_columns
+from ..ops.hostfilter import eval_block_host
+from ..ops.select import k_bucket, select_topk_device, select_topk_host
 from ..ops.stage import stage_block
 from ..traceql.plan import plan_search_request
 from ..util.distinct import DistinctStringCollector
@@ -36,6 +48,10 @@ _WELL_KNOWN_RES = {
     "k8s.pod.name": "res.pod_id",
     "k8s.container.name": "res.container_id",
 }
+
+# column IO for the host evaluation path (reads overlap across columns;
+# shared across queries -- each read is one ranged GET + zstd decode)
+_host_io_pool = ThreadPoolExecutor(max_workers=8, thread_name_prefix="search-io")
 
 
 @dataclass
@@ -104,11 +120,52 @@ def _plan_for_block(blk: BackendBlock, req: SearchRequest):
     )
 
 
-def _verify_and_build(
-    blk: BackendBlock, req: SearchRequest, sids: np.ndarray, counts: np.ndarray
+# --------------------------------------------------- candidate selection
+
+
+def _start_key_host(blk: BackendBlock) -> np.ndarray:
+    """trace.start_ms column (the top-k selection key), cached on the
+    immutable block."""
+    key = getattr(blk, "_start_key_host", None)
+    if key is None:
+        key = blk._start_key_host = blk.pack.read("trace.start_ms")
+    return key
+
+
+def _start_key_dev(blk: BackendBlock, nb: int):
+    key = getattr(blk, "_start_key_dev", None)
+    if key is None or key.shape[0] != nb:
+        import jax.numpy as jnp
+
+        from ..ops.device import pad_rows
+
+        key = jnp.asarray(pad_rows(_start_key_host(blk), nb, np.int32(0)))
+        blk._start_key_dev = key
+    return key
+
+
+def _verify_candidates(blk: BackendBlock, req: SearchRequest, sids, needs_verify: bool):
+    """Exact host re-check of TraceQL candidates when the device filter
+    was conservative. Bounded: callers pass at most the escalation k."""
+    if not (needs_verify and req.query and len(sids)):
+        return sids
+    from ..traceql.hosteval import trace_matches
+    from ..traceql.parser import parse
+
+    q = parse(req.query)
+    traces = blk.materialize_traces([int(s) for s in sids])
+    return np.asarray(
+        [s for s, tr in zip(sids, traces) if tr is not None and trace_matches(q, tr)],
+        dtype=np.int64,
+    )
+
+
+def _build_results(
+    blk: BackendBlock, req: SearchRequest, sids: list[int], counts: dict[int, int]
 ) -> list[SearchResult]:
     """Exact host re-check of time/duration + result materialization from
-    the cached trace-level index."""
+    the cached trace-level index. O(len(sids)) -- callers cap it at the
+    escalation k, never the full match count."""
     ti = blk.trace_index
     d = blk.dictionary
     out = []
@@ -131,88 +188,158 @@ def _verify_and_build(
                 root_trace_name=d.string(int(ti["trace.root_name_id"][sid])),
                 start_time_unix_nano=start_ns,
                 duration_ms=dur_ms,
-                matched_spans=int(counts[sid]),
+                matched_spans=int(counts.get(sid, 0)),
             )
         )
     return out
+
+
+def _collect_topk(blk: BackendBlock, req: SearchRequest, needs_verify: bool,
+                  selector, limit: int) -> list[SearchResult]:
+    """Escalating top-k collect: select k candidates (newest first),
+    verify exactly, and only widen k when verification rejected enough
+    to fall short of the limit. selector(k) -> (sids, counts, n_match)."""
+    nt = blk.meta.total_traces
+    if nt == 0:
+        return []
+    k = min(k_bucket(max(2 * limit, 32)), nt)
+    out: list[SearchResult] = []
+    seen: set[int] = set()
+    while True:
+        sids, cnts, n_match = selector(k)
+        fresh = [(int(s), int(c)) for s, c in zip(sids, cnts) if int(s) not in seen]
+        seen.update(s for s, _ in fresh)
+        if fresh:
+            ok = _verify_candidates(
+                blk, req, np.asarray([s for s, _ in fresh], dtype=np.int64), needs_verify
+            )
+            okset = {int(s) for s in ok}
+            out.extend(
+                _build_results(blk, req, [s for s, _ in fresh if s in okset], dict(fresh))
+            )
+        if len(out) >= limit or len(seen) >= n_match or k >= nt:
+            return out
+        k = min(k_bucket(k * 4), nt)
+
+
+# ---------------------------------------------------- per-block search
+
+
+def _host_cols(blk: BackendBlock, needed: list[str], groups_range):
+    """Raw (unpadded) host columns for the numpy evaluator; span/sattr
+    axis columns cover only groups_range when given, with sattr owners
+    rebased to the local span rows (same contract as ops/stage.py)."""
+    pack = blk.pack
+    span_ax = pack.axes.get(S.AX_SPAN)
+    sliced = groups_range is not None and span_ax is not None and span_ax.n_groups > 0
+    span_base = span_ax.offsets[groups_range[0]] if sliced and groups_range else 0
+
+    def read(name):
+        pref = name.split(".", 1)[0]
+        if sliced and pref in ("span", "sattr"):
+            return name, pack.read_groups(name, groups_range)
+        return name, pack.read(name)
+
+    cols = dict(_host_io_pool.map(read, [n for n in needed if not n.startswith("span@")]))
+    if "sattr.span" in cols and span_base:
+        cols["sattr.span"] = cols["sattr.span"] - span_base
+    if "trace.span_off" in cols and sliced:
+        hi = span_ax.offsets[groups_range[-1] + 1] if groups_range else 0
+        cols["trace.span_off"] = (
+            np.clip(cols["trace.span_off"], span_base, hi) - span_base
+        ).astype(np.int32)
+    return cols
 
 
 def search_block(
     blk: BackendBlock,
     req: SearchRequest,
     groups_range: list[int] | None = None,
+    mode: str = "auto",
 ) -> SearchResponse:
-    """Search one block (optionally one row-group shard of it)."""
+    """Search one block (optionally one row-group shard of it).
+
+    mode: 'device' | 'host' | 'auto'. auto picks the device engine for
+    blocks the storage layer keeps hot (TempoDB.open_block pins its
+    cached readers) or that already hold staged device columns, and the
+    host engine for cold one-shot readers, where column upload + a
+    dispatch round trip would dominate a single scan."""
     resp = SearchResponse()
     if not blk.meta.overlaps_time(req.start, req.end):
         return resp
     planned = _plan_for_block(blk, req)
     if planned.prune:
         return resp
+    limit = req.limit or DEFAULT_LIMIT
     operands = Operands.build(planned.rows, planned.tables or None)
     needed = required_columns(planned.conds)
-    span_ax = blk.pack.axes.get("span")
-    if groups_range is not None:
-        n_rows = sum(
-            span_ax.offsets[g + 1] - span_ax.offsets[g] for g in groups_range
-        ) if span_ax else 0
+    pack = blk.pack
+    io0 = pack.bytes_read  # per-query IO delta (pack counts lifetime bytes)
+    span_ax = pack.axes.get(S.AX_SPAN)
+    if groups_range is not None and span_ax is not None:
+        n_rows = sum(span_ax.offsets[g + 1] - span_ax.offsets[g] for g in groups_range)
     else:
         n_rows = span_ax.n_rows if span_ax else 0
-    n_span_cols = max(1, sum(1 for n in needed if n.startswith(("span.", "sattr."))))
-    if n_rows * 4 * n_span_cols > _STREAM_MIN_STAGE_BYTES:
-        # large scan: stream row-group chunks, prefetching the next chunk's
-        # IO while the device filters the current one (ops/stream.py)
-        from ..ops.stream import eval_block_streamed
 
-        trace_mask, counts, n_spans_seen = eval_block_streamed(
-            blk, needed, (planned.tree, planned.conds), operands, groups=groups_range
-        )
-        sids = np.nonzero(trace_mask)[0]
+    use_device = mode == "device" or (
+        mode == "auto"
+        and (getattr(blk, "device_pinned", False)
+             or getattr(blk, "_staged_cache", None) is not None)
+    )
+
+    if use_device:
+        n_span_cols = max(1, sum(1 for n in needed if n.startswith(("span.", "sattr."))))
+        if n_rows * 4 * n_span_cols > _STREAM_MIN_STAGE_BYTES:
+            # large scan: stream row-group chunks, prefetching the next
+            # chunk's IO while the device filters the current one
+            from ..ops.stream import eval_block_streamed
+
+            tm, counts, n_spans_seen = eval_block_streamed(
+                blk, needed, (planned.tree, planned.conds), operands,
+                groups=groups_range, return_device=True,
+            )
+            key = _start_key_dev(blk, tm.shape[0])
+        else:
+            staged = stage_block(blk, needed + ["trace.start_ms"], groups=groups_range)
+            tm, counts = eval_block(
+                (planned.tree, planned.conds),
+                staged.cols,
+                operands,
+                staged.n_spans,
+                staged.n_traces,
+                staged.n_spans_b,
+                staged.n_res_b,
+                staged.n_traces_b,
+                span_out=False,
+            )
+            key = staged.cols["trace.start_ms"]
+            n_spans_seen = staged.n_spans
+
+        def selector(k):
+            return select_topk_device(tm, key, counts, k)
     else:
-        staged = stage_block(blk, needed, groups=groups_range)
-        _, trace_mask, counts = eval_block(
-            (planned.tree, planned.conds),
-            staged.cols,
-            operands,
-            staged.n_spans,
-            staged.n_traces,
-            staged.n_spans_b,
-            staged.n_res_b,
-            staged.n_traces_b,
+        cols = _host_cols(blk, needed, groups_range)
+        n_spans_seen = cols["span.trace_sid"].shape[0]
+        tm, counts = eval_block_host(
+            (planned.tree, planned.conds), cols, operands,
+            n_spans_seen, blk.meta.total_traces,
         )
-        counts = np.asarray(counts)
-        n_spans_seen = staged.n_spans
-        sids = np.nonzero(np.asarray(trace_mask)[: staged.n_traces])[0]
-    # device filter may be conservative (clamped encodings / mixed OR):
-    # exact host re-check of each candidate (hosteval.py)
-    sids = _verify_candidates(blk, req, sids, planned.needs_verify)
-    results = _verify_and_build(blk, req, sids, counts)
+        key = _start_key_host(blk)
+
+        def selector(k):
+            return select_topk_host(tm, key, counts, k)
+
+    results = _collect_topk(blk, req, planned.needs_verify, selector, limit)
     results.sort(key=lambda r: -r.start_time_unix_nano)
-    resp.traces = results[: req.limit]
+    resp.traces = results[:limit]
     resp.inspected_spans = n_spans_seen
-    resp.inspected_bytes = blk.pack.bytes_read
+    resp.inspected_bytes = pack.bytes_read - io0
     return resp
 
 
 # ---- stacked multi-block device search (parallel/search.py)
 
 _DEVICE_SEARCH_MAX_BYTES = 512 << 20  # stacked-column budget before falling back
-
-
-def _verify_candidates(blk: BackendBlock, req: SearchRequest, sids, needs_verify: bool):
-    """Exact host re-check of TraceQL candidates when the device filter
-    was conservative (same step as search_block's verify leg)."""
-    if not (needs_verify and req.query and len(sids)):
-        return sids
-    from ..traceql.hosteval import trace_matches
-    from ..traceql.parser import parse
-
-    q = parse(req.query)
-    traces = blk.materialize_traces([int(s) for s in sids])
-    return np.asarray(
-        [s for s, tr in zip(sids, traces) if tr is not None and trace_matches(q, tr)],
-        dtype=np.int64,
-    )
 
 
 def search_blocks_device(
@@ -278,7 +405,9 @@ def _search_group_device(items, tree, conds, req: SearchRequest, mesh, resp: Sea
     from ..parallel.search import sharded_search
 
     dp, sp = mesh.shape["dp"], mesh.shape["sp"]
-    needed = required_columns(conds)
+    # span@ materialization is a staged-cache concept; the stacked path
+    # reads and stacks raw columns only
+    needed = [n for n in required_columns(conds) if not n.startswith("span@")]
     span_cols = [n for n in needed if n.startswith("span.")]
     B = len(items)
     Bp = ((B + dp - 1) // dp) * dp
@@ -289,6 +418,7 @@ def _search_group_device(items, tree, conds, req: SearchRequest, mesh, resp: Sea
     NT_b = bucket(max(max(blk.meta.total_traces for blk, _ in items), 1))
 
     host: dict[str, np.ndarray] = {}
+    io0 = [blk.pack.bytes_read for blk, _ in items]
 
     def read_block_cols(blk):
         return {n: blk.pack.read(n) for n in needed}
@@ -303,6 +433,16 @@ def _search_group_device(items, tree, conds, req: SearchRequest, mesh, resp: Sea
     ]
     R_b = bucket(max(max(n_res_per), 1))
     for n in needed:
+        if n == "trace.span_off":
+            # (NT_b+1,) offsets per block; padded trace rows collapse to
+            # empty segments by repeating the final offset
+            out = np.zeros((Bp, NT_b + 1), dtype=np.int32)
+            for bi, cols in enumerate(per_block):
+                a = cols[n]
+                out[bi, : a.shape[0]] = a
+                out[bi, a.shape[0]:] = a[-1] if a.size else 0
+            host[n] = out
+            continue
         if n.startswith("span."):
             shape, fill = (Bp, S_b), PAD_I32
         elif n.startswith("res."):
@@ -327,14 +467,19 @@ def _search_group_device(items, tree, conds, req: SearchRequest, mesh, resp: Sea
     operands += [Operands.build([(0, 0, 0, 0.0, 0.0)] * len(conds))] * (Bp - B)
     tm, sc = sharded_search(mesh, tree, conds, operands, host, n_spans, nt=NT_b)
 
+    limit = req.limit or DEFAULT_LIMIT
     results: list[SearchResult] = []
     for bi, (blk, p) in enumerate(items):
         nt = blk.meta.total_traces
-        sids = np.nonzero(tm[bi][:nt])[0]
-        sids = _verify_candidates(blk, req, sids, p.needs_verify)
-        results.extend(_verify_and_build(blk, req, sids, sc[bi]))
+        mask, cnt = tm[bi][:nt], sc[bi][:nt]
+        key = _start_key_host(blk)[:nt]
+
+        def selector(k, mask=mask, cnt=cnt, key=key):
+            return select_topk_host(mask, key, cnt, k)
+
+        results.extend(_collect_topk(blk, req, p.needs_verify, selector, limit))
         resp.inspected_spans += int(n_spans[bi])
-        resp.inspected_bytes += blk.pack.bytes_read
+        resp.inspected_bytes += blk.pack.bytes_read - io0[bi]
     return results
 
 

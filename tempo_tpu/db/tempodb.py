@@ -80,6 +80,10 @@ class TempoDB:
             blk = self._block_cache.get(key)
             if blk is None:
                 blk = BackendBlock(self.backend, meta)
+                # cached readers are long-lived over immutable blocks:
+                # mark them device-worthy so search_block's auto mode
+                # stages (and keeps) their columns on the accelerator
+                blk.device_pinned = self.cfg.device_search
                 if len(self._block_cache) >= self.cfg.block_cache_blocks:
                     self._block_cache.pop(next(iter(self._block_cache)))
                 self._block_cache[key] = blk

@@ -106,13 +106,21 @@ def _flatten(conds) -> list:
 
 
 def required_columns(conds) -> list[str]:
-    need = {"span.trace_sid"}
+    # trace.span_off: spans are stored grouped by trace, so span->trace
+    # aggregation is cumsum + gather-at-offsets (no scatter; see
+    # _offset_counts). trace_sid still feeds the trace->span gather.
+    # span@<res col> entries are NOT physical columns: they ask the
+    # staging layer to materialize that res column at span level once
+    # (query-independent), so the kernel avoids a per-query span-length
+    # gather. Readers of raw columns must skip them.
+    need = {"span.trace_sid", "trace.span_off"}
     for c in _flatten(conds):
         if c.target in (T_SPAN, T_TRACE):
             need.add(c.col)
         elif c.target == T_RES:
             need.add(c.col)
             need.add("span.res_idx")
+            need.add(f"span@{c.col}")
         elif c.target == T_SATTR:
             need.update({"sattr.span", "sattr.key_id", "sattr.vtype"})
             if c.col in _ATTR_VALUE_COL:
@@ -158,6 +166,20 @@ def _cmp(op: str, x, v0, v1, f0, f1, is_float: bool, table):
     raise ValueError(f"unknown op {op}")
 
 
+def _offset_counts(mask, off):
+    """Per-segment True counts when rows are GROUPED by segment (the
+    vtpu layout: spans sorted by trace, attrs sorted by owner):
+    exclusive cumsum + two gathers at the segment offsets. On TPU this
+    is a parallel scan instead of a scatter -- XLA lowers segment_sum/
+    segment_max over 1M+ rows to a serialized scatter loop that costs
+    tens of ms and monopolizes the chip; the scan form is ~10x faster
+    and pipelines across concurrent queries. off: (n_seg+1,) rows."""
+    ecs = jnp.concatenate(
+        [jnp.zeros(1, jnp.int32), jnp.cumsum(mask.astype(jnp.int32))]
+    )
+    return ecs[off[1:]] - ecs[off[:-1]]
+
+
 def _cond_mask(c: Cond, i, cols, ops_i, ops_f, tables, n_spans_b, n_res_b, valid_span):
     """Span-level mask for one condition."""
     key, v0, v1 = ops_i[i, 0], ops_i[i, 1], ops_i[i, 2]
@@ -166,6 +188,19 @@ def _cond_mask(c: Cond, i, cols, ops_i, ops_f, tables, n_spans_b, n_res_b, valid
     if c.target == T_SPAN:
         return _cmp(c.op, cols[c.col], v0, v1, f0, f1, c.is_float, table) & valid_span
     if c.target == T_RES:
+        pre = cols.get(f"span@{c.col}")
+        if pre is not None:
+            # span-level materialization of the res column (one gather at
+            # STAGE time, query-independent, cached) -- a direct compare
+            # here instead of a span-length gather per query. The PAD
+            # sentinel marks spans with no resource row (idx < 0).
+            from .device import PAD_I32
+
+            return (
+                _cmp(c.op, pre, v0, v1, f0, f1, c.is_float, table)
+                & (pre != PAD_I32)
+                & valid_span
+            )
         res_mask = _cmp(c.op, cols[c.col], v0, v1, f0, f1, c.is_float, table)
         idx = jnp.clip(cols["span.res_idx"], 0, res_mask.shape[0] - 1)
         return res_mask[idx] & (cols["span.res_idx"] >= 0) & valid_span
@@ -179,14 +214,19 @@ def _cond_mask(c: Cond, i, cols, ops_i, ops_f, tables, n_spans_b, n_res_b, valid
             vt_ok = cols[f"{pre}.vtype"] == _VT_CODE[c.col]
             row_hit = key_match & vt_ok & _cmp(c.op, vcol, v0, v1, f0, f1, c.is_float, table)
         if pre == T_SATTR:
+            if "sattr.off" in cols:  # grouped-by-span rows: scan, no scatter
+                return (_offset_counts(row_hit, cols["sattr.off"]) > 0) & valid_span
             owner = jnp.clip(cols["sattr.span"], 0, n_spans_b - 1)
             return (
                 jax.ops.segment_max(row_hit.astype(jnp.int32), owner, num_segments=n_spans_b) > 0
             ) & valid_span
-        owner = jnp.clip(cols["rattr.res"], 0, n_res_b - 1)
-        res_mask = (
-            jax.ops.segment_max(row_hit.astype(jnp.int32), owner, num_segments=n_res_b) > 0
-        )
+        if "rattr.off" in cols:
+            res_mask = _offset_counts(row_hit, cols["rattr.off"]) > 0
+        else:
+            owner = jnp.clip(cols["rattr.res"], 0, n_res_b - 1)
+            res_mask = (
+                jax.ops.segment_max(row_hit.astype(jnp.int32), owner, num_segments=n_res_b) > 0
+            )
         idx = jnp.clip(cols["span.res_idx"], 0, n_res_b - 1)
         return res_mask[idx] & (cols["span.res_idx"] >= 0) & valid_span
     raise ValueError(f"bad target {c.target}")
@@ -228,10 +268,16 @@ def normalize_tree(tree: CondTree, conds: tuple[Cond, ...]) -> CondTree:
 
 @lru_cache(maxsize=256)
 def _compiled(tree: CondTree | None, conds: tuple[Cond, ...], table_idxs: tuple[int, ...],
-              n_spans_b: int, n_res_b: int, n_traces_b: int):
+              n_spans_b: int, n_res_b: int, n_traces_b: int, span_out: bool = True):
     """tree is a TRACE-level expression: leaves are ('cond', i) with a
     trace-target cond or ('tracify', span_tree) aggregating a span-level
-    subtree; None matches everything."""
+    subtree; None matches everything.
+
+    span_out=False drops the span-level mask output, which lets the
+    program skip the trace->span survival gather entirely (counts are
+    zeroed at TRACE level instead) -- a span-length random gather is one
+    of the most expensive ops on the TPU, and the search path only ever
+    consumes trace-level outputs."""
 
     @jax.jit
     def run(cols, ops_i, ops_f, table_list, n_spans, n_traces):
@@ -251,14 +297,18 @@ def _compiled(tree: CondTree | None, conds: tuple[Cond, ...], table_idxs: tuple[
                 out = (out & m) if t[0] == "and" else (out | m)
             return out
 
-        def tracify(span_mask):
+        def seg_counts(span_mask):
+            """Matched-span count per trace."""
+            if "trace.span_off" in cols:  # grouped layout: scan + gather
+                return _offset_counts(span_mask & valid_span, cols["trace.span_off"])
             sid = jnp.where(valid_span & span_mask, cols["span.trace_sid"], n_traces_b)
             sid = jnp.clip(sid, 0, n_traces_b)
-            return (
-                jax.ops.segment_max(span_mask.astype(jnp.int32), sid,
-                                    num_segments=n_traces_b + 1)[:n_traces_b]
-                > 0
-            )
+            return jax.ops.segment_sum(
+                span_mask.astype(jnp.int32), sid, num_segments=n_traces_b + 1
+            )[:n_traces_b]
+
+        def tracify(span_mask):
+            return seg_counts(span_mask) > 0
 
         def ev_trace(t):
             if t[0] == "tracify":
@@ -278,25 +328,26 @@ def _compiled(tree: CondTree | None, conds: tuple[Cond, ...], table_idxs: tuple[
 
         if tree is None:
             trace_mask = valid_trace
-            span_mask = valid_span
+            union = valid_span
         else:
             trace_mask = ev_trace(tree) & valid_trace
             if span_masks:
-                span_mask = span_masks[0]
+                union = span_masks[0]
                 for m in span_masks[1:]:
-                    span_mask = span_mask | m
+                    union = union | m
             else:
-                span_mask = valid_span
-            # a span only counts if its trace survived trace-level conds
-            tsid = jnp.clip(cols["span.trace_sid"], 0, n_traces_b - 1)
-            span_mask = span_mask & trace_mask[tsid] & valid_span
+                union = valid_span
 
-        sid = jnp.where(valid_span & span_mask, cols["span.trace_sid"], n_traces_b)
-        sid = jnp.clip(sid, 0, n_traces_b)
-        span_count = jax.ops.segment_sum(
-            span_mask.astype(jnp.int32), sid, num_segments=n_traces_b + 1
-        )[:n_traces_b]
+        if not span_out:
+            # spans only count toward surviving traces; zero at trace
+            # level -- no span-length gather needed
+            span_count = jnp.where(trace_mask, seg_counts(union), 0)
+            return trace_mask, span_count
 
+        # a span only counts if its trace survived trace-level conds
+        tsid = jnp.clip(cols["span.trace_sid"], 0, n_traces_b - 1)
+        span_mask = union & trace_mask[tsid] & valid_span
+        span_count = seg_counts(span_mask)
         return span_mask, trace_mask, span_count
 
     return run
@@ -322,7 +373,7 @@ def eval_block(
     query,
     combinator_or_cols,
     *args,
-    **kwargs,
+    span_out: bool = True,
 ):
     """Two call forms:
 
@@ -331,7 +382,8 @@ def eval_block(
     eval_block(groups, "and", cols, operands, ...)            -- CNF form
 
     Returns (span_mask (n_spans_b,), trace_mask (n_traces_b,),
-    per-trace matched span count)."""
+    per-trace matched span count); with span_out=False just
+    (trace_mask, counts) -- cheaper on device (no span-level gather)."""
     if isinstance(combinator_or_cols, str):
         groups = query
         if combinator_or_cols != "and":
@@ -350,16 +402,20 @@ def eval_block(
 
     tables = operands.tables or {}
     table_idxs = tuple(sorted(tables))
+    # host arrays/scalars go straight into the jit call: the dispatch
+    # uploads them as one batch. Eager jnp conversions here would each
+    # issue a separate device_put -- a blocking round trip per array on
+    # a high-latency host<->device link.
     table_list = [
-        jnp.asarray(pad_rows(np.asarray(tables[i], dtype=np.uint8), bucket(max(1, len(tables[i]))), 0))
+        pad_rows(np.asarray(tables[i], dtype=np.uint8), bucket(max(1, len(tables[i]))), 0)
         for i in table_idxs
     ]
-    fn = _compiled(tree, conds, table_idxs, n_spans_b, n_res_b, n_traces_b)
+    fn = _compiled(tree, conds, table_idxs, n_spans_b, n_res_b, n_traces_b, span_out)
     return fn(
         cols,
-        jnp.asarray(operands.ints),
-        jnp.asarray(operands.floats),
+        operands.ints,
+        operands.floats,
         table_list,
-        jnp.int32(n_spans),
-        jnp.int32(n_traces),
+        np.int32(n_spans),
+        np.int32(n_traces),
     )

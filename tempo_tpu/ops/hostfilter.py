@@ -1,0 +1,188 @@
+"""Host (numpy) mirror of the device filter semantics for one-shot scans.
+
+Cost-based dispatch: staging a block's columns onto the accelerator pays
+off when the block is queried repeatedly (the production querier keeps
+immutable blocks hot -- ops/stage.py caches the padded device arrays).
+For a COLD one-shot scan the device path's fixed costs (host->device
+upload of every needed column + a dispatch/sync round trip) exceed the
+scan itself, so the planner evaluates the same condition tree vectorized
+on host instead -- identical semantics (conservative encodings, same
+needs_verify contract), no padding, no upload. The reference has only
+this mode (vparquet/block_search.go is all-CPU); we have both and pick
+by block temperature (db/search.py).
+
+Everything is O(rows) numpy: predicate masks, attr->span scatter via
+bincount, span->trace aggregation via bincount over trace_sid.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .filter import (
+    Cond,
+    Operands,
+    T_RATTR,
+    T_RES,
+    T_SATTR,
+    T_SPAN,
+    T_TRACE,
+    _ATTR_VALUE_COL,
+    _VT_CODE,
+    normalize_tree,
+)
+
+
+def _cmp_np(op: str, x: np.ndarray, v0, v1, f0, f1, is_float: bool, table):
+    a, b = (f0, f1) if is_float else (v0, v1)
+    if op == "eq":
+        return x == a
+    if op == "ne":
+        return x != a
+    if op == "ne_present":
+        return (x != a) & (x >= 0)
+    if op == "ne_clamped":
+        return (x != a) | (x == 2**31 - 1) | (x == -(2**31) + 1)
+    if op == "lt":
+        return x < a
+    if op == "le":
+        return x <= a
+    if op == "gt":
+        return x > a
+    if op == "ge":
+        return x >= a
+    if op == "range":
+        return (x >= a) & (x <= b)
+    if op == "exists":
+        return np.ones(x.shape, dtype=bool)
+    if op in ("intable", "notintable"):
+        t = np.asarray(table)
+        hit = t[np.clip(x, 0, t.shape[0] - 1)] > 0
+        if op == "notintable":
+            hit = ~hit
+        return hit & (x >= 0)
+    raise ValueError(f"unknown op {op}")
+
+
+def _scatter_owner(row_hit: np.ndarray, owner: np.ndarray, n: int) -> np.ndarray:
+    """OR rows onto their owner axis: True where any owned row hit."""
+    if not row_hit.any():
+        return np.zeros(n, dtype=bool)
+    o = owner[row_hit]
+    o = o[(o >= 0) & (o < n)]
+    return np.bincount(o, minlength=n).astype(bool)
+
+
+def _cond_mask_np(c: Cond, i: int, cols, ops_i, ops_f, tables, n_spans, n_res):
+    key, v0, v1 = int(ops_i[i, 0]), int(ops_i[i, 1]), int(ops_i[i, 2])
+    f0, f1 = float(ops_f[i, 0]), float(ops_f[i, 1])
+    table = tables.get(i)
+    if c.target == T_SPAN:
+        return _cmp_np(c.op, cols[c.col], v0, v1, f0, f1, c.is_float, table)
+    if c.target == T_RES:
+        rm = _cmp_np(c.op, cols[c.col], v0, v1, f0, f1, c.is_float, table)
+        idx = cols["span.res_idx"]
+        return rm[np.clip(idx, 0, rm.shape[0] - 1)] & (idx >= 0)
+    if c.target in (T_SATTR, T_RATTR):
+        pre = c.target
+        key_match = cols[f"{pre}.key_id"] == key
+        if c.col == "any":
+            row_hit = key_match
+        else:
+            vcol = cols[f"{pre}.{_ATTR_VALUE_COL[c.col]}"]
+            vt_ok = cols[f"{pre}.vtype"] == _VT_CODE[c.col]
+            row_hit = key_match & vt_ok & _cmp_np(c.op, vcol, v0, v1, f0, f1, c.is_float, table)
+        if pre == T_SATTR:
+            return _scatter_owner(row_hit, cols["sattr.span"], n_spans)
+        res_hit = _scatter_owner(row_hit, cols["rattr.res"], n_res)
+        idx = cols["span.res_idx"]
+        return res_hit[np.clip(idx, 0, n_res - 1)] & (idx >= 0)
+    raise ValueError(f"bad target {c.target}")
+
+
+def eval_block_host(
+    query,
+    cols: dict[str, np.ndarray],
+    operands: Operands,
+    n_spans: int,
+    n_traces: int,
+):
+    """Evaluate (tree, conds) over RAW unpadded host columns.
+
+    `cols['sattr.span']` must be rebased to local span rows when the
+    columns cover a row-group slice (same contract as ops/stage.py).
+    `span.trace_sid` stays global. Returns (trace_mask (n_traces,) bool,
+    span_count (n_traces,) int64) -- identical semantics to
+    ops.filter.eval_block's trace outputs.
+    """
+    tree, conds = query
+    if tree is not None:
+        tree = normalize_tree(tree, conds)
+    tables = operands.tables or {}
+    ops_i, ops_f = operands.ints, operands.floats
+    n_res = 0
+    for n, a in cols.items():
+        if n.startswith("res."):
+            n_res = max(n_res, a.shape[0])
+    tsid = cols["span.trace_sid"]
+    span_masks: list[np.ndarray] = []
+
+    def ev_span(t):
+        if t[0] == "cond":
+            i = t[1]
+            return _cond_mask_np(conds[i], i, cols, ops_i, ops_f, tables, n_spans, n_res)
+        ms = [ev_span(ch) for ch in t[1:]]
+        out = ms[0]
+        for m in ms[1:]:
+            out = (out & m) if t[0] == "and" else (out | m)
+        return out
+
+    span_off = cols.get("trace.span_off")
+
+    def seg_counts(span_mask):
+        """Matched spans per trace; offset scan when grouped, else bincount."""
+        if span_off is not None:
+            ecs = np.concatenate([[0], np.cumsum(span_mask)])
+            return ecs[span_off[1:]] - ecs[span_off[:-1]]
+        hit = tsid[span_mask]
+        hit = hit[(hit >= 0) & (hit < n_traces)]
+        return np.bincount(hit, minlength=n_traces)
+
+    def tracify(span_mask):
+        return seg_counts(span_mask) > 0
+
+    def ev_trace(t):
+        if t[0] == "tracify":
+            sm = ev_span(t[1])
+            span_masks.append(sm)
+            return tracify(sm)
+        if t[0] == "cond":
+            i = t[1]
+            c = conds[i]
+            return _cmp_np(c.op, cols[c.col], int(ops_i[i, 1]), int(ops_i[i, 2]),
+                           float(ops_f[i, 0]), float(ops_f[i, 1]), c.is_float,
+                           tables.get(i))
+        ms = [ev_trace(ch) for ch in t[1:]]
+        out = ms[0]
+        for m in ms[1:]:
+            out = (out & m) if t[0] == "and" else (out | m)
+        return out
+
+    if tree is None:
+        trace_mask = np.ones(n_traces, dtype=bool)
+        union = np.ones(n_spans, dtype=bool)
+    else:
+        trace_mask = ev_trace(tree)
+        if trace_mask.shape[0] != n_traces:  # pure trace-cond trees
+            trace_mask = trace_mask[:n_traces]
+        if span_masks:
+            union = span_masks[0]
+            for m in span_masks[1:]:
+                union = union | m
+        else:
+            union = np.ones(n_spans, dtype=bool)
+
+    # spans only count toward surviving traces; zero at trace level
+    # (mirrors ops/filter's span_out=False program)
+    counts = np.where(trace_mask, seg_counts(union), 0)
+    return trace_mask, counts

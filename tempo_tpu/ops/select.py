@@ -1,0 +1,79 @@
+"""Top-k result selection: pick the `limit` newest matching traces
+WITHOUT shipping full masks to host.
+
+The device filter produces (trace_mask, span_count) sized to the trace
+axis. Materializing results used to mean one device->host transfer per
+array plus a Python loop over every candidate -- on a high-latency
+host<->device link each sync costs tens of ms, and the loop cost scaled
+with match count, not with the result limit. Instead the selection
+itself runs on device: key = trace start time under the mask,
+`lax.top_k`, gather the per-trace counts at the winners, and return ONE
+small fused int32 vector `[sids | counts | valid | n_match]` -- a single
+fetch whose size is O(k), so query cost is O(limit) past the filter
+kernel no matter how many traces matched.
+
+Host re-verification may reject candidates (conservative device
+encodings), so callers over-select and escalate k (db/search.py's
+collect loop). The numpy variant serves the host evaluation path
+(ops/hostfilter.py) with identical ordering semantics.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_NEG = -(2**31)
+
+
+def k_bucket(k: int) -> int:
+    """Power-of-two k so escalation reuses few compiled programs."""
+    b = 16
+    while b < k:
+        b <<= 1
+    return b
+
+
+@lru_cache(maxsize=64)
+def _compiled_select(k: int):
+    @jax.jit
+    def sel(mask, key, counts):
+        keyed = jnp.where(mask, key.astype(jnp.int32), jnp.int32(_NEG))
+        _, topi = jax.lax.top_k(keyed, k)
+        valid = jnp.take(mask, topi).astype(jnp.int32)
+        return jnp.concatenate([
+            topi.astype(jnp.int32),
+            jnp.take(counts, topi).astype(jnp.int32),
+            valid,
+            jnp.sum(mask.astype(jnp.int32))[None],
+        ])
+
+    return sel
+
+
+def select_topk_device(mask, key, counts, k: int):
+    """mask/key/counts: same-length device (or host) arrays; k <= len.
+    Returns (sids desc-by-key, counts at sids, n_match) as numpy --
+    one device sync total."""
+    k = int(min(k, mask.shape[0]))
+    out = np.asarray(_compiled_select(k)(mask, key, counts))
+    sids, cnts, valid = out[:k], out[k : 2 * k], out[2 * k : 3 * k] > 0
+    return sids[valid], cnts[valid], int(out[3 * k])
+
+
+def select_topk_host(mask: np.ndarray, key: np.ndarray, counts: np.ndarray, k: int):
+    """Numpy twin: argpartition + sort, same descending-key order."""
+    n = mask.shape[0]
+    n_match = int(np.count_nonzero(mask))
+    k = int(min(k, n))
+    keyed = np.where(mask, key.astype(np.int64), np.int64(-(2**62)))
+    if k < n:
+        part = np.argpartition(-keyed, k - 1)[:k] if k > 0 else np.empty(0, np.int64)
+    else:
+        part = np.arange(n)
+    part = part[np.argsort(-keyed[part], kind="stable")]
+    sids = part[mask[part]]
+    return sids.astype(np.int64), counts[sids], n_match

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -24,6 +25,13 @@ from .device import PAD_I32, bucket, pad_rows
 
 _CACHE_MAX_ENTRIES = 32  # per block
 _CACHE_MAX_ENTRY_BYTES = 256 << 20
+
+@jax.jit
+def _res_to_span(res_vals, res_idx):
+    """Broadcast a res-axis column to span rows; PAD where no resource."""
+    out = res_vals[jnp.clip(res_idx, 0, res_vals.shape[0] - 1)]
+    return jnp.where(res_idx >= 0, out, PAD_I32)
+
 
 _AXIS_OF = {
     "span": S.AX_SPAN,
@@ -70,6 +78,8 @@ def stage_block(
 
     host: dict[str, np.ndarray] = {}
     n_res = 0
+    materialize = [n.split("@", 1)[1] for n in needed if n.startswith("span@")]
+    needed = [n for n in needed if not n.startswith("span@")]
     for name in needed:
         pref = name.split(".", 1)[0]
         ax = _AXIS_OF.get(pref)
@@ -100,15 +110,39 @@ def stage_block(
         n_res_b=n_res_b,
         span_base=span_base,
     )
+    # owner-offset columns: rows of every child table are grouped by
+    # owner, so the kernel aggregates with cumsum + offset gathers
+    # (ops/filter._offset_counts) -- the owner row columns themselves
+    # never need to reach the device.
+    if "sattr.span" in host:
+        owners = np.clip(host["sattr.span"] - span_base, 0, max(n_spans, 1) - 1)
+        cnt = np.bincount(owners, minlength=max(n_spans, 1)) if owners.size else np.zeros(
+            max(n_spans, 1), dtype=np.int64
+        )
+        off = np.concatenate([[0], np.cumsum(cnt)]).astype(np.int32)
+        host["sattr.off"] = pad_rows(off, n_spans_b + 1, off[-1] if off.size else 0)
+        del host["sattr.span"]
+    if "rattr.res" in host:
+        owners = np.clip(host["rattr.res"], 0, max(n_res, 1) - 1)
+        cnt = np.bincount(owners, minlength=max(n_res, 1)) if owners.size else np.zeros(
+            max(n_res, 1), dtype=np.int64
+        )
+        off = np.concatenate([[0], np.cumsum(cnt)]).astype(np.int32)
+        host["rattr.off"] = pad_rows(off, n_res_b + 1, off[-1] if off.size else 0)
+        del host["rattr.res"]  # superseded on device by the offsets
+
     for name, arr in host.items():
         pref = name.split(".", 1)[0]
-        if pref == "span":
+        if name == "trace.span_off":
+            # rebase global span rows to the staged slice; padded trace
+            # rows collapse to empty segments (count 0)
+            arr = (np.clip(arr, span_base, span_hi) - span_base).astype(np.int32)
+            arr = pad_rows(arr, n_traces_b + 1, arr[-1] if arr.size else 0)
+        elif name in ("sattr.off", "rattr.off"):
+            pass  # already padded above
+        elif pref == "span":
             arr = pad_rows(arr, n_spans_b, PAD_I32)
         elif pref == "sattr":
-            if name == "sattr.span":
-                # rebase owner to staged-local rows; pads clip safely since
-                # their key_id sentinel never matches
-                arr = arr - span_base
             arr = pad_rows(arr, bucket(max(arr.shape[0], 1)), PAD_I32)
         elif pref == "rattr":
             arr = pad_rows(arr, bucket(max(arr.shape[0], 1)), PAD_I32)
@@ -120,6 +154,17 @@ def stage_block(
             else:
                 continue  # host-only trace columns are not staged
         staged.cols[name] = jnp.asarray(arr)
+
+    # materialize requested res columns at SPAN level: the res->span
+    # broadcast gather is query-independent, so paying it once here
+    # (cached with the staged entry) removes a span-length random gather
+    # -- one of the most expensive TPU ops -- from every query's kernel
+    if materialize and "span.res_idx" in staged.cols:
+        for name in materialize:
+            if name in staged.cols:
+                staged.cols[f"span@{name}"] = _res_to_span(
+                    staged.cols[name], staged.cols["span.res_idx"]
+                )
     if cache:
         nbytes = sum(a.nbytes for a in staged.cols.values())
         if nbytes <= _CACHE_MAX_ENTRY_BYTES:

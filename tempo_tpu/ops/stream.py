@@ -68,10 +68,14 @@ def eval_block_streamed(
     operands: Operands,
     groups: list[int] | None = None,
     groups_per_chunk: int = DEFAULT_GROUPS_PER_CHUNK,
+    return_device: bool = False,
 ):
     """Evaluate a condition tree over a block by streaming row-group
     chunks through the device. Returns (trace_mask (n_traces,),
-    span_count (n_traces,), n_spans_seen) as numpy."""
+    span_count (n_traces,), n_spans_seen) as numpy -- or, with
+    return_device, (trace_mask_dev, counts_dev, n_spans_seen) as PADDED
+    device arrays with no host sync at all: the caller's top-k selector
+    (ops/select.py) does the single fetch."""
     tree, conds = tree_conds
     if tree is not None:
         tree = normalize_tree(tree, conds)
@@ -103,20 +107,26 @@ def eval_block_streamed(
     n_spans_seen = 0
 
     def run_tree(t, staged):
-        _, tm, sc = eval_block(
+        tm, sc = eval_block(
             (t, conds), staged.cols, operands,
             staged.n_spans, staged.n_traces,
             staged.n_spans_b, staged.n_res_b, staged.n_traces_b,
+            span_out=False,
         )
         return tm, sc  # device arrays, padded (n_traces_b,)
 
     single_tracify = sum(1 for lf in leaves if lf[0] == "tracify") == 1
-    nxt = _prefetch_pool.submit(stage_block, blk, needed, chunk_groups[0])
+    # cache=False: the streamed path exists because staging the whole
+    # block exceeds the device budget, so pinning each chunk in the staged
+    # cache would be pure churn (per-block FIFO would evict before reuse)
+    nxt = _prefetch_pool.submit(stage_block, blk, needed, chunk_groups[0], cache=False)
     try:
         for ci in range(len(chunk_groups)):
             staged = nxt.result()
             if ci + 1 < len(chunk_groups):
-                nxt = _prefetch_pool.submit(stage_block, blk, needed, chunk_groups[ci + 1])
+                nxt = _prefetch_pool.submit(
+                    stage_block, blk, needed, chunk_groups[ci + 1], cache=False
+                )
             if tree is None:
                 tm, sc = run_tree(None, staged)
                 counts_dev = sc if counts_dev is None else counts_dev + sc
@@ -134,6 +144,29 @@ def eval_block_streamed(
             n_spans_seen += staged.n_spans
     finally:
         nxt.cancel()  # abandoned prefetch on error mustn't leak device work
+
+    if return_device:
+        import jax.numpy as jnp
+
+        if counts_dev is None:
+            counts_dev = jnp.zeros(max(n_traces, 1), dtype=jnp.int32)
+        nb = counts_dev.shape[0]
+        valid = jnp.arange(nb, dtype=jnp.int32) < n_traces
+        if tree is None:
+            tm_dev = (counts_dev > 0) & valid
+        else:
+            def evd(sk):
+                if sk[0] == "leaf":
+                    h = leaf_hits[sk[1]]
+                    return h if h is not None else jnp.zeros(nb, dtype=bool)
+                vals = [evd(ch) for ch in sk[1:]]
+                out = vals[0]
+                for v in vals[1:]:
+                    out = (out & v) if sk[0] == "and" else (out | v)
+                return out
+
+            tm_dev = evd(skeleton) & valid
+        return tm_dev, counts_dev, n_spans_seen
 
     counts = (
         np.asarray(counts_dev)[:n_traces].astype(np.int64)

@@ -116,11 +116,23 @@ def make_sharded_search(mesh, tree, conds: tuple[Cond, ...], col_names: tuple[st
             return out
 
         def seg_reduce(mask):
-            sid = jnp.clip(jnp.where(mask, cols["span.trace_sid"], NT), 0, NT)
-            local_c = jax.vmap(
-                lambda m, s: jax.ops.segment_sum(m.astype(jnp.int32), s,
-                                                 num_segments=NT + 1)[:NT]
-            )(mask, sid)
+            if "trace.span_off" in cols:
+                # grouped layout: per-shard cumsum + offset gathers, then
+                # psum over 'sp' stitches traces straddling shard cuts --
+                # no scatter anywhere (see ops/filter._offset_counts)
+                off = cols["trace.span_off"]  # (Bl, NT+1) global span rows
+                ecs = jnp.concatenate(
+                    [jnp.zeros((mask.shape[0], 1), jnp.int32),
+                     jnp.cumsum(mask.astype(jnp.int32), axis=1)], axis=1)
+                lo = jnp.clip(off[:, :-1] - row0, 0, Sl)
+                hi = jnp.clip(off[:, 1:] - row0, 0, Sl)
+                local_c = jnp.take_along_axis(ecs, hi, 1) - jnp.take_along_axis(ecs, lo, 1)
+            else:
+                sid = jnp.clip(jnp.where(mask, cols["span.trace_sid"], NT), 0, NT)
+                local_c = jax.vmap(
+                    lambda m, s: jax.ops.segment_sum(m.astype(jnp.int32), s,
+                                                     num_segments=NT + 1)[:NT]
+                )(mask, sid)
             return jax.lax.psum(local_c, "sp")  # (Bl, NT)
 
         def ev_trace(t):
