@@ -263,6 +263,15 @@ class BackendBlock:
             groups = self._groups_for_span_range(lo, hi)
             base = self.pack.axes[S.AX_SPAN].offsets[groups[0]]
             sl = slice(lo - base, hi - base)
+            # one threaded decode for EVERY chunk this trace touches
+            # (span cols + child tables); the reads below then hit the
+            # pack's decompressed-chunk cache
+            wants = [(c, groups) for c in _MAT_SPAN_COLS]
+            for pre, fields in (("sattr", ("span",) + _ATTR_FIELDS),
+                                ("ev", ("span", "time_ns", "name_id", "dropped")),
+                                ("ln", ("span", "trace_id", "span_id", "state_id"))):
+                wants += [(f"{pre}.{f}", groups) for f in fields]
+            self.pack.warm(wants)
             sp_cols = {c: self.pack.read_groups(c, groups)[sl] for c in _MAT_SPAN_COLS}
 
             sat = _ChildRows(self.pack, "sattr", "span", S.AX_SATTR, groups, _ATTR_FIELDS)

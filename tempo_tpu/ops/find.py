@@ -97,12 +97,14 @@ def lookup_ids_blocks_cached(blocks: list, query_codes: np.ndarray) -> np.ndarra
     if B == 0 or q == 0:
         return np.full((B, q), -1, dtype=np.int32)
     qb = bucket(q)
-    queries = jnp.asarray(pad_rows(np.asarray(query_codes, np.int32), qb, PAD_I32))
+    # host arrays ride the dispatch upload; eager jnp conversions here
+    # would each pay a blocking host->device round trip
+    queries = pad_rows(np.asarray(query_codes, np.int32), qb, PAD_I32)
     outs = []
     for blk in blocks:
         dev_ids, n = _device_ids(blk)
         n_steps = int(dev_ids.shape[0]).bit_length()
-        outs.append(_lookup_kernel(dev_ids, queries, jnp.int32(n), n_steps))
+        outs.append(_lookup_kernel(dev_ids, queries, np.int32(n), n_steps))
     stacked = jnp.stack(outs) if len(outs) > 1 else outs[0][None]
     return np.asarray(stacked)[:, :q]
 
@@ -126,9 +128,7 @@ def lookup_ids_blocks(id_code_arrays: list[np.ndarray], query_codes: np.ndarray)
     qb = bucket(q)
     queries = pad_rows(np.asarray(query_codes, dtype=np.int32), qb, PAD_I32)
     n_steps = int(T).bit_length()
-    out = _lookup_blocks_kernel(
-        jnp.asarray(ids), jnp.asarray(queries), jnp.asarray(n_valid), n_steps
-    )
+    out = _lookup_blocks_kernel(ids, queries, n_valid, n_steps)
     return np.asarray(out)[:, :q]
 
 
@@ -144,5 +144,5 @@ def lookup_ids(id_codes: np.ndarray, query_codes: np.ndarray) -> np.ndarray:
     ids = pad_rows(np.asarray(id_codes, dtype=np.int32), tb, np.int32(2**31 - 1))
     queries = pad_rows(np.asarray(query_codes, dtype=np.int32), qb, PAD_I32)
     n_steps = int(tb).bit_length()  # ceil(log2(tb)) + 1 covers the range
-    out = _lookup_kernel(jnp.asarray(ids), jnp.asarray(queries), jnp.int32(n), n_steps)
+    out = _lookup_kernel(ids, queries, np.int32(n), n_steps)
     return np.asarray(out)[:q]
