@@ -187,6 +187,11 @@ class ColumnPack:
 
     def _chunk(self, rec: list) -> bytes:
         off, stored_len, raw_len, codec = rec
+        if raw_len == 0 and stored_len == 0:
+            # zero-length chunks share the byte offset of the NEXT chunk
+            # (writer advances offset by stored size) -- never cache them
+            # under that offset or they poison the real chunk's entry
+            return b""
         hit = self._cache_get(off)
         if hit is not None:
             return hit
@@ -200,7 +205,10 @@ class ColumnPack:
     def _chunks(self, recs: list[list]) -> bytes:
         """Fetch + decode many chunks; zstd chunks decompress as one
         threaded native batch when >1 (native/vtpu_native.cc)."""
-        parts: list[bytes | None] = [self._cache_get(rec[0]) for rec in recs]
+        parts: list[bytes | None] = [
+            b"" if (rec[1] == 0 and rec[2] == 0) else self._cache_get(rec[0])
+            for rec in recs
+        ]
         miss = [i for i, p in enumerate(parts) if p is None]
         zst = [i for i in miss if recs[i][3] == CODEC_ZSTD]
         if len(zst) > 1:
