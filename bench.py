@@ -249,8 +249,10 @@ def bench_find_and_search(tmp: str) -> None:
         assert got is not None
     _emit("find_trace_by_id_p50_ms", float(np.median(lat) * 1e3), "ms", 0.0)
 
-    # --- batched device lookup (the frontend ID-shard / multi-block unit):
-    # Q ids bisect the block's device-cached sorted index in one kernel
+    # --- batched lookup, production auto path (the frontend ID-shard /
+    # multi-block unit): on one chip this is the host vectorized
+    # searchsorted engine (each device dispatch+fetch costs a full link
+    # RTT); on a mesh the device kernel takes over (parallel/find.py)
     from tempo_tpu.ops.find import lookup_ids_blocks_cached
 
     blk = db.open_block(meta)

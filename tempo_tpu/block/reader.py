@@ -63,10 +63,12 @@ class _ChildRows:
             self.owner = self.cols[f"{prefix}.{owner_col}"]
         self.prefix = prefix
 
-    def range_for_owner(self, owner_row: int) -> range:
-        lo = np.searchsorted(self.owner, owner_row, side="left")
-        hi = np.searchsorted(self.owner, owner_row, side="right")
-        return range(int(lo), int(hi))
+    def ranges(self, owner_rows: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        """Vectorized per-owner [lo, hi) ranges: two batched searchsorted
+        calls for ALL owner rows instead of two scalar calls per row."""
+        lo = np.searchsorted(self.owner, owner_rows, side="left")
+        hi = np.searchsorted(self.owner, owner_rows, side="right")
+        return lo, hi
 
     def field(self, name: str, j: int):
         return self.cols[f"{self.prefix}.{name}"][j]
@@ -75,9 +77,9 @@ class _ChildRows:
         return self.global_base + j
 
 
-def _attrs_from(child: _ChildRows, owner_row: int, d: Dictionary) -> dict:
+def _attrs_from(child: _ChildRows, jlo: int, jhi: int, d: Dictionary) -> dict:
     out = {}
-    for j in child.range_for_owner(owner_row):
+    for j in range(jlo, jhi):
         out[d.string(int(child.field("key_id", j)))] = decode_attr_value(
             int(child.field("vtype", j)),
             int(child.field("str_id", j)),
@@ -157,22 +159,20 @@ class BackendBlock:
         return True
 
     def find_trace_sid(self, trace_id: bytes) -> int:
-        """Binary search the sorted trace-id index; -1 if absent."""
-        ids = self.trace_index["trace.id"]
-        n = ids.shape[0]
-        if n == 0:
+        """Binary search the sorted trace-id index; -1 if absent.
+        Shares the cached void16 view with the batched host engine
+        (ops/find.lookup_ids_blocks_host)."""
+        from ..ops.find import _ids_void
+
+        iv = _ids_void(self)
+        n = iv.shape[0]
+        padded = trace_id.rjust(16, b"\x00")
+        if n == 0 or len(padded) != 16:  # oversize ids can match nothing
             return -1
-        flat = ids.tobytes()
-        tid = trace_id.rjust(16, b"\x00")
-        lo, hi = 0, n
-        while lo < hi:
-            mid = (lo + hi) // 2
-            if flat[mid * 16 : mid * 16 + 16] < tid:
-                lo = mid + 1
-            else:
-                hi = mid
-        if lo < n and flat[lo * 16 : lo * 16 + 16] == tid:
-            return lo
+        tid = np.frombuffer(padded, dtype=np.uint8).view("V16")
+        pos = int(np.searchsorted(iv, tid[0]))
+        if pos < n and iv[pos] == tid[0]:
+            return pos
         return -1
 
     def find_trace_by_id(self, trace_id: bytes) -> Trace | None:
@@ -206,7 +206,8 @@ class BackendBlock:
         scope_version = self.pack.read("scope.version_id")
         return res_ded, ded_key, rattr, scope_name, scope_version
 
-    def _resource_attrs(self, res_idx: int, d: Dictionary) -> dict:
+    def _resource_attrs(self, res_idx: int, d: Dictionary,
+                        rrange: tuple[int, int] | None = None) -> dict:
         res_ded, ded_key, rattr, _, _ = self._res_tables
         attrs: dict = {}
         for col, arr in res_ded.items():
@@ -215,8 +216,11 @@ class BackendBlock:
                 attrs[ded_key[col]] = d.string(code)
         owner = rattr.get("rattr.res")
         if owner is not None and len(owner):
-            lo = int(np.searchsorted(owner, res_idx, side="left"))
-            hi = int(np.searchsorted(owner, res_idx, side="right"))
+            if rrange is not None:
+                lo, hi = rrange
+            else:
+                lo = int(np.searchsorted(owner, res_idx, side="left"))
+                hi = int(np.searchsorted(owner, res_idx, side="right"))
             for j in range(lo, hi):
                 attrs[d.string(int(rattr["rattr.key_id"][j]))] = decode_attr_value(
                     int(rattr["rattr.vtype"][j]),
@@ -278,17 +282,32 @@ class BackendBlock:
             evs = _ChildRows(self.pack, "ev", "span", S.AX_EVENT, groups, ("time_ns", "name_id", "dropped"))
             lns = _ChildRows(self.pack, "ln", "span", S.AX_LINK, groups, ("trace_id", "span_id", "state_id"))
 
+            # batched child-table ranges: one searchsorted pair per table
+            # for the whole trace, not per span
+            rows = np.arange(lo, hi, dtype=np.int64)
+            sat_lo, sat_hi = sat.ranges(rows)
+            ev_lo, ev_hi = evs.ranges(rows)
+            ln_lo, ln_hi = lns.ranges(rows)
+            res_u = np.unique(sp_cols["span.res_idx"])
+            rowner = self._res_tables[2].get("rattr.res")
+            if rowner is not None and len(rowner):
+                r_lo = np.searchsorted(rowner, res_u, side="left")
+                r_hi = np.searchsorted(rowner, res_u, side="right")
+                res_ranges = {int(r): (int(a), int(b)) for r, a, b in zip(res_u, r_lo, r_hi)}
+            else:
+                res_ranges = {int(r): (0, 0) for r in res_u}
+
             tid_bytes = self.trace_index["trace.id"][sid].tobytes()
             t = Trace()
             batches: dict[int, ResourceSpans] = {}
             scopes: dict[tuple[int, int], ScopeSpans] = {}
             for i in range(hi - lo):
-                row = lo + i
                 res_idx = int(sp_cols["span.res_idx"][i])
                 scope_idx = int(sp_cols["span.scope_idx"][i])
                 rs = batches.get(res_idx)
                 if rs is None:
-                    rs = ResourceSpans(resource=Resource(attrs=self._resource_attrs(res_idx, d)))
+                    rs = ResourceSpans(resource=Resource(attrs=self._resource_attrs(
+                        res_idx, d, res_ranges.get(res_idx))))
                     batches[res_idx] = rs
                     t.resource_spans.append(rs)
                 skey = (res_idx, scope_idx)
@@ -316,9 +335,9 @@ class BackendBlock:
                     status_code=int(sp_cols["span.status"][i]),
                     status_message=d.string(int(sp_cols["span.status_msg_id"][i])),
                     dropped_attributes_count=int(sp_cols["span.dropped_attrs"][i]),
-                    attrs=_attrs_from(sat, row, d),
+                    attrs=_attrs_from(sat, int(sat_lo[i]), int(sat_hi[i]), d),
                 )
-                for j in evs.range_for_owner(row):
+                for j in range(int(ev_lo[i]), int(ev_hi[i])):
                     e = Event(
                         time_unix_nano=int(evs.field("time_ns", j)),
                         name=d.string(int(evs.field("name_id", j))),
@@ -326,7 +345,7 @@ class BackendBlock:
                         attrs=global_attrs(evattr_all, "evattr.ev", evs.global_row(j)),
                     )
                     sp.events.append(e)
-                for j in lns.range_for_owner(row):
+                for j in range(int(ln_lo[i]), int(ln_hi[i])):
                     link = Link(
                         trace_id=lns.field("trace_id", j).tobytes(),
                         span_id=lns.field("span_id", j).tobytes(),

@@ -141,6 +141,12 @@ def codes_to_trace_id(codes) -> bytes:
     return b"".join(int(int(c) + 0x80000000).to_bytes(4, "big") for c in codes)
 
 
+def codes_to_id_bytes(codes: np.ndarray) -> np.ndarray:
+    """Vectorized codes_to_trace_id: (Q,4) int32 lanes -> (Q,16) u8."""
+    u = (codes.astype(np.int64) + 0x80000000).astype(np.uint32)
+    return np.ascontiguousarray(u).astype(">u4").view(np.uint8).reshape(-1, 16)
+
+
 def ns_to_rel_ms(ns: int, base_ns: int) -> int:
     """Conservative int32 millisecond offset (floor), clamped."""
     v = (int(ns) - int(base_ns)) // 1_000_000

@@ -145,15 +145,11 @@ class TempoDB:
         if self.mesh.devices.size > 1:
             codes = list(self.pool.map(lambda b: b.trace_index["trace.id_codes"], blocks))
             sids = sharded_find_rows(self.mesh, codes, query)
-        elif len(blocks) > 1:
-            # device-cached per-block id indexes; one transfer for results
+        else:
+            # single chip: lookup_ids_blocks_cached auto-routes to the
+            # host searchsorted engine (zero device round trips)
             list(self.pool.map(lambda b: b.trace_index, blocks))  # parallel IO
             sids = lookup_ids_blocks_cached(blocks, query)
-        else:
-            # a lone id in one block: a host bisect is O(log n) with zero
-            # device round trips -- the device kernel's value is BATCHED
-            # lookups (many ids / many blocks) and mesh sharding
-            sids = np.asarray([[blocks[0].find_trace_sid(trace_id)]], dtype=np.int32)
         hits = [(blk, int(sid)) for blk, sid in zip(blocks, sids[:, 0]) if sid >= 0]
         return list(self.pool.map(lambda h: h[0].materialize_traces([h[1]])[0], hits))
 
