@@ -223,26 +223,41 @@ def bench_kernel() -> None:
 
 
 def bench_find_and_search(tmp: str) -> None:
+    """BASELINE config #2 shape: a 10-block local backend holding the
+    reference's own dataset size (~150 K traces / 10.4 M spans total,
+    docs/design-proposals/2022-04 Parquet.md:211-218), searched through
+    the PRODUCTION engine (TempoDB.search -> search_blocks_fused: the
+    same path the frontend's block-batch jobs execute)."""
     from tempo_tpu.backend.local import LocalBackend
-    from tempo_tpu.block.reader import BackendBlock
     from tempo_tpu.db import TempoDB, TempoDBConfig
     from tempo_tpu.db.search import SearchRequest, search_block
 
     rng = np.random.default_rng(7)
     backend = LocalBackend(tmp + "/store")
-    n_traces, spans_per = 1 << 16, 32  # 2.1 M spans
-    meta, ids = synth_block(backend, "bench", rng, n_traces, spans_per)
+    n_blocks, n_traces, spans_per = 10, 1 << 15, 32  # 10 x 1.05 M = 10.5 M spans
+    metas, ids_per = [], []
+    for _ in range(n_blocks):
+        meta, ids = synth_block(backend, "bench", rng, n_traces, spans_per)
+        metas.append(meta)
+        ids_per.append(ids)
+    total_spans = n_blocks * n_traces * spans_per
 
     db = TempoDB(TempoDBConfig(wal_path=tmp + "/wal"), backend=backend)
     db.poll_now()
 
-    # --- find p50 (device path: bloom read + batched bisection kernel)
+    # --- find p50 (bloom gates + batched lookup + row materialization
+    # across the 10-block backend). Steady-state: warm each block's
+    # row-group chunk cache first (the production querier's long-lived
+    # readers sit on hot caches; the reference's 0.18 s figure likewise
+    # rides the OS page cache)
+    group_traces = (1 << 16) // spans_per  # traces per 64Ki-span row group
+    for b in range(n_blocks):
+        for sid in range(0, n_traces, group_traces):
+            assert db.find_trace_by_id("bench", ids_per[b][sid].tobytes()) is not None
     picks = rng.integers(0, n_traces, size=120)
-    tid0 = ids[int(picks[0])].tobytes()
-    assert db.find_trace_by_id("bench", tid0) is not None  # warm + compile
     lat = []
-    for p in picks[20:]:
-        tid = ids[int(p)].tobytes()
+    for i, p in enumerate(picks[20:]):
+        tid = ids_per[i % n_blocks][int(p)].tobytes()
         t0 = time.perf_counter()
         got = db.find_trace_by_id("bench", tid)
         lat.append(time.perf_counter() - t0)
@@ -255,42 +270,76 @@ def bench_find_and_search(tmp: str) -> None:
     # RTT); on a mesh the device kernel takes over (parallel/find.py)
     from tempo_tpu.ops.find import lookup_ids_blocks_cached
 
-    blk = db.open_block(meta)
+    blocks = [db.open_block(m) for m in metas]
     Q = 256
     qidx = rng.integers(0, n_traces, size=Q)
-    qcodes = (ids[qidx].view(">u4").astype(np.int64) - 0x80000000).astype(np.int32).reshape(Q, 4)
-    sids = lookup_ids_blocks_cached([blk], qcodes)  # warm (ids upload + compile)
+    qcodes = (ids_per[0][qidx].view(">u4").astype(np.int64) - 0x80000000).astype(np.int32).reshape(Q, 4)
+    sids = lookup_ids_blocks_cached(blocks, qcodes)  # warm
     assert (sids[0] >= 0).all()
     iters_f = 20
     t0 = time.perf_counter()
     for _ in range(iters_f):
-        sids = lookup_ids_blocks_cached([blk], qcodes)
+        sids = lookup_ids_blocks_cached(blocks, qcodes)
     dt = time.perf_counter() - t0
+    # ids RESOLVED per second (each call answers Q ids against all 10
+    # blocks' indexes); the per-block bisection work is 10x that
     _emit("find_batched_device_ids_per_sec", Q * iters_f / dt, "ids/s", 0.0)
 
-    # --- e2e search, cold: fresh reader every iteration => full
-    # footer/column IO + zstd decode + host->device staging + filter +
-    # verify each time
+    # --- e2e search over the 10-block backend through TempoDB.search.
+    # Correctness gate first: the fused device engine must agree with a
+    # per-block host-engine scan.
     req = SearchRequest(tags={"service.name": "svc-003"},
                         min_duration_ms=100, limit=50)
-    resp = search_block(BackendBlock(backend, meta), req)  # warm compile
-    assert resp.inspected_spans == n_traces * spans_per
+    # touch 1 = host engine; touch 2 = staging upload; touch 3+ = pure
+    # device (search_blocks_fused promote-on-second-touch policy)
+    for _ in range(3):
+        resp = db.search("bench", req)
+    assert resp.inspected_spans == total_spans
+    assert len(resp.traces) == req.limit
+    # correctness gate: every trace the device engine returned must be a
+    # REAL match -- materialize it and check the predicate holds on the
+    # wire form (a span whose resource is svc-003, trace duration >=
+    # min_duration) -- and the per-block host engine must agree on the
+    # global newest-first frontier (within the 1 s device key granularity)
+    for t in resp.traces:
+        tr = db.find_trace_by_id("bench", bytes.fromhex(t.trace_id))
+        assert tr is not None
+        assert t.duration_ms >= req.min_duration_ms
+        svcs = {rs.resource.attrs.get("service.name") for rs in tr.resource_spans}
+        assert "svc-003" in svcs, svcs
+    host_newest = []
+    for m in metas:
+        r = search_block(db.open_block(m), req, mode="host")
+        host_newest.extend(r.traces)
+    host_newest.sort(key=lambda t: -t.start_time_unix_nano)
+    got_ids = {t.trace_id for t in resp.traces}
+    cutoff = min(t.start_time_unix_nano for t in resp.traces)
+    missed = [t for t in host_newest[: req.limit]
+              if t.trace_id not in got_ids
+              and t.start_time_unix_nano > cutoff + 1_000_000_000]
+    assert not missed, f"device engine missed {len(missed)} strictly-newer matches"
+
+    # cold: a fresh TempoDB + readers every iteration => every byte from
+    # disk + zstd decode + host->device staging + filter + one sync
     iters = 5
     t0 = time.perf_counter()
     for _ in range(iters):
-        resp = search_block(BackendBlock(backend, meta), req)
-    cold = n_traces * spans_per * iters / (time.perf_counter() - t0)
+        dbc = TempoDB(TempoDBConfig(wal_path=tmp + "/wal"), backend=backend)
+        dbc.poll_now()
+        resp = dbc.search("bench", req)
+        assert resp.inspected_spans == total_spans
+        dbc.close()
+    cold = total_spans * iters / (time.perf_counter() - t0)
 
-    # --- e2e search, hot block: one long-lived reader (the production
-    # querier pattern over immutable blocks) => staged device arrays are
-    # cached; measures filter + result path only. The reference's analog
-    # hot path still re-decodes parquet pages from the OS page cache.
-    blk = BackendBlock(backend, meta)
-    search_block(blk, req)  # populate staged cache
+    # hot: long-lived readers (the production querier pattern over
+    # immutable blocks) => staged device arrays cached; ~one device sync
+    # per query. The reference's analog hot path still re-decodes
+    # parquet pages from the OS page cache every query.
     t0 = time.perf_counter()
     for _ in range(iters):
-        resp = search_block(blk, req)
-    warm = n_traces * spans_per * iters / (time.perf_counter() - t0)
+        resp = db.search("bench", req)
+        assert resp.inspected_spans == total_spans
+    warm = total_spans * iters / (time.perf_counter() - t0)
     db.close()
     return cold, warm
 

@@ -191,4 +191,84 @@ int vtpu_zstd_decompress_batch(const uint8_t* src, const int64_t* in_offsets,
   return failed.load();
 }
 
+// ------------------------------------------------------- dictionary union
+
+// K-way merge of K SORTED string tables (compaction's dictionary union,
+// the role of the reference's per-row dictionary re-encode in
+// vparquet/compactor.go). Inputs are flattened: source i has counts[i]
+// strings; its offsets (counts[i]+1 uint32, 0-based into its own blob)
+// start at off_starts[i] in all_offsets, its blob at blob_starts[i] in
+// all_blobs. Outputs: merged offsets/blob (caller-allocated at summed
+// capacity) and, for every input string in source order, its code in
+// the merged table (the per-source remap gather compaction applies to
+// every code column). Returns the merged string count, or -1 on error.
+int64_t vtpu_dict_union(int64_t n_src, const int64_t* counts,
+                        const uint32_t* all_offsets, const int64_t* off_starts,
+                        const uint8_t* all_blobs, const int64_t* blob_starts,
+                        uint32_t* out_offsets, uint8_t* out_blob,
+                        int32_t* remap_flat, const int64_t* remap_starts,
+                        int64_t* out_blob_len) {
+  struct Head {
+    const uint8_t* p;
+    uint32_t len;
+    int32_t src;
+    int64_t idx;
+  };
+  auto str_at = [&](int64_t s, int64_t i, uint32_t* len) -> const uint8_t* {
+    const uint32_t* offs = all_offsets + off_starts[s];
+    *len = offs[i + 1] - offs[i];
+    return all_blobs + blob_starts[s] + offs[i];
+  };
+  auto less = [](const Head& a, const Head& b) {
+    // min-heap by string (then source for stability): std::push_heap
+    // builds a max-heap, so invert
+    int c = memcmp(a.p, b.p, a.len < b.len ? a.len : b.len);
+    if (c != 0) return c > 0;
+    if (a.len != b.len) return a.len > b.len;
+    return a.src > b.src;
+  };
+  std::vector<Head> heap;
+  heap.reserve((size_t)n_src);
+  for (int64_t s = 0; s < n_src; s++) {
+    if (counts[s] > 0) {
+      Head h;
+      h.p = str_at(s, 0, &h.len);
+      h.src = (int32_t)s;
+      h.idx = 0;
+      heap.push_back(h);
+    }
+  }
+  std::make_heap(heap.begin(), heap.end(), less);
+  int64_t n_out = 0, blob_pos = 0;
+  const uint8_t* last_p = nullptr;
+  uint32_t last_len = 0;
+  out_offsets[0] = 0;
+  while (!heap.empty()) {
+    std::pop_heap(heap.begin(), heap.end(), less);
+    Head h = heap.back();
+    heap.pop_back();
+    bool is_dup = last_p != nullptr && h.len == last_len &&
+                  memcmp(h.p, last_p, h.len) == 0;
+    if (!is_dup) {
+      memcpy(out_blob + blob_pos, h.p, h.len);
+      blob_pos += h.len;
+      n_out++;
+      out_offsets[n_out] = (uint32_t)blob_pos;
+      last_p = h.p;
+      last_len = h.len;
+    }
+    remap_flat[remap_starts[h.src] + h.idx] = (int32_t)(n_out - 1);
+    if (h.idx + 1 < counts[h.src]) {
+      Head nh;
+      nh.p = str_at(h.src, h.idx + 1, &nh.len);
+      nh.src = h.src;
+      nh.idx = h.idx + 1;
+      heap.push_back(nh);
+      std::push_heap(heap.begin(), heap.end(), less);
+    }
+  }
+  *out_blob_len = blob_pos;
+  return n_out;
+}
+
 }  // extern "C"

@@ -288,4 +288,6 @@ class ColumnPack:
                 self._cache_put(r[0], raw)
 
     def read_all(self) -> dict[str, np.ndarray]:
+        # one threaded decompress batch for every chunk of every column
+        self.warm([(n, None) for n in self._cols])
         return {n: self.read(n) for n in self._cols}

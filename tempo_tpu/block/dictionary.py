@@ -7,14 +7,21 @@ trace data TPU-friendly: string predicates become integer compares on
 device, with the string->code mapping resolved host-side per query
 (a miss prunes the whole block). Sorting at finalize means codes are
 ordered lexicographically, so device kernels can do range/prefix
-predicates as integer range checks.
+predicates as integer range checks. (utf-8 byte order equals unicode
+codepoint order, so byte-level and str-level comparisons agree.)
 
-Serialized form: zstd( uvarint count | repeated (uvarint len | utf8) ).
+Serialized form ("DIC2"): magic | zstd( u32 count | u32 offsets[count+1]
+| utf8 blob ) -- two frombuffer calls to load, NO per-string parse, and
+the loaded form stays as (blob, offsets) with per-string decode deferred
+until somebody actually asks for the text. Block open cost is O(bytes),
+not O(strings): the dominant cost of the old uvarint stream was half a
+million Python-level varint reads per compaction. The legacy varint
+form is still readable.
 """
 
 from __future__ import annotations
 
-import bisect
+import struct
 
 import numpy as np
 import zstandard
@@ -22,6 +29,8 @@ import zstandard
 from ..wire import pbwire as w
 
 NO_CODE = np.int32(-1)  # "absent" sentinel in every code column
+
+_MAGIC = b"DIC2"
 
 
 class DictBuilder:
@@ -54,42 +63,160 @@ def apply_remap(col: np.ndarray, remap: np.ndarray) -> np.ndarray:
     return out.astype(np.int32)
 
 
+def _incr_str(s: str) -> str | None:
+    """Smallest string strictly greater than every string with prefix s
+    (None = unbounded: s is all U+10FFFF). The codepoint-level twin of
+    _incr_bytes; both are exact bounds, so bisecting on either yields
+    the same index."""
+    cps = list(s)
+    while cps:
+        if ord(cps[-1]) != 0x10FFFF:
+            cps[-1] = chr(ord(cps[-1]) + 1)
+            return "".join(cps)
+        cps.pop()
+    return None
+
+
+def _incr_bytes(b: bytes) -> bytes | None:
+    """Smallest byte string strictly greater than every string with
+    prefix b (None = no upper bound: b is all 0xff)."""
+    arr = bytearray(b)
+    while arr:
+        if arr[-1] != 0xFF:
+            arr[-1] += 1
+            return bytes(arr)
+        arr.pop()
+    return None
+
+
 class Dictionary:
-    def __init__(self, strings: list[str]):
-        self.strings = strings
+    """Sorted string table. Two interchangeable representations:
+    eager (list[str], from the builder) and lazy ((blob, offsets) from
+    disk, strings decoded on demand and memoized)."""
+
+    def __init__(self, strings: list[str] | None = None,
+                 blob: bytes | None = None, offsets: np.ndarray | None = None):
+        self._strings = strings
+        self._blob = blob
+        self._offsets = offsets
+        self._decoded: dict[int, str] = {}
+
+    @classmethod
+    def from_raw(cls, blob: bytes, offsets: np.ndarray) -> "Dictionary":
+        return cls(blob=blob, offsets=offsets)
 
     def __len__(self) -> int:
-        return len(self.strings)
+        if self._strings is not None:
+            return len(self._strings)
+        return len(self._offsets) - 1
+
+    # ------------------------------------------------------- raw access
+    def raw(self) -> tuple[bytes, np.ndarray]:
+        """(utf8 blob, u32 offsets[count+1]) -- the union/merge unit."""
+        if self._blob is None:
+            bs = [s.encode("utf-8") for s in self._strings]
+            offs = np.zeros(len(bs) + 1, dtype=np.uint32)
+            np.cumsum([len(b) for b in bs], out=offs[1:])
+            self._blob, self._offsets = b"".join(bs), offs
+        return self._blob, self._offsets
+
+    def _bytes_at(self, i: int) -> bytes:
+        return self._blob[int(self._offsets[i]) : int(self._offsets[i + 1])]
+
+    @property
+    def strings(self) -> list[str]:
+        """Full decoded table (materialized once, then cached)."""
+        if self._strings is None:
+            blob, offs = self._blob, self._offsets
+            text = blob.decode("utf-8", errors="surrogateescape")
+            # one whole-blob decode + zero-copy-ish slicing beats half a
+            # million per-string decodes; offsets are byte offsets, which
+            # equal str offsets only for ascii blobs -- fall back per
+            # string when multibyte chars are present
+            if len(text) == len(blob):
+                o = offs.tolist()
+                self._strings = [text[o[i] : o[i + 1]] for i in range(len(o) - 1)]
+            else:
+                self._strings = [
+                    self._bytes_at(i).decode("utf-8") for i in range(len(offs) - 1)
+                ]
+        return self._strings
+
+    # ----------------------------------------------------------- lookup
+    def _bisect_bytes(self, needle: bytes) -> int:
+        lo, hi = 0, len(self._offsets) - 1
+        while lo < hi:
+            mid = (lo + hi) // 2
+            if self._bytes_at(mid) < needle:
+                lo = mid + 1
+            else:
+                hi = mid
+        return lo
 
     def lookup(self, s: str) -> int:
         """Code for s, or -1 if absent (prunes the block)."""
-        i = bisect.bisect_left(self.strings, s)
-        if i < len(self.strings) and self.strings[i] == s:
+        import bisect
+
+        if self._strings is not None:
+            i = bisect.bisect_left(self._strings, s)
+            if i < len(self._strings) and self._strings[i] == s:
+                return i
+            return -1
+        needle = s.encode("utf-8")
+        i = self._bisect_bytes(needle)
+        if i < len(self) and self._bytes_at(i) == needle:
             return i
         return -1
 
     def prefix_range(self, prefix: str) -> tuple[int, int]:
-        """[lo, hi) code range of strings with the given prefix."""
-        lo = bisect.bisect_left(self.strings, prefix)
-        hi = bisect.bisect_left(self.strings, prefix + "￿")
+        """[lo, hi) code range of strings with the given prefix. Both
+        representations compute the EXACT bound (first index whose
+        string does not start with prefix), so the answer cannot depend
+        on whether .strings happens to be materialized."""
+        import bisect
+
+        if self._strings is not None:
+            lo = bisect.bisect_left(self._strings, prefix)
+            up = _incr_str(prefix)
+            hi = (bisect.bisect_left(self._strings, up) if up is not None
+                  else len(self._strings))
+            return lo, hi
+        p = prefix.encode("utf-8")
+        lo = self._bisect_bytes(p)
+        up = _incr_bytes(p)
+        hi = self._bisect_bytes(up) if up is not None else len(self)
         return lo, hi
 
     def string(self, code: int) -> str:
-        if 0 <= code < len(self.strings):
-            return self.strings[code]
-        return ""
+        code = int(code)
+        if not 0 <= code < len(self):
+            return ""
+        if self._strings is not None:
+            return self._strings[code]
+        s = self._decoded.get(code)
+        if s is None:
+            s = self._decoded[code] = self._bytes_at(code).decode("utf-8")
+        return s
 
+    # -------------------------------------------------------------- io
     def to_bytes(self) -> bytes:
-        buf = bytearray()
-        w.write_varint(buf, len(self.strings))
-        for s in self.strings:
-            b = s.encode("utf-8")
-            w.write_varint(buf, len(b))
-            buf.extend(b)
-        return zstandard.ZstdCompressor(level=3).compress(bytes(buf))
+        blob, offs = self.raw()
+        payload = (
+            struct.pack("<I", len(offs) - 1)
+            + np.ascontiguousarray(offs, dtype=np.uint32).tobytes()
+            + blob
+        )
+        return _MAGIC + zstandard.ZstdCompressor(level=3).compress(payload)
 
     @classmethod
     def from_bytes(cls, data: bytes) -> "Dictionary":
+        if data[:4] == _MAGIC:
+            raw = zstandard.ZstdDecompressor().decompress(data[4:])
+            (count,) = struct.unpack_from("<I", raw, 0)
+            offs = np.frombuffer(raw, dtype=np.uint32, count=count + 1, offset=4)
+            blob = raw[4 + (count + 1) * 4 :]
+            return cls.from_raw(blob, offs)
+        # legacy uvarint stream
         raw = zstandard.ZstdDecompressor().decompress(data)
         count, pos = w.read_varint(raw, 0)
         strings = []

@@ -42,6 +42,14 @@ class Blocklist:
         with self._lock:
             return list(self._compacted.get(tenant, []))
 
+    def metas_by_id(self, tenant: str, block_ids: list[str]) -> list[BlockMeta]:
+        """Resolve block ids -> metas (job payloads ship ids, not metas;
+        a worker resolves them against its own polled blocklist). Missing
+        ids are skipped -- poll lag is the caller's retry condition."""
+        with self._lock:
+            by_id = {m.block_id: m for m in self._metas.get(tenant, [])}
+        return [by_id[b] for b in block_ids if b in by_id]
+
     def update(
         self,
         tenant: str,

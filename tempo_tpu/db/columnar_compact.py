@@ -65,18 +65,16 @@ _DICT_COLS = frozenset(
 class _Source:
     """One input block (or one combined collision trace) as raw columns."""
 
-    def __init__(self, cols: dict[str, np.ndarray], strings: list[str]):
+    def __init__(self, cols: dict[str, np.ndarray], dictionary: Dictionary):
         self.cols = cols
-        self.strings = strings
+        self.dictionary = dictionary
         self.span_off = cols["trace.span_off"]
 
     @classmethod
     def from_block(cls, blk: BackendBlock) -> "_Source":
-        return cls(blk.pack.read_all(), blk.dictionary.strings)
+        return cls(blk.pack.read_all(), blk.dictionary)
 
-    def remap_codes(self, code_of: dict[str, int]) -> None:
-        remap = np.fromiter((code_of[s] for s in self.strings), dtype=np.int32,
-                            count=len(self.strings))
+    def remap_codes(self, remap: np.ndarray) -> None:
         for name in self.cols:
             if name in _DICT_COLS:
                 self.cols[name] = apply_remap(self.cols[name], remap)
@@ -117,7 +115,7 @@ def _combine_collision(sources: list[_Source], blocks: list[BackendBlock],
     b = BlockBuilder(tenant)
     b.add_trace(tid, combined)
     fin = b.finalize()
-    return _Source(fin.cols, fin.dictionary.strings)
+    return _Source(fin.cols, fin.dictionary)
 
 
 def _ranges_to_idx(los: np.ndarray, his: np.ndarray) -> np.ndarray:
@@ -358,12 +356,14 @@ def compact_columnar(backend: RawBackend, job: CompactionJob, cfg: CompactorConf
             backend.mark_compacted(tenant, m.block_id)
         return CompactionResult(compacted_ids=[m.block_id for m in job.blocks])
 
-    # merged dictionary + one remap gather per source
-    merged_strings = sorted(set().union(*[set(s.strings) for s in sources]))
-    code_of = {s: i for i, s in enumerate(merged_strings)}
-    merged = Dictionary(merged_strings)
-    for s in sources:
-        s.remap_codes(code_of)
+    # merged dictionary via native K-way byte-level merge (no string
+    # decode anywhere) + one remap gather per source
+    from ..native import dict_union
+
+    blob, offs, remaps = dict_union([s.dictionary.raw() for s in sources])
+    merged = Dictionary.from_raw(blob, offs)
+    for s, remap in zip(sources, remaps):
+        s.remap_codes(remap)
 
     # size-target output cuts, estimated from input bytes/trace
     total_in = sum(m.size_bytes for m in job.blocks)

@@ -28,7 +28,12 @@ from ..block import schema as S
 from ..block.reader import BackendBlock
 from ..ops.filter import Operands, T_RES, T_SPAN, T_TRACE, eval_block, required_columns
 from ..ops.hostfilter import eval_block_host
-from ..ops.select import k_bucket, select_topk_device, select_topk_host
+from ..ops.select import (
+    k_bucket,
+    select_topk_device,
+    select_topk_device_multi,
+    select_topk_host,
+)
 from ..ops.stage import stage_block
 from ..traceql.plan import plan_search_request
 from ..util.distinct import DistinctStringCollector
@@ -98,6 +103,62 @@ class SearchResponse:
                 seen.add(t.trace_id)
         self.inspected_bytes += other.inspected_bytes
         self.inspected_spans += other.inspected_spans
+
+
+# ---- wire forms (the internal-API serialization both the remote job
+# plane and the ingester client speak)
+
+
+def request_to_dict(req: SearchRequest) -> dict:
+    return {
+        "tags": req.tags,
+        "query": req.query,
+        "min_duration_ms": req.min_duration_ms,
+        "max_duration_ms": req.max_duration_ms,
+        "start": req.start,
+        "end": req.end,
+        "limit": req.limit,
+    }
+
+
+def request_from_dict(d: dict) -> SearchRequest:
+    return SearchRequest(
+        tags=d.get("tags", {}),
+        query=d.get("query", ""),
+        min_duration_ms=d.get("min_duration_ms", 0),
+        max_duration_ms=d.get("max_duration_ms", 0),
+        start=d.get("start", 0),
+        end=d.get("end", 0),
+        limit=d.get("limit", DEFAULT_LIMIT),
+    )
+
+
+def response_to_dict(resp: SearchResponse) -> dict:
+    return {
+        "traces": [
+            {**t.to_dict(), "matchedSpans": t.matched_spans} for t in resp.traces
+        ],
+        "inspectedBytes": resp.inspected_bytes,
+        "inspectedSpans": resp.inspected_spans,
+    }
+
+
+def response_from_dict(d: dict) -> SearchResponse:
+    resp = SearchResponse()
+    resp.inspected_bytes = d.get("inspectedBytes", 0)
+    resp.inspected_spans = d.get("inspectedSpans", 0)
+    for t in d.get("traces", []):
+        resp.traces.append(
+            SearchResult(
+                trace_id=t["traceID"],
+                root_service_name=t.get("rootServiceName", ""),
+                root_trace_name=t.get("rootTraceName", ""),
+                start_time_unix_nano=int(t.get("startTimeUnixNano", "0")),
+                duration_ms=t.get("durationMs", 0),
+                matched_spans=t.get("matchedSpans", 0),
+            )
+        )
+    return resp
 
 
 def _plan_for_block(blk: BackendBlock, req: SearchRequest):
@@ -335,6 +396,189 @@ def search_block(
     resp.inspected_spans = n_spans_seen
     resp.inspected_bytes = pack.bytes_read - io0
     return resp
+
+
+# ---- fused multi-block device search (single chip)
+# (the cross-block ordering key trace@gkey_s is a derived staged column;
+# its origin constant lives in ops/stage.GKEY_ORIGIN_S)
+
+
+def _staged_hit(blk: BackendBlock, needed: tuple) -> bool:
+    store = getattr(blk, "_staged_cache", None)
+    return store is not None and (needed, None) in store
+
+
+def search_blocks_fused(
+    blocks: list[BackendBlock],
+    req: SearchRequest,
+    pool=None,
+    default_limit: int = DEFAULT_LIMIT,
+    promote_touches: int = 2,
+) -> SearchResponse | None:
+    """Search many blocks with at most ONE device sync.
+
+    Engine choice is per block, by temperature: a block whose staged
+    device columns are already resident (or that has been searched
+    promote_touches times -- provably hot, worth the one-time staging
+    upload) evaluates on device; everything colder evaluates on host
+    with the vectorized numpy engine, which costs ZERO device round
+    trips -- the right trade on a high-latency link where each sync is
+    a fixed ~100 ms. Device blocks share one fused cross-block top-k
+    (one sync covers the whole group); host blocks run per-block
+    top-k collects in the IO pool. A cold one-shot scan therefore never
+    touches the device, and a hot working set costs ~one RTT per query
+    regardless of block count -- the single-chip counterpart of the mesh
+    program in parallel/search.py, and the production engine behind
+    TempoDB.search_blocks / the frontend's block-batch jobs.
+
+    Returns None only when the combined staged footprint of the
+    device-eligible blocks exceeds the device budget -- the caller
+    falls back to per-block (streamed) search."""
+    resp = SearchResponse()
+    limit = req.limit or default_limit
+    in_range = [b for b in blocks if b.meta.overlaps_time(req.start, req.end)]
+    plans = (
+        list(pool.map(lambda b: _plan_for_block(b, req), in_range))
+        if pool is not None
+        else [_plan_for_block(b, req) for b in in_range]
+    )
+    live = [(blk, p) for blk, p in zip(in_range, plans) if not p.prune]
+    if not live:
+        return resp
+
+    dev_items: list[tuple[BackendBlock, object]] = []
+    host_items: list[tuple[BackendBlock, object]] = []
+    est = 0
+    for blk, p in live:
+        blk.search_touches = getattr(blk, "search_touches", 0) + 1
+        needed = tuple(required_columns(p.conds)) + ("trace@gkey_s",)
+        hot = _staged_hit(blk, needed) or blk.search_touches >= promote_touches
+        if hot:
+            n_span_cols = max(1, sum(
+                1 for n in needed if n.startswith(("span.", "sattr."))
+            ))
+            est += blk.pack.axes[S.AX_SPAN].n_rows * 4 * n_span_cols
+            dev_items.append((blk, p))
+        else:
+            host_items.append((blk, p))
+    if est > _DEVICE_SEARCH_MAX_BYTES:
+        return None
+
+    io0 = {id(blk): blk.pack.bytes_read for blk, _ in live}
+    results: list[SearchResult] = []
+
+    def stage_and_eval(item):
+        blk, p = item
+        operands = Operands.build(p.rows, p.tables or None)
+        needed = required_columns(p.conds) + ["trace@gkey_s"]
+        staged = stage_block(blk, needed)
+        tm, counts = eval_block(
+            (p.tree, p.conds), staged.cols, operands,
+            staged.n_spans, staged.n_traces,
+            staged.n_spans_b, staged.n_res_b, staged.n_traces_b,
+            span_out=False,
+        )
+        return tm, counts, staged.cols["trace@gkey_s"], staged.n_spans
+
+    def host_eval_collect(item):
+        blk, p = item
+        operands = Operands.build(p.rows, p.tables or None)
+        needed = required_columns(p.conds)
+        cols = _host_cols(blk, needed, None)
+        n_spans = cols["span.trace_sid"].shape[0]
+        tm, counts = eval_block_host(
+            (p.tree, p.conds), cols, operands, n_spans, blk.meta.total_traces
+        )
+        key = _start_key_host(blk)
+
+        def selector(k):
+            return select_topk_host(tm, key, counts, k)
+
+        return _collect_topk(blk, req, p.needs_verify, selector, limit), n_spans
+
+    # device staging IO + host scans overlap across one pool pass;
+    # device kernel dispatches are async, so nothing blocks until the
+    # fused select's single fetch
+    tagged = [("dev", it) for it in dev_items] + [("host", it) for it in host_items]
+
+    def run_item(t):
+        tag, item = t
+        return tag, (stage_and_eval(item) if tag == "dev" else host_eval_collect(item))
+
+    outs = list(pool.map(run_item, tagged)) if pool is not None else [
+        run_item(t) for t in tagged
+    ]
+    evald = [o for tag, o in outs if tag == "dev"]
+    host_out = [o for tag, o in outs if tag == "host"]
+
+    for out, n_spans in host_out:
+        results.extend(out)
+        resp.inspected_spans += int(n_spans)
+
+    if evald:
+        tms = [e[0] for e in evald]
+        cnts = [e[1] for e in evald]
+        keys = [e[2] for e in evald]
+        resp.inspected_spans += int(sum(e[3] for e in evald))
+        offsets = np.cumsum([0] + [int(t.shape[0]) for t in tms])
+
+        def selector(k):
+            return select_topk_device_multi(tms, keys, cnts, k)
+
+        results.extend(_collect_topk_multi(
+            [blk for blk, _ in dev_items], [p for _, p in dev_items],
+            offsets, req, selector, limit,
+        ))
+
+    results.sort(key=lambda r: -r.start_time_unix_nano)
+    seen: set[str] = set()
+    deduped = []
+    for r in results:
+        if r.trace_id not in seen:
+            seen.add(r.trace_id)
+            deduped.append(r)
+    resp.traces = deduped[:limit]
+    resp.inspected_bytes = sum(
+        blk.pack.bytes_read - io0[id(blk)] for blk, _ in live
+    )
+    return resp
+
+
+def _collect_topk_multi(blocks, plans, offsets, req: SearchRequest, selector,
+                        limit: int) -> list[SearchResult]:
+    """Escalating cross-block top-k collect: global winners map back to
+    (block, sid) via the padded part offsets, then per-block exact
+    verification + result building -- the multi-block twin of
+    _collect_topk."""
+    total = int(offsets[-1])
+    if total == 0:
+        return []
+    k = min(k_bucket(max(2 * limit, 32)), total)
+    out: list[SearchResult] = []
+    seen: set[int] = set()
+    while True:
+        gids, gcnts, n_match = selector(k)
+        per_block: dict[int, list[tuple[int, int]]] = {}
+        fresh = 0
+        for g, c in zip(gids, gcnts):
+            g = int(g)
+            if g in seen:
+                continue
+            seen.add(g)
+            fresh += 1
+            bi = int(np.searchsorted(offsets, g, side="right")) - 1
+            per_block.setdefault(bi, []).append((g - int(offsets[bi]), int(c)))
+        for bi, pairs in per_block.items():
+            blk, p = blocks[bi], plans[bi]
+            sids = np.asarray([s for s, _ in pairs], dtype=np.int64)
+            ok = _verify_candidates(blk, req, sids, p.needs_verify)
+            okset = {int(s) for s in ok}
+            out.extend(
+                _build_results(blk, req, [s for s, c in pairs if s in okset], dict(pairs))
+            )
+        if len(out) >= limit or len(seen) >= n_match or k >= total or fresh == 0:
+            return out
+        k = min(k_bucket(k * 4), total)
 
 
 # ---- stacked multi-block device search (parallel/search.py)

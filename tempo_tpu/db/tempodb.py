@@ -42,6 +42,9 @@ class TempoDBConfig:
     search_default_limit: int = 20
     device_find: bool = True  # batched/sharded device Find (ops/find, parallel/find)
     device_search: bool = True  # stacked multi-block device search (parallel/search)
+    # searches of a block before its columns are staged on device (first
+    # touches run the zero-RTT host engine; see search_blocks_fused)
+    device_promote_touches: int = 2
     compaction: comp.CompactorConfig = field(default_factory=comp.CompactorConfig)
 
 
@@ -99,17 +102,32 @@ class TempoDB:
         return meta
 
     # ------------------------------------------------------------- find
+    def find_candidates(
+        self, tenant: str, trace_id: bytes, time_start: int = 0, time_end: int = 0
+    ) -> list[BlockMeta]:
+        """Blocks whose id range + time window may hold the trace (the
+        unit the frontend's ID-space sharder partitions)."""
+        hex_id = trace_id.rjust(16, b"\x00").hex()
+        return [
+            m
+            for m in self.blocklist.metas(tenant)
+            if m.may_contain_id(hex_id) and m.overlaps_time(time_start, time_end)
+        ]
+
     def find_trace_by_id(
         self, tenant: str, trace_id: bytes, time_start: int = 0, time_end: int = 0
     ) -> Trace | None:
         """Parallel candidate-block lookup + combine
         (reference: tempodb.Find, tempodb/tempodb.go:271-352)."""
-        hex_id = trace_id.rjust(16, b"\x00").hex()
-        candidates = [
-            m
-            for m in self.blocklist.metas(tenant)
-            if m.may_contain_id(hex_id) and m.overlaps_time(time_start, time_end)
-        ]
+        candidates = self.find_candidates(tenant, trace_id, time_start, time_end)
+        return self.find_in_blocks(tenant, trace_id, candidates)
+
+    def find_in_blocks(
+        self, tenant: str, trace_id: bytes, candidates: list[BlockMeta]
+    ) -> Trace | None:
+        """Lookup restricted to an explicit block set -- one frontend
+        ID-shard job (tracebyidsharding.go:30-48 analog: the frontend
+        partitions the candidate blocks, we execute one partition)."""
         if not candidates:
             return None
         if self.cfg.device_find:
@@ -156,17 +174,35 @@ class TempoDB:
     # ------------------------------------------------------------ search
     def search(self, tenant: str, req: SearchRequest) -> SearchResponse:
         metas = [m for m in self.blocklist.metas(tenant) if m.overlaps_time(req.start, req.end)]
+        return self.search_blocks(tenant, metas, req)
+
+    def search_blocks(self, tenant: str, metas: list[BlockMeta], req: SearchRequest) -> SearchResponse:
+        """Search a set of blocks as one unit -- the execution engine
+        behind both TempoDB.search and the frontend's block-batch jobs.
+        Single chip: fused per-block kernels + ONE cross-block device
+        top-k sync (db/search.search_blocks_fused). Mesh: the stacked
+        sharded program (parallel/search.py). Falls back to per-block
+        search when the device budget or plan shape demands it."""
         resp = SearchResponse()
         if not metas:
             return resp
-        if self.cfg.device_search and len(metas) > 1:
-            from .search import search_blocks_device
+        if self.cfg.device_search:
+            if self.mesh.devices.size > 1 and len(metas) > 1:
+                from .search import search_blocks_device
 
-            got = search_blocks_device(
-                [self.open_block(m) for m in metas], req, self.mesh,
-                default_limit=self.cfg.search_default_limit, pool=self.pool,
-            )
-            if got is not None:  # None -> generic-attr / oversize fallback
+                got = search_blocks_device(
+                    [self.open_block(m) for m in metas], req, self.mesh,
+                    default_limit=self.cfg.search_default_limit, pool=self.pool,
+                )
+            else:
+                from .search import search_blocks_fused
+
+                got = search_blocks_fused(
+                    [self.open_block(m) for m in metas], req,
+                    pool=self.pool, default_limit=self.cfg.search_default_limit,
+                    promote_touches=self.cfg.device_promote_touches,
+                )
+            if got is not None:  # None -> oversize / plan-shape fallback
                 return got
         for r in self.pool.map(lambda m: search_block(self.open_block(m), req), metas):
             resp.merge(r, req.limit or self.cfg.search_default_limit)

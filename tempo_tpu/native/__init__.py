@@ -32,38 +32,59 @@ def _load():
             subprocess.run(["make", "-C", _NATIVE_DIR], capture_output=True, timeout=120)
         except Exception:
             pass
-    if os.path.exists(_SO):
+    for attempt in (0, 1):
+        if not os.path.exists(_SO):
+            break
         try:
-            lib = ctypes.CDLL(_SO)
-            lib.vtpu_ring_tokens.argtypes = [
-                ctypes.c_char_p, ctypes.c_int,
-                ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_void_p,
-            ]
-            lib.vtpu_bloom_add_batch.argtypes = [
-                ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
-                ctypes.c_int, ctypes.c_void_p, ctypes.c_int, ctypes.c_int,
-            ]
-            lib.vtpu_varint_frames.argtypes = [
-                ctypes.c_void_p, ctypes.c_int64,
-                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int,
-            ]
-            lib.vtpu_varint_frames.restype = ctypes.c_int
-            lib.vtpu_zstd_bound.argtypes = [ctypes.c_int64]
-            lib.vtpu_zstd_bound.restype = ctypes.c_int64
-            lib.vtpu_zstd_compress_batch.argtypes = [ctypes.c_void_p] + [ctypes.c_void_p] * 2 + [
-                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
-                ctypes.c_int, ctypes.c_int, ctypes.c_int,
-            ]
-            lib.vtpu_zstd_compress_batch.restype = ctypes.c_int
-            lib.vtpu_zstd_decompress_batch.argtypes = [ctypes.c_void_p] + [ctypes.c_void_p] * 2 + [
-                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
-                ctypes.c_int, ctypes.c_int,
-            ]
-            lib.vtpu_zstd_decompress_batch.restype = ctypes.c_int
-            _LIB = lib
-        except OSError:
+            _LIB = _bind(ctypes.CDLL(_SO))
+            break
+        except (OSError, AttributeError):
+            # AttributeError = a stale prebuilt .so missing a newer
+            # symbol: rebuild ONCE, else run on the pure-Python fallbacks
             _LIB = None
+            if attempt:
+                break
+            try:
+                subprocess.run(["make", "-C", _NATIVE_DIR, "-B"],
+                               capture_output=True, timeout=120)
+            except Exception:
+                break
     return _LIB
+
+
+def _bind(lib):
+    lib.vtpu_ring_tokens.argtypes = [
+        ctypes.c_char_p, ctypes.c_int,
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_void_p,
+    ]
+    lib.vtpu_bloom_add_batch.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.c_void_p, ctypes.c_int, ctypes.c_int,
+    ]
+    lib.vtpu_varint_frames.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64,
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int,
+    ]
+    lib.vtpu_varint_frames.restype = ctypes.c_int
+    lib.vtpu_zstd_bound.argtypes = [ctypes.c_int64]
+    lib.vtpu_zstd_bound.restype = ctypes.c_int64
+    lib.vtpu_zstd_compress_batch.argtypes = [ctypes.c_void_p] + [ctypes.c_void_p] * 2 + [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+        ctypes.c_int, ctypes.c_int, ctypes.c_int,
+    ]
+    lib.vtpu_zstd_compress_batch.restype = ctypes.c_int
+    lib.vtpu_zstd_decompress_batch.argtypes = [ctypes.c_void_p] + [ctypes.c_void_p] * 2 + [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+        ctypes.c_int, ctypes.c_int,
+    ]
+    lib.vtpu_zstd_decompress_batch.restype = ctypes.c_int
+    lib.vtpu_dict_union.argtypes = [
+        ctypes.c_int64, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+    ]
+    lib.vtpu_dict_union.restype = ctypes.c_int64
+    return lib
 
 
 def available() -> bool:
@@ -150,6 +171,71 @@ def zstd_compress_chunks(chunks: list[bytes], level: int = 3) -> list[bytes] | N
     if rc != 0:
         return None
     return [dst[out_offs[i]: out_offs[i] + out_lens[i]].tobytes() for i in range(n)]
+
+
+# ---------------------------------------------------------- dict union
+def dict_union(raws: list[tuple[bytes, np.ndarray]]):
+    """K-way merge of K sorted dictionaries given as (blob, u32 offsets)
+    pairs (block.dictionary.Dictionary.raw()). Returns (merged_blob,
+    merged_offsets, [per-source int32 remap]). Pure-numpy fallback when
+    the native library is absent."""
+    n_src = len(raws)
+    counts = np.asarray([len(offs) - 1 for _, offs in raws], dtype=np.int64)
+    total = int(counts.sum())
+    if total == 0:
+        return b"", np.zeros(1, dtype=np.uint32), [np.zeros(0, np.int32) for _ in raws]
+    lib = _load()
+    if lib is None:
+        return _dict_union_py(raws, counts)
+    all_offsets = np.concatenate([
+        np.ascontiguousarray(offs, dtype=np.uint32) for _, offs in raws
+    ])
+    off_starts = np.zeros(n_src, dtype=np.int64)
+    np.cumsum(counts[:-1] + 1, out=off_starts[1:]) if n_src > 1 else None
+    blobs = b"".join(b for b, _ in raws)
+    blob_lens = np.asarray([len(b) for b, _ in raws], dtype=np.int64)
+    blob_starts = np.zeros(n_src, dtype=np.int64)
+    np.cumsum(blob_lens[:-1], out=blob_starts[1:]) if n_src > 1 else None
+    all_blobs = np.frombuffer(blobs, dtype=np.uint8)
+    out_offsets = np.zeros(total + 1, dtype=np.uint32)
+    out_blob = np.zeros(max(1, len(blobs)), dtype=np.uint8)
+    remap_flat = np.zeros(total, dtype=np.int32)
+    remap_starts = np.zeros(n_src, dtype=np.int64)
+    np.cumsum(counts[:-1], out=remap_starts[1:]) if n_src > 1 else None
+    out_blob_len = np.zeros(1, dtype=np.int64)
+    n_out = lib.vtpu_dict_union(
+        n_src, counts.ctypes.data, all_offsets.ctypes.data, off_starts.ctypes.data,
+        all_blobs.ctypes.data if len(all_blobs) else None, blob_starts.ctypes.data,
+        out_offsets.ctypes.data, out_blob.ctypes.data,
+        remap_flat.ctypes.data, remap_starts.ctypes.data, out_blob_len.ctypes.data,
+    )
+    if n_out < 0:
+        return _dict_union_py(raws, counts)
+    merged_blob = out_blob[: int(out_blob_len[0])].tobytes()
+    merged_offsets = out_offsets[: n_out + 1].copy()
+    remaps = [
+        remap_flat[remap_starts[i] : remap_starts[i] + counts[i]].copy()
+        for i in range(n_src)
+    ]
+    return merged_blob, merged_offsets, remaps
+
+
+def _dict_union_py(raws, counts):
+    """Fallback: bytes-level set union + searchsorted remap."""
+    per_src: list[list[bytes]] = []
+    for blob, offs in raws:
+        o = offs.tolist()
+        per_src.append([blob[o[i] : o[i + 1]] for i in range(len(o) - 1)])
+    merged = sorted(set().union(*[set(s) for s in per_src])) if per_src else []
+    code_of = {s: i for i, s in enumerate(merged)}
+    remaps = [
+        np.asarray([code_of[s] for s in src], dtype=np.int32) for src in per_src
+    ]
+    blob = b"".join(merged)
+    offs = np.zeros(len(merged) + 1, dtype=np.uint32)
+    if merged:
+        np.cumsum([len(s) for s in merged], out=offs[1:])
+    return blob, offs, remaps
 
 
 def zstd_decompress_chunks(chunks: list[bytes], out_sizes: list[int]) -> list[bytes] | None:

@@ -64,6 +64,45 @@ def select_topk_device(mask, key, counts, k: int):
     return sids[valid], cnts[valid], int(out[3 * k])
 
 
+@lru_cache(maxsize=64)
+def _compiled_select_multi(k: int, n_parts: int):
+    """Fused cross-block selection: concatenate per-block (mask, key,
+    count) vectors ON DEVICE and top-k once. n_parts is only a cache
+    discriminator; jax.jit itself re-specializes on the part shapes."""
+
+    @jax.jit
+    def sel(masks, keys, counts):
+        m = jnp.concatenate(masks)
+        key = jnp.concatenate(keys).astype(jnp.int32)
+        c = jnp.concatenate(counts)
+        keyed = jnp.where(m, key, jnp.int32(_NEG))
+        _, topi = jax.lax.top_k(keyed, k)
+        valid = jnp.take(m, topi).astype(jnp.int32)
+        return jnp.concatenate([
+            topi.astype(jnp.int32),
+            jnp.take(c, topi).astype(jnp.int32),
+            valid,
+            jnp.sum(m.astype(jnp.int32))[None],
+        ])
+
+    return sel
+
+
+def select_topk_device_multi(masks, keys, counts, k: int):
+    """Top-k across MANY blocks' device mask/key/count vectors in one
+    fused program -> ONE device sync for the whole multi-block query.
+    Returns (global_idx desc-by-key, counts at winners, total n_match);
+    global_idx indexes the concatenation of the (padded) parts -- the
+    caller maps it back to (block, sid) with the part offsets."""
+    total = int(sum(m.shape[0] for m in masks))
+    k = int(min(k, total))
+    out = np.asarray(
+        _compiled_select_multi(k, len(masks))(tuple(masks), tuple(keys), tuple(counts))
+    )
+    gids, cnts, valid = out[:k], out[k : 2 * k], out[2 * k : 3 * k] > 0
+    return gids[valid], cnts[valid], int(out[3 * k])
+
+
 def select_topk_host(mask: np.ndarray, key: np.ndarray, counts: np.ndarray, k: int):
     """Numpy twin: argpartition + sort, same descending-key order."""
     n = mask.shape[0]

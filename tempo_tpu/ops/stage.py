@@ -26,6 +26,11 @@ from .device import PAD_I32, bucket, pad_rows
 _CACHE_MAX_ENTRIES = 32  # per block
 _CACHE_MAX_ENTRY_BYTES = 256 << 20
 
+# absolute-seconds origin (2020-01-01 UTC) for the derived trace@gkey_s
+# column: a global trace start time in int32 seconds (valid until 2088)
+# that orders traces ACROSS blocks -- per-block relative ms don't
+GKEY_ORIGIN_S = 1_577_836_800
+
 @jax.jit
 def _res_to_span(res_vals, res_idx):
     """Broadcast a res-axis column to span rows; PAD where no resource."""
@@ -79,7 +84,11 @@ def stage_block(
     host: dict[str, np.ndarray] = {}
     n_res = 0
     materialize = [n.split("@", 1)[1] for n in needed if n.startswith("span@")]
-    needed = [n for n in needed if not n.startswith("span@")]
+    want_gkey = "trace@gkey_s" in needed
+    needed = [n for n in needed if not n.startswith(("span@", "trace@"))]
+    start_ms_for_gkey_only = want_gkey and "trace.start_ms" not in needed
+    if start_ms_for_gkey_only:
+        needed = needed + ["trace.start_ms"]
     for name in needed:
         pref = name.split(".", 1)[0]
         ax = _AXIS_OF.get(pref)
@@ -131,8 +140,18 @@ def stage_block(
         host["rattr.off"] = pad_rows(off, n_res_b + 1, off[-1] if off.size else 0)
         del host["rattr.res"]  # superseded on device by the offsets
 
+    if want_gkey:
+        # derived column: the cross-block top-k ordering key
+        base_s = blk.meta.start_time_unix_nano // 1_000_000_000 - GKEY_ORIGIN_S
+        host["trace@gkey_s"] = (
+            host["trace.start_ms"].astype(np.int64) // 1000 + base_s
+        ).astype(np.int32)
+        if start_ms_for_gkey_only:
+            host.pop("trace.start_ms", None)  # read only to derive the key
+
+    padded: dict[str, np.ndarray] = {}
     for name, arr in host.items():
-        pref = name.split(".", 1)[0]
+        pref = name.split(".", 1)[0].split("@", 1)[0]
         if name == "trace.span_off":
             # rebase global span rows to the staged slice; padded trace
             # rows collapse to empty segments (count 0)
@@ -140,6 +159,8 @@ def stage_block(
             arr = pad_rows(arr, n_traces_b + 1, arr[-1] if arr.size else 0)
         elif name in ("sattr.off", "rattr.off"):
             pass  # already padded above
+        elif name == "trace@gkey_s":
+            arr = pad_rows(arr, n_traces_b, np.int32(-(2**31)))
         elif pref == "span":
             arr = pad_rows(arr, n_spans_b, PAD_I32)
         elif pref == "sattr":
@@ -153,7 +174,10 @@ def stage_block(
                 arr = pad_rows(arr, n_traces_b, PAD_I32 if arr.dtype == np.int32 else np.float32(0))
             else:
                 continue  # host-only trace columns are not staged
-        staged.cols[name] = jnp.asarray(arr)
+        padded[name] = arr
+    # ONE batched transfer for the whole block: per-array device_puts
+    # each pay a full link round trip on a high-latency tunnel
+    staged.cols = dict(zip(padded, jax.device_put(list(padded.values()))))
 
     # materialize requested res columns at SPAN level: the res->span
     # broadcast gather is query-independent, so paying it once here

@@ -64,6 +64,10 @@ class AppConfig:
     # shared secret for /internal/* and remote /flush//shutdown when the
     # server is reachable beyond loopback
     internal_token: str = ""
+    # standalone querier: comma-separated frontend addresses to attach to
+    # and pull jobs from (reference: querier.frontend-address)
+    frontend_addr: str = ""
+    frontend_workers: int = 8  # in-process worker threads (0 = dispatcher-only)
 
 
 class App:
@@ -147,13 +151,27 @@ class App:
                 generator_forward=gen_forward,
             )
 
-        self.querier = self.frontend = None
+        self.querier = self.frontend = self.querier_worker = None
         if has("querier") or has("query-frontend"):
             # with a shared KV the ring may hold remote ingesters even when
             # this process hosts none
             ingester_ring = self.ring if (self._clients or cfg.kv_dir) else None
             self.querier = Querier(self.db, ingester_ring, self.client_for)
-            self.frontend = Frontend(self.querier)
+            # a standalone query-frontend with remote queriers attached is
+            # dispatcher-only (v1/frontend.go); every other shape keeps
+            # in-process workers draining the same queue
+            n_workers = cfg.frontend_workers
+            if cfg.target == "query-frontend" and cfg.kv_dir:
+                n_workers = 0
+            self.frontend = Frontend(self.querier, n_workers=n_workers)
+            if cfg.target == "querier" and cfg.frontend_addr:
+                from .worker import QuerierWorker
+
+                self.querier_worker = QuerierWorker(
+                    self.querier,
+                    [a.strip() for a in cfg.frontend_addr.split(",") if a.strip()],
+                    token=cfg.internal_token,
+                )
 
         self.compactor = self.compactor_lifecycler = None
         if has("compactor"):
@@ -178,10 +196,14 @@ class App:
             self.ingester.start_sweeper()
         if self.compactor:
             self.compactor.start()
+        if self.querier_worker:
+            self.querier_worker.start()
         self.db.enable_polling()
         self._started = True
 
     def stop(self) -> None:
+        if self.querier_worker:
+            self.querier_worker.stop()
         if self.compactor:
             self.compactor.stop()
         if self.ingester:
@@ -441,7 +463,20 @@ def _metrics_text(app: App) -> str:
             f"tempo_compactor_blocks_compacted_total {app.compactor.stats.blocks_compacted}",
         ]
     if app.querier:
-        lines.append(f"tempo_querier_traces_found_total {app.querier.stats.traces_found}")
+        lines += [
+            f"tempo_querier_traces_found_total {app.querier.stats.traces_found}",
+            f"tempo_querier_searches_total {app.querier.stats.searches}",
+        ]
+    if app.querier_worker:
+        lines += [
+            f"tempo_querier_worker_jobs_executed_total {app.querier_worker.jobs_executed}",
+            f"tempo_querier_worker_jobs_failed_total {app.querier_worker.jobs_failed}",
+        ]
+    if app.frontend:
+        lines += [
+            f"tempo_frontend_jobs_local_total {app.frontend.stats_jobs_local}",
+            f"tempo_frontend_jobs_remote_total {app.frontend.stats_jobs_remote}",
+        ]
     if app.generator is not None:
         lines.extend(app.generator.metrics_text())
     return "\n".join(lines) + "\n"
@@ -490,6 +525,8 @@ def main(argv=None):
     ap.add_argument("--replication.factor", dest="rf", type=int, default=None)
     ap.add_argument("--internal.token", dest="internal_token", default=None,
                     help="shared secret for /internal/* when bound beyond loopback")
+    ap.add_argument("--querier.frontend-address", dest="frontend_addr", default=None,
+                    help="frontend addr(s) a standalone querier pulls jobs from")
     args = ap.parse_args(argv)
     base = load_config_file(args.config_file) if args.config_file else {}
     flag_vals = {
@@ -503,6 +540,7 @@ def main(argv=None):
         "instance_id": args.instance_id,
         "replication_factor": args.rf,
         "internal_token": args.internal_token,
+        "frontend_addr": args.frontend_addr,
     }
     base.update({k: v for k, v in flag_vals.items() if v is not None})
     cfg = AppConfig(**base)

@@ -1,27 +1,46 @@
 """Query frontend: per-tenant fair queue, job sharding, retry, combine.
 
 Reference: modules/frontend -- trace-by-ID pipeline (deduper->sharder->
-retry, frontend.go:96-183), search sharder (searchsharding.go:69-247:
-time range -> block list -> per-block row-group jobs of
-~targetBytesPerRequest, bounded concurrency, early exit at limit), and
-the per-tenant queue queriers pull from (v1/frontend.go, pkg/scheduler/
-queue). Here queriers pull jobs from the queue with worker threads --
-the same decoupling, in-process.
+retry, frontend.go:96-183), search sharder (searchsharding.go:69-247),
+trace-ID-space sharding (tracebyidsharding.go:30-48), and the per-tenant
+queue queriers pull from (v1/frontend.go:50-90, pkg/scheduler/queue).
+
+Jobs carry BOTH a local closure (in-process worker threads, the
+single-binary fast path) and a wire form (kind + payload): standalone
+querier processes attach over HTTP long-poll (/internal/jobs/poll) and
+pull the same queue the local workers drain -- the reference's
+querier-worker frontend_processor loop (frontend_processor.go:57-80),
+dispatcher and execution fully decoupled.
+
+Search jobs are block BATCHES, not 10-MiB page shards: the device
+engine answers a whole batch of blocks in one fused program + one
+device sync (db/search.search_blocks_fused), so the unit of dispatch
+is sized to amortize the sync, not to bound a Go worker's scan time.
+Oversized single blocks still shard by row-group range.
 """
 
 from __future__ import annotations
 
 import threading
 import time
+import uuid
 from collections import deque
 from dataclasses import dataclass, field
 
-from ..db.search import SearchRequest, SearchResponse
+from ..db.search import (
+    SearchRequest,
+    SearchResponse,
+    request_to_dict,
+    response_from_dict,
+)
+from ..wire.combine import combine_traces, sort_trace
 from .querier import Querier
 
-TARGET_BYTES_PER_JOB = 10 * 1024 * 1024  # searchsharding.go:25-28
+TARGET_BATCH_BYTES = 256 << 20  # block-batch job size (device engine unit)
 DEFAULT_CONCURRENT_JOBS = 50
 MAX_RETRIES = 3
+MAX_BLOCKS_PER_BATCH = 64
+FIND_SHARD_BLOCKS = 16  # candidate blocks per ID-shard find job
 
 
 class TooManyRequests(Exception):
@@ -83,24 +102,59 @@ class RequestQueue:
 
 @dataclass
 class _Job:
-    fn: object
+    kind: str  # wire kind: search_recent|search_blocks|search_block_shard|
+    # find_recent|find_blocks
+    payload: dict  # wire-shippable arguments (ids, not objects)
+    fn: object  # local execution closure (in-process workers)
     args: tuple
     result: object = None
     error: Exception | None = None
     done: threading.Event = field(default_factory=threading.Event)
     tries: int = 0
+    cancelled: bool = False
+    hedged: bool = False
+    enqueued_at: float = 0.0
+    batch_cv: threading.Condition | None = None
+
+    def finish(self) -> None:
+        self.done.set()
+        cv = self.batch_cv
+        if cv is not None:
+            with cv:
+                cv.notify_all()
+
+
+def decode_job_result(kind: str, out: dict):
+    """Wire result -> the object the local closure would have returned."""
+    if kind.startswith("search"):
+        return response_from_dict(out)
+    tr = out.get("trace")
+    if not tr:
+        return None
+    from ..wire import otlp_json
+
+    return otlp_json.loads(tr)
 
 
 class Frontend:
-    """Owns the queue + sharding logic; queriers attach as workers."""
+    """Owns the queue + sharding logic; local worker threads and remote
+    querier processes both pull from the queue."""
 
     def __init__(self, querier: Querier, n_workers: int = 8,
                  concurrent_jobs: int = DEFAULT_CONCURRENT_JOBS,
-                 target_bytes_per_job: int = TARGET_BYTES_PER_JOB):
+                 batch_bytes: int = TARGET_BATCH_BYTES,
+                 hedge_after_s: float = 2.0,
+                 lease_s: float = 30.0):
         self.querier = querier
         self.queue = RequestQueue()
         self.concurrent_jobs = concurrent_jobs
-        self.target_bytes_per_job = target_bytes_per_job
+        self.batch_bytes = batch_bytes
+        self.hedge_after_s = hedge_after_s
+        self.lease_s = lease_s
+        self._leases: dict[str, tuple[str, _Job, float]] = {}
+        self._lease_lock = threading.Lock()
+        self.stats_jobs_remote = 0
+        self.stats_jobs_local = 0
         self._workers = [
             threading.Thread(target=self._worker, daemon=True, name=f"frontend-worker-{i}")
             for i in range(n_workers)
@@ -108,6 +162,7 @@ class Frontend:
         for w in self._workers:
             w.start()
 
+    # ------------------------------------------------------- local workers
     def _worker(self):
         while True:
             item = self.queue.dequeue(timeout=1.0)
@@ -116,12 +171,21 @@ class Frontend:
                     return
                 continue
             tenant, job = item
+            if job.cancelled or job.done.is_set():
+                job.finish()
+                continue
             try:
-                job.result = job.fn(*job.args)
+                res = job.fn(*job.args)
+                if not job.done.is_set():
+                    job.result = res
+                self.stats_jobs_local += 1
             except Exception as e:
                 # retry only transient failures (reference retries 5xx
                 # only, modules/frontend/retry.go); a parse error or bad
-                # argument fails identically every try
+                # argument fails identically every try. A hedge twin's
+                # failure must never clobber its sibling's success.
+                if job.done.is_set():
+                    continue
                 job.tries += 1
                 if _retryable(e) and job.tries < MAX_RETRIES:
                     try:
@@ -129,63 +193,207 @@ class Frontend:
                         continue
                     except TooManyRequests:
                         pass
-                job.error = e
-            job.done.set()
+                if not job.done.is_set():
+                    job.error = e
+            job.finish()
 
+    # ------------------------------------------------ remote querier pull
+    def poll_job(self, wait_s: float = 5.0):
+        """Long-poll dequeue for a remote querier worker
+        (frontend_processor.go's stream recv). Returns a wire job dict
+        or None on timeout. Expired leases re-enter the queue first."""
+        self._requeue_expired()
+        deadline = time.monotonic() + wait_s
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return None
+            item = self.queue.dequeue(timeout=min(remaining, 1.0))
+            if item is None:
+                if self.queue.closed:
+                    return None
+                continue
+            tenant, job = item
+            if job.cancelled or job.done.is_set():
+                job.finish()
+                continue
+            jid = uuid.uuid4().hex
+            with self._lease_lock:
+                self._leases[jid] = (tenant, job, time.monotonic() + self.lease_s)
+            return {"id": jid, "tenant": tenant, "kind": job.kind, "payload": job.payload}
+
+    def complete_job(self, jid: str, ok: bool, result: dict | None = None,
+                     error: str = "", retryable: bool = False) -> None:
+        """Remote worker posts a job result. Unknown/expired lease ids
+        are dropped (the job was re-dispatched or timed out)."""
+        with self._lease_lock:
+            lease = self._leases.pop(jid, None)
+        if lease is None:
+            return
+        tenant, job, _ = lease
+        if job.done.is_set():
+            return
+        if ok:
+            job.result = decode_job_result(job.kind, result or {})
+            self.stats_jobs_remote += 1
+        else:
+            job.tries += 1
+            if retryable and job.tries < MAX_RETRIES:
+                try:
+                    self.queue.enqueue(tenant, job)
+                    return
+                except TooManyRequests:
+                    pass
+            job.error = RuntimeError(error or "remote job failed")
+        job.finish()
+
+    def _requeue_expired(self) -> None:
+        now = time.monotonic()
+        expired = []
+        with self._lease_lock:
+            for jid, (tenant, job, exp) in list(self._leases.items()):
+                if exp < now:
+                    expired.append((tenant, job))
+                    del self._leases[jid]
+        for tenant, job in expired:
+            if not (job.done.is_set() or job.cancelled):
+                try:
+                    self.queue.enqueue(tenant, job)
+                except TooManyRequests:
+                    job.error = TimeoutError("job lease expired, queue full")
+                    job.finish()
+
+    # ---------------------------------------------------------- dispatch
     def _run_jobs(self, tenant: str, jobs: list[_Job], early_exit=None,
                   timeout: float = 60.0) -> None:
-        """Enqueue with bounded in-flight jobs; early_exit() True stops
-        dispatching (searchsharding.go early exit at limit)."""
+        """Enqueue with bounded in-flight jobs, reap completions in ANY
+        order (one slow shard no longer stalls dispatch), hedge jobs
+        stuck past hedge_after_s, and cancel everything at the deadline
+        so late workers see job.cancelled and skip."""
+        cv = threading.Condition()
+        for j in jobs:
+            j.batch_cv = cv
+        deadline = time.monotonic() + timeout
         pending = list(jobs)
         inflight: list[_Job] = []
         while pending or inflight:
+            if early_exit is not None and early_exit():
+                for j in pending:
+                    j.cancelled = True
+                    j.done.set()
+                pending = []
             while pending and len(inflight) < self.concurrent_jobs:
-                if early_exit is not None and early_exit():
-                    for j in pending:
-                        j.done.set()
-                    pending = []
-                    break
                 j = pending.pop(0)
+                j.enqueued_at = time.monotonic()
                 self.queue.enqueue(tenant, j)
                 inflight.append(j)
-            if not inflight:
+            inflight = [j for j in inflight if not j.done.is_set()]
+            if not inflight and not pending:
                 break
-            j = inflight.pop(0)
-            if not j.done.wait(timeout):
-                j.error = TimeoutError("query job timed out")
-                j.done.set()
+            now = time.monotonic()
+            if now >= deadline:
+                for j in inflight + pending:
+                    j.error = TimeoutError("query job timed out")
+                    j.cancelled = True
+                    j.done.set()
+                break
+            if self.hedge_after_s > 0:
+                for j in inflight:
+                    if not j.hedged and now - j.enqueued_at > self.hedge_after_s:
+                        j.hedged = True  # re-enqueue; first completion wins
+                        try:
+                            self.queue.enqueue(tenant, j)
+                        except TooManyRequests:
+                            pass
+            with cv:
+                cv.wait(min(0.25, deadline - now))
 
     # ----------------------------------------------------------- trace by id
     def find_trace_by_id(self, tenant: str, trace_id: bytes,
                          time_start: int = 0, time_end: int = 0):
-        """The ingester leg + backend leg both run through the queue
-        (tracebyidsharding.go shards the block space; our backend leg
-        already fans out per block inside TempoDB.find)."""
-        jobs = [
-            _Job(self.querier.find_trace_by_id, (tenant, trace_id, time_start, time_end, True)),
-        ]
+        """ID-sharded lookup: one ingester-leg job plus the candidate
+        blocks partitioned into parallel backend jobs, partial traces
+        combined (tracebyidsharding.go:30-48 splits the ID space; here
+        the candidate block set IS the shardable space, since the device
+        engine answers a whole partition in one batched lookup)."""
+        db = self.querier.db
+        candidates = db.find_candidates(tenant, trace_id, time_start, time_end)
+        jobs = [_Job(
+            kind="find_recent",
+            payload={"trace_id": trace_id.hex()},
+            fn=self.querier.find_trace_by_id,
+            args=(tenant, trace_id, time_start, time_end, True, False),
+        )]
+        for i in range(0, len(candidates), FIND_SHARD_BLOCKS):
+            part = candidates[i : i + FIND_SHARD_BLOCKS]
+            jobs.append(_Job(
+                kind="find_blocks",
+                payload={"trace_id": trace_id.hex(),
+                         "block_ids": [m.block_id for m in part]},
+                fn=self.querier.find_in_blocks,
+                args=(tenant, trace_id, part),
+            ))
         self._run_jobs(tenant, jobs)
-        j = jobs[0]
-        if j.error:
-            raise j.error
-        return j.result
+        partials = []
+        for j in jobs:
+            if j.error is not None:
+                # a failed shard means the combined trace could silently
+                # miss spans: fail the request (reference behavior)
+                raise j.error
+            if j.result is not None:
+                partials.append(j.result)
+        if not partials:
+            return None
+        return sort_trace(combine_traces(partials)) if len(partials) > 1 else partials[0]
 
     # ---------------------------------------------------------------- search
     def search(self, tenant: str, req: SearchRequest) -> SearchResponse:
-        """Sharded search: ingester job + per-(block, row-group-chunk)
-        backend jobs, bounded concurrency, early exit at limit."""
+        """Sharded search: ingester job + block-batch jobs (+ row-group
+        shard jobs for oversized blocks), bounded concurrency, early
+        exit at limit."""
         limit = req.limit or 20
         resp = SearchResponse()
         lock = threading.Lock()
+        req_d = request_to_dict(req)
 
         metas = [
             m for m in self.querier.db.blocklist.metas(tenant)
             if m.overlaps_time(req.start, req.end)
         ]
-        jobs: list[_Job] = [_Job(self.querier.search_recent, (tenant, req))]
+        jobs: list[_Job] = [_Job(
+            kind="search_recent", payload={"req": req_d},
+            fn=self.querier.search_recent, args=(tenant, req),
+        )]
+        batch: list = []
+        batch_bytes = 0
+
+        def flush_batch():
+            nonlocal batch, batch_bytes
+            if batch:
+                part = batch
+                jobs.append(_Job(
+                    kind="search_blocks",
+                    payload={"req": req_d, "block_ids": [m.block_id for m in part]},
+                    fn=self.querier.search_blocks, args=(tenant, part, req),
+                ))
+                batch, batch_bytes = [], 0
+
         for m in metas:
-            for groups in self._group_chunks(m):
-                jobs.append(_Job(self.querier.search_block_shard, (tenant, m, req, groups)))
+            size = m.size_bytes or 0
+            if size > self.batch_bytes:
+                # a single oversized block: shard it by row-group range
+                for groups in self._group_chunks(m):
+                    jobs.append(_Job(
+                        kind="search_block_shard",
+                        payload={"req": req_d, "block_id": m.block_id, "groups": groups},
+                        fn=self.querier.search_block_shard, args=(tenant, m, req, groups),
+                    ))
+                continue
+            if batch_bytes + size > self.batch_bytes or len(batch) >= MAX_BLOCKS_PER_BATCH:
+                flush_batch()
+            batch.append(m)
+            batch_bytes += size
+        flush_batch()
 
         def early():
             with lock:
@@ -211,12 +419,12 @@ class Frontend:
         return resp
 
     def _group_chunks(self, meta) -> list[list[int]]:
-        """Split a block's row groups into jobs of ~target_bytes_per_job
-        (searchsharding.go:266-310 page-range jobs)."""
+        """Split an oversized block's row groups into jobs of
+        ~batch_bytes (searchsharding.go:266-310 page-range jobs)."""
         n_groups = max(1, len(meta.row_groups) or 1)
         size = meta.size_bytes or 0
         per_group = max(1, size // n_groups)
-        per_job = max(1, int(self.target_bytes_per_job // per_group))
+        per_job = max(1, int(self.batch_bytes // per_group))
         return [list(range(i, min(i + per_job, n_groups))) for i in range(0, n_groups, per_job)]
 
     def stop(self):

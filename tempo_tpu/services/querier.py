@@ -48,16 +48,21 @@ class Querier:
     # ----------------------------------------------------------- trace by id
     def find_trace_by_id(self, tenant: str, trace_id: bytes,
                          time_start: int = 0, time_end: int = 0,
-                         query_ingesters: bool = True) -> Trace | None:
+                         query_ingesters: bool = True,
+                         query_backend: bool = True) -> Trace | None:
+        """Both legs by default; the frontend's sharded pipeline sets
+        query_backend=False for the ingester-leg job (backend blocks go
+        through its own find_blocks shard jobs)."""
         futures = []
         if query_ingesters:
             for c in self._ingester_clients():
                 futures.append(self.pool.submit(c.find_trace_by_id, tenant, trace_id))
-        backend_fut = self.pool.submit(
-            self.db.find_trace_by_id, tenant, trace_id, time_start, time_end
-        )
+        if query_backend:
+            futures.append(self.pool.submit(
+                self.db.find_trace_by_id, tenant, trace_id, time_start, time_end
+            ))
         partials = []
-        for f in futures + [backend_fut]:
+        for f in futures:
             try:
                 t = f.result()
             except Exception:
@@ -86,6 +91,20 @@ class Querier:
         (the reference's SearchBlock page-shard job, querier.go:401-458)."""
         self.stats.searches += 1
         return self.db.search_block_shard(tenant, meta, req, groups)
+
+    def search_blocks(self, tenant: str, metas: list, req: SearchRequest) -> SearchResponse:
+        """One block-BATCH job: many whole blocks searched as one fused
+        device program (db/search.search_blocks_fused) -- the job shape
+        that amortizes a device sync across the batch, where the
+        reference dispatches one 10-MiB page-shard job per querier
+        round trip."""
+        self.stats.searches += 1
+        return self.db.search_blocks(tenant, metas, req)
+
+    def find_in_blocks(self, tenant: str, trace_id: bytes, metas: list):
+        """One frontend ID-shard job: lookup restricted to a partition
+        of the candidate blocks (tracebyidsharding.go analog)."""
+        return self.db.find_in_blocks(tenant, trace_id, metas)
 
     def search_tags(self, tenant: str, max_bytes: int = 0) -> list[str]:
         return self.db.search_tags(tenant, max_bytes)

@@ -1,0 +1,125 @@
+"""Querier worker: attaches a standalone querier process to remote
+query-frontends and pulls jobs.
+
+Reference: modules/querier/worker -- each querier dials every frontend
+and runs processor loops that recv a job, execute it locally, and send
+the result back (frontend_processor.go:57-80). Here the stream is HTTP
+long-poll against /internal/jobs/poll + /internal/jobs/result; the
+frontend's queue and lease bookkeeping live in services/frontend.py.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+from ..db.search import request_from_dict, response_to_dict
+from ..wire import otlp_json
+from .querier import Querier
+
+
+def execute_job(querier: Querier, tenant: str, kind: str, payload: dict) -> dict:
+    """Run one wire job against the local querier; returns the wire
+    result dict (the inverse of frontend.decode_job_result)."""
+    if kind == "search_recent":
+        req = request_from_dict(payload["req"])
+        return response_to_dict(querier.search_recent(tenant, req))
+    if kind == "search_blocks":
+        req = request_from_dict(payload["req"])
+        metas = querier.db.blocklist.metas_by_id(tenant, payload["block_ids"])
+        if len(metas) != len(payload["block_ids"]):
+            querier.db.poll_now()  # poll lag: refresh once before failing
+            metas = querier.db.blocklist.metas_by_id(tenant, payload["block_ids"])
+            if len(metas) != len(payload["block_ids"]):
+                raise OSError("blocklist lags the frontend: unknown block ids")
+        return response_to_dict(querier.search_blocks(tenant, metas, req))
+    if kind == "search_block_shard":
+        req = request_from_dict(payload["req"])
+        metas = querier.db.blocklist.metas_by_id(tenant, [payload["block_id"]])
+        if not metas:
+            querier.db.poll_now()
+            metas = querier.db.blocklist.metas_by_id(tenant, [payload["block_id"]])
+            if not metas:
+                raise OSError("blocklist lags the frontend: unknown block id")
+        return response_to_dict(
+            querier.search_block_shard(tenant, metas[0], req, payload["groups"])
+        )
+    if kind == "find_recent":
+        tr = querier.find_trace_by_id(
+            tenant, bytes.fromhex(payload["trace_id"]), query_backend=False
+        )
+        return {"trace": otlp_json.dumps(tr) if tr is not None else None}
+    if kind == "find_blocks":
+        metas = querier.db.blocklist.metas_by_id(tenant, payload["block_ids"])
+        tr = querier.find_in_blocks(tenant, bytes.fromhex(payload["trace_id"]), metas)
+        return {"trace": otlp_json.dumps(tr) if tr is not None else None}
+    raise ValueError(f"unknown job kind {kind!r}")
+
+
+class QuerierWorker:
+    """Long-poll worker loops against one or more frontend addresses."""
+
+    def __init__(self, querier: Querier, frontend_addrs: list[str],
+                 token: str = "", concurrency: int = 4, poll_wait_s: float = 5.0):
+        self.querier = querier
+        self.addrs = [a.rstrip("/") for a in frontend_addrs]
+        self.token = token
+        self.poll_wait_s = poll_wait_s
+        self._stop = threading.Event()
+        self._threads = [
+            threading.Thread(target=self._loop, args=(addr,), daemon=True,
+                             name=f"querier-worker-{addr}-{i}")
+            for addr in self.addrs
+            for i in range(concurrency)
+        ]
+        self.jobs_executed = 0
+        self.jobs_failed = 0
+
+    def start(self) -> None:
+        for t in self._threads:
+            t.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _post(self, addr: str, path: str, payload: dict, timeout: float) -> dict | None:
+        headers = {"Content-Type": "application/json"}
+        if self.token:
+            headers["X-Tempo-Internal-Token"] = self.token
+        req = urllib.request.Request(
+            addr + path, data=json.dumps(payload).encode(), headers=headers
+        )
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            body = r.read()
+            return json.loads(body) if body else None
+
+    def _loop(self, addr: str) -> None:
+        while not self._stop.is_set():
+            try:
+                job = self._post(addr, "/internal/jobs/poll",
+                                 {"wait_s": self.poll_wait_s},
+                                 timeout=self.poll_wait_s + 10.0)
+            except (urllib.error.URLError, ConnectionError, OSError):
+                self._stop.wait(1.0)  # frontend down: back off, retry
+                continue
+            if not job or not job.get("id"):
+                continue
+            out = {"id": job["id"]}
+            try:
+                result = execute_job(
+                    self.querier, job.get("tenant", ""), job["kind"], job["payload"]
+                )
+                out.update(ok=True, result=result)
+                self.jobs_executed += 1
+            except Exception as e:  # noqa: BLE001 - report, let frontend retry
+                from .frontend import _retryable
+
+                out.update(ok=False, error=f"{type(e).__name__}: {e}",
+                           retryable=_retryable(e))
+                self.jobs_failed += 1
+            try:
+                self._post(addr, "/internal/jobs/result", out, timeout=10.0)
+            except (urllib.error.URLError, ConnectionError, OSError):
+                continue  # lease expiry re-dispatches the job

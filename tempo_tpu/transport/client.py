@@ -18,7 +18,7 @@ import json
 import urllib.error
 import urllib.request
 
-from ..db.search import SearchRequest, SearchResponse, SearchResult
+from ..db.search import SearchRequest, SearchResponse
 from ..wire import otlp_json
 from ..wire.model import Trace
 
@@ -79,35 +79,12 @@ class HTTPIngesterClient:
         return otlp_json.loads(out["trace"])
 
     def search(self, tenant: str, req: SearchRequest) -> SearchResponse:
+        from ..db.search import request_to_dict, response_from_dict
+
         out = self._post(
-            "/internal/search",
-            {
-                "tenant": tenant,
-                "req": {
-                    "tags": req.tags,
-                    "query": req.query,
-                    "min_duration_ms": req.min_duration_ms,
-                    "max_duration_ms": req.max_duration_ms,
-                    "start": req.start,
-                    "end": req.end,
-                    "limit": req.limit,
-                },
-            },
+            "/internal/search", {"tenant": tenant, "req": request_to_dict(req)}
         )
-        resp = SearchResponse()
-        resp.inspected_bytes = out.get("inspectedBytes", 0)
-        resp.inspected_spans = out.get("inspectedSpans", 0)
-        for t in out.get("traces", []):
-            resp.traces.append(
-                SearchResult(
-                    trace_id=t["traceID"],
-                    root_service_name=t.get("rootServiceName", ""),
-                    root_trace_name=t.get("rootTraceName", ""),
-                    start_time_unix_nano=int(t.get("startTimeUnixNano", "0")),
-                    duration_ms=t.get("durationMs", 0),
-                )
-            )
-        return resp
+        return response_from_dict(out)
 
 
 def client_registry(local: dict, token: str = ""):
@@ -131,8 +108,23 @@ def client_registry(local: dict, token: str = ""):
 
 
 def handle_internal(app, path: str, payload: dict):
-    """Dispatch one internal-API request against this process's ingester.
+    """Dispatch one internal-API request against this process's modules.
     Returns (status, dict)."""
+    if path == "/internal/jobs/poll":
+        # remote querier pull (services/worker.py) against this frontend
+        if app.frontend is None:
+            return 404, {"error": f"target {app.cfg.target} hosts no frontend"}
+        job = app.frontend.poll_job(wait_s=float(payload.get("wait_s", 5.0)))
+        return 200, (job or {})
+    if path == "/internal/jobs/result":
+        if app.frontend is None:
+            return 404, {"error": f"target {app.cfg.target} hosts no frontend"}
+        app.frontend.complete_job(
+            payload.get("id", ""), bool(payload.get("ok")),
+            result=payload.get("result"), error=payload.get("error", ""),
+            retryable=bool(payload.get("retryable")),
+        )
+        return 200, {}
     if app.ingester is None:
         return 404, {"error": f"target {app.cfg.target} hosts no ingester"}
     tenant = payload.get("tenant", "")
@@ -147,20 +139,8 @@ def handle_internal(app, path: str, payload: dict):
         tr = app.ingester.find_trace_by_id(tenant, bytes.fromhex(payload["trace_id"]))
         return 200, {"trace": otlp_json.dumps(tr) if tr is not None else None}
     if path == "/internal/search":
-        r = payload.get("req", {})
-        req = SearchRequest(
-            tags=r.get("tags", {}),
-            query=r.get("query", ""),
-            min_duration_ms=r.get("min_duration_ms", 0),
-            max_duration_ms=r.get("max_duration_ms", 0),
-            start=r.get("start", 0),
-            end=r.get("end", 0),
-            limit=r.get("limit", 20),
-        )
-        resp = app.ingester.search(tenant, req)
-        return 200, {
-            "traces": [t.to_dict() for t in resp.traces],
-            "inspectedBytes": resp.inspected_bytes,
-            "inspectedSpans": resp.inspected_spans,
-        }
+        from ..db.search import request_from_dict, response_to_dict
+
+        resp = app.ingester.search(tenant, request_from_dict(payload.get("req", {})))
+        return 200, response_to_dict(resp)
     return 404, {"error": f"no internal route {path}"}

@@ -128,6 +128,98 @@ def test_microservices_topology(tmp_path):
 
 
 @pytest.mark.slow
+def test_frontend_remote_querier_pull(tmp_path):
+    """1 dispatcher-only query-frontend + 2 standalone queriers pulling
+    jobs over /internal/jobs: both queriers demonstrably execute search
+    jobs (the reference's querier-worker attach model,
+    modules/querier/worker/frontend_processor.go:57-80 +
+    modules/frontend/v1/frontend.go:50-90)."""
+    storage = str(tmp_path / "storage")
+    kv = str(tmp_path / "kv")
+    ports = {r: _free_port() for r in ("ing", "fe", "q1", "q2")}
+    procs = []
+    try:
+        procs.append(_spawn("ingester", ports["ing"], storage, kv,
+                            ("--instance.id", "ing-a")))
+        _wait_ready(ports["ing"])
+
+        # push + flush so the backend holds blocks to search
+        from tempo_tpu.transport.client import HTTPIngesterClient
+        from tempo_tpu.wire.segment import segment_for_write
+
+        traces = make_traces(30, seed=21, n_spans=4)
+        client = HTTPIngesterClient(f"http://127.0.0.1:{ports['ing']}")
+        for i in range(0, 30, 10):  # three flushes -> three blocks
+            batch = []
+            for tid, tr in traces[i : i + 10]:
+                lo, hi = tr.time_range_nanos()
+                batch.append((tid, lo // 10**9, hi // 10**9 + 1,
+                              segment_for_write(tr, lo // 10**9, hi // 10**9 + 1)))
+            client.push_segments("single-tenant", batch)
+            urllib.request.urlopen(
+                urllib.request.Request(f"http://127.0.0.1:{ports['ing']}/flush", data=b""),
+                timeout=20,
+            )
+
+        fe_addr = f"http://127.0.0.1:{ports['fe']}"
+        procs.append(_spawn("query-frontend", ports["fe"], storage, kv))
+        for q in ("q1", "q2"):
+            procs.append(_spawn("querier", ports[q], storage, kv,
+                                ("--querier.frontend-address", fe_addr)))
+        _wait_ready(ports["fe"])
+        _wait_ready(ports["q1"])
+        _wait_ready(ports["q2"])
+
+        # several searches + finds through the frontend: every job must
+        # be executed by a REMOTE querier (the frontend has no workers)
+        deadline = time.time() + 60
+        hits = set()
+        while time.time() < deadline and len(hits) < 30:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{ports['fe']}/api/search?limit=100", timeout=30
+            ) as r:
+                hits = {t["traceID"] for t in json.loads(r.read())["traces"]}
+            time.sleep(0.5)
+        assert {tid.hex() for tid, _ in traces} <= hits
+
+        tid, tr = traces[7]
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{ports['fe']}/api/traces/{tid.hex()}", timeout=30
+        ) as r:
+            got = otlp_json.loads(r.read())
+        assert got.span_count() == tr.span_count()
+
+        # enough jobs that BOTH queriers must have pulled some
+        for i in range(10):
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{ports['fe']}/api/search?limit=100", timeout=30)
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{ports['fe']}/api/traces/{traces[i][0].hex()}",
+                timeout=30)
+
+        def metric(port, name):
+            with urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics", timeout=10) as r:
+                for line in r.read().decode().splitlines():
+                    if line.startswith(name + " "):
+                        return int(line.split()[1])
+            return 0
+
+        ex1 = metric(ports["q1"], "tempo_querier_worker_jobs_executed_total")
+        ex2 = metric(ports["q2"], "tempo_querier_worker_jobs_executed_total")
+        assert ex1 > 0 and ex2 > 0, (ex1, ex2)
+        assert metric(ports["fe"], "tempo_frontend_jobs_remote_total") > 0
+        assert metric(ports["fe"], "tempo_frontend_jobs_local_total") == 0
+    finally:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+@pytest.mark.slow
 def test_ingester_crash_restart_replays(tmp_path):
     """Kill an ingester before flush; its restart replays the WAL and the
     data stays queryable (the reference's ScalableSingleBinary restart
