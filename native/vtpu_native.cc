@@ -191,6 +191,95 @@ int vtpu_zstd_decompress_batch(const uint8_t* src, const int64_t* in_offsets,
   return failed.load();
 }
 
+// ---------------------------------------------------------- run gather
+
+// Copy n_runs row ranges from src to dst: run i moves lens[i] rows from
+// src row src_offs[i] to dst row dst_offs[i]; rows are itemsize bytes.
+// The compaction merge's unit of data movement (columnar_compact
+// _assemble): one memcpy per run instead of a per-ELEMENT numpy fancy
+// index, so the index arrays (8 bytes/row/column) never exist and the
+// traffic is just src+dst.
+void vtpu_gather_runs(const uint8_t* src, uint8_t* dst,
+                      const int64_t* src_offs, const int64_t* dst_offs,
+                      const int64_t* lens, int64_t n_runs, int64_t itemsize) {
+  for (int64_t i = 0; i < n_runs; i++) {
+    memcpy(dst + dst_offs[i] * itemsize, src + src_offs[i] * itemsize,
+           (size_t)(lens[i] * itemsize));
+  }
+}
+
+// Same, but each run reads from an absolute source ADDRESS: callers
+// with K source arrays order runs by destination (dst writes stream
+// sequentially, each source reads stream too) and pass per-run
+// src pointers computed host-side. dst_offs/lens in rows.
+void vtpu_gather_runs_addr(const int64_t* src_addrs, uint8_t* dst,
+                           const int64_t* dst_offs, const int64_t* lens,
+                           int64_t n_runs, int64_t itemsize) {
+  // runs are typically a handful of rows (one trace's spans; ONE row on
+  // the trace axis) -- glibc memcpy's dispatch overhead dominates at
+  // that size, so 4/8-byte rows take a plain word loop instead
+  if (itemsize == 4) {
+    uint32_t* d32 = (uint32_t*)dst;
+    for (int64_t i = 0; i < n_runs; i++) {
+      const uint32_t* s = (const uint32_t*)(uintptr_t)src_addrs[i];
+      uint32_t* d = d32 + dst_offs[i];
+      int64_t n = lens[i];
+      for (int64_t j = 0; j < n; j++) d[j] = s[j];
+    }
+    return;
+  }
+  if (itemsize == 8) {
+    uint64_t* d64 = (uint64_t*)dst;
+    for (int64_t i = 0; i < n_runs; i++) {
+      const uint64_t* s = (const uint64_t*)(uintptr_t)src_addrs[i];
+      uint64_t* d = d64 + dst_offs[i];
+      int64_t n = lens[i];
+      for (int64_t j = 0; j < n; j++) d[j] = s[j];
+    }
+    return;
+  }
+  for (int64_t i = 0; i < n_runs; i++) {
+    memcpy(dst + dst_offs[i] * itemsize, (const void*)(uintptr_t)src_addrs[i],
+           (size_t)(lens[i] * itemsize));
+  }
+}
+
+// Gather runs of an int32 code column while remapping codes through a
+// lookup table (negative codes = "absent" sentinels pass through):
+// compaction's dictionary re-encode fused into the merge copy, so the
+// remap costs no extra memory pass. remap_addrs[i]/remap_lens[i] give
+// run i's source remap table. Returns the count of out-of-range codes
+// (corrupt input); non-zero means the caller must redo via its checked
+// fallback -- the kernel writes such codes through unchanged rather
+// than reading past the table.
+int64_t vtpu_gather_runs_remap(const int64_t* src_addrs, int32_t* dst,
+                               const int64_t* dst_offs, const int64_t* lens,
+                               const int64_t* remap_addrs,
+                               const int64_t* remap_lens, int64_t n_runs) {
+  int64_t oob = 0;
+  for (int64_t i = 0; i < n_runs; i++) {
+    const int32_t* s = (const int32_t*)(uintptr_t)src_addrs[i];
+    const int32_t* remap = (const int32_t*)(uintptr_t)remap_addrs[i];
+    const int64_t rlen = remap_lens[i];
+    int32_t* d = dst + dst_offs[i];
+    int64_t n = lens[i];
+    for (int64_t j = 0; j < n; j++) {
+      int32_t v = s[j];
+      if (v >= 0) {
+        if (v < rlen) {
+          d[j] = remap[v];
+        } else {
+          d[j] = v;
+          oob++;
+        }
+      } else {
+        d[j] = v;
+      }
+    }
+  }
+  return oob;
+}
+
 // ------------------------------------------------------- dictionary union
 
 // K-way merge of K SORTED string tables (compaction's dictionary union,

@@ -451,13 +451,13 @@ def compute_row_groups(cols, start_ms, dur_us, row_group_spans):
     return axes, col_axis, row_groups
 
 
-def write_block(backend: RawBackend, fin: FinalizedBlock) -> BlockMeta:
+def write_block(backend: RawBackend, fin: FinalizedBlock, level: int = 3) -> BlockMeta:
     """Write all block objects; meta.json last so pollers never see a
     partial block (reference writes meta last for the same reason)."""
     m = fin.meta
     app = backend.open_append(m.tenant_id, m.block_id, DATA_NAME)
     try:
-        for part in pack_columns_stream(fin.cols, fin.axes, fin.col_axis):
+        for part in pack_columns_stream(fin.cols, fin.axes, fin.col_axis, level=level):
             app.append(part)
         app.close()
     except BaseException:

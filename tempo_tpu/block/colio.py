@@ -66,43 +66,51 @@ def pack_columns_stream(
     footer: dict = {"cols": {}, "axes": {k: v.offsets for k, v in axes.items()}}
     offset = 0
 
+    from ..native import zstd_compress_from
+
     for name, arr in cols.items():
         arr = np.ascontiguousarray(arr)
         axis = col_axis.get(name)
-        raws: list[bytes] = []
+        row_bytes = arr.dtype.itemsize * int(np.prod(arr.shape[1:], dtype=np.int64))
         if axis is not None:
             ax = axes[axis]
             if ax.n_rows != arr.shape[0]:
                 raise ValueError(
                     f"column {name}: {arr.shape[0]} rows != axis {axis} ({ax.n_rows})"
                 )
-            for g in range(ax.n_groups):
-                lo, hi = ax.offsets[g], ax.offsets[g + 1]
-                raws.append(arr[lo:hi].tobytes())
+            bounds = [(ax.offsets[g] * row_bytes, ax.offsets[g + 1] * row_bytes)
+                      for g in range(ax.n_groups)]
         else:
-            raws.append(arr.tobytes())
+            bounds = [(0, arr.shape[0] * row_bytes)]
+        buf = arr.reshape(-1).view(np.uint8) if arr.size else np.empty(0, np.uint8)
 
         # compress this column's compressible chunks in one threaded
-        # native batch (native/vtpu_native.cc); python zstd as fallback
-        to_compress = [i for i, r in enumerate(raws) if len(r) >= _MIN_COMPRESS]
+        # native batch STRAIGHT FROM the array's memory (no per-chunk
+        # source copies); python zstd as fallback
+        to_compress = [i for i, (lo, hi) in enumerate(bounds) if hi - lo >= _MIN_COMPRESS]
         compressed: dict[int, bytes] = {}
         if to_compress:
-            from ..native import zstd_compress_chunks
-
-            outs = zstd_compress_chunks([raws[i] for i in to_compress], level)
+            outs = zstd_compress_from(
+                buf,
+                np.asarray([bounds[i][0] for i in to_compress], np.int64),
+                np.asarray([bounds[i][1] - bounds[i][0] for i in to_compress], np.int64),
+                level,
+            )
             if outs is None:
                 comp = zstandard.ZstdCompressor(level=level)
-                outs = [comp.compress(raws[i]) for i in to_compress]
+                outs = [comp.compress(buf[bounds[i][0] : bounds[i][1]].tobytes())
+                        for i in to_compress]
             compressed = dict(zip(to_compress, outs))
 
         recs: list[list] = []
-        for i, raw in enumerate(raws):
+        for i, (lo, hi) in enumerate(bounds):
+            raw_len = hi - lo
             z = compressed.get(i)
-            if z is not None and len(z) < len(raw):
+            if z is not None and len(z) < raw_len:
                 data, codec = z, CODEC_ZSTD
             else:
-                data, codec = raw, CODEC_RAW
-            recs.append([offset, len(data), len(raw), codec])
+                data, codec = buf[lo:hi].tobytes(), CODEC_RAW
+            recs.append([offset, len(data), raw_len, codec])
             offset += len(data)
             yield data
         footer["cols"][name] = {
@@ -288,6 +296,54 @@ class ColumnPack:
                 self._cache_put(r[0], raw)
 
     def read_all(self) -> dict[str, np.ndarray]:
-        # one threaded decompress batch for every chunk of every column
-        self.warm([(n, None) for n in self._cols])
-        return {n: self.read(n) for n in self._cols}
+        """Every column, zero-copy: ONE destination buffer laid out
+        column-after-column, every zstd chunk decompressed straight into
+        its final position (native batch), raw chunks memcpy'd, then each
+        column is a frombuffer VIEW of the buffer. The bulk-read path
+        compaction uses -- no chunk cache round trips, no joins."""
+        from ..native import available, zstd_decompress_into
+
+        if not available():
+            self.warm([(n, None) for n in self._cols])
+            return {n: self.read(n) for n in self._cols}
+
+        col_base: dict[str, int] = {}
+        z_chunks: list[bytes] = []
+        z_offs: list[int] = []
+        z_lens: list[int] = []
+        raw_parts: list[tuple[int, bytes]] = []
+        bytes_read0 = self.bytes_read
+        pos = 0
+        for name, meta in self._cols.items():
+            pos = (pos + 15) & ~15  # keep every column view 16B-aligned
+            col_base[name] = pos
+            for off, stored, raw_len, codec in meta["chunks"]:
+                if raw_len == 0:
+                    continue
+                data = self._read_range(off, stored)
+                self.bytes_read += stored
+                if codec == CODEC_ZSTD:
+                    z_chunks.append(data)
+                    z_offs.append(pos)
+                    z_lens.append(raw_len)
+                else:
+                    raw_parts.append((pos, data))
+                pos += raw_len
+        dst = np.empty(pos, dtype=np.uint8)
+        if z_chunks and not zstd_decompress_into(
+            z_chunks, dst, np.asarray(z_offs), np.asarray(z_lens)
+        ):
+            # native refused mid-flight: fall back wholesale (and undo
+            # this attempt's IO accounting -- the fallback re-counts)
+            self.bytes_read = bytes_read0
+            self.warm([(n, None) for n in self._cols])
+            return {n: self.read(n) for n in self._cols}
+        for p, data in raw_parts:
+            dst[p : p + len(data)] = np.frombuffer(data, dtype=np.uint8)
+        out: dict[str, np.ndarray] = {}
+        for name, meta in self._cols.items():
+            dt = np.dtype(meta["dtype"])
+            n_bytes = int(np.prod(meta["shape"], dtype=np.int64)) * dt.itemsize
+            base = col_base[name]
+            out[name] = dst[base : base + n_bytes].view(dt).reshape(meta["shape"])
+        return out

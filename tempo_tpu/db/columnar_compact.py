@@ -62,6 +62,9 @@ _DICT_COLS = frozenset(
 )
 
 
+_AXIS_PREFIXES = frozenset({"span", "trace", "sattr", "ev", "ln", "evattr", "lnattr"})
+
+
 class _Source:
     """One input block (or one combined collision trace) as raw columns."""
 
@@ -69,14 +72,24 @@ class _Source:
         self.cols = cols
         self.dictionary = dictionary
         self.span_off = cols["trace.span_off"]
+        self.remap: np.ndarray | None = None
+        self.fused_remap = False
 
     @classmethod
     def from_block(cls, blk: BackendBlock) -> "_Source":
         return cls(blk.pack.read_all(), blk.dictionary)
 
-    def remap_codes(self, remap: np.ndarray) -> None:
+    def remap_codes(self, remap: np.ndarray, fused: bool = False) -> None:
+        """Re-encode dict-code columns into the merged dictionary. With
+        fused=True (native available), axis columns skip the pre-pass:
+        _assemble's copy kernel applies the remap in-flight, saving a
+        full read+write pass over every code column."""
+        self.remap = np.ascontiguousarray(remap, dtype=np.int32)
+        self.fused_remap = fused
         for name in self.cols:
-            if name in _DICT_COLS:
+            if name in _DICT_COLS and not (
+                fused and name.split(".", 1)[0] in _AXIS_PREFIXES
+            ):
                 self.cols[name] = apply_remap(self.cols[name], remap)
 
     def child_range(self, owner_col: str, lo: int, hi: int) -> tuple[int, int]:
@@ -127,6 +140,27 @@ def _ranges_to_idx(los: np.ndarray, his: np.ndarray) -> np.ndarray:
         return np.empty(0, dtype=np.int64)
     starts = np.cumsum(lens) - lens
     return np.repeat(los - starts, lens) + np.arange(total, dtype=np.int64)
+
+
+def _run_copy(src: np.ndarray, dst: np.ndarray, src_offs: np.ndarray,
+              dst_offs: np.ndarray, lens: np.ndarray) -> None:
+    """Move row runs src->dst: native per-run memcpy (no index arrays
+    exist at all -- the index traffic, 8 bytes/row/column, used to cost
+    more than the data), numpy fancy-index fallback (also taken on
+    dtype mismatch, where memcpy would land rows at wrong offsets)."""
+    from ..native import gather_runs
+
+    if (src.size and src.dtype == dst.dtype
+            and gather_runs(np.ascontiguousarray(src), dst, src_offs, dst_offs, lens)):
+        return
+    si = _ranges_to_idx(src_offs, src_offs + lens)
+    di = _ranges_to_idx(dst_offs, dst_offs + lens)
+    dst[di] = src[si]
+
+
+def _packed_offs(lens: np.ndarray) -> np.ndarray:
+    cs = np.cumsum(lens)
+    return cs - lens
 
 
 def _assemble(tenant: str, sources: list[_Source], chunks: list[tuple[int, int, int]],
@@ -184,26 +218,66 @@ def _assemble(tenant: str, sources: list[_Source], chunks: list[tuple[int, int, 
     for a in child_axes:
         ax_b[a], ax_n[a] = bases(ax_hi[a] - ax_lo[a])
 
-    # per (source, axis) gather/scatter indexes
-    gather: dict[tuple[int, str], tuple[np.ndarray, np.ndarray]] = {}
+    # per (source, axis) RUN tables: (src row starts, dst row starts,
+    # lens). Data moves by per-run memcpy (_run_copy); element-level
+    # index arrays never exist except inside special-column temps.
+    runs_of: dict[tuple[int, str], tuple[np.ndarray, np.ndarray, np.ndarray]] = {}
     axis_ranges = {"trace": (clo, chi, tr_b), "span": (span_lo, span_hi, sp_b)}
     for a in child_axes:
         axis_ranges[a] = (ax_lo[a], ax_hi[a], ax_b[a])
     for si in src_order:
         ii = by_src[si]
         for a, (alo, ahi, ab) in axis_ranges.items():
-            src_idx = _ranges_to_idx(alo[ii], ahi[ii])
-            dst_idx = _ranges_to_idx(ab[ii], ab[ii] + (ahi[ii] - alo[ii]))
-            gather[(si, a)] = (src_idx, dst_idx)
+            runs_of[(si, a)] = (alo[ii], ab[ii], ahi[ii] - alo[ii])
 
-    # owner-column rebase offsets: dest parent base - src parent lo, per row
-    owner_off: dict[tuple[int, str], np.ndarray] = {}
+    def dst_ordered_copy(axis: str, col: str, out: np.ndarray,
+                         remap: bool = False) -> bool:
+        """ONE copy pass per column in global dst order: dst writes
+        stream sequentially and each source's reads stream too (the
+        merge's memory-optimal order); per-run absolute src addresses
+        carry the source interleave. remap=True fuses the dictionary
+        re-encode into the same pass (per-run remap-table addresses)."""
+        from ..native import gather_runs_addr, gather_runs_remap
+
+        alo, ahi, ab = axis_ranges[axis]
+        arrs = [np.ascontiguousarray(sources[si].cols[col]) for si in src_order]
+        row_bytes = out.dtype.itemsize * int(np.prod(out.shape[1:], dtype=np.int64))
+        base = np.zeros(len(sources), dtype=np.int64)
+        for si, arr in zip(src_order, arrs):
+            base[si] = arr.ctypes.data
+        addrs = base[csrc] + alo * row_bytes
+        if remap:
+            rbase = np.zeros(len(sources), dtype=np.int64)
+            rlen = np.zeros(len(sources), dtype=np.int64)
+            for si in src_order:
+                rbase[si] = sources[si].remap.ctypes.data
+                rlen[si] = sources[si].remap.shape[0]
+            return gather_runs_remap(addrs, out, ab, ahi - alo,
+                                     rbase[csrc], rlen[csrc])
+        return gather_runs_addr(addrs, out, ab, ahi - alo)
+
+    def packed_gather(si: int, axis: str, src: np.ndarray) -> np.ndarray:
+        """Gather source rows of one axis into PACKED dst order (the
+        concatenation of this source's dst runs): the staging buffer for
+        columns needing element-level math before placement."""
+        s_offs, _, lens = runs_of[(si, axis)]
+        out = np.empty((int(lens.sum()),) + src.shape[1:], dtype=src.dtype)
+        _run_copy(src, out, s_offs, _packed_offs(lens), lens)
+        return out
+
+    def packed_scatter(si: int, axis: str, packed: np.ndarray, out: np.ndarray) -> None:
+        _, d_offs, lens = runs_of[(si, axis)]
+        _run_copy(packed, out, _packed_offs(lens), d_offs, lens)
+
+    # owner-column rebase offsets per PACKED row: dst parent base - src
+    # parent lo, repeated per run
     parent_of = {"sattr": (sp_b, span_lo), "ev": (sp_b, span_lo), "ln": (sp_b, span_lo),
                  "evattr": (ax_b["ev"], ax_lo["ev"]), "lnattr": (ax_b["ln"], ax_lo["ln"])}
-    for si in src_order:
+
+    def owner_off_packed(si: int, a: str) -> np.ndarray:
         ii = by_src[si]
-        for a, (pb, plo) in parent_of.items():
-            owner_off[(si, a)] = np.repeat(pb[ii] - plo[ii], (ax_hi[a] - ax_lo[a])[ii])
+        pb, plo = parent_of[a]
+        return np.repeat(pb[ii] - plo[ii], (ax_hi[a] - ax_lo[a])[ii])
 
     # res/scope subsetting: only rows this block's spans reference
     span_resvals: dict[int, np.ndarray] = {}
@@ -214,9 +288,8 @@ def _assemble(tenant: str, sources: list[_Source], chunks: list[tuple[int, int, 
     scope_base: dict[int, int] = {}
     rb = sb = 0
     for si in src_order:
-        src_idx, _ = gather[(si, "span")]
-        rv = sources[si].cols["span.res_idx"][src_idx]
-        sv = sources[si].cols["span.scope_idx"][src_idx]
+        rv = packed_gather(si, "span", sources[si].cols["span.res_idx"])
+        sv = packed_gather(si, "span", sources[si].cols["span.scope_idx"])
         span_resvals[si], span_scopevals[si] = rv, sv
         ur = np.unique(rv)
         us = np.unique(sv)
@@ -232,6 +305,9 @@ def _assemble(tenant: str, sources: list[_Source], chunks: list[tuple[int, int, 
         return np.where(old >= 0, new, old).astype(np.int32)
 
     axis_rows = {"trace": n_traces, "span": n_spans, **ax_n}
+    _OWNER_COLS = frozenset(
+        {"sattr.span", "ev.span", "ln.span", "evattr.ev", "lnattr.ln"}
+    )
 
     cols: dict[str, np.ndarray] = {}
     for n in names:
@@ -243,15 +319,28 @@ def _assemble(tenant: str, sources: list[_Source], chunks: list[tuple[int, int, 
         if pref in axis_rows:
             out = np.empty((axis_rows[pref],) + like.shape[1:], dtype=like.dtype)
             for si in src_order:
-                src_idx, dst_idx = gather[(si, pref)]
-                vals = sources[si].cols[n][src_idx]
                 if n == "span.res_idx":
-                    vals = _translate(si, span_resvals[si], used_res, res_base)
+                    packed_scatter(si, pref, _translate(
+                        si, span_resvals[si], used_res, res_base), out)
                 elif n == "span.scope_idx":
-                    vals = _translate(si, span_scopevals[si], used_scope, scope_base)
-                elif n in ("sattr.span", "ev.span", "ln.span", "evattr.ev", "lnattr.ln"):
-                    vals = (vals + owner_off[(si, pref)]).astype(like.dtype)
-                out[dst_idx] = vals
+                    packed_scatter(si, pref, _translate(
+                        si, span_scopevals[si], used_scope, scope_base), out)
+                elif n in _OWNER_COLS:
+                    packed = packed_gather(si, pref, sources[si].cols[n])
+                    packed = (packed + owner_off_packed(si, pref)).astype(like.dtype)
+                    packed_scatter(si, pref, packed, out)
+                else:
+                    fuse = n in _DICT_COLS and sources[si].fused_remap
+                    if si == src_order[0] and dst_ordered_copy(pref, n, out, remap=fuse):
+                        break  # one dst-ordered pass covered every source
+                    src_col = sources[si].cols[n]
+                    if fuse:
+                        # kernel declined (odd dtype / stale lib): remap
+                        # into a LOCAL copy -- mutating the source would
+                        # double-remap it in later output blocks
+                        src_col = apply_remap(src_col, sources[si].remap)
+                    s_offs, d_offs, lens = runs_of[(si, pref)]
+                    _run_copy(src_col, out, s_offs, d_offs, lens)
             cols[n] = out
         elif pref in ("res", "scope"):
             used = used_res if pref == "res" else used_scope
@@ -273,9 +362,9 @@ def _assemble(tenant: str, sources: list[_Source], chunks: list[tuple[int, int, 
     # recomputed columns
     span_counts = np.empty(n_traces, dtype=np.int64)
     for si in src_order:
-        src_idx, dst_idx = gather[(si, "trace")]
-        so = sources[si].span_off.astype(np.int64)
-        span_counts[dst_idx] = so[src_idx + 1] - so[src_idx]
+        so_diff = np.diff(sources[si].span_off.astype(np.int64))
+        s_offs, d_offs, lens = runs_of[(si, "trace")]
+        _run_copy(so_diff, span_counts, s_offs, d_offs, lens)
     cols["trace.span_off"] = np.concatenate(
         [[0], np.cumsum(span_counts)]
     ).astype(np.int32)
@@ -309,7 +398,7 @@ def _assemble(tenant: str, sources: list[_Source], chunks: list[tuple[int, int, 
 
     if bloom is None:
         bloom = ShardedBloom.for_estimated_items(max(n_traces, 1))
-        bloom.add_many([ids[i].tobytes() for i in range(n_traces)])
+        bloom.add_array(ids[:n_traces])
     m.bloom_shards = bloom.n_shards
     m.bloom_shard_bits = bloom.shard_bits
     return FinalizedBlock(m, cols, axes, col_axis, merged, bloom)
@@ -357,13 +446,16 @@ def compact_columnar(backend: RawBackend, job: CompactionJob, cfg: CompactorConf
         return CompactionResult(compacted_ids=[m.block_id for m in job.blocks])
 
     # merged dictionary via native K-way byte-level merge (no string
-    # decode anywhere) + one remap gather per source
+    # decode anywhere) + one remap gather per source (axis columns
+    # defer their remap into _assemble's fused copy kernel)
+    from ..native import available as native_available
     from ..native import dict_union
 
     blob, offs, remaps = dict_union([s.dictionary.raw() for s in sources])
     merged = Dictionary.from_raw(blob, offs)
+    fused = native_available()
     for s, remap in zip(sources, remaps):
-        s.remap_codes(remap)
+        s.remap_codes(remap, fused=fused)
 
     # size-target output cuts, estimated from input bytes/trace
     total_in = sum(m.size_bytes for m in job.blocks)
@@ -391,7 +483,7 @@ def compact_columnar(backend: RawBackend, job: CompactionJob, cfg: CompactorConf
     for cl in chunk_lists:
         bloom = _union_input_blooms(blocks) if single_out else None
         fin = _assemble(tenant, sources, cl, merged, out_level, cfg.row_group_spans, bloom)
-        meta = write_block(backend, fin)
+        meta = write_block(backend, fin, level=cfg.zstd_level)
         result.new_blocks.append(meta)
         result.traces_out += fin.meta.total_traces
         result.spans_out += fin.meta.total_spans

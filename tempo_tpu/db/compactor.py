@@ -61,6 +61,11 @@ class CompactorConfig:
     row_group_spans: int = 1 << 16
     columnar: bool = True  # numpy-level merge fast path (columnar_compact.py)
     target_block_bytes: int = 0  # output size cut; 0 -> max_block_bytes
+    # output zstd level: compaction rewrites every byte, so the fast
+    # level keeps the compactor ahead of ingest (the reference trades
+    # the same way: snappy on the write-heavy v2 path); ingest-time
+    # block builds keep level 3
+    zstd_level: int = 1
 
 
 def select_jobs(tenant: str, metas: list[BlockMeta], cfg: CompactorConfig, now: float | None = None) -> list[CompactionJob]:
@@ -198,7 +203,7 @@ def _compact_wire(backend: RawBackend, job: CompactionJob, cfg: CompactorConfig)
 
     fin = builder.finalize(bloom=_union_input_blooms(blocks))
     result.spans_out = fin.meta.total_spans
-    meta = write_block(backend, fin)
+    meta = write_block(backend, fin, level=cfg.zstd_level)
     result.new_blocks = [meta]
     result.compacted_ids = [m.block_id for m in job.blocks]
     for m in job.blocks:

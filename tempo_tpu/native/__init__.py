@@ -84,6 +84,19 @@ def _bind(lib):
         ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
     ]
     lib.vtpu_dict_union.restype = ctypes.c_int64
+    lib.vtpu_gather_runs.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+    ]
+    lib.vtpu_gather_runs_addr.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+        ctypes.c_int64, ctypes.c_int64,
+    ]
+    lib.vtpu_gather_runs_remap.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+    ]
+    lib.vtpu_gather_runs_remap.restype = ctypes.c_int64
     return lib
 
 
@@ -125,6 +138,18 @@ def bloom_add_batch(bloom, trace_ids: list[bytes], k: int) -> bool:
     return True
 
 
+def bloom_add_ids_array(bloom, ids: np.ndarray, k: int) -> bool:
+    """Insert a C-contiguous (n, 16) uint8 id array directly."""
+    lib = _load()
+    if lib is None or ids.shape[1:] != (16,) or not ids.flags.c_contiguous:
+        return False
+    lib.vtpu_bloom_add_batch(
+        bloom.words.ctypes.data, bloom.n_shards, bloom.words.shape[1],
+        bloom.shard_bits, k, ids.ctypes.data, 16, ids.shape[0],
+    )
+    return True
+
+
 # --------------------------------------------------------------- wal frames
 def varint_frames(data: bytes) -> tuple[np.ndarray, np.ndarray, bool, int] | None:
     """Scan uvarint frames: (body_offsets, body_lengths, clean, torn_at)
@@ -150,27 +175,127 @@ _N_THREADS = max(2, (os.cpu_count() or 4) // 2)
 
 
 def zstd_compress_chunks(chunks: list[bytes], level: int = 3) -> list[bytes] | None:
-    lib = _load()
-    if lib is None or not chunks:
+    if not chunks:
         return None
     n = len(chunks)
     src = np.frombuffer(b"".join(chunks), dtype=np.uint8)
     in_lens = np.asarray([len(c) for c in chunks], dtype=np.int64)
     in_offs = np.zeros(n, dtype=np.int64)
     np.cumsum(in_lens[:-1], out=in_offs[1:]) if n > 1 else None
+    return zstd_compress_from(src, in_offs, in_lens, level)
+
+
+# --------------------------------------------------------- run gather
+def gather_runs(src: np.ndarray, dst: np.ndarray, src_offs: np.ndarray,
+                dst_offs: np.ndarray, lens: np.ndarray) -> bool:
+    """Row-range copies src->dst (both C-contiguous, same dtype/row
+    shape): run i moves lens[i] rows from src_offs[i] to dst_offs[i].
+    Returns False if the caller must fall back to numpy indexing."""
+    lib = _load()
+    if lib is None:
+        return False
+    if not (src.flags.c_contiguous and dst.flags.c_contiguous):
+        return False
+    itemsize = src.dtype.itemsize * int(np.prod(src.shape[1:], dtype=np.int64))
+    lib.vtpu_gather_runs(
+        src.ctypes.data, dst.ctypes.data,
+        np.ascontiguousarray(src_offs, dtype=np.int64).ctypes.data,
+        np.ascontiguousarray(dst_offs, dtype=np.int64).ctypes.data,
+        np.ascontiguousarray(lens, dtype=np.int64).ctypes.data,
+        len(src_offs), itemsize,
+    )
+    return True
+
+
+def gather_runs_addr(src_addrs: np.ndarray, dst: np.ndarray,
+                     dst_offs: np.ndarray, lens: np.ndarray) -> bool:
+    """Run copies with per-run absolute source addresses (int64), dst
+    offsets/lens in rows: the dst-sequential multi-source merge copy.
+    Sources MUST be C-contiguous arrays kept alive by the caller."""
+    lib = _load()
+    if lib is None or not dst.flags.c_contiguous:
+        return False
+    itemsize = dst.dtype.itemsize * int(np.prod(dst.shape[1:], dtype=np.int64))
+    lib.vtpu_gather_runs_addr(
+        np.ascontiguousarray(src_addrs, dtype=np.int64).ctypes.data,
+        dst.ctypes.data,
+        np.ascontiguousarray(dst_offs, dtype=np.int64).ctypes.data,
+        np.ascontiguousarray(lens, dtype=np.int64).ctypes.data,
+        len(src_addrs), itemsize,
+    )
+    return True
+
+
+def gather_runs_remap(src_addrs: np.ndarray, dst: np.ndarray,
+                      dst_offs: np.ndarray, lens: np.ndarray,
+                      remap_addrs: np.ndarray, remap_lens: np.ndarray) -> bool:
+    """gather_runs_addr fused with an int32 code remap (per-run remap
+    table address + length; negative codes pass through). Returns False
+    when the caller must redo via its checked fallback -- including
+    out-of-range codes (corrupt input), which the kernel refuses to
+    read past."""
+    lib = _load()
+    if lib is None or dst.dtype != np.int32 or not dst.flags.c_contiguous:
+        return False
+    oob = lib.vtpu_gather_runs_remap(
+        np.ascontiguousarray(src_addrs, dtype=np.int64).ctypes.data,
+        dst.ctypes.data,
+        np.ascontiguousarray(dst_offs, dtype=np.int64).ctypes.data,
+        np.ascontiguousarray(lens, dtype=np.int64).ctypes.data,
+        np.ascontiguousarray(remap_addrs, dtype=np.int64).ctypes.data,
+        np.ascontiguousarray(remap_lens, dtype=np.int64).ctypes.data,
+        len(src_addrs),
+    )
+    return oob == 0
+
+
+# --------------------------------------------------- zstd into-buffer
+def zstd_decompress_into(chunks: list[bytes], dst: np.ndarray,
+                         out_offs: np.ndarray, out_lens: np.ndarray) -> bool:
+    """Batch-decompress chunks straight into caller-provided positions of
+    one destination buffer (uint8) -- no per-chunk bytes objects, no
+    joins. Returns False -> caller falls back."""
+    lib = _load()
+    n = len(chunks)
+    if lib is None or n == 0:
+        return False
+    src = np.frombuffer(b"".join(chunks), dtype=np.uint8)
+    in_lens = np.asarray([len(c) for c in chunks], dtype=np.int64)
+    in_offs = np.zeros(n, dtype=np.int64)
+    np.cumsum(in_lens[:-1], out=in_offs[1:]) if n > 1 else None
+    rc = lib.vtpu_zstd_decompress_batch(
+        src.ctypes.data if len(src) else None, in_offs.ctypes.data, in_lens.ctypes.data,
+        dst.ctypes.data,
+        np.ascontiguousarray(out_offs, dtype=np.int64).ctypes.data,
+        np.ascontiguousarray(out_lens, dtype=np.int64).ctypes.data,
+        n, _N_THREADS,
+    )
+    return rc == 0
+
+
+def zstd_compress_from(buf: np.ndarray, in_offs: np.ndarray, in_lens: np.ndarray,
+                       level: int = 3) -> list[bytes] | None:
+    """Batch-compress ranges of an existing contiguous buffer (uint8
+    view) without materializing per-chunk source bytes."""
+    lib = _load()
+    n = len(in_offs)
+    if lib is None or n == 0:
+        return None
+    in_offs = np.ascontiguousarray(in_offs, dtype=np.int64)
+    in_lens = np.ascontiguousarray(in_lens, dtype=np.int64)
     bounds = np.asarray([lib.vtpu_zstd_bound(int(l)) for l in in_lens], dtype=np.int64)
     out_offs = np.zeros(n, dtype=np.int64)
     np.cumsum(bounds[:-1], out=out_offs[1:]) if n > 1 else None
     dst = np.zeros(int(bounds.sum()), dtype=np.uint8)
     out_lens = np.zeros(n, dtype=np.int64)
     rc = lib.vtpu_zstd_compress_batch(
-        src.ctypes.data if len(src) else None, in_offs.ctypes.data, in_lens.ctypes.data,
+        buf.ctypes.data, in_offs.ctypes.data, in_lens.ctypes.data,
         dst.ctypes.data, out_offs.ctypes.data, out_lens.ctypes.data,
         n, level, _N_THREADS,
     )
     if rc != 0:
         return None
-    return [dst[out_offs[i]: out_offs[i] + out_lens[i]].tobytes() for i in range(n)]
+    return [dst[out_offs[i] : out_offs[i] + out_lens[i]].tobytes() for i in range(n)]
 
 
 # ---------------------------------------------------------- dict union
@@ -239,23 +364,13 @@ def _dict_union_py(raws, counts):
 
 
 def zstd_decompress_chunks(chunks: list[bytes], out_sizes: list[int]) -> list[bytes] | None:
-    lib = _load()
-    if lib is None or not chunks:
+    if not chunks:
         return None
     n = len(chunks)
-    src = np.frombuffer(b"".join(chunks), dtype=np.uint8)
-    in_lens = np.asarray([len(c) for c in chunks], dtype=np.int64)
-    in_offs = np.zeros(n, dtype=np.int64)
-    np.cumsum(in_lens[:-1], out=in_offs[1:]) if n > 1 else None
     out_lens = np.asarray(out_sizes, dtype=np.int64)
     out_offs = np.zeros(n, dtype=np.int64)
     np.cumsum(out_lens[:-1], out=out_offs[1:]) if n > 1 else None
     dst = np.zeros(int(out_lens.sum()), dtype=np.uint8)
-    rc = lib.vtpu_zstd_decompress_batch(
-        src.ctypes.data if len(src) else None, in_offs.ctypes.data, in_lens.ctypes.data,
-        dst.ctypes.data, out_offs.ctypes.data, out_lens.ctypes.data,
-        n, _N_THREADS,
-    )
-    if rc != 0:
+    if not zstd_decompress_into(chunks, dst, out_offs, out_lens):
         return None
     return [dst[out_offs[i]: out_offs[i] + out_lens[i]].tobytes() for i in range(n)]
