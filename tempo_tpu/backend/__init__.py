@@ -18,14 +18,35 @@ def open_backend(cfg: dict) -> RawBackend:
         return LocalBackend(cfg.get("path", "./tempo-data"))
     if kind in ("mem", "memory"):
         return MemBackend()
-    if kind in ("s3", "gcs"):
+    if kind == "gcs":
+        # native JSON-API backend (the primary TPU-VM store); HMAC keys
+        # select the S3-interoperability endpoint instead
+        if cfg.get("access_key"):
+            from .s3 import S3Backend
+
+            inner = S3Backend(
+                endpoint=cfg.get("endpoint") or "https://storage.googleapis.com",
+                bucket=cfg["bucket"],
+                access_key=cfg.get("access_key", ""),
+                secret_key=cfg.get("secret_key", ""),
+                region=cfg.get("region", "us-east-1"),
+                prefix=cfg.get("prefix", ""),
+            )
+        else:
+            from .gcs import GCSBackend
+
+            inner = GCSBackend(
+                bucket=cfg["bucket"],
+                prefix=cfg.get("prefix", ""),
+                endpoint=cfg.get("endpoint", ""),
+                token=cfg.get("token", ""),
+            )
+        return _wrap(inner, cfg)
+    if kind == "s3":
         from .s3 import S3Backend
 
-        endpoint = cfg.get("endpoint") or (
-            "https://storage.googleapis.com" if kind == "gcs" else "https://s3.amazonaws.com"
-        )
         inner = S3Backend(
-            endpoint=endpoint,
+            endpoint=cfg.get("endpoint") or "https://s3.amazonaws.com",
             bucket=cfg["bucket"],
             access_key=cfg.get("access_key", ""),
             secret_key=cfg.get("secret_key", ""),

@@ -107,9 +107,20 @@ class RawBackend(abc.ABC):
 
     # ---- compacted-marker protocol
     def mark_compacted(self, tenant: str, block_id: str) -> None:
-        """Rename meta.json -> meta.compacted.json (same protocol as the
-        reference's local/gcs compactors)."""
+        """Rename meta.json -> meta.compacted.json, stamping the mark
+        time (reference: CompactedBlockMeta.CompactedTime) so
+        compacted-retention measures from when the block was marked,
+        not from its data window."""
+        import json
+        import time as _time
+
         data = self.read(tenant, block_id, META_NAME)
+        try:
+            d = json.loads(data)
+            d["compacted_at_unix"] = _time.time()
+            data = json.dumps(d, separators=(",", ":")).encode()
+        except (ValueError, TypeError):
+            pass  # unparseable meta: keep the verbatim-copy rename
         self.write(tenant, block_id, COMPACTED_META_NAME, data)
         self._delete_object(tenant, block_id, META_NAME)
 

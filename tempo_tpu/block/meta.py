@@ -43,6 +43,10 @@ class BlockMeta:
     row_groups: list[RowGroupStats] = field(default_factory=list)
     # replication/dedupe bookkeeping used by the ingester
     replication_factor: int = 1
+    # stamped into meta.compacted.json at MARK time (reference:
+    # backend.CompactedBlockMeta.CompactedTime); compacted-retention runs
+    # off this, never off the data's own time window
+    compacted_at_unix: float = 0.0
 
     @staticmethod
     def new(tenant: str, block_id: str | None = None) -> "BlockMeta":

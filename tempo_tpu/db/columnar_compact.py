@@ -457,12 +457,16 @@ def compact_columnar(backend: RawBackend, job: CompactionJob, cfg: CompactorConf
     for s, remap in zip(sources, remaps):
         s.remap_codes(remap, fused=fused)
 
-    # size-target output cuts, estimated from input bytes/trace
+    # size-target output cuts, estimated from input bytes/trace. NOTE:
+    # every output block carries the FULL merged dictionary (subsetting
+    # it per output would force a second remap pass over every code
+    # column), so the per-block trace budget is what remains of the
+    # target AFTER the dictionary blob.
     total_in = sum(m.size_bytes for m in job.blocks)
     total_traces_in = max(1, sum(m.total_traces for m in job.blocks))
     bpt = max(1.0, total_in / total_traces_in)
     target = cfg.target_block_bytes or cfg.max_block_bytes
-    cap_traces = max(1, int(target / bpt))
+    cap_traces = max(1, int(max(target - len(blob), target // 4) / bpt))
 
     result = CompactionResult()
     chunk_lists: list[list[tuple[int, int, int]]] = [[]]

@@ -236,8 +236,16 @@ def apply_retention(
             backend.mark_compacted(tenant, m.block_id)
             out.marked.append(m.block_id)
     for m in compacted:
-        # compacted metas carry no marker time in round 1: use block end
-        if m.end_time_unix_nano < (now - cfg.retention_s - cfg.compacted_retention_s) * 1e9 and owns(m.block_id):
+        if m.compacted_at_unix:
+            # delete only once compacted_retention has elapsed SINCE THE
+            # MARK (retention.go:70-90): a block compacted long after its
+            # data window still gets its full grace period
+            expired = m.compacted_at_unix < now - cfg.compacted_retention_s
+        else:  # legacy marker without a stamp: fall back to block end
+            expired = m.end_time_unix_nano < (
+                now - cfg.retention_s - cfg.compacted_retention_s
+            ) * 1e9
+        if expired and owns(m.block_id):
             backend.delete_block(tenant, m.block_id)
             out.deleted.append(m.block_id)
     return out

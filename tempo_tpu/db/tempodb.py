@@ -64,6 +64,11 @@ class TempoDB:
         self._mesh = None
         # compaction ownership + dedupe hooks, overridden by the service layer
         self.owns_job = lambda job_hash: True
+        from ..util.metrics import Counter, Histogram
+
+        self.poll_duration = Histogram("tempo_blocklist_poll_duration_seconds")
+        self.poll_errors = Counter("tempo_blocklist_poll_errors_total")
+        self.polls = Counter("tempo_blocklist_polls_total")
 
     @property
     def mesh(self):
@@ -229,7 +234,11 @@ class TempoDB:
 
     # ----------------------------------------------------------- polling
     def poll_now(self) -> None:
-        metas, compacted = self.poller.poll()
+        from ..util.metrics import timed
+
+        self.polls.inc()
+        with timed(self.poll_duration):
+            metas, compacted = self.poller.poll()
         self.blocklist.apply_poll_results(metas, compacted)
         with self._cache_lock:  # drop cached readers for vanished blocks
             live = {(t, m.block_id) for t in metas for m in metas[t]}
@@ -245,7 +254,7 @@ class TempoDB:
                 try:
                     self.poll_now()
                 except Exception:  # noqa: BLE001 - poll errors keep last list
-                    pass
+                    self.poll_errors.inc()
 
         self.poll_now()
         self._poll_thread = threading.Thread(target=loop, daemon=True, name="blocklist-poller")

@@ -13,6 +13,9 @@ high latency."""
 
 from __future__ import annotations
 
+import threading
+import weakref
+from collections import OrderedDict
 from dataclasses import dataclass, field
 
 import jax
@@ -25,6 +28,61 @@ from .device import PAD_I32, bucket, pad_rows
 
 _CACHE_MAX_ENTRIES = 32  # per block
 _CACHE_MAX_ENTRY_BYTES = 256 << 20
+
+# aggregate device-memory budget across EVERY block's staged cache: an
+# LRU over (block, entry) pairs, so a wide working set evicts the
+# coldest block's columns instead of growing until HBM OOMs
+_GLOBAL_CACHE_BUDGET = 4 << 30
+_lru_lock = threading.Lock()
+_lru: OrderedDict[tuple[int, tuple], tuple] = OrderedDict()  # -> (blk weakref, nbytes)
+_lru_bytes = 0
+
+
+def set_staged_cache_budget(n_bytes: int) -> None:
+    global _GLOBAL_CACHE_BUDGET
+    _GLOBAL_CACHE_BUDGET = n_bytes
+    with _lru_lock:
+        _evict_over_budget_locked()
+
+
+def _lru_touch(blk, key: tuple, nbytes: int) -> None:
+    global _lru_bytes
+    k = (id(blk), key)
+    with _lru_lock:
+        existing = _lru.get(k)
+        if existing is not None:
+            if existing[0]() is blk:
+                _lru.move_to_end(k)
+                return
+            # id() reuse after the old block was GC'd: replace the stale
+            # entry and its accounting
+            _lru_bytes -= existing[1]
+            del _lru[k]
+        _lru[k] = (weakref.ref(blk), nbytes)
+        _lru_bytes += nbytes
+        _evict_over_budget_locked()
+
+
+def _lru_drop(blk, key: tuple) -> None:
+    """Per-block cap evictions must release their global accounting."""
+    global _lru_bytes
+    k = (id(blk), key)
+    with _lru_lock:
+        entry = _lru.pop(k, None)
+        if entry is not None:
+            _lru_bytes -= entry[1]
+
+
+def _evict_over_budget_locked() -> None:
+    global _lru_bytes
+    while _lru_bytes > _GLOBAL_CACHE_BUDGET and len(_lru) > 1:
+        (_bid, key), (wr, nbytes) = _lru.popitem(last=False)
+        _lru_bytes -= nbytes
+        blk = wr()
+        if blk is not None:
+            store = getattr(blk, "_staged_cache", None)
+            if store is not None:
+                store.pop(key, None)
 
 # absolute-seconds origin (2020-01-01 UTC) for the derived trace@gkey_s
 # column: a global trace start time in int32 seconds (valid until 2088)
@@ -73,6 +131,7 @@ def stage_block(
     if store is not None:
         hit = store.get(key)
         if hit is not None:
+            _lru_touch(blk, key, sum(a.nbytes for a in hit.cols.values()))
             return hit
     pack = blk.pack
     span_ax = pack.axes[S.AX_SPAN]
@@ -196,6 +255,9 @@ def stage_block(
                 store = {}
                 blk._staged_cache = store
             if len(store) >= _CACHE_MAX_ENTRIES:
-                store.pop(next(iter(store)))
+                victim = next(iter(store))
+                store.pop(victim)
+                _lru_drop(blk, victim)
             store[key] = staged
+            _lru_touch(blk, key, nbytes)
     return staged

@@ -40,6 +40,7 @@ TENANT_HEADER = "X-Scope-OrgID"  # reference: shared orgid header
 
 INGESTER_RING = "ingester-ring"
 COMPACTOR_RING = "compactor-ring"
+GENERATOR_RING = "generator-ring"
 
 
 @dataclass
@@ -68,6 +69,9 @@ class AppConfig:
     # and pull jobs from (reference: querier.frontend-address)
     frontend_addr: str = ""
     frontend_workers: int = 8  # in-process worker threads (0 = dispatcher-only)
+    # OTLP gRPC receiver port (reference receiver default 4317);
+    # 0 = disabled, -1 = ephemeral (tests)
+    otlp_grpc_port: int = 0
 
 
 class App:
@@ -136,19 +140,32 @@ class App:
                                          addr=cfg.advertise_addr)
             self._clients[self.lifecycler.desc.addr] = self.ingester
 
-        self.generator = None
+        self.generator = self.generator_lifecycler = None
         gen_forward = None
         if cfg.enable_generator and (has("metrics-generator") or cfg.target == "all"):
             from .generator import MetricsGenerator
 
             self.generator = MetricsGenerator(self.overrides)
             gen_forward = self.generator.push
+            if cfg.kv_dir and cfg.target == "metrics-generator":
+                # standalone generator joins its own ring so distributors
+                # shuffle-shard tenants across the generator fleet
+                self.generator_lifecycler = Lifecycler(
+                    self.kv, GENERATOR_RING, cfg.instance_id, addr=cfg.advertise_addr
+                )
 
         self.distributor = None
         if has("distributor"):
+            # local generator -> in-process tap; shared-KV topology with
+            # no local generator -> shuffle-sharded remote generator ring
+            gen_ring = (
+                Ring(self.kv, GENERATOR_RING)
+                if cfg.kv_dir and self.generator is None
+                else None
+            )
             self.distributor = Distributor(
                 self.ring, self.client_for, self.overrides,
-                generator_forward=gen_forward,
+                generator_forward=gen_forward, generator_ring=gen_ring,
             )
 
         self.querier = self.frontend = self.querier_worker = None
@@ -163,7 +180,8 @@ class App:
             n_workers = cfg.frontend_workers
             if cfg.target == "query-frontend" and cfg.kv_dir:
                 n_workers = 0
-            self.frontend = Frontend(self.querier, n_workers=n_workers)
+            self.frontend = Frontend(self.querier, n_workers=n_workers,
+                                     overrides=self.overrides)
             if cfg.target == "querier" and cfg.frontend_addr:
                 from .worker import QuerierWorker
 
@@ -171,6 +189,7 @@ class App:
                     self.querier,
                     [a.strip() for a in cfg.frontend_addr.split(",") if a.strip()],
                     token=cfg.internal_token,
+                    worker_id=cfg.instance_id,
                 )
 
         self.compactor = self.compactor_lifecycler = None
@@ -184,6 +203,7 @@ class App:
             self.compactor = Compactor(self.db, comp_ring, cfg.instance_id,
                                        cycle_s=cfg.compaction_cycle_s)
         self._started = False
+        self.otlp_grpc = None
         self.http_server: ThreadingHTTPServer | None = None
 
     # ------------------------------------------------------------ lifecycle
@@ -192,16 +212,33 @@ class App:
             self.lifecycler.start()
         if self.compactor_lifecycler:
             self.compactor_lifecycler.start()
+        if self.generator_lifecycler:
+            self.generator_lifecycler.start()
         if self.ingester:
             self.ingester.start_sweeper()
         if self.compactor:
             self.compactor.start()
         if self.querier_worker:
             self.querier_worker.start()
+        self.overrides.start_reloader()  # hot-reload per-tenant limits
+        if self.distributor is not None and self.cfg.otlp_grpc_port != 0:
+            from .otlp_grpc import OTLPGrpcReceiver
+
+            self.otlp_grpc = OTLPGrpcReceiver(self)
+            port = max(0, self.cfg.otlp_grpc_port)  # -1 -> ephemeral
+            # same bind policy as serve_http: loopback unless peers
+            # reach this process from other hosts
+            adv = self.cfg.advertise_addr
+            local = ("127.0.0.1" in adv) or ("localhost" in adv) or not adv
+            host = self.cfg.http_host or ("127.0.0.1" if local else "0.0.0.0")
+            self.cfg.otlp_grpc_port = self.otlp_grpc.start(port, host=host)
         self.db.enable_polling()
         self._started = True
 
     def stop(self) -> None:
+        self.overrides.stop()
+        if self.otlp_grpc is not None:
+            self.otlp_grpc.stop()
         if self.querier_worker:
             self.querier_worker.stop()
         if self.compactor:
@@ -214,6 +251,8 @@ class App:
             self.lifecycler.leave()
         if self.compactor_lifecycler:
             self.compactor_lifecycler.leave()
+        if self.generator_lifecycler:
+            self.generator_lifecycler.leave()
         self.db.close()
         if self.http_server:
             self.http_server.shutdown()
@@ -449,19 +488,43 @@ def _metrics_text(app: App) -> str:
             f"tempo_distributor_spans_received_total {d.spans_received}",
             f"tempo_distributor_bytes_received_total {d.bytes_received}",
             f"tempo_distributor_push_failures_total {d.push_failures}",
+            f"tempo_distributor_spans_refused_rate_total {d.spans_refused_rate}",
+            f"tempo_distributor_traces_refused_size_total {d.traces_refused_size}",
         ]
+        lines += app.distributor.push_latency.text()
     if app.ingester:
+        from .ingester import FLUSH_DURATION, FLUSH_FAILURES, WAL_REPLAYS
+
         lines += [
             f"tempo_ingester_blocks_flushed_total "
             f"{sum(i.blocks_flushed for i in app.ingester.instances.values())}",
             f"tempo_ingester_live_traces "
             f"{sum(len(i.live) for i in app.ingester.instances.values())}",
         ]
+        lines += FLUSH_DURATION.text() + FLUSH_FAILURES.text() + WAL_REPLAYS.text()
     if app.compactor:
         lines += [
             f"tempo_compactor_runs_total {app.compactor.stats.runs}",
             f"tempo_compactor_blocks_compacted_total {app.compactor.stats.blocks_compacted}",
+            f"tempo_compactor_blocks_retained_total {app.compactor.stats.blocks_retained}",
+            f"tempo_compactor_errors_total {len(app.compactor.stats.errors)}",
         ]
+        lines += app.compactor.compaction_duration.text()
+    # storage-engine + backend-wrapper metrics (poller, cache, hedging)
+    lines += app.db.polls.text() + app.db.poll_errors.text() + app.db.poll_duration.text()
+    lines.append(
+        "tempo_blocklist_length "
+        f"{sum(len(app.db.blocklist.metas(t)) for t in app.db.blocklist.tenants())}"
+    )
+    b = app.db.backend
+    while b is not None:
+        if hasattr(b, "hits"):
+            lines.append(f"tempo_cache_hits_total {b.hits}")
+        if hasattr(b, "hedged_requests"):
+            lines.append(f"tempo_backend_hedged_requests_total {b.hedged_requests}")
+        b = getattr(b, "inner", None)
+    if app.frontend:
+        lines += app.frontend.query_latency.text()
     if app.querier:
         lines += [
             f"tempo_querier_traces_found_total {app.querier.stats.traces_found}",
@@ -527,6 +590,8 @@ def main(argv=None):
                     help="shared secret for /internal/* when bound beyond loopback")
     ap.add_argument("--querier.frontend-address", dest="frontend_addr", default=None,
                     help="frontend addr(s) a standalone querier pulls jobs from")
+    ap.add_argument("--distributor.otlp-grpc-port", dest="otlp_grpc_port", type=int,
+                    default=None, help="OTLP gRPC receiver port (0=off, -1=ephemeral)")
     args = ap.parse_args(argv)
     base = load_config_file(args.config_file) if args.config_file else {}
     flag_vals = {
@@ -541,6 +606,7 @@ def main(argv=None):
         "replication_factor": args.rf,
         "internal_token": args.internal_token,
         "frontend_addr": args.frontend_addr,
+        "otlp_grpc_port": args.otlp_grpc_port,
     }
     base.update({k: v for k, v in flag_vals.items() if v is not None})
     cfg = AppConfig(**base)

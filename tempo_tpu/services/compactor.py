@@ -31,6 +31,12 @@ class Compactor:
         self.instance_id = instance_id
         self.cycle_s = cycle_s
         self.stats = CompactorStats()
+        from ..util.metrics import Histogram
+
+        self.compaction_duration = Histogram(
+            "tempo_compactor_cycle_duration_seconds",
+            buckets=(0.1, 0.5, 1.0, 5.0, 15.0, 60.0, 300.0),
+        )
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         # install ring ownership into the db's compaction driver
@@ -38,10 +44,13 @@ class Compactor:
             self.db.owns_job = lambda h: ring.owns(instance_id, h)
 
     def run_once(self) -> None:
+        from ..util.metrics import timed
+
         self.stats.runs += 1
         for tenant in self.db.tenants():
             try:
-                results = self.db.compact_once(tenant)
+                with timed(self.compaction_duration):
+                    results = self.db.compact_once(tenant)
                 self.stats.blocks_compacted += sum(len(r.compacted_ids) for r in results)
                 ret = self.db.retention_once(tenant)
                 self.stats.blocks_retained += len(ret.deleted) if ret else 0

@@ -39,22 +39,68 @@ class DistributorStats:
 
 class Distributor:
     def __init__(self, ring: Ring, client_for, overrides: Overrides,
-                 generator_forward=None):
+                 generator_forward=None, generator_ring: Ring | None = None):
         """client_for(addr) -> object with push_segments(tenant, batch);
-        generator_forward(tenant, traces) optional metrics-generator tap."""
+        generator_forward(tenant, traces) optional in-process
+        metrics-generator tap (single binary). generator_ring selects
+        REMOTE generators instead, per-tenant shuffle-sharded
+        (distributor.go:410-442: metrics_generator_ring_size members
+        per tenant, traces routed within the shard by id hash)."""
         self.ring = ring
         self.client_for = client_for
         self.overrides = overrides
         self.limiter = RateLimiter(overrides)
         self.generator_forward = generator_forward
+        self.generator_ring = generator_ring
         self.stats = DistributorStats()
+        from ..util.metrics import Histogram
+
+        self.push_latency = Histogram("tempo_distributor_push_duration_seconds")
+
+    def _forward_to_generators(self, tenant: str, per_trace: dict) -> None:
+        if self.generator_ring is not None:
+            from ..util.hashing import fnv1a_32
+
+            size = self.overrides.for_tenant(tenant).metrics_generator_ring_size
+            shard = self.generator_ring.shuffle_shard(tenant, size)
+            if not shard:
+                return
+            by_member: dict[str, list] = defaultdict(list)
+            for tid, tr in per_trace.items():
+                member = shard[fnv1a_32(tid) % len(shard)]
+                by_member[member.addr].append(tr)
+            for addr, traces in by_member.items():
+                try:
+                    self.client_for(addr).push_generator(tenant, traces)
+                except Exception:
+                    pass  # metrics tap must never fail ingest
+        elif self.generator_forward is not None:
+            try:
+                self.generator_forward(tenant, list(per_trace.values()))
+            except Exception:
+                pass
 
     # ---------------------------------------------------------------- push
     def push(self, tenant: str, batches: list[ResourceSpans]) -> None:
         """One OTLP export request worth of ResourceSpans."""
+        from ..util.metrics import timed
+
+        with timed(self.push_latency):
+            self._push(tenant, batches)
+
+    def _push(self, tenant: str, batches: list[ResourceSpans]) -> None:
         now = time.time()
         n_spans = sum(len(ss.spans) for rs in batches for ss in rs.scope_spans)
         self.stats.spans_received += n_spans
+
+        # cheap pre-gate BEFORE rebatch/serialization: if even a
+        # conservative LOWER BOUND on the wire size (ids + timestamps
+        # alone exceed 16 bytes/span) can't pass the bucket, refuse
+        # without paying encoding CPU; the exact-bytes limiter still
+        # applies below on real wire bytes
+        if not self.limiter.peek(tenant, n_spans * 16, now):
+            self.stats.spans_refused_rate += n_spans
+            raise PushError(429, f"tenant {tenant} over ingestion rate limit")
 
         per_trace = self._requests_by_trace_id(batches)
         if not per_trace:
@@ -118,11 +164,7 @@ class Distributor:
             raise PushError(500, f"{len(failed)} traces failed quorum write: {errors[:1]}")
         self.stats.traces_pushed += len(lim_filtered)
 
-        if self.generator_forward is not None:
-            try:
-                self.generator_forward(tenant, list(per_trace.values()))
-            except Exception:
-                pass  # metrics tap must never fail ingest
+        self._forward_to_generators(tenant, per_trace)
 
     # ------------------------------------------------------------ rebatch
     @staticmethod

@@ -80,14 +80,17 @@ class RequestQueue:
             q.append(job)
             self.cv.notify()
 
-    def dequeue(self, timeout: float = 0.5):
+    def dequeue(self, timeout: float = 0.5, allowed=None):
+        """Next (tenant, job), fair across tenants; allowed(tenant) False
+        skips a tenant for THIS caller (per-tenant querier shuffle-shard,
+        pkg/scheduler/queue/user_queues.go)."""
         with self.cv:
             while True:
                 for _ in range(len(self.order)):
                     tenant = self.order[0]
                     self.order.rotate(-1)
                     q = self.queues.get(tenant)
-                    if q:
+                    if q and (allowed is None or allowed(tenant)):
                         return tenant, q.popleft()
                 if self.closed:
                     return None
@@ -144,17 +147,25 @@ class Frontend:
                  concurrent_jobs: int = DEFAULT_CONCURRENT_JOBS,
                  batch_bytes: int = TARGET_BATCH_BYTES,
                  hedge_after_s: float = 2.0,
-                 lease_s: float = 30.0):
+                 lease_s: float = 30.0,
+                 overrides=None,
+                 worker_expiry_s: float = 60.0):
         self.querier = querier
         self.queue = RequestQueue()
         self.concurrent_jobs = concurrent_jobs
         self.batch_bytes = batch_bytes
         self.hedge_after_s = hedge_after_s
         self.lease_s = lease_s
+        self.overrides = overrides
+        self.worker_expiry_s = worker_expiry_s
+        self._remote_workers: dict[str, float] = {}  # worker id -> last poll
         self._leases: dict[str, tuple[str, _Job, float]] = {}
         self._lease_lock = threading.Lock()
         self.stats_jobs_remote = 0
         self.stats_jobs_local = 0
+        from ..util.metrics import Histogram
+
+        self.query_latency = Histogram("tempo_frontend_query_duration_seconds")
         self._workers = [
             threading.Thread(target=self._worker, daemon=True, name=f"frontend-worker-{i}")
             for i in range(n_workers)
@@ -198,17 +209,48 @@ class Frontend:
             job.finish()
 
     # ------------------------------------------------ remote querier pull
-    def poll_job(self, wait_s: float = 5.0):
+    def _tenant_allowed(self, tenant: str, worker_id: str) -> bool:
+        """Per-tenant querier shuffle-shard: with max_queriers_per_tenant
+        set, each tenant's jobs go to a deterministic subset of the
+        currently-attached workers (user_queues.go). Subsets re-shuffle
+        as workers come and go, and every tenant always has at least one
+        live assigned worker by construction."""
+        if not worker_id or self.overrides is None:
+            return True
+        k = self.overrides.for_tenant(tenant).max_queriers_per_tenant
+        if k <= 0:
+            return True
+        now = time.monotonic()
+        with self._lease_lock:
+            self._remote_workers = {
+                w: t for w, t in self._remote_workers.items()
+                if now - t < self.worker_expiry_s
+            }
+            workers = sorted(self._remote_workers)
+        if k >= len(workers):
+            return True
+        import random
+
+        from ..util.hashing import fnv1a_32
+
+        rng = random.Random(fnv1a_32(tenant.encode()))
+        return worker_id in rng.sample(workers, k)
+
+    def poll_job(self, wait_s: float = 5.0, worker_id: str = ""):
         """Long-poll dequeue for a remote querier worker
         (frontend_processor.go's stream recv). Returns a wire job dict
         or None on timeout. Expired leases re-enter the queue first."""
+        if worker_id:
+            with self._lease_lock:
+                self._remote_workers[worker_id] = time.monotonic()
         self._requeue_expired()
+        allowed = (lambda t: self._tenant_allowed(t, worker_id)) if worker_id else None
         deadline = time.monotonic() + wait_s
         while True:
             remaining = deadline - time.monotonic()
             if remaining <= 0:
                 return None
-            item = self.queue.dequeue(timeout=min(remaining, 1.0))
+            item = self.queue.dequeue(timeout=min(remaining, 1.0), allowed=allowed)
             if item is None:
                 if self.queue.closed:
                     return None
@@ -316,6 +358,13 @@ class Frontend:
         combined (tracebyidsharding.go:30-48 splits the ID space; here
         the candidate block set IS the shardable space, since the device
         engine answers a whole partition in one batched lookup)."""
+        from ..util.metrics import timed
+
+        with timed(self.query_latency, 'op="traces"'):
+            return self._find_trace_by_id(tenant, trace_id, time_start, time_end)
+
+    def _find_trace_by_id(self, tenant: str, trace_id: bytes,
+                          time_start: int = 0, time_end: int = 0):
         db = self.querier.db
         candidates = db.find_candidates(tenant, trace_id, time_start, time_end)
         jobs = [_Job(
@@ -351,6 +400,12 @@ class Frontend:
         """Sharded search: ingester job + block-batch jobs (+ row-group
         shard jobs for oversized blocks), bounded concurrency, early
         exit at limit."""
+        from ..util.metrics import timed
+
+        with timed(self.query_latency, 'op="search"'):
+            return self._search(tenant, req)
+
+    def _search(self, tenant: str, req: SearchRequest) -> SearchResponse:
         limit = req.limit or 20
         resp = SearchResponse()
         lock = threading.Lock()

@@ -165,38 +165,72 @@ class SpanMetricsProcessor:
 class _Edge:
     client_service: str = ""
     server_service: str = ""
+    client_dur_s: float = 0.0
+    server_dur_s: float = 0.0
+    failed: bool = False
     t: float = 0.0
 
 
 class ServiceGraphsProcessor:
     """Pairs client/server spans by (trace_id, span_id/parent_id) through
-    an expiring edge store (servicegraphs store/store.go)."""
+    an expiring edge store (servicegraphs store/store.go), emitting the
+    reference's full edge series (servicegraphs.go:62-80): request
+    counts, failed counts, and client/server latency histograms. Like
+    span-metrics, completed edges buffer as columns and fold through the
+    device segmented reduce on collect()."""
 
     def __init__(self, wait_s: float = 10.0, max_items: int = 10_000):
         self.lock = threading.Lock()
         self.wait_s = wait_s
         self.max_items = max_items
         self.pending: dict[tuple, _Edge] = {}
-        self.counts: dict[tuple[str, str], int] = defaultdict(int)
+        self.edge_ids: dict[tuple[str, str], int] = {}
+        self.edge_list: list[tuple[str, str]] = []
         self.expired = 0
+        # pending completed-edge columns
+        self._eid: list[int] = []
+        self._client_dur: list[float] = []
+        self._server_dur: list[float] = []
+        self._failed: list[bool] = []
+        # aggregated state, per edge id
+        self.counts = np.zeros(0, dtype=np.int64)
+        self.failed_counts = np.zeros(0, dtype=np.int64)
+        self.client_sum = np.zeros(0, dtype=np.float64)
+        self.server_sum = np.zeros(0, dtype=np.float64)
+        self.client_buckets = np.zeros((0, len(LATENCY_BUCKETS) + 1), dtype=np.int64)
+        self.server_buckets = np.zeros((0, len(LATENCY_BUCKETS) + 1), dtype=np.int64)
 
     def push(self, tenant_unused: str, traces: list[Trace]) -> None:
         now = time.time()
         with self.lock:
             for tr in traces:
                 for res, _, sp in tr.all_spans():
+                    failed = int(sp.status_code) == 2
+                    dur_s = max(0, sp.duration_nanos) / 1e9
                     if sp.kind == SpanKind.CLIENT:
                         key = (sp.trace_id, sp.span_id)
                         e = self.pending.setdefault(key, _Edge(t=now))
                         e.client_service = res.service_name
+                        e.client_dur_s = dur_s
+                        e.failed = e.failed or failed
                     elif sp.kind == SpanKind.SERVER:
                         key = (sp.trace_id, sp.parent_span_id)
                         e = self.pending.setdefault(key, _Edge(t=now))
                         e.server_service = res.service_name
+                        e.server_dur_s = dur_s
+                        e.failed = e.failed or failed
                     else:
                         continue
                     if e.client_service and e.server_service:
-                        self.counts[(e.client_service, e.server_service)] += 1
+                        ek = (e.client_service, e.server_service)
+                        eid = self.edge_ids.get(ek)
+                        if eid is None:
+                            eid = self.edge_ids[ek] = len(self.edge_list)
+                            self.edge_list.append(ek)
+                        self._eid.append(eid)
+                        self._client_dur.append(e.client_dur_s)
+                        self._server_dur.append(e.server_dur_s)
+                        self._failed.append(e.failed)
                         del self.pending[key]
             self._expire(now)
 
@@ -207,12 +241,78 @@ class ServiceGraphsProcessor:
                 del self.pending[k]
                 self.expired += 1
 
-    def metrics_text(self) -> list[str]:
+    def collect(self) -> None:
+        """Fold pending completed edges into per-edge series with the
+        same segmented reduce the span-metrics processor uses."""
         with self.lock:
-            return [
-                f'traces_service_graph_request_total{{client="{c}",server="{s}"}} {n}'
-                for (c, s), n in sorted(self.counts.items())
-            ]
+            if not self._eid:
+                return
+            eid = np.asarray(self._eid, dtype=np.int32)
+            cdur = np.asarray(self._client_dur, dtype=np.float32)
+            sdur = np.asarray(self._server_dur, dtype=np.float32)
+            failed = np.asarray(self._failed, dtype=bool)
+            self._eid, self._client_dur, self._server_dur, self._failed = [], [], [], []
+            n_edges = len(self.edge_list)
+        from ..ops.reduce import span_metrics_reduce
+
+        ccalls, csum, cbuckets = span_metrics_reduce(eid, cdur, n_edges, LATENCY_BUCKETS)
+        _, ssum, sbuckets = span_metrics_reduce(eid, sdur, n_edges, LATENCY_BUCKETS)
+        fcounts = np.bincount(eid[failed], minlength=n_edges).astype(np.int64)
+        with self.lock:
+            if len(self.counts) < n_edges:
+                pad = n_edges - len(self.counts)
+                zb = np.zeros((pad, self.client_buckets.shape[1]), np.int64)
+                self.counts = np.concatenate([self.counts, np.zeros(pad, np.int64)])
+                self.failed_counts = np.concatenate([self.failed_counts, np.zeros(pad, np.int64)])
+                self.client_sum = np.concatenate([self.client_sum, np.zeros(pad, np.float64)])
+                self.server_sum = np.concatenate([self.server_sum, np.zeros(pad, np.float64)])
+                self.client_buckets = np.concatenate([self.client_buckets, zb])
+                self.server_buckets = np.concatenate([self.server_buckets, zb.copy()])
+            self.counts[:n_edges] += ccalls[:n_edges]
+            self.failed_counts[:n_edges] += fcounts[:n_edges]
+            self.client_sum[:n_edges] += csum[:n_edges]
+            self.server_sum[:n_edges] += ssum[:n_edges]
+            self.client_buckets[:n_edges] += cbuckets[:n_edges]
+            self.server_buckets[:n_edges] += sbuckets[:n_edges]
+
+    def metrics_text(self) -> list[str]:
+        self.collect()
+        out = []
+        with self.lock:
+            for eid, (c, s) in enumerate(self.edge_list):
+                if eid >= len(self.counts) or self.counts[eid] == 0:
+                    continue
+                lab = f'client="{c}",server="{s}"'
+                out.append(f"traces_service_graph_request_total{{{lab}}} {int(self.counts[eid])}")
+                out.append(
+                    f"traces_service_graph_request_failed_total{{{lab}}} "
+                    f"{int(self.failed_counts[eid])}"
+                )
+                for side, total, buckets in (
+                    ("client", self.client_sum, self.client_buckets),
+                    ("server", self.server_sum, self.server_buckets),
+                ):
+                    out.append(
+                        f"traces_service_graph_request_{side}_seconds_sum{{{lab}}} "
+                        f"{total[eid]:.6f}"
+                    )
+                    out.append(
+                        f"traces_service_graph_request_{side}_seconds_count{{{lab}}} "
+                        f"{int(self.counts[eid])}"
+                    )
+                    cum = 0
+                    for bi, edge in enumerate(LATENCY_BUCKETS):
+                        cum += int(buckets[eid, bi])
+                        out.append(
+                            f'traces_service_graph_request_{side}_seconds_bucket'
+                            f'{{{lab},le="{edge}"}} {cum}'
+                        )
+                    cum += int(buckets[eid, -1])
+                    out.append(
+                        f'traces_service_graph_request_{side}_seconds_bucket'
+                        f'{{{lab},le="+Inf"}} {cum}'
+                    )
+        return out
 
 
 class MetricsGenerator:

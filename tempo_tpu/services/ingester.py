@@ -24,8 +24,15 @@ from ..db.tempodb import TempoDB
 from ..db.wal import WAL, WALBlock
 from ..wire.combine import combine_traces, sort_trace
 from ..wire.model import Trace
+from ..util.metrics import Counter, Histogram, timed
 from ..wire.segment import segment_to_trace
 from .distributor import PushError
+
+# process-wide ingester instrumentation (the reference's promauto
+# package-level metrics, modules/ingester/flush.go)
+FLUSH_DURATION = Histogram("tempo_ingester_flush_duration_seconds")
+FLUSH_FAILURES = Counter("tempo_ingester_flush_failures_total")
+WAL_REPLAYS = Counter("tempo_ingester_wal_replays_total")
 
 
 @dataclass
@@ -153,8 +160,10 @@ class Instance:
                     self.head.append(lt.trace_id, lt.start_s, lt.end_s, seg)
             self.head.flush()
         try:
-            meta = self.db.write_block(self.tenant, traces)
+            with timed(FLUSH_DURATION):
+                meta = self.db.write_block(self.tenant, traces)
         except Exception:
+            FLUSH_FAILURES.inc()
             # block write failed: restore the cut set for the next retry;
             # the old WAL file stays on disk as the checkpoint. MERGE into
             # any entry cut for the same id since the snapshot (setdefault
@@ -285,6 +294,7 @@ class Ingester:
     def replay_wal(self) -> int:
         """Startup: WAL files -> live state of fresh instances, then an
         immediate cut (ingester.go:326-400 replays into blocks)."""
+        WAL_REPLAYS.inc()
         n = 0
         for rb in self.wal.rescan_blocks():
             if not rb.records:

@@ -1,0 +1,84 @@
+"""OTLP/gRPC trace receiver: the default OTel exporter transport.
+
+Reference: the distributor's receiver shim runs the OTLP gRPC receiver
+on :4317 (modules/distributor/receiver/shim.go:95-101). Here it's a
+grpc generic handler -- no generated stubs: the ExportTraceServiceRequest
+wire form is `repeated ResourceSpans = 1`, byte-identical to TracesData,
+so the existing hand-rolled OTLP codec (wire/otlp_pb.py) decodes it
+directly, and the empty ExportTraceServiceResponse serializes to b"".
+
+Tenancy rides the x-scope-orgid metadata key (the gRPC twin of the
+X-Scope-OrgID header); push limit errors map to the canonical gRPC
+codes (429 -> RESOURCE_EXHAUSTED, 400 -> INVALID_ARGUMENT), which OTel
+SDK exporters understand as retryable / fatal respectively.
+"""
+
+from __future__ import annotations
+
+from concurrent import futures
+
+from ..wire import otlp_pb
+
+_EXPORT_METHOD = "Export"
+_SERVICE = "opentelemetry.proto.collector.trace.v1.TraceService"
+
+
+class OTLPGrpcReceiver:
+    def __init__(self, app, max_workers: int = 8):
+        self.app = app
+        self._max_workers = max_workers
+        self._server = None
+        self.port = 0
+        self.requests = 0
+        self.failures = 0
+
+    def start(self, port: int = 4317, host: str = "127.0.0.1") -> int:
+        import grpc
+
+        app = self.app
+        recv = self
+
+        def export(request: bytes, context) -> bytes:
+            recv.requests += 1
+            try:
+                md = {k.lower(): v for k, v in (context.invocation_metadata() or [])}
+                # gRPC metadata keys are lowercase; re-shape for tenant_of
+                tenant = app.tenant_of({"X-Scope-OrgID": md.get("x-scope-orgid", "")})
+                tr = otlp_pb.decode_trace(request)
+                app.distributor.push(tenant, tr.resource_spans)
+                return b""
+            except Exception as e:
+                recv.failures += 1
+                from .distributor import PushError
+
+                if isinstance(e, PushError):
+                    code = (grpc.StatusCode.RESOURCE_EXHAUSTED if e.status == 429
+                            else grpc.StatusCode.UNAUTHENTICATED if e.status == 401
+                            else grpc.StatusCode.INVALID_ARGUMENT)
+                else:
+                    code = grpc.StatusCode.INTERNAL
+                context.abort(code, f"{type(e).__name__}: {e}")
+
+        handler = grpc.method_handlers_generic_handler(
+            _SERVICE,
+            {
+                _EXPORT_METHOD: grpc.unary_unary_rpc_method_handler(
+                    export,
+                    request_deserializer=None,  # raw bytes in
+                    response_serializer=None,  # raw bytes out
+                )
+            },
+        )
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=self._max_workers,
+                                       thread_name_prefix="otlp-grpc"),
+        )
+        self._server.add_generic_rpc_handlers((handler,))
+        self.port = self._server.add_insecure_port(f"{host}:{port}")
+        self._server.start()
+        return self.port
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.stop(grace=1.0)
+            self._server = None

@@ -21,6 +21,7 @@ class Limits:
     # query
     max_bytes_per_tag_values_query: int = 5 * 1024 * 1024
     max_search_duration_s: int = 0  # 0 = unlimited
+    max_queriers_per_tenant: int = 0  # queue shuffle-shard size; 0 = all
     # storage
     block_retention_s: int = 0  # 0 = use compactor default
     # generator
@@ -102,6 +103,21 @@ class RateLimiter:
         self.overrides = overrides
         self._lock = threading.Lock()
         self._buckets: dict[str, tuple[float, float]] = {}  # tenant -> (tokens, last_ts)
+
+    def peek(self, tenant: str, nbytes: int, now: float) -> bool:
+        """Would a request of nbytes pass right now? Consumes nothing:
+        the cheap pre-serialization gate -- callers pass a LOWER BOUND
+        on the request's wire size, so a refusal here is always also a
+        refusal of the exact-bytes check, and a tenant hard over its
+        limit never pays segment-encoding CPU for a doomed request."""
+        lim = self.overrides.for_tenant(tenant)
+        rate = lim.ingestion_rate_limit_bytes
+        burst = lim.ingestion_burst_size_bytes
+        if rate <= 0:
+            return True
+        with self._lock:
+            tokens, last = self._buckets.get(tenant, (float(burst), now))
+            return min(float(burst), tokens + (now - last) * rate) >= nbytes
 
     def allow(self, tenant: str, nbytes: int, now: float) -> bool:
         lim = self.overrides.for_tenant(tenant)

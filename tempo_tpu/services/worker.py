@@ -62,11 +62,13 @@ class QuerierWorker:
     """Long-poll worker loops against one or more frontend addresses."""
 
     def __init__(self, querier: Querier, frontend_addrs: list[str],
-                 token: str = "", concurrency: int = 4, poll_wait_s: float = 5.0):
+                 token: str = "", concurrency: int = 4, poll_wait_s: float = 5.0,
+                 worker_id: str = ""):
         self.querier = querier
         self.addrs = [a.rstrip("/") for a in frontend_addrs]
         self.token = token
         self.poll_wait_s = poll_wait_s
+        self.worker_id = worker_id
         self._stop = threading.Event()
         self._threads = [
             threading.Thread(target=self._loop, args=(addr,), daemon=True,
@@ -99,7 +101,8 @@ class QuerierWorker:
         while not self._stop.is_set():
             try:
                 job = self._post(addr, "/internal/jobs/poll",
-                                 {"wait_s": self.poll_wait_s},
+                                 {"wait_s": self.poll_wait_s,
+                                  "worker_id": self.worker_id},
                                  timeout=self.poll_wait_s + 10.0)
             except (urllib.error.URLError, ConnectionError, OSError):
                 self._stop.wait(1.0)  # frontend down: back off, retry

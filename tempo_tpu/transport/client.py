@@ -71,6 +71,14 @@ class HTTPIngesterClient:
             },
         )
 
+    def push_generator(self, tenant: str, traces) -> None:
+        """Forward traces to a remote metrics-generator (the shuffle-
+        sharded generator write path, distributor.go:410-442)."""
+        self._post(
+            "/internal/genpush",
+            {"tenant": tenant, "traces": [otlp_json.dumps(t) for t in traces]},
+        )
+
     # ------------------------------------------------ Querier (read path)
     def find_trace_by_id(self, tenant: str, trace_id: bytes) -> Trace | None:
         out = self._post("/internal/find", {"tenant": tenant, "trace_id": trace_id.hex()})
@@ -114,7 +122,8 @@ def handle_internal(app, path: str, payload: dict):
         # remote querier pull (services/worker.py) against this frontend
         if app.frontend is None:
             return 404, {"error": f"target {app.cfg.target} hosts no frontend"}
-        job = app.frontend.poll_job(wait_s=float(payload.get("wait_s", 5.0)))
+        job = app.frontend.poll_job(wait_s=float(payload.get("wait_s", 5.0)),
+                                    worker_id=payload.get("worker_id", ""))
         return 200, (job or {})
     if path == "/internal/jobs/result":
         if app.frontend is None:
@@ -124,6 +133,12 @@ def handle_internal(app, path: str, payload: dict):
             result=payload.get("result"), error=payload.get("error", ""),
             retryable=bool(payload.get("retryable")),
         )
+        return 200, {}
+    if path == "/internal/genpush":
+        if app.generator is None:
+            return 404, {"error": f"target {app.cfg.target} hosts no generator"}
+        traces = [otlp_json.loads(t) for t in payload.get("traces", [])]
+        app.generator.push(payload.get("tenant", ""), traces)
         return 200, {}
     if app.ingester is None:
         return 404, {"error": f"target {app.cfg.target} hosts no ingester"}

@@ -1,0 +1,96 @@
+"""Minimal Prometheus-style instruments.
+
+The role promauto plays across the reference (histograms + counters on
+every subsystem, e.g. modules/distributor/distributor.go:56-103,
+tempodb/blocklist/poller.go:26-68), sized to this codebase: lock-free
+enough for the hot paths (float adds under a small lock), rendered to
+exposition text by /metrics.
+"""
+
+from __future__ import annotations
+
+import threading
+
+DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                   1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+
+
+class Histogram:
+    """Cumulative-bucket latency histogram."""
+
+    def __init__(self, name: str, buckets: tuple[float, ...] = DEFAULT_BUCKETS):
+        self.name = name
+        self.buckets = buckets
+        self._lock = threading.Lock()
+        self._counts: dict[str, list[int]] = {}
+        self._sums: dict[str, float] = {}
+        self._totals: dict[str, int] = {}
+
+    def observe(self, value: float, labels: str = "") -> None:
+        with self._lock:
+            counts = self._counts.get(labels)
+            if counts is None:
+                counts = self._counts[labels] = [0] * (len(self.buckets) + 1)
+                self._sums[labels] = 0.0
+                self._totals[labels] = 0
+            for i, edge in enumerate(self.buckets):
+                if value <= edge:
+                    counts[i] += 1
+                    break
+            else:
+                counts[-1] += 1
+            self._sums[labels] += value
+            self._totals[labels] += 1
+
+    def text(self) -> list[str]:
+        out = []
+        with self._lock:
+            for labels, counts in self._counts.items():
+                sep = "," if labels else ""
+                cum = 0
+                for i, edge in enumerate(self.buckets):
+                    cum += counts[i]
+                    out.append(f'{self.name}_bucket{{{labels}{sep}le="{edge}"}} {cum}')
+                cum += counts[-1]
+                out.append(f'{self.name}_bucket{{{labels}{sep}le="+Inf"}} {cum}')
+                out.append(f"{self.name}_sum{{{labels}}} {self._sums[labels]:.6f}")
+                out.append(f"{self.name}_count{{{labels}}} {self._totals[labels]}")
+        return out
+
+
+class Counter:
+    def __init__(self, name: str):
+        self.name = name
+        self._lock = threading.Lock()
+        self._vals: dict[str, float] = {}
+
+    def inc(self, n: float = 1, labels: str = "") -> None:
+        with self._lock:
+            self._vals[labels] = self._vals.get(labels, 0) + n
+
+    def get(self, labels: str = "") -> float:
+        with self._lock:
+            return self._vals.get(labels, 0)
+
+    def text(self) -> list[str]:
+        with self._lock:
+            return [
+                f"{self.name}{{{labels}}} {v:g}" if labels else f"{self.name} {v:g}"
+                for labels, v in self._vals.items()
+            ]
+
+
+def timed(hist: Histogram, labels: str = ""):
+    """Context manager: observe the block's wall time."""
+    import time
+
+    class _T:
+        def __enter__(self):
+            self.t0 = time.perf_counter()
+            return self
+
+        def __exit__(self, *exc):
+            hist.observe(time.perf_counter() - self.t0, labels)
+            return False
+
+    return _T()

@@ -6,6 +6,17 @@ pushes known traces, reads them back by id and via search, and emits
 
 Run: python -m tempo_tpu.vulture --push-url http://host:3200 \
         --query-url http://host:3200 --cycles 10 --interval 5
+
+Alert thresholds (what the reference's vulture dashboards page on):
+  - notfound_byid > 0 over 10m     -> CRITICAL: written traces are not
+    readable by id (ingest loss or find-path regression).
+  - missing_spans > 0 over 10m     -> CRITICAL: partial traces returned
+    (combiner/replication bug, not just a slow leg).
+  - notfound_search / requests > 0.01 over 30m -> WARNING: fresh traces
+    absent from search results (blocklist poll lag or search-path bug;
+    tolerate brief ingest->searchable delay).
+  - error rate (HTTP failures / requests) > 0.05 over 5m -> WARNING:
+    availability, usually ring/frontend health rather than data loss.
 """
 
 from __future__ import annotations

@@ -179,3 +179,75 @@ def test_jaeger_query_shim(server):
     sp = j["data"][0]["spans"][0]
     assert {"traceID", "spanID", "operationName", "startTime", "duration",
             "tags", "processID"} <= set(sp)
+
+
+def test_otlp_grpc_ingest(tmp_path):
+    """Push via OTLP gRPC (the default OTel exporter transport) to a
+    -target=all app and read the trace back by id over HTTP (reference:
+    receiver shim's gRPC receiver, modules/distributor/receiver/shim.go)."""
+    grpc = pytest.importorskip("grpc")
+    from tempo_tpu.wire import otlp_pb
+
+    cfg = AppConfig(
+        storage_path=str(tmp_path / "store"),
+        http_port=_free_port(),
+        otlp_grpc_port=-1,  # ephemeral
+        compaction_cycle_s=9999,
+        ingester=IngesterConfig(max_trace_idle_s=0.0, max_block_age_s=0.0,
+                                flush_check_period_s=9999),
+    )
+    app = App(cfg)
+    app.start()
+    app.serve_http(background=True)
+    try:
+        assert cfg.otlp_grpc_port > 0  # receiver bound an ephemeral port
+        ch = grpc.insecure_channel(f"127.0.0.1:{cfg.otlp_grpc_port}")
+        export = ch.unary_unary(
+            "/opentelemetry.proto.collector.trace.v1.TraceService/Export",
+            request_serializer=None, response_deserializer=None,
+        )
+        traces = make_traces(5, seed=31, n_spans=4)
+        for _, tr in traces:
+            # ExportTraceServiceRequest wire == TracesData wire
+            resp = export(otlp_pb.encode_trace(tr))
+            assert resp == b""
+        base = f"http://127.0.0.1:{cfg.http_port}"
+        tid, tr = traces[2]
+        with urllib.request.urlopen(f"{base}/api/traces/{tid.hex()}", timeout=10) as r:
+            got = otlp_json.loads(r.read())
+        assert got.span_count() == tr.span_count()
+        # malformed payload maps to INVALID_ARGUMENT, not a hung stream
+        with pytest.raises(grpc.RpcError) as ei:
+            export(b"\xff\xff\xff")
+        assert ei.value.code() in (grpc.StatusCode.INVALID_ARGUMENT,
+                                   grpc.StatusCode.INTERNAL)
+        ch.close()
+    finally:
+        app.stop()
+
+
+def test_metrics_depth(server):
+    """/metrics exposes latency histograms plus a broad counter set
+    (>=25 series) across roles (reference: promauto instrumentation on
+    every subsystem, distributor.go:56-103, poller.go:26-68)."""
+    app, base = server
+    # generate some traffic so histograms have observations
+    traces = make_traces(3, seed=77, n_spans=3)
+    for _, tr in traces:
+        req = urllib.request.Request(base + "/v1/traces",
+                                     data=otlp_json.dumps(tr).encode(),
+                                     headers={"Content-Type": "application/json"})
+        urllib.request.urlopen(req, timeout=10)
+    urllib.request.urlopen(f"{base}/api/search?limit=10", timeout=15)
+    urllib.request.urlopen(f"{base}/api/traces/{traces[0][0].hex()}", timeout=15)
+    with urllib.request.urlopen(base + "/metrics", timeout=10) as r:
+        text = r.read().decode()
+    lines = [l for l in text.splitlines() if l and not l.startswith("#")]
+    assert len(lines) >= 25, f"only {len(lines)} series"
+    assert any("tempo_distributor_push_duration_seconds_bucket" in l for l in lines)
+    assert any("tempo_frontend_query_duration_seconds_bucket" in l
+               and 'op="search"' in l for l in lines)
+    assert any("tempo_frontend_query_duration_seconds_bucket" in l
+               and 'op="traces"' in l for l in lines)
+    assert any(l.startswith("tempo_blocklist_polls_total") for l in lines)
+    assert any(l.startswith("tempo_blocklist_length") for l in lines)

@@ -7,6 +7,7 @@ golden cases, ingester lifecycle, frontend sharding) at the same seams.
 
 import threading
 import time
+from dataclasses import replace
 
 import numpy as np
 import pytest
@@ -297,3 +298,132 @@ def test_app_target_gating(tmp_path):
         App(AppConfig(target="distributor", storage_path=str(tmp_path / "s3")))
     with pytest.raises(ValueError):
         App(AppConfig(target="bogus", storage_path=str(tmp_path / "s4")))
+
+
+def test_frontend_find_shards_blocks(pipeline):
+    """Trace-by-ID over a many-block backend shards the candidate block
+    set into parallel find_blocks jobs and combines PARTIAL traces from
+    different shards (tracebyidsharding.go:30-48 analog)."""
+    db, ing, dist, q, fe = pipeline
+    # one trace whose spans are split across two blocks far apart in the
+    # candidate list, plus filler blocks so sharding kicks in
+    tid, tr = make_traces(1, seed=91, n_spans=8)[0]
+    spans = tr.resource_spans
+    from tempo_tpu.wire.model import Trace
+
+    part1, part2 = Trace(resource_spans=spans[:1]), Trace(resource_spans=spans[1:])
+    # pad the trace to have >=2 resource_spans for the split
+    if len(spans) < 2:
+        part1 = part2 = tr
+    db.write_block(TENANT, [(tid, part1)])
+    for i in range(40):
+        db.write_block(TENANT, sorted(make_traces(2, seed=200 + i, n_spans=2),
+                                      key=lambda t: t[0]))
+    db.write_block(TENANT, [(tid, part2)])
+
+    from tempo_tpu.services import frontend as fe_mod
+
+    calls = []
+    orig = q.find_in_blocks
+
+    def spy(tenant, trace_id, metas):
+        calls.append(len(metas))
+        return orig(tenant, trace_id, metas)
+
+    q.find_in_blocks = spy
+    n_candidates = len(db.find_candidates(TENANT, tid))
+    assert n_candidates >= 2  # both halves' blocks at minimum
+    old = fe_mod.FIND_SHARD_BLOCKS
+    fe_mod.FIND_SHARD_BLOCKS = 2  # force multiple shard jobs
+    try:
+        got = fe.find_trace_by_id(TENANT, tid)
+    finally:
+        fe_mod.FIND_SHARD_BLOCKS = old
+    assert got is not None
+    # the frontend must have issued one job per 2-block partition
+    assert len(calls) == -(-n_candidates // 2), calls
+    assert sum(calls) == n_candidates
+    if part1 is not part2:
+        assert got.span_count() == tr.span_count()  # partials combined
+
+
+def test_generator_shuffle_shard_disjoint():
+    """Two tenants route to DISJOINT generator subsets at ring size 2
+    (distributor.go:410-442 shuffle-sharded generator writes)."""
+    kv = InMemoryKV()
+    clients = {}
+    pushed = {}  # addr -> [(tenant, n_traces)]
+
+    class FakeGen:
+        def __init__(self, addr):
+            self.addr = addr
+
+        def push_generator(self, tenant, traces):
+            pushed.setdefault(self.addr, []).append((tenant, len(traces)))
+
+    for i in range(4):
+        lc = Lifecycler(kv, "generator-ring", f"gen-{i}", addr=f"gen-{i}:9095")
+        lc.join()
+        clients[f"gen-{i}:9095"] = FakeGen(f"gen-{i}:9095")
+    gen_ring = Ring(kv, "generator-ring")
+
+    # also a local ingester ring so pushes succeed
+    lc = Lifecycler(kv, "ing", "ing-0")
+    lc.join()
+
+    class FakeIng:
+        def push_segments(self, tenant, batch):
+            pass
+
+    ing_ring = Ring(kv, "ing")
+    clients[lc.desc.addr] = FakeIng()
+    ov = Overrides()
+    ov.defaults = replace(ov.defaults, metrics_generator_ring_size=2)
+    dist = Distributor(ing_ring, clients.__getitem__, ov, generator_ring=gen_ring)
+
+    # find two tenants with disjoint shuffle shards (deterministic)
+    names = [f"tenant-{i}" for i in range(40)]
+    subset = {n: frozenset(d.addr for d in gen_ring.shuffle_shard(n, 2)) for n in names}
+    pair = next(
+        (a, b) for a in names for b in names if not (subset[a] & subset[b])
+    )
+    for tenant in pair:
+        for tid, tr in make_traces(6, seed=hash(tenant) % 1000, n_spans=2):
+            dist.push(tenant, tr.resource_spans)
+
+    got = {t: set() for t in pair}
+    for addr, recs in pushed.items():
+        for tenant, _n in recs:
+            if tenant in got:
+                got[tenant].add(addr)
+    a, b = pair
+    assert got[a] and got[a] <= subset[a]
+    assert got[b] and got[b] <= subset[b]
+    assert not (got[a] & got[b])  # disjoint generator subsets
+
+
+def test_queue_querier_shuffle_shard(pipeline):
+    """With max_queriers_per_tenant=1, every job of a tenant is leased to
+    the SAME remote worker; the other attached worker never sees it
+    (pkg/scheduler/queue/user_queues.go)."""
+    db, ing, dist, q, _fe = pipeline
+    ov = Overrides()
+    ov.defaults = replace(ov.defaults, max_queriers_per_tenant=1)
+    fe = Frontend(q, n_workers=0, overrides=ov)  # dispatcher-only
+
+    # attach two workers (a poll registers the worker id)
+    assert fe.poll_job(wait_s=0.01, worker_id="w1") is None
+    assert fe.poll_job(wait_s=0.01, worker_id="w2") is None
+
+    from tempo_tpu.services.frontend import _Job
+
+    for i in range(6):
+        fe.queue.enqueue(TENANT, _Job(kind="search_recent", payload={},
+                                      fn=lambda: None, args=()))
+    leased = {"w1": 0, "w2": 0}
+    for _ in range(12):
+        for w in ("w1", "w2"):
+            job = fe.poll_job(wait_s=0.01, worker_id=w)
+            if job:
+                leased[w] += 1
+    assert sorted(leased.values()) == [0, 6], leased
