@@ -76,5 +76,11 @@ def _wrap(inner: RawBackend, cfg: dict) -> RawBackend:
     if cfg.get("hedge_requests_after_s"):
         inner = HedgedBackend(inner, hedge_after_s=float(cfg["hedge_requests_after_s"]))
     if cfg.get("cache", True) and cfg.get("cache_max_bytes", 1) != 0:
-        inner = CachedBackend(inner, max_bytes=int(cfg.get("cache_max_bytes", 256 << 20)))
+        external = None
+        if cfg.get("external_cache"):
+            from .extcache import open_external_cache
+
+            external = open_external_cache(cfg["external_cache"])
+        inner = CachedBackend(inner, max_bytes=int(cfg.get("cache_max_bytes", 256 << 20)),
+                              external=external)
     return inner

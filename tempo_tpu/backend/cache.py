@@ -26,14 +26,25 @@ MAX_CACHED_RANGE = 1 << 20  # ranges above 1 MiB are bulk column reads
 
 
 class CachedBackend(RawBackend):
-    def __init__(self, inner: RawBackend, max_bytes: int = 256 * 1024 * 1024):
+    def __init__(self, inner: RawBackend, max_bytes: int = 256 * 1024 * 1024,
+                 external=None):
+        """external: optional shared cache tier (backend/extcache.py
+        memcached/redis client) between the local LRU and the store, so
+        a querier fleet fetches each control object from object storage
+        once per cluster, not once per process."""
         self.inner = inner
         self.max_bytes = max_bytes
+        self.external = external
         self._lock = threading.Lock()
         self._lru: OrderedDict[tuple, bytes] = OrderedDict()
         self._bytes = 0
         self.hits = 0
         self.misses = 0
+        self.external_hits = 0
+
+    @staticmethod
+    def _ext_key(key: tuple) -> str:
+        return ":".join(str(p) for p in key)
 
     # ------------------------------------------------------------- cache
     @staticmethod
@@ -82,27 +93,37 @@ class CachedBackend(RawBackend):
     def write_tenant_object(self, tenant, name, data):
         self.inner.write_tenant_object(tenant, name, data)
 
+    def _read_tiered(self, key: tuple, fetch):
+        """local LRU -> external cache -> store, back-filling each
+        tier above the one that answered."""
+        data = self._get(key)
+        if data is not None:
+            return data
+        if self.external is not None:
+            data = self.external.get(self._ext_key(key))
+            if data is not None:
+                self.external_hits += 1
+                self._put(key, data)
+                return data
+        data = fetch()
+        self._put(key, data)
+        if self.external is not None:
+            self.external.set(self._ext_key(key), data)
+        return data
+
     def read(self, tenant, block_id, name):
         key = (tenant, block_id, name)
-        if self._cacheable(name):
-            data = self._get(key)
-            if data is not None:
-                return data
-        data = self.inner.read(tenant, block_id, name)
-        if self._cacheable(name):
-            self._put(key, data)
-        return data
+        if not self._cacheable(name):
+            return self.inner.read(tenant, block_id, name)
+        return self._read_tiered(key, lambda: self.inner.read(tenant, block_id, name))
 
     def read_range(self, tenant, block_id, name, offset, length):
         key = (tenant, block_id, name, offset, length)
-        if self._cacheable(name, length):
-            data = self._get(key)
-            if data is not None:
-                return data
-        data = self.inner.read_range(tenant, block_id, name, offset, length)
-        if self._cacheable(name, length):
-            self._put(key, data)
-        return data
+        if not self._cacheable(name, length):
+            return self.inner.read_range(tenant, block_id, name, offset, length)
+        return self._read_tiered(
+            key, lambda: self.inner.read_range(tenant, block_id, name, offset, length)
+        )
 
     def read_tenant_object(self, tenant, name):
         return self.inner.read_tenant_object(tenant, name)

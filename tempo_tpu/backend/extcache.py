@@ -1,0 +1,189 @@
+"""External cache clients: memcached and redis over raw sockets.
+
+The role of pkg/cache's memcached/redis clients in the reference: a
+querier FLEET shares one cache tier for blooms/dictionaries/footers, so
+a block's control objects are fetched from object storage once per
+cluster instead of once per process. No SDKs: the memcached text
+protocol and RESP are both line protocols a few dozen lines long.
+
+CachedBackend takes one of these as its second tier: local LRU ->
+external cache -> object store, populating both on the way back (the
+reference's cache.NewCache composition, tempodb/backend/cache/cache.go).
+Failures degrade to the store -- a cache outage must never fail reads.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+
+from ..util.hashing import fnv1a_32
+
+
+class _SocketPool:
+    """One pooled connection per address; callers borrow under a lock
+    (these protocols are request/response, one in flight per conn)."""
+
+    def __init__(self, addr: tuple[str, int], timeout: float):
+        self.addr = addr
+        self.timeout = timeout
+        self._lock = threading.Lock()
+        self._sock: socket.socket | None = None
+
+    def __enter__(self):
+        self._lock.acquire()
+        try:
+            if self._sock is None:
+                self._sock = socket.create_connection(self.addr, timeout=self.timeout)
+            return self._sock
+        except BaseException:
+            # __exit__ never runs when __enter__ raises: release here or
+            # the pool deadlocks forever after one failed connect
+            self._lock.release()
+            raise
+
+    def __exit__(self, exc_type, *a):
+        if exc_type is not None and self._sock is not None:
+            try:
+                self._sock.close()
+            finally:
+                self._sock = None
+        self._lock.release()
+        return False
+
+
+def _recv_line(sock: socket.socket) -> bytes:
+    out = bytearray()
+    while not out.endswith(b"\r\n"):
+        b = sock.recv(1)
+        if not b:
+            raise ConnectionError("cache connection closed")
+        out += b
+    return bytes(out[:-2])
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    out = bytearray()
+    while len(out) < n:
+        chunk = sock.recv(n - len(out))
+        if not chunk:
+            raise ConnectionError("cache connection closed")
+        out += chunk
+    return bytes(out)
+
+
+class MemcachedCache:
+    """Text-protocol client; keys shard across servers by fnv32 (the
+    reference's memcached client uses consistent jump-hashing; modulo
+    keeps the same one-server-owns-one-key property)."""
+
+    def __init__(self, addrs: list[str], timeout: float = 0.5,
+                 ttl_s: int = 3600, max_item_bytes: int = 1 << 20):
+        self.pools = []
+        for a in addrs:
+            host, _, port = a.partition(":")
+            self.pools.append(_SocketPool((host, int(port or 11211)), timeout))
+        self.ttl_s = ttl_s
+        self.max_item_bytes = max_item_bytes
+
+    def _pool(self, key: str) -> _SocketPool:
+        return self.pools[fnv1a_32(key.encode()) % len(self.pools)]
+
+    @staticmethod
+    def _safe_key(key: str) -> str:
+        """Memcached keys must be <=250 printable-ASCII bytes with no
+        whitespace; anything else desyncs the text protocol (a CRLF in a
+        key turns the value bytes into commands -- cross-key cache
+        poisoning). Unsafe or oversized keys map to a stable hash."""
+        if len(key) <= 240 and all(33 <= ord(c) <= 126 for c in key):
+            return key
+        import hashlib
+
+        return "h:" + hashlib.sha256(key.encode()).hexdigest()
+
+    def get(self, key: str) -> bytes | None:
+        key = self._safe_key(key)
+        try:
+            with self._pool(key) as sock:
+                sock.sendall(f"get {key}\r\n".encode())
+                line = _recv_line(sock)
+                if not line.startswith(b"VALUE"):
+                    return None  # END
+                n = int(line.rsplit(b" ", 1)[1])
+                data = _recv_exact(sock, n)
+                _recv_exact(sock, 2)  # \r\n
+                end = _recv_line(sock)
+                if end != b"END":
+                    raise ConnectionError(f"bad memcached tail {end!r}")
+                return data
+        except (OSError, ValueError, ConnectionError):
+            return None
+
+    def set(self, key: str, value: bytes) -> None:
+        if len(value) > self.max_item_bytes:
+            return
+        key = self._safe_key(key)
+        try:
+            with self._pool(key) as sock:
+                sock.sendall(
+                    f"set {key} 0 {self.ttl_s} {len(value)}\r\n".encode()
+                    + value + b"\r\n"
+                )
+                _recv_line(sock)  # STORED
+        except (OSError, ConnectionError):
+            pass
+
+
+class RedisCache:
+    """RESP client: GET/SETEX only."""
+
+    def __init__(self, addr: str, timeout: float = 0.5, ttl_s: int = 3600,
+                 max_item_bytes: int = 1 << 20):
+        host, _, port = addr.partition(":")
+        self.pool = _SocketPool((host, int(port or 6379)), timeout)
+        self.ttl_s = ttl_s
+        self.max_item_bytes = max_item_bytes
+
+    @staticmethod
+    def _cmd(parts: list[bytes]) -> bytes:
+        out = b"*%d\r\n" % len(parts)
+        for p in parts:
+            out += b"$%d\r\n%s\r\n" % (len(p), p)
+        return out
+
+    def get(self, key: str) -> bytes | None:
+        try:
+            with self.pool as sock:
+                sock.sendall(self._cmd([b"GET", key.encode()]))
+                line = _recv_line(sock)
+                if not line.startswith(b"$") or line == b"$-1":
+                    return None
+                n = int(line[1:])
+                data = _recv_exact(sock, n)
+                _recv_exact(sock, 2)
+                return data
+        except (OSError, ValueError, ConnectionError):
+            return None
+
+    def set(self, key: str, value: bytes) -> None:
+        if len(value) > self.max_item_bytes:
+            return
+        try:
+            with self.pool as sock:
+                sock.sendall(self._cmd(
+                    [b"SETEX", key.encode(), str(self.ttl_s).encode(), value]
+                ))
+                _recv_line(sock)  # +OK
+        except (OSError, ConnectionError):
+            pass
+
+
+def open_external_cache(cfg: dict):
+    """Config -> client: {"kind": "memcached", "addrs": [...]} or
+    {"kind": "redis", "addr": "host:port"}."""
+    kind = cfg.get("kind", "")
+    if kind == "memcached":
+        return MemcachedCache(cfg["addrs"], ttl_s=int(cfg.get("ttl_s", 3600)))
+    if kind == "redis":
+        return RedisCache(cfg["addr"], ttl_s=int(cfg.get("ttl_s", 3600)))
+    raise ValueError(f"unknown external cache kind {kind!r}")

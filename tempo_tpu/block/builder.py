@@ -451,13 +451,17 @@ def compute_row_groups(cols, start_ms, dur_us, row_group_spans):
     return axes, col_axis, row_groups
 
 
-def write_block(backend: RawBackend, fin: FinalizedBlock, level: int = 3) -> BlockMeta:
+def write_block(backend: RawBackend, fin: FinalizedBlock, level: int = 3,
+                codec: str = "zstd") -> BlockMeta:
     """Write all block objects; meta.json last so pollers never see a
-    partial block (reference writes meta last for the same reason)."""
+    partial block (reference writes meta last for the same reason).
+    codec selects the chunk compression (colio codec matrix); readers
+    dispatch per chunk, so mixed-codec backends are fine."""
     m = fin.meta
     app = backend.open_append(m.tenant_id, m.block_id, DATA_NAME)
     try:
-        for part in pack_columns_stream(fin.cols, fin.axes, fin.col_axis, level=level):
+        for part in pack_columns_stream(fin.cols, fin.axes, fin.col_axis,
+                                        level=level, codec=codec):
             app.append(part)
         app.close()
     except BaseException:

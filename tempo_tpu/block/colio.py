@@ -31,6 +31,43 @@ CODEC_RAW = "raw"
 CODEC_ZSTD = "zstd"
 _MIN_COMPRESS = 128
 
+# codec matrix (reference: tempodb/backend/encoding.go's nine codecs).
+# zstd is the default and the only one with a native threaded batch
+# path; the stdlib codecs trade ratio/CPU differently (gzip/zlib for
+# interop, lz4-class speed isn't in the stdlib so snappy/lz4 map to
+# "none" guidance in docs). Decode always dispatches on the chunk's
+# recorded codec, so blocks written with any codec stay readable.
+
+
+def _gzip_c(data: bytes, level: int) -> bytes:
+    import zlib
+
+    return zlib.compress(data, min(level, 9))
+
+
+def _gzip_d(data: bytes, raw_len: int) -> bytes:
+    import zlib
+
+    return zlib.decompress(data)
+
+
+def _lzma_c(data: bytes, level: int) -> bytes:
+    import lzma
+
+    return lzma.compress(data, preset=min(level, 6))
+
+
+def _lzma_d(data: bytes, raw_len: int) -> bytes:
+    import lzma
+
+    return lzma.decompress(data)
+
+
+_EXTRA_CODECS: dict[str, tuple] = {  # name -> (compress(data, level), decompress)
+    "gzip": (_gzip_c, _gzip_d),
+    "lzma": (_lzma_c, _lzma_d),
+}
+
 
 class AxisChunks:
     """Row boundaries of the row groups along one axis: offsets[g] ..
@@ -54,6 +91,7 @@ def pack_columns_stream(
     axes: dict[str, AxisChunks] | None = None,
     col_axis: dict[str, str] | None = None,
     level: int = 3,
+    codec: str = CODEC_ZSTD,
 ):
     """Yield the serialized pack as byte parts, ONE COLUMN AT A TIME
     (chunks of a column compress as one threaded native batch, then the
@@ -63,6 +101,11 @@ def pack_columns_stream(
     tracker (v2/streaming_block.go:13-90)."""
     axes = axes or {}
     col_axis = col_axis or {}
+    if codec not in (CODEC_ZSTD, CODEC_RAW) and codec not in _EXTRA_CODECS:
+        raise ValueError(
+            f"unknown codec {codec!r} (matrix: "
+            f"{[CODEC_RAW, CODEC_ZSTD, *sorted(_EXTRA_CODECS)]})"
+        )
     footer: dict = {"cols": {}, "axes": {k: v.offsets for k, v in axes.items()}}
     offset = 0
 
@@ -84,12 +127,14 @@ def pack_columns_stream(
             bounds = [(0, arr.shape[0] * row_bytes)]
         buf = arr.reshape(-1).view(np.uint8) if arr.size else np.empty(0, np.uint8)
 
-        # compress this column's compressible chunks in one threaded
-        # native batch STRAIGHT FROM the array's memory (no per-chunk
-        # source copies); python zstd as fallback
-        to_compress = [i for i, (lo, hi) in enumerate(bounds) if hi - lo >= _MIN_COMPRESS]
+        # compress this column's compressible chunks: zstd runs as one
+        # threaded native batch STRAIGHT FROM the array's memory (no
+        # per-chunk source copies, python zstd as fallback); the stdlib
+        # codec matrix handles the rest per chunk
+        to_compress = [i for i, (lo, hi) in enumerate(bounds)
+                       if hi - lo >= _MIN_COMPRESS and codec != CODEC_RAW]
         compressed: dict[int, bytes] = {}
-        if to_compress:
+        if to_compress and codec == CODEC_ZSTD:
             outs = zstd_compress_from(
                 buf,
                 np.asarray([bounds[i][0] for i in to_compress], np.int64),
@@ -101,16 +146,22 @@ def pack_columns_stream(
                 outs = [comp.compress(buf[bounds[i][0] : bounds[i][1]].tobytes())
                         for i in to_compress]
             compressed = dict(zip(to_compress, outs))
+        elif to_compress:
+            cfun = _EXTRA_CODECS[codec][0]  # unknown codec fails loudly here
+            compressed = {
+                i: cfun(buf[bounds[i][0] : bounds[i][1]].tobytes(), level)
+                for i in to_compress
+            }
 
         recs: list[list] = []
         for i, (lo, hi) in enumerate(bounds):
             raw_len = hi - lo
             z = compressed.get(i)
             if z is not None and len(z) < raw_len:
-                data, codec = z, CODEC_ZSTD
+                data, chunk_codec = z, codec
             else:
-                data, codec = buf[lo:hi].tobytes(), CODEC_RAW
-            recs.append([offset, len(data), raw_len, codec])
+                data, chunk_codec = buf[lo:hi].tobytes(), CODEC_RAW
+            recs.append([offset, len(data), raw_len, chunk_codec])
             offset += len(data)
             yield data
         footer["cols"][name] = {
@@ -130,10 +181,11 @@ def pack_columns(
     axes: dict[str, AxisChunks] | None = None,
     col_axis: dict[str, str] | None = None,
     level: int = 3,
+    codec: str = CODEC_ZSTD,
 ) -> bytes:
     """Serialize columns. Columns named in col_axis are chunked along the
     given axis' row groups; others are stored as a single chunk."""
-    return b"".join(pack_columns_stream(cols, axes, col_axis, level))
+    return b"".join(pack_columns_stream(cols, axes, col_axis, level, codec))
 
 
 class ColumnPack:
@@ -207,6 +259,8 @@ class ColumnPack:
         self.bytes_read += stored_len
         if codec == CODEC_ZSTD:
             data = self._dctx.decompress(data, max_output_size=raw_len)
+        elif codec != CODEC_RAW:
+            data = _EXTRA_CODECS[codec][1](data, raw_len)  # codec matrix
         self._cache_put(off, data)
         return data
 
@@ -327,6 +381,8 @@ class ColumnPack:
                     z_offs.append(pos)
                     z_lens.append(raw_len)
                 else:
+                    if codec != CODEC_RAW:
+                        data = _EXTRA_CODECS[codec][1](data, raw_len)
                     raw_parts.append((pos, data))
                 pos += raw_len
         dst = np.empty(pos, dtype=np.uint8)

@@ -361,4 +361,6 @@ class BackendBlock:
 
 def open_block(backend: RawBackend, tenant: str, block_id: str) -> BackendBlock:
     meta = BlockMeta.from_json(backend.read(tenant, block_id, "meta.json"))
-    return BackendBlock(backend, meta)
+    from .versioned import open_block_versioned
+
+    return open_block_versioned(backend, meta)

@@ -1,0 +1,59 @@
+"""Versioned block-encoding registry: the format-evolution seam.
+
+Reference: tempodb/encoding/versioned.go:17-46 -- every complete block
+carries its encoding version in meta.json; readers dispatch through a
+registry (FromVersion/OpenBlock) so new formats can ship while old
+blocks stay readable, and an unknown version fails loudly instead of
+misparsing bytes.
+
+Here `vtpu1` (block/{builder,reader,colio}) is the current format.
+Introducing `vtpu2` means registering a second opener -- nothing above
+this seam (TempoDB, search, compaction inputs) names a concrete reader
+class. Compaction OUTPUT always writes the latest version, which is how
+old formats age out of a backend, same as the reference's compactors.
+"""
+
+from __future__ import annotations
+
+from ..backend.base import RawBackend
+from .meta import BlockMeta
+
+CURRENT_VERSION = "vtpu1"
+
+
+class UnknownVersion(Exception):
+    def __init__(self, version: str):
+        super().__init__(
+            f"unknown block encoding version {version!r} "
+            f"(supported: {sorted(_ENCODINGS)}); refusing to misparse"
+        )
+        self.version = version
+
+
+_ENCODINGS: dict[str, object] = {}
+
+
+def register_encoding(version: str, opener) -> None:
+    """opener(backend, meta) -> block reader object."""
+    _ENCODINGS[version] = opener
+
+
+def open_block_versioned(backend: RawBackend, meta: BlockMeta):
+    """The FromVersion dispatch: meta.version selects the reader."""
+    opener = _ENCODINGS.get(meta.version or CURRENT_VERSION)
+    if opener is None:
+        raise UnknownVersion(meta.version)
+    return opener(backend, meta)
+
+
+def supported_versions() -> list[str]:
+    return sorted(_ENCODINGS)
+
+
+def _open_vtpu1(backend: RawBackend, meta: BlockMeta):
+    from .reader import BackendBlock
+
+    return BackendBlock(backend, meta)
+
+
+register_encoding("vtpu1", _open_vtpu1)

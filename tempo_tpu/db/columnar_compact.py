@@ -406,7 +406,11 @@ def _assemble(tenant: str, sources: list[_Source], chunks: list[tuple[int, int, 
 
 def compact_columnar(backend: RawBackend, job: CompactionJob, cfg: CompactorConfig) -> CompactionResult:
     tenant = job.tenant
-    blocks = [BackendBlock(backend, m) for m in job.blocks]
+    from ..block.versioned import open_block_versioned
+
+    # version dispatch: an unknown-format input must fail the job
+    # loudly, never be misparsed as vtpu1 bytes
+    blocks = [open_block_versioned(backend, m) for m in job.blocks]
     sources = [_Source.from_block(b) for b in blocks]
     names = set(sources[0].cols)
     if any(set(s.cols) != names for s in sources[1:]):

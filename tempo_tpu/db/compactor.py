@@ -164,7 +164,9 @@ def _compact_wire(backend: RawBackend, job: CompactionJob, cfg: CompactorConfig)
     """Wire-model merge: every trace decodes to the wire model and
     re-encodes through the builder. Correct for any inputs; used as the
     columnar fast path's fallback."""
-    blocks = [BackendBlock(backend, m) for m in job.blocks]
+    from ..block.versioned import open_block_versioned
+
+    blocks = [open_block_versioned(backend, m) for m in job.blocks]
     out_level = max(m.compaction_level for m in job.blocks) + 1
     builder = BlockBuilder(
         job.tenant,

@@ -87,7 +87,9 @@ class TempoDB:
         with self._cache_lock:
             blk = self._block_cache.get(key)
             if blk is None:
-                blk = BackendBlock(self.backend, meta)
+                from ..block.versioned import open_block_versioned
+
+                blk = open_block_versioned(self.backend, meta)
                 # cached readers are long-lived over immutable blocks:
                 # mark them device-worthy so search_block's auto mode
                 # stages (and keeps) their columns on the accelerator

@@ -54,7 +54,9 @@ def handler(event: dict) -> dict:
         from .backend.base import meta_name
 
         meta = BlockMeta.from_json(backend.read(tenant, block_id, meta_name()))
-        blk = BackendBlock(backend, meta)
+        from .block.versioned import open_block_versioned
+
+        blk = open_block_versioned(backend, meta)
         with _lock:
             _blocks[cache_key] = blk
             while len(_blocks) > _MAX_CACHED_BLOCKS:

@@ -202,6 +202,9 @@ class App:
             comp_ring = Ring(self.kv, COMPACTOR_RING)
             self.compactor = Compactor(self.db, comp_ring, cfg.instance_id,
                                        cycle_s=cfg.compaction_cycle_s)
+        from .usagestats import UsageReporter
+
+        self.usage = UsageReporter(self.db.backend, cfg.target)
         self._started = False
         self.otlp_grpc = None
         self.http_server: ThreadingHTTPServer | None = None
@@ -353,6 +356,8 @@ def _make_handler(app: App):
                     return self._send(200, _metrics_text(app), "text/plain")
                 if u.path == "/status/config":
                     return self._send(200, json.dumps(_config_dict(app.cfg), indent=2))
+                if u.path == "/status/usage-stats":
+                    return self._send(200, json.dumps(app.usage.report(app), indent=2))
                 if app.querier is None:
                     return self._err(404, f"target {app.cfg.target} serves no query API")
                 tenant = app.tenant_of(self.headers)

@@ -43,6 +43,59 @@ class LiveTrace:
     last_append: float = 0.0
     start_s: int = 0
     end_s: int = 0
+    # lazy search index (see _SearchEntry): built on first search touch,
+    # reused until a new segment arrives
+    search_index: object = None
+    indexed_segments: int = 0
+
+
+@dataclass
+class _SearchEntry:
+    """Per-trace search index: the role of the reference's flatbuffer
+    search data (tempodb/search/) -- tag kv pairs, names, time range and
+    result fields extracted ONCE, so repeated live searches never
+    re-decode segments. Built lazily at first search (zero ingest-path
+    cost; the decode amortizes across every later query) and invalidated
+    by segment appends."""
+
+    kv: set  # lowered (key, value) pairs across span+resource attrs
+    names: set  # span names
+    start_ns: int
+    dur_ms: int
+    root_service: str
+    root_name: str
+
+    @classmethod
+    def build(cls, tr: Trace) -> "_SearchEntry":
+        kv: set = set()
+        names: set = set()
+        root = None
+        for res, _, sp in tr.all_spans():
+            if root is None:
+                root = (res.service_name, sp.name)
+            names.add(sp.name)
+            for k, v in sp.attrs.items():
+                kv.add((k, str(v).lower()))
+            for k, v in res.attrs.items():
+                kv.add((k, str(v).lower()))
+        lo, hi = tr.time_range_nanos()
+        return cls(
+            kv=kv,
+            names=names,
+            start_ns=lo or 0,
+            dur_ms=max(0, ((hi or 0) - (lo or 0)) // 1_000_000),
+            root_service=root[0] if root else "",
+            root_name=root[1] if root else "",
+        )
+
+    def matches_tags(self, tags: dict[str, str]) -> bool:
+        for k, v in tags.items():
+            if k == "name":
+                if v not in self.names:
+                    return False
+            elif (k, v.lower()) not in self.kv:
+                return False
+        return True
 
 
 @dataclass
@@ -194,10 +247,31 @@ class Instance:
             return None
         return sort_trace(combine_traces([segment_to_trace(s) for s in segs]))
 
+    def _index_of(self, lt: LiveTrace) -> tuple[_SearchEntry, Trace | None]:
+        """The trace's search index, (re)built only when segments arrived
+        since the last build. Returns (entry, decoded trace when this
+        call had to decode, else None) so callers needing the full trace
+        (TraceQL) never decode twice. The segment snapshot is taken
+        under the instance lock: a segment appended mid-build must not
+        be counted as indexed."""
+        with self.lock:
+            segs = list(lt.segments)
+            idx = lt.search_index
+            if idx is not None and lt.indexed_segments == len(segs):
+                return idx, None
+        tr = sort_trace(combine_traces([segment_to_trace(s) for s in segs]))
+        idx = _SearchEntry.build(tr)
+        with self.lock:
+            lt.search_index = idx
+            lt.indexed_segments = len(segs)
+        return idx, tr
+
     def search_live(self, req: SearchRequest) -> SearchResponse:
-        """Linear scan of live + cut traces (the reference's live-trace
-        search leg, instance_search.go); N is bounded by live-trace
-        limits so the host loop is fine."""
+        """Live + cut traces answered from the incremental per-trace
+        search index (the reference's tempodb/search data role): tag,
+        duration and time predicates never re-decode segments; only
+        TraceQL queries evaluate on the decoded trace, and only for
+        traces that survive the time filter."""
         from ..traceql.hosteval import trace_matches
         from ..traceql.parser import parse
 
@@ -210,46 +284,31 @@ class Instance:
                 continue
             if req.end and lt.start_s > req.end:
                 continue
-            tr = sort_trace(combine_traces([segment_to_trace(s) for s in lt.segments]))
-            if q is not None and not trace_matches(q, tr):
+            idx, decoded = self._index_of(lt)
+            if req.tags and not idx.matches_tags(req.tags):
                 continue
-            if req.tags and not _tags_match(tr, req.tags):
+            if req.min_duration_ms and idx.dur_ms < req.min_duration_ms:
                 continue
-            lo, hi = tr.time_range_nanos()
-            dur_ms = max(0, ((hi or 0) - (lo or 0)) // 1_000_000)
-            if req.min_duration_ms and dur_ms < req.min_duration_ms:
+            if req.max_duration_ms and idx.dur_ms > req.max_duration_ms:
                 continue
-            if req.max_duration_ms and dur_ms > req.max_duration_ms:
-                continue
-            root = next(iter(tr.all_spans()), None)
+            if q is not None:
+                tr = decoded if decoded is not None else sort_trace(
+                    combine_traces([segment_to_trace(s) for s in lt.segments])
+                )
+                if not trace_matches(q, tr):
+                    continue
             resp.traces.append(
                 SearchResult(
                     trace_id=lt.trace_id.hex(),
-                    root_service_name=root[0].service_name if root else "",
-                    root_trace_name=root[2].name if root else "",
-                    start_time_unix_nano=lo or 0,
-                    duration_ms=dur_ms,
+                    root_service_name=idx.root_service,
+                    root_trace_name=idx.root_name,
+                    start_time_unix_nano=idx.start_ns,
+                    duration_ms=idx.dur_ms,
                 )
             )
             if len(resp.traces) >= (req.limit or 20):
                 break
         return resp
-
-
-def _tags_match(tr: Trace, tags: dict[str, str]) -> bool:
-    for k, v in tags.items():
-        hit = False
-        for res, _, sp in tr.all_spans():
-            if k == "name":
-                hit = sp.name == v
-            else:
-                av = sp.attrs.get(k, res.attrs.get(k))
-                hit = av is not None and str(av).lower() == v.lower()
-            if hit:
-                break
-        if not hit:
-            return False
-    return True
 
 
 class Ingester:
@@ -263,6 +322,8 @@ class Ingester:
         self.instances: dict[str, Instance] = {}
         self.lock = threading.Lock()
         self._stop = threading.Event()
+        self._flush_retry_at: dict[str, float] = {}
+        self._flush_backoff: dict[str, float] = {}
         self._sweeper: threading.Thread | None = None
         self.replayed_blocks = 0
 
@@ -327,14 +388,34 @@ class Ingester:
     def sweep_all(self, force: bool = False) -> None:
         with self.lock:
             insts = list(self.instances.values())
+        now = time.time()
         for inst in insts:
             inst.cut_complete_traces(force=force)
-            inst.cut_block_if_ready(force=force)
+            # per-tenant exponential backoff after a failed flush
+            # (reference: flushqueues retry-with-backoff, flush.go:62-67)
+            # -- a broken backend must not be hammered every sweep, and
+            # one tenant's failures must not skip the others' cuts
+            key = inst.tenant
+            if not force and now < self._flush_retry_at.get(key, 0.0):
+                continue
+            try:
+                inst.cut_block_if_ready(force=force)
+                self._flush_retry_at.pop(key, None)
+                self._flush_backoff.pop(key, None)
+            except Exception:
+                if force:
+                    raise
+                backoff = min(self._flush_backoff.get(key, 1.0) * 2, 60.0)
+                self._flush_backoff[key] = backoff
+                self._flush_retry_at[key] = now + backoff
 
     def start_sweeper(self) -> None:
         def loop():
             while not self._stop.wait(self.cfg.flush_check_period_s):
-                self.sweep_all()
+                try:
+                    self.sweep_all()
+                except Exception:  # noqa: BLE001 - sweeper must survive
+                    pass
 
         self._sweeper = threading.Thread(target=loop, daemon=True, name="ingester-sweep")
         self._sweeper.start()

@@ -251,3 +251,16 @@ def test_metrics_depth(server):
                and 'op="traces"' in l for l in lines)
     assert any(l.startswith("tempo_blocklist_polls_total") for l in lines)
     assert any(l.startswith("tempo_blocklist_length") for l in lines)
+
+
+def test_usage_stats(server):
+    """Cluster seed persists in the backend; /status/usage-stats serves
+    the report (reference: pkg/usagestats, deployment-local here)."""
+    app, base = server
+    with urllib.request.urlopen(base + "/status/usage-stats", timeout=10) as r:
+        rep = json.loads(r.read())
+    assert rep["clusterID"] and rep["target"] == "all"
+    assert "blocklist_length" in rep["metrics"]
+    # stable across reads (seed persisted, not regenerated)
+    with urllib.request.urlopen(base + "/status/usage-stats", timeout=10) as r:
+        assert json.loads(r.read())["clusterID"] == rep["clusterID"]

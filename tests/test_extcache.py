@@ -1,0 +1,148 @@
+"""External cache tier: memcached text protocol + RESP clients against
+in-process fake servers, and the tiered CachedBackend composition."""
+
+import socketserver
+import threading
+
+import pytest
+
+from tempo_tpu.backend.cache import CachedBackend
+from tempo_tpu.backend.extcache import MemcachedCache, RedisCache, open_external_cache
+from tempo_tpu.backend.mem import MemBackend
+
+
+class _FakeMemcached(socketserver.StreamRequestHandler):
+    store: dict[bytes, bytes] = {}
+
+    def handle(self):
+        while True:
+            line = self.rfile.readline()
+            if not line:
+                return
+            parts = line.strip().split()
+            if parts and parts[0] == b"get":
+                val = self.store.get(parts[1])
+                if val is not None:
+                    self.wfile.write(b"VALUE %s 0 %d\r\n%s\r\nEND\r\n" % (parts[1], len(val), val))
+                else:
+                    self.wfile.write(b"END\r\n")
+            elif parts and parts[0] == b"set":
+                n = int(parts[4])
+                data = self.rfile.read(n)
+                self.rfile.read(2)
+                self.store[parts[1]] = data
+                self.wfile.write(b"STORED\r\n")
+            else:
+                self.wfile.write(b"ERROR\r\n")
+
+
+class _FakeRedis(socketserver.StreamRequestHandler):
+    store: dict[bytes, bytes] = {}
+
+    def _read_cmd(self):
+        line = self.rfile.readline()
+        if not line or not line.startswith(b"*"):
+            return None
+        n = int(line[1:].strip())
+        parts = []
+        for _ in range(n):
+            ln = int(self.rfile.readline()[1:].strip())
+            parts.append(self.rfile.read(ln))
+            self.rfile.read(2)
+        return parts
+
+    def handle(self):
+        while True:
+            cmd = self._read_cmd()
+            if cmd is None:
+                return
+            if cmd[0].upper() == b"GET":
+                val = self.store.get(cmd[1])
+                if val is None:
+                    self.wfile.write(b"$-1\r\n")
+                else:
+                    self.wfile.write(b"$%d\r\n%s\r\n" % (len(val), val))
+            elif cmd[0].upper() == b"SETEX":
+                self.store[cmd[1]] = cmd[3]
+                self.wfile.write(b"+OK\r\n")
+            else:
+                self.wfile.write(b"-ERR\r\n")
+
+
+def _serve(handler_cls):
+    srv = socketserver.ThreadingTCPServer(("127.0.0.1", 0), handler_cls)
+    srv.daemon_threads = True
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv, f"127.0.0.1:{srv.server_address[1]}"
+
+
+@pytest.fixture()
+def memcached():
+    _FakeMemcached.store = {}
+    srv, addr = _serve(_FakeMemcached)
+    yield addr
+    srv.shutdown()
+
+
+@pytest.fixture()
+def redis():
+    _FakeRedis.store = {}
+    srv, addr = _serve(_FakeRedis)
+    yield addr
+    srv.shutdown()
+
+
+def test_memcached_roundtrip(memcached):
+    c = MemcachedCache([memcached])
+    assert c.get("k1") is None
+    c.set("k1", b"\x00\x01bloom-bytes")
+    assert c.get("k1") == b"\x00\x01bloom-bytes"
+    # oversized values are refused, not errors
+    c.set("big", b"x" * (2 << 20))
+    assert c.get("big") is None
+
+
+def test_redis_roundtrip(redis):
+    c = RedisCache(redis)
+    assert c.get("k") is None
+    c.set("k", b"DICT")
+    assert c.get("k") == b"DICT"
+
+
+def test_cache_down_degrades():
+    """A dead cache server degrades to misses/no-ops, never errors."""
+    c = MemcachedCache(["127.0.0.1:1"])  # nothing listens there
+    assert c.get("k") is None
+    c.set("k", b"v")  # swallowed
+    r = RedisCache("127.0.0.1:1")
+    assert r.get("k") is None
+
+
+def test_tiered_cached_backend(memcached):
+    """Fleet semantics: a SECOND process (fresh local LRU) finds control
+    objects in the shared external tier without touching the store."""
+    ext = open_external_cache({"kind": "memcached", "addrs": [memcached]})
+    store = MemBackend()
+    store.write("t", "b", "bloom-0", b"BLOOM")
+
+    class Counting(MemBackend):
+        pass
+
+    c1 = CachedBackend(store, external=ext)
+    assert c1.read("t", "b", "bloom-0") == b"BLOOM"  # miss -> store, fills both
+
+    reads = []
+    orig = store.read
+
+    def spy(tenant, block_id, name):
+        reads.append(name)
+        return orig(tenant, block_id, name)
+
+    store.read = spy
+    c2 = CachedBackend(store, external=ext)  # "another querier process"
+    assert c2.read("t", "b", "bloom-0") == b"BLOOM"
+    assert reads == []  # answered by the external tier
+    assert c2.external_hits == 1
+    # and now it's in c2's local LRU too
+    assert c2.read("t", "b", "bloom-0") == b"BLOOM"
+    assert c2.hits == 1

@@ -427,3 +427,46 @@ def test_queue_querier_shuffle_shard(pipeline):
             if job:
                 leased[w] += 1
     assert sorted(leased.values()) == [0, 6], leased
+
+
+def test_ingester_flush_backoff(tmp_path):
+    """A failing block flush backs off exponentially per tenant instead
+    of retrying every sweep, and recovers once the backend heals
+    (reference: flushqueues retry-with-backoff, flush.go:62-67)."""
+    db = TempoDB(TempoDBConfig(wal_path=str(tmp_path / "dw")), backend=MemBackend())
+    ing = Ingester(WAL(str(tmp_path / "w")), db, Overrides(),
+                   IngesterConfig(max_trace_idle_s=0.0, max_block_age_s=0.0))
+    from tempo_tpu.wire.segment import segment_for_write
+
+    traces = make_traces(4, seed=5, n_spans=3)
+    batch = []
+    for tid, tr in traces:
+        lo, hi = tr.time_range_nanos()
+        batch.append((tid, lo // 10**9, hi // 10**9 + 1,
+                      segment_for_write(tr, lo // 10**9, hi // 10**9 + 1)))
+    ing.push_segments(TENANT, batch)
+
+    calls = []
+    orig = db.write_block
+
+    def failing(tenant, trs):
+        calls.append(time.time())
+        raise OSError("backend down")
+
+    db.write_block = failing
+    ing.sweep_all()  # first failure -> backoff armed
+    n1 = len(calls)
+    assert n1 == 1
+    ing.sweep_all()  # inside backoff window: no retry
+    assert len(calls) == n1
+    ing._flush_retry_at[TENANT] = 0.0  # window elapsed
+    ing.sweep_all()
+    assert len(calls) == n1 + 1
+    assert ing._flush_backoff[TENANT] == 4.0  # doubled
+
+    db.write_block = orig  # backend heals
+    ing._flush_retry_at[TENANT] = 0.0
+    ing.sweep_all()
+    assert len(db.blocklist.metas(TENANT)) == 1
+    assert TENANT not in ing._flush_backoff  # state cleared
+    db.close()
