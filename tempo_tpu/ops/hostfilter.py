@@ -139,14 +139,39 @@ def eval_block_host(
 
     span_off = cols.get("trace.span_off")
 
+    # (mask, counts) memo holding STRONG refs: tracify and the final
+    # counts usually fold the same union mask; identity on live objects
+    # can't alias, unlike id() keys of freed temporaries
+    seg_memo: list[tuple[np.ndarray, np.ndarray]] = []
+
     def seg_counts(span_mask):
-        """Matched spans per trace; offset scan when grouped, else bincount."""
+        """Matched spans per trace: one reduceat over the grouped span
+        axis (5x a cumsum scan), else bincount by trace sid."""
+        for m, c in seg_memo:
+            if m is span_mask:
+                return c
         if span_off is not None:
-            ecs = np.concatenate([[0], np.cumsum(span_mask)])
-            return ecs[span_off[1:]] - ecs[span_off[:-1]]
-        hit = tsid[span_mask]
-        hit = hit[(hit >= 0) & (hit < n_traces)]
-        return np.bincount(hit, minlength=n_traces)
+            if n_spans == 0 or span_off.shape[0] <= 1:
+                out = np.zeros(n_traces, dtype=np.int64)
+            else:
+                # sentinel-padded reduceat: starts may legally equal
+                # n_spans (sliced row-group shards clip trailing
+                # offsets), and reduceat yields mask[start] for empty
+                # segments -- the zero sentinel makes both exact
+                padded = np.concatenate(
+                    [span_mask.astype(np.int64), np.zeros(1, np.int64)]
+                )
+                starts = np.minimum(span_off[:-1], n_spans)
+                out = np.add.reduceat(padded, starts)
+                empty = span_off[1:] == span_off[:-1]
+                if empty.any():
+                    out[empty] = 0
+        else:
+            h = tsid[span_mask]
+            h = h[(h >= 0) & (h < n_traces)]
+            out = np.bincount(h, minlength=n_traces)
+        seg_memo.append((span_mask, out))
+        return out
 
     def tracify(span_mask):
         return seg_counts(span_mask) > 0
