@@ -455,6 +455,18 @@ def _make_handler(app: App):
                         tr = otlp_pb.decode_trace(body)
                     app.distributor.push(tenant, tr.resource_spans)
                     return self._send(200, "{}")
+                if u.path == "/api/traces":  # Jaeger collector thrift ingest
+                    if app.distributor is None:
+                        return self._err(404, f"target {app.cfg.target} does not ingest")
+                    from ..wire import jaeger_thrift
+
+                    tenant = app.tenant_of(self.headers)
+                    try:
+                        rs = jaeger_thrift.decode_batch(body)
+                    except jaeger_thrift.ThriftError as e:
+                        return self._err(400, f"bad thrift payload: {e}")
+                    app.distributor.push(tenant, [rs])
+                    return self._send(202, "")
                 if u.path == "/api/v2/spans":  # Zipkin v2 JSON ingest
                     if app.distributor is None:
                         return self._err(404, f"target {app.cfg.target} does not ingest")

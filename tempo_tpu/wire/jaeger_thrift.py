@@ -1,0 +1,178 @@
+"""Jaeger thrift-binary ingest codec.
+
+Reference: the receiver shim's jaeger receiver accepts thrift Batch
+payloads on /api/traces (modules/distributor/receiver/shim.go; the
+jaeger collector's HTTP endpoint). This is a hand-rolled thrift BINARY
+protocol reader (the wire format is a public spec: typed fields with
+i16 ids, length-prefixed strings, typed lists) feeding the same wire
+model OTLP ingest uses -- no thrift toolchain.
+
+Model (jaeger.thrift): Batch{1:Process, 2:[Span]};
+Process{1:serviceName, 2:[Tag]}; Span{1:traceIdLow, 2:traceIdHigh,
+3:spanId, 4:parentSpanId, 5:operationName, 6:[SpanRef], 7:flags,
+8:startTime us, 9:duration us, 10:[Tag], 11:[Log]};
+Tag{1:key, 2:vType, 3:vStr, 4:vDouble, 5:vBool, 6:vLong, 7:vBinary};
+SpanRef{1:refType, 2:traceIdLow, 3:traceIdHigh, 4:spanId}.
+"""
+
+from __future__ import annotations
+
+import struct
+
+from .model import (
+    Event,
+    Link,
+    Resource,
+    ResourceSpans,
+    Scope,
+    ScopeSpans,
+    Span,
+    SpanKind,
+    StatusCode,
+)
+
+# thrift binary type codes
+_STOP, _BOOL, _BYTE, _DOUBLE, _I16, _I32, _I64 = 0, 2, 3, 4, 6, 8, 10
+_STRING, _STRUCT, _MAP, _SET, _LIST = 11, 12, 13, 14, 15
+
+
+class ThriftError(ValueError):
+    pass
+
+
+class _Reader:
+    def __init__(self, data: bytes):
+        self.data = data
+        self.pos = 0
+
+    def _take(self, n: int) -> bytes:
+        if self.pos + n > len(self.data):
+            raise ThriftError("truncated thrift payload")
+        out = self.data[self.pos : self.pos + n]
+        self.pos += n
+        return out
+
+    def read(self, ttype: int):
+        if ttype == _BOOL:
+            return self._take(1)[0] != 0
+        if ttype == _BYTE:
+            return self._take(1)[0]
+        if ttype == _DOUBLE:
+            return struct.unpack(">d", self._take(8))[0]
+        if ttype == _I16:
+            return struct.unpack(">h", self._take(2))[0]
+        if ttype == _I32:
+            return struct.unpack(">i", self._take(4))[0]
+        if ttype == _I64:
+            return struct.unpack(">q", self._take(8))[0]
+        if ttype == _STRING:
+            (n,) = struct.unpack(">i", self._take(4))
+            if n < 0:
+                raise ThriftError("negative string length")
+            return self._take(n)
+        if ttype == _STRUCT:
+            return self.read_struct()
+        if ttype in (_LIST, _SET):
+            et = self._take(1)[0]
+            (n,) = struct.unpack(">i", self._take(4))
+            if n < 0:
+                raise ThriftError("negative list length")
+            return [self.read(et) for _ in range(n)]
+        if ttype == _MAP:
+            kt, vt = self._take(1)[0], self._take(1)[0]
+            (n,) = struct.unpack(">i", self._take(4))
+            return {self.read(kt): self.read(vt) for _ in range(max(0, n))}
+        raise ThriftError(f"unsupported thrift type {ttype}")
+
+    def read_struct(self) -> dict[int, object]:
+        out: dict[int, object] = {}
+        while True:
+            ttype = self._take(1)[0]
+            if ttype == _STOP:
+                return out
+            (fid,) = struct.unpack(">h", self._take(2))
+            out[fid] = self.read(ttype)
+
+
+def _tags_to_attrs(tags) -> dict:
+    attrs = {}
+    for t in tags or []:
+        key = (t.get(1) or b"").decode("utf-8", "replace")
+        vtype = t.get(2, 0)
+        if vtype == 0:
+            attrs[key] = (t.get(3) or b"").decode("utf-8", "replace")
+        elif vtype == 1:
+            attrs[key] = float(t.get(4, 0.0))
+        elif vtype == 2:
+            attrs[key] = bool(t.get(5, False))
+        elif vtype == 3:
+            attrs[key] = int(t.get(6, 0))
+        else:  # binary: hex like the reference's translator
+            attrs[key] = (t.get(7) or b"").hex()
+    return attrs
+
+
+_KIND_MAP = {
+    "client": SpanKind.CLIENT, "server": SpanKind.SERVER,
+    "producer": SpanKind.PRODUCER, "consumer": SpanKind.CONSUMER,
+    "internal": SpanKind.INTERNAL,
+}
+
+
+def decode_batch(data: bytes) -> ResourceSpans:
+    """One thrift Batch -> one ResourceSpans (Process == resource)."""
+    r = _Reader(data)
+    batch = r.read_struct()
+    process = batch.get(1) or {}
+    service = (process.get(1) or b"").decode("utf-8", "replace")
+    res_attrs = _tags_to_attrs(process.get(2))
+    res_attrs["service.name"] = service
+
+    spans = []
+    for s in batch.get(2) or []:
+        tid = (int(s.get(2, 0)) & (2**64 - 1)).to_bytes(8, "big") + \
+              (int(s.get(1, 0)) & (2**64 - 1)).to_bytes(8, "big")
+        parent = int(s.get(4, 0)) & (2**64 - 1)
+        links: list[Link] = []
+        for ref in s.get(6) or []:
+            ref_tid = ((int(ref.get(3, 0)) & (2**64 - 1)).to_bytes(8, "big")
+                       + (int(ref.get(2, 0)) & (2**64 - 1)).to_bytes(8, "big"))
+            ref_sid = (int(ref.get(4, 0)) & (2**64 - 1)).to_bytes(8, "big")
+            if ref.get(1, 0) == 0 and not parent:  # CHILD_OF -> parent
+                parent = int(ref.get(4, 0)) & (2**64 - 1)
+            elif ref.get(1, 0) != 0:  # FOLLOWS_FROM -> link (otel mapping)
+                links.append(Link(trace_id=ref_tid, span_id=ref_sid,
+                                  attrs={"jaeger.ref_type": "follows_from"}))
+        events = [  # Jaeger logs -> otel events (the standard translator)
+            Event(
+                time_unix_nano=int(log.get(1, 0)) * 1000,
+                name="log",
+                attrs=_tags_to_attrs(log.get(2)),
+            )
+            for log in s.get(11) or []
+        ]
+        attrs = _tags_to_attrs(s.get(10))
+        kind = _KIND_MAP.get(str(attrs.pop("span.kind", "")).lower(),
+                             SpanKind.INTERNAL)
+        status = StatusCode.UNSET
+        if str(attrs.get("error", "")).lower() in ("true", "1"):
+            status = StatusCode.ERROR
+        start_us = int(s.get(8, 0))
+        dur_us = int(s.get(9, 0))
+        spans.append(Span(
+            trace_id=tid,
+            span_id=(int(s.get(3, 0)) & (2**64 - 1)).to_bytes(8, "big"),
+            parent_span_id=parent.to_bytes(8, "big") if parent else b"",
+            name=(s.get(5) or b"").decode("utf-8", "replace"),
+            kind=kind,
+            start_unix_nano=start_us * 1000,
+            end_unix_nano=(start_us + dur_us) * 1000,
+            status_code=status,
+            attrs=attrs,
+            events=events,
+            links=links,
+        ))
+    return ResourceSpans(
+        resource=Resource(attrs=res_attrs),
+        scope_spans=[ScopeSpans(scope=Scope(name="jaeger"), spans=spans)],
+    )
