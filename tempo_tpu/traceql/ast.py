@@ -71,3 +71,32 @@ Expr = Union[Comparison, LogicalExpr]
 @dataclass(frozen=True)
 class SpansetFilter:
     expr: Expr | None  # None = `{}` (match all spans)
+
+
+AGGREGATE_FNS = ("count", "avg", "min", "max", "sum")
+
+
+@dataclass(frozen=True)
+class Aggregate:
+    """One pipeline stage: `| fn(field?) op literal` -- a scalar filter
+    over the spanset's matched spans (expr.y's scalarFilter over
+    aggregate expressions). count() takes no field; the others fold a
+    numeric field (duration or a numeric attribute) of matched spans."""
+
+    fn: str  # one of AGGREGATE_FNS
+    field: Field | None
+    op: str  # '=', '!=', '<', '<=', '>', '>='
+    value: Static
+
+
+@dataclass(frozen=True)
+class Pipeline:
+    """`{ ... } | agg ...` -- the spanset filter piped through scalar
+    aggregate filters; a trace matches when its matched spans pass
+    every stage."""
+
+    filter: SpansetFilter
+    stages: tuple[Aggregate, ...]
+
+
+Query = Union[SpansetFilter, Pipeline]

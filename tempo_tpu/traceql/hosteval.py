@@ -17,7 +17,15 @@ from __future__ import annotations
 import re
 
 from ..wire.model import Resource, Span, Trace
-from .ast import Comparison, Field, LogicalExpr, Scope, SpansetFilter, Static
+from .ast import (
+    Comparison,
+    Field,
+    LogicalExpr,
+    Pipeline,
+    Scope,
+    SpansetFilter,
+    Static,
+)
 
 _STATUS_NAMES = {0: "unset", 1: "ok", 2: "error"}
 _KIND_NAMES = {0: "unspecified", 1: "internal", 2: "server", 3: "client", 4: "producer", 5: "consumer"}
@@ -117,8 +125,62 @@ def _eval_expr(expr, span: Span, res: Resource, tvals: dict) -> bool:
     raise TypeError(f"cannot evaluate {expr!r}")
 
 
-def trace_matches(q: SpansetFilter, trace: Trace) -> bool:
-    """True iff some span of the trace satisfies the spanset filter."""
+def _agg_field_value(f: Field, span: Span, res: Resource):
+    """Numeric value of the aggregate's field for one span (None = the
+    span contributes nothing to the fold)."""
+    if f.scope == Scope.INTRINSIC:
+        if f.name == "duration":
+            return span.duration_nanos
+        return None
+    if f.scope == Scope.SPAN:
+        v = span.attrs.get(f.name)
+    elif f.scope == Scope.RESOURCE:
+        v = res.attrs.get(f.name)
+    else:  # EITHER
+        v = span.attrs.get(f.name, res.attrs.get(f.name))
+    return v if isinstance(v, (int, float)) and not isinstance(v, bool) else None
+
+
+def _eval_pipeline(q: Pipeline, trace: Trace, tvals: dict) -> bool:
+    """Exact evaluation: matched spans of the filter, folded through
+    every scalar aggregate stage (expr.y scalarFilter semantics)."""
+    matched: list[tuple[Span, Resource]] = []
+    for rs in trace.resource_spans:
+        for ss in rs.scope_spans:
+            for sp in ss.spans:
+                if q.filter.expr is None or _eval_expr(q.filter.expr, sp, rs.resource, tvals):
+                    matched.append((sp, rs.resource))
+    if not matched:
+        # an empty spanset never reaches the pipeline (reference drops
+        # empty spansets first), so `| count() = 0` matches nothing --
+        # identically to the device prefilter path
+        return False
+    for st in q.stages:
+        if st.fn == "count":
+            actual: float | int | None = len(matched)
+        else:
+            vals = [v for sp, res in matched
+                    if (v := _agg_field_value(st.field, sp, res)) is not None]
+            if not vals:
+                return False  # nothing to fold: the scalar is undefined
+            actual = {
+                "avg": sum(vals) / len(vals),
+                "min": min(vals),
+                "max": max(vals),
+                "sum": sum(vals),
+            }[st.fn]
+        want = st.value.value
+        if not _cmp_values(st.op, actual, want):
+            return False
+    return True
+
+
+def trace_matches(q, trace: Trace) -> bool:
+    """True iff the trace satisfies the query: some span passes a
+    spanset filter; for pipelines, the matched spans also pass every
+    aggregate stage."""
+    if isinstance(q, Pipeline):
+        return _eval_pipeline(q, trace, _trace_values(trace))
     if q.expr is None:
         return True
     tvals = _trace_values(trace)

@@ -33,13 +33,16 @@ def _unescape(s: str) -> str:
 
 
 from .ast import (
+    AGGREGATE_FNS,
     INTRINSICS,
     KIND_NAMES,
     STATUS_NAMES,
+    Aggregate,
     Comparison,
     Field,
     LogicalExpr,
     ParseError,
+    Pipeline,
     Scope,
     SpansetFilter,
     Static,
@@ -51,7 +54,7 @@ _TOKEN_RE = re.compile(
   | (?P<string>"(?:[^"\\]|\\.)*"|`[^`]*`)
   | (?P<duration>\d+(?:\.\d+)?(?:ns|us|µs|ms|s|m|h)(?:\d+(?:\.\d+)?(?:ns|us|µs|ms|s|m|h))*)
   | (?P<number>-?\d+(?:\.\d+)?)
-  | (?P<op>=~|!~|!=|<=|>=|&&|\|\||[{}()=<>.])
+  | (?P<op>=~|!~|!=|<=|>=|&&|\|\||[{}()=<>.|])
   | (?P<ident>[a-zA-Z_][a-zA-Z0-9_./-]*)
 """,
     re.VERBOSE,
@@ -103,24 +106,60 @@ class _Parser:
             raise ParseError(f"expected {text!r}, got {val!r}")
 
     # ---- grammar
-    def parse_query(self) -> SpansetFilter:
+    def parse_query(self):
         self.expect("{")
         if self.peek()[1] == "}":
             self.next()
-            self._expect_eof()
-            return SpansetFilter(expr=None)
-        expr = self.parse_or()
-        self.expect("}")
+            sf = SpansetFilter(expr=None)
+        else:
+            expr = self.parse_or()
+            self.expect("}")
+            sf = SpansetFilter(expr=expr)
+        stages = []
+        while self.peek()[1] == "|":
+            self.next()
+            stages.append(self.parse_aggregate())
         self._expect_eof()
-        return SpansetFilter(expr=expr)
+        return Pipeline(sf, tuple(stages)) if stages else sf
+
+    def parse_aggregate(self) -> Aggregate:
+        kind, fn = self.next()
+        if fn not in AGGREGATE_FNS:
+            raise ParseError(
+                f"unsupported pipeline stage {fn!r} (supported: {AGGREGATE_FNS})"
+            )
+        self.expect("(")
+        field = None
+        if self.peek()[1] != ")":
+            if fn == "count":
+                raise ParseError("count() takes no argument")
+            field = self.try_field()
+            if field is None:
+                raise ParseError(f"{fn}() needs a field argument")
+            if field.scope == Scope.INTRINSIC and field.name != "duration":
+                # the other intrinsics are strings/enums: folding them
+                # can never match, so fail at parse time
+                raise ParseError(
+                    f"{fn}() needs a numeric field; intrinsic {field.name!r} is not"
+                )
+        elif fn != "count":
+            raise ParseError(f"{fn}() needs a field argument")
+        self.expect(")")
+        kind, op = self.next()
+        if op not in ("=", "!=", "<", "<=", ">", ">="):
+            raise ParseError(f"bad aggregate comparison operator {op!r}")
+        value = self.parse_literal(field)
+        allowed = ("int",) if fn == "count" else ("int", "float", "duration")
+        if value.kind not in allowed:
+            raise ParseError(
+                f"{fn}() comparisons need a {' / '.join(allowed)} literal, got {value.kind}"
+            )
+        return Aggregate(fn=fn, field=field, op=op, value=value)
 
     def _expect_eof(self):
         kind, val = self.peek()
         if kind != "eof":
-            raise ParseError(
-                f"unsupported trailing content {val!r}: only single spanset "
-                "filters are executable (pipelines are not yet supported)"
-            )
+            raise ParseError(f"unsupported trailing content {val!r}")
 
     def parse_or(self):
         lhs = self.parse_and()
@@ -212,5 +251,6 @@ class _Parser:
         raise ParseError(f"expected literal, got {val!r}")
 
 
-def parse(src: str) -> SpansetFilter:
+def parse(src: str):
+    """-> SpansetFilter, or Pipeline when `| agg() op N` stages follow."""
     return _Parser(tokenize(src)).parse_query()

@@ -16,7 +16,7 @@ pairs => two-lane integer compares, no f64 needed.
 from __future__ import annotations
 
 import re
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace
 
 import numpy as np
 
@@ -362,8 +362,16 @@ def plan_search_request(
 
     p = Plan()
     children: list = []
+    force_verify = False
     if query:
         q = parse(query)
+        if not isinstance(q, SpansetFilter):
+            # pipeline: the device filter prunes by the spanset; the
+            # aggregate stages (count/avg/min/max/sum scalar filters)
+            # evaluate EXACTLY on host over surviving candidates
+            # (hosteval._eval_pipeline), so verification is mandatory
+            force_verify = True
+            q = q.filter
         if q.expr is not None:
             children.append(_plan_expr(p, d, q.expr))
     for key, value in tags.items():
@@ -402,4 +410,7 @@ def plan_search_request(
         children.append(
             p.cond(Cond(target="trace", col="trace.start_ms", op="range", needs_verify=True), v0=lo, v1=hi)
         )
-    return _finish(p, children)
+    planned = _finish(p, children)
+    if force_verify and not planned.prune:
+        planned = replace(planned, needs_verify=True)
+    return planned

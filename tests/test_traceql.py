@@ -299,3 +299,77 @@ def test_wellknown_resource_exists(db2):
     # service.name is set on every trace; k8s.pod.name on none
     assert len(_run(d, "{ resource.service.name }")) == 4
     assert _run(d, "{ resource.k8s.pod.name }") == set()
+
+
+def test_pipeline_aggregates_parse_and_eval():
+    """`{...} | count()/avg()/... op N` scalar filters (expr.y pipeline
+    stages), evaluated exactly on the wire model."""
+    from tempo_tpu.traceql.ast import Pipeline
+    from tempo_tpu.traceql.hosteval import trace_matches
+    from tempo_tpu.traceql.parser import parse
+    from tempo_tpu.wire.model import Resource, ResourceSpans, Scope, ScopeSpans, Span, Trace
+
+    def mk_trace(durs_ms, svc="api"):
+        spans = [
+            Span(trace_id=b"\x01" * 16, span_id=bytes([i] * 8), name=f"op{i}",
+                 start_unix_nano=10**18, end_unix_nano=10**18 + d * 10**6,
+                 attrs={"n": i})
+            for i, d in enumerate(durs_ms)
+        ]
+        return Trace(resource_spans=[ResourceSpans(
+            resource=Resource(attrs={"service.name": svc}),
+            scope_spans=[ScopeSpans(scope=Scope(), spans=spans)])])
+
+    q = parse("{ } | count() > 2")
+    assert isinstance(q, Pipeline)
+    assert trace_matches(q, mk_trace([1, 2, 3]))
+    assert not trace_matches(q, mk_trace([1, 2]))
+
+    # aggregate over the filtered spanset, not all spans
+    q = parse('{ duration > 5ms } | count() = 2')
+    assert trace_matches(q, mk_trace([1, 10, 20]))
+    assert not trace_matches(q, mk_trace([10, 20, 30]))
+
+    q = parse("{ } | avg(duration) >= 10ms")
+    assert trace_matches(q, mk_trace([5, 15]))
+    assert not trace_matches(q, mk_trace([5, 5]))
+
+    q = parse("{ } | max(duration) < 10ms | min(duration) > 1ms")
+    assert trace_matches(q, mk_trace([2, 9]))
+    assert not trace_matches(q, mk_trace([2, 19]))
+
+    q = parse("{ } | sum(span.n) = 3")
+    assert trace_matches(q, mk_trace([1, 1, 1]))  # n = 0+1+2
+
+    # empty spansets never reach the pipeline (reference semantics):
+    # the live and block paths must agree
+    q = parse("{ duration > 1s } | count() < 1")
+    assert not trace_matches(q, mk_trace([1, 2]))
+
+    import pytest as _pytest
+    from tempo_tpu.traceql.ast import ParseError
+    for bad in ("{ } | count(duration) > 1", "{ } | avg() > 1",
+                "{ } | p99() > 1", '{ } | count() > "x"',
+                "{ } | count() > 5ms", "{ } | avg(name) > 0",
+                "{ } | max(status) = 2"):
+        with _pytest.raises(ParseError):
+            parse(bad)
+
+
+def test_pipeline_aggregates_e2e_search(tmp_path):
+    """Pipelines run through the full search path: device spanset
+    prefilter + exact host aggregate verification."""
+    from tempo_tpu.backend.mem import MemBackend
+    from tempo_tpu.db import TempoDB, TempoDBConfig
+    from tempo_tpu.db.search import SearchRequest
+
+    db = TempoDB(TempoDBConfig(wal_path=str(tmp_path / "wal")), backend=MemBackend())
+    traces = make_traces(30, seed=17, n_spans=5)  # 5 spans each
+    few = make_traces(6, seed=18, n_spans=2)  # 2 spans each
+    db.write_block("t", sorted(traces + few, key=lambda t: t[0]))
+
+    resp = db.search("t", SearchRequest(query="{ } | count() > 3", limit=100))
+    assert {t.trace_id for t in resp.traces} == {tid.hex() for tid, _ in traces}
+    resp = db.search("t", SearchRequest(query="{ } | count() <= 2", limit=100))
+    assert {t.trace_id for t in resp.traces} == {tid.hex() for tid, _ in few}
+    db.close()
