@@ -72,6 +72,10 @@ class AppConfig:
     # OTLP gRPC receiver port (reference receiver default 4317);
     # 0 = disabled, -1 = ephemeral (tests)
     otlp_grpc_port: int = 0
+    # self-tracing: query operations emit spans into this tenant through
+    # the local distributor ("" = off); reference: the app traces its own
+    # handlers and ships them like any tenant's (SURVEY.md 5.1)
+    self_tracing_tenant: str = ""
 
 
 class App:
@@ -202,6 +206,14 @@ class App:
             comp_ring = Ring(self.kv, COMPACTOR_RING)
             self.compactor = Compactor(self.db, comp_ring, cfg.instance_id,
                                        cycle_s=cfg.compaction_cycle_s)
+        if (cfg.self_tracing_tenant and self.frontend is not None
+                and self.distributor is not None):
+            from .selftrace import SelfTracer
+
+            self.frontend.self_tracer = SelfTracer(
+                self.distributor.push, tenant=cfg.self_tracing_tenant
+            )
+
         from .usagestats import UsageReporter
 
         self.usage = UsageReporter(self.db.backend, cfg.target)

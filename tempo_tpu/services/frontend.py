@@ -116,10 +116,14 @@ class _Job:
     tries: int = 0
     cancelled: bool = False
     hedged: bool = False
-    enqueued_at: float = 0.0
+    enqueued_at: float = 0.0  # monotonic (hedging)
+    started_wall: float = 0.0  # wall clock (self-trace spans)
+    done_at: float = 0.0  # wall clock
     batch_cv: threading.Condition | None = None
 
     def finish(self) -> None:
+        if not self.done.is_set():  # a late hedge twin must not clobber
+            self.done_at = time.time()  # the winner's end time
         self.done.set()
         cv = self.batch_cv
         if cv is not None:
@@ -166,12 +170,21 @@ class Frontend:
         from ..util.metrics import Histogram
 
         self.query_latency = Histogram("tempo_frontend_query_duration_seconds")
+        self.self_tracer = None  # set by the app when self-tracing is on
         self._workers = [
             threading.Thread(target=self._worker, daemon=True, name=f"frontend-worker-{i}")
             for i in range(n_workers)
         ]
         for w in self._workers:
             w.start()
+
+    def _emit_self_trace(self, jobs: list[_Job], t) -> None:
+        """Attach one child span per dispatched job to the active trace."""
+        for j in jobs:
+            if j.started_wall and j.done_at:
+                t.child(f"job:{j.kind}", j.started_wall, j.done_at,
+                        {"cancelled": j.cancelled, "hedged": j.hedged,
+                         "error": j.error is not None})
 
     # ------------------------------------------------------- local workers
     def _worker(self):
@@ -322,11 +335,12 @@ class Frontend:
             if early_exit is not None and early_exit():
                 for j in pending:
                     j.cancelled = True
-                    j.done.set()
+                    j.finish()
                 pending = []
             while pending and len(inflight) < self.concurrent_jobs:
                 j = pending.pop(0)
                 j.enqueued_at = time.monotonic()
+                j.started_wall = time.time()
                 self.queue.enqueue(tenant, j)
                 inflight.append(j)
             inflight = [j for j in inflight if not j.done.is_set()]
@@ -337,7 +351,8 @@ class Frontend:
                 for j in inflight + pending:
                     j.error = TimeoutError("query job timed out")
                     j.cancelled = True
-                    j.done.set()
+                    j.finish()  # stamps done_at: the slow job must show
+                    # up in self-traces -- it IS the pathology
                 break
             if self.hedge_after_s > 0:
                 for j in inflight:
@@ -361,10 +376,16 @@ class Frontend:
         from ..util.metrics import timed
 
         with timed(self.query_latency, 'op="traces"'):
-            return self._find_trace_by_id(tenant, trace_id, time_start, time_end)
+            if self.self_tracer is None or tenant == self.self_tracer.tenant:
+                return self._find_trace_by_id(tenant, trace_id, time_start, time_end)
+            with self.self_tracer.trace(
+                "frontend.find_trace_by_id", {"tenant": tenant}
+            ) as t:
+                return self._find_trace_by_id(tenant, trace_id, time_start, time_end,
+                                              trace=t)
 
     def _find_trace_by_id(self, tenant: str, trace_id: bytes,
-                          time_start: int = 0, time_end: int = 0):
+                          time_start: int = 0, time_end: int = 0, trace=None):
         db = self.querier.db
         candidates = db.find_candidates(tenant, trace_id, time_start, time_end)
         jobs = [_Job(
@@ -383,6 +404,8 @@ class Frontend:
                 args=(tenant, trace_id, part),
             ))
         self._run_jobs(tenant, jobs)
+        if trace is not None:
+            self._emit_self_trace(jobs, trace)
         partials = []
         for j in jobs:
             if j.error is not None:
@@ -403,9 +426,14 @@ class Frontend:
         from ..util.metrics import timed
 
         with timed(self.query_latency, 'op="search"'):
-            return self._search(tenant, req)
+            if self.self_tracer is None or tenant == self.self_tracer.tenant:
+                return self._search(tenant, req)
+            with self.self_tracer.trace(
+                "frontend.search", {"tenant": tenant, "q": req.query or ""}
+            ) as t:
+                return self._search(tenant, req, trace=t)
 
-    def _search(self, tenant: str, req: SearchRequest) -> SearchResponse:
+    def _search(self, tenant: str, req: SearchRequest, trace=None) -> SearchResponse:
         limit = req.limit or 20
         resp = SearchResponse()
         lock = threading.Lock()
@@ -469,6 +497,8 @@ class Frontend:
         t.start()
         self._run_jobs(tenant, jobs, early_exit=early)
         collector_done.wait(timeout=60.0)
+        if trace is not None:
+            self._emit_self_trace(jobs, trace)
         resp.traces.sort(key=lambda r: -r.start_time_unix_nano)
         resp.traces = resp.traces[:limit]
         return resp

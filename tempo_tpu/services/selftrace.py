@@ -1,0 +1,113 @@
+"""Self-tracing: the backend traces its own query path into itself.
+
+Reference: the app instruments handlers with its tracing client and
+ships those spans like any tenant's (SURVEY.md 5.1) -- dogfooding that
+makes slow queries debuggable with the product itself. Here a
+SelfTracer records a root span per frontend query plus one child span
+per dispatched job, and pushes the finished trace through the
+distributor under a dedicated tenant. Pushes from the self tenant are
+never traced (no recursion), and failures are swallowed -- observability
+must not fail queries.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+from ..wire.model import Resource, ResourceSpans, Scope, ScopeSpans, Span, SpanKind
+
+
+class SelfTracer:
+    def __init__(self, push, tenant: str = "self", service: str = "tempo-tpu"):
+        """push(tenant, [ResourceSpans]) -- the distributor entrypoint.
+        Finished traces ship from a background thread (the reference's
+        async batch exporter role): the query hot path only enqueues."""
+        self.push = push
+        self.tenant = tenant
+        self.service = service
+        self.spans_emitted = 0
+        self._lock = threading.Lock()
+        import queue
+
+        self._q: queue.SimpleQueue = queue.SimpleQueue()
+        self._shipper = threading.Thread(target=self._ship_loop, daemon=True,
+                                         name="selftrace-shipper")
+        self._shipper.start()
+
+    def trace(self, name: str, attrs: dict | None = None):
+        return _ActiveTrace(self, name, attrs or {})
+
+    def _enqueue(self, rs, n_spans: int) -> None:
+        self._q.put((rs, n_spans))
+
+    def _ship_loop(self) -> None:
+        while True:
+            rs, n_spans = self._q.get()
+            try:
+                self.push(self.tenant, [rs])
+                with self._lock:
+                    self.spans_emitted += n_spans
+            except Exception:
+                pass  # self-observability must never fail anything
+
+    def flush(self, timeout_s: float = 2.0) -> None:
+        """Best-effort drain (tests): wait until the queue empties."""
+        deadline = time.time() + timeout_s
+        while not self._q.empty() and time.time() < deadline:
+            time.sleep(0.01)
+
+
+class _ActiveTrace:
+    """One root span + flat children, finished and pushed on __exit__."""
+
+    def __init__(self, tracer: SelfTracer, name: str, attrs: dict):
+        self.tracer = tracer
+        self.name = name
+        self.attrs = attrs
+        self.trace_id = os.urandom(16)
+        self.root_id = os.urandom(8)
+        self.t0 = 0.0
+        self.children: list[tuple[str, float, float, dict]] = []
+        self._lock = threading.Lock()
+
+    def child(self, name: str, t_start: float, t_end: float, attrs: dict | None = None):
+        with self._lock:
+            self.children.append((name, t_start, t_end, attrs or {}))
+
+    def __enter__(self):
+        self.t0 = time.time()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        t1 = time.time()
+        if exc_type is not None:
+            self.attrs["error"] = True
+            self.attrs["error.type"] = exc_type.__name__
+        spans = [Span(
+            trace_id=self.trace_id,
+            span_id=self.root_id,
+            name=self.name,
+            kind=SpanKind.SERVER,
+            start_unix_nano=int(self.t0 * 1e9),
+            end_unix_nano=int(t1 * 1e9),
+            attrs=self.attrs,
+        )]
+        for name, cs, ce, attrs in self.children:
+            spans.append(Span(
+                trace_id=self.trace_id,
+                span_id=os.urandom(8),
+                parent_span_id=self.root_id,
+                name=name,
+                kind=SpanKind.INTERNAL,
+                start_unix_nano=int(cs * 1e9),
+                end_unix_nano=int(ce * 1e9),
+                attrs=attrs,
+            ))
+        rs = ResourceSpans(
+            resource=Resource(attrs={"service.name": self.tracer.service}),
+            scope_spans=[ScopeSpans(scope=Scope(name="selftrace"), spans=spans)],
+        )
+        self.tracer._enqueue(rs, len(spans))
+        return False

@@ -7,6 +7,7 @@ force flush, query again from the backend.
 
 import json
 import socket
+import urllib.parse
 import urllib.request
 
 import pytest
@@ -264,3 +265,53 @@ def test_usage_stats(server):
     # stable across reads (seed persisted, not regenerated)
     with urllib.request.urlopen(base + "/status/usage-stats", timeout=10) as r:
         assert json.loads(r.read())["clusterID"] == rep["clusterID"]
+
+
+def test_self_tracing(tmp_path):
+    """With self-tracing on, a user query produces a queryable trace of
+    ITSELF (root span + per-job children) under the self tenant."""
+    cfg = AppConfig(
+        storage_path=str(tmp_path / "store"),
+        http_port=_free_port(),
+        multitenancy=True,
+        self_tracing_tenant="self",
+        compaction_cycle_s=9999,
+        ingester=IngesterConfig(max_trace_idle_s=0.0, max_block_age_s=0.0,
+                                flush_check_period_s=9999),
+    )
+    app = App(cfg)
+    app.start()
+    app.serve_http(background=True)
+    base = f"http://127.0.0.1:{cfg.http_port}"
+    try:
+        traces = make_traces(3, seed=88, n_spans=3)
+        for _, tr in traces:
+            req = urllib.request.Request(base + "/v1/traces",
+                                         data=otlp_json.dumps(tr).encode(),
+                                         headers={"Content-Type": "application/json",
+                                                  "X-Scope-OrgID": "t1"})
+            urllib.request.urlopen(req, timeout=10)
+        # a user-tenant search gets traced...
+        req = urllib.request.Request(base + "/api/search?limit=10",
+                                     headers={"X-Scope-OrgID": "t1"})
+        urllib.request.urlopen(req, timeout=15)
+        app.frontend.self_tracer.flush()  # async shipper drains
+        # ...and the self tenant can be queried for it with the product
+        req = urllib.request.Request(
+            base + "/api/search?tags=" + urllib.parse.quote("name=frontend.search") + "&limit=10",
+            headers={"X-Scope-OrgID": "self"})
+        with urllib.request.urlopen(req, timeout=15) as r:
+            hits = json.loads(r.read())["traces"]
+        assert hits, "no self-trace recorded"
+        # the self trace has job child spans
+        with urllib.request.urlopen(
+            urllib.request.Request(base + f"/api/traces/{hits[0]['traceID']}",
+                                   headers={"X-Scope-OrgID": "self"}), timeout=15) as r:
+            tr = otlp_json.loads(r.read())
+        names = [sp.name for _, _, sp in tr.all_spans()]
+        assert "frontend.search" in names
+        assert any(n.startswith("job:") for n in names), names
+        # and querying the self tenant did NOT recurse into more traces
+        assert app.frontend.self_tracer.spans_emitted < 50
+    finally:
+        app.stop()
