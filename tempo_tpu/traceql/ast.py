@@ -75,6 +75,20 @@ class SpansetFilter:
 
 AGGREGATE_FNS = ("count", "avg", "min", "max", "sum")
 
+SPANSET_OPS = (">", ">>", "~", "&&", "||")
+
+
+@dataclass(frozen=True)
+class SpansetOp:
+    """Two spansets combined at trace level (expr.y spansetExpression):
+    `>` direct parent/child, `>>` ancestor/descendant, `~` siblings,
+    `&&` both present, `||` either present. Left-associative chains
+    nest on the lhs."""
+
+    op: str  # one of SPANSET_OPS
+    lhs: "SpansetExpr"
+    rhs: "SpansetExpr"
+
 
 @dataclass(frozen=True)
 class Aggregate:
@@ -91,12 +105,13 @@ class Aggregate:
 
 @dataclass(frozen=True)
 class Pipeline:
-    """`{ ... } | agg ...` -- the spanset filter piped through scalar
+    """`{ ... } | agg ...` -- a spanset expression piped through scalar
     aggregate filters; a trace matches when its matched spans pass
     every stage."""
 
-    filter: SpansetFilter
+    filter: "SpansetExpr"
     stages: tuple[Aggregate, ...]
 
 
-Query = Union[SpansetFilter, Pipeline]
+SpansetExpr = Union[SpansetFilter, SpansetOp]
+Query = Union[SpansetFilter, SpansetOp, Pipeline]

@@ -24,6 +24,7 @@ from .ast import (
     Pipeline,
     Scope,
     SpansetFilter,
+    SpansetOp,
     Static,
 )
 
@@ -141,15 +142,84 @@ def _agg_field_value(f: Field, span: Span, res: Resource):
     return v if isinstance(v, (int, float)) and not isinstance(v, bool) else None
 
 
+def _matched_spans(expr, trace: Trace, tvals: dict) -> list[tuple[Span, Resource]]:
+    """The spanset an expression selects from one trace: filter matches,
+    or the structural/combinator result of two spansets
+    (expr.y spansetExpression semantics)."""
+    if isinstance(expr, SpansetFilter):
+        out = []
+        for rs in trace.resource_spans:
+            for ss in rs.scope_spans:
+                for sp in ss.spans:
+                    if expr.expr is None or _eval_expr(expr.expr, sp, rs.resource, tvals):
+                        out.append((sp, rs.resource))
+        return out
+    lhs = _matched_spans(expr.lhs, trace, tvals)
+    rhs = _matched_spans(expr.rhs, trace, tvals)
+    if expr.op == "&&":
+        # both present: result is the union of both sides' spans
+        return _union(lhs, rhs) if lhs and rhs else []
+    if expr.op == "||":
+        return _union(lhs, rhs)
+
+    def _parent(sp: Span) -> bytes:
+        # zero-filled parent ids mean "no parent", same rule as root
+        # detection elsewhere in this module
+        p = sp.parent_span_id
+        return p if p and p.strip(b"\x00") else b""
+
+    lhs_ids = {sp.span_id for sp, _ in lhs if sp.span_id}
+    if expr.op == ">":
+        return [(sp, r) for sp, r in rhs if _parent(sp) in lhs_ids]
+    if expr.op == ">>":
+        parent_of: dict[bytes, bytes] = {}
+        for rs in trace.resource_spans:
+            for ss in rs.scope_spans:
+                for sp in ss.spans:
+                    if sp.span_id:
+                        parent_of[sp.span_id] = _parent(sp)
+        out = []
+        for sp, r in rhs:
+            anc = _parent(sp)
+            seen = set()
+            while anc and anc not in seen:
+                if anc in lhs_ids:
+                    out.append((sp, r))
+                    break
+                seen.add(anc)
+                anc = parent_of.get(anc, b"")
+        return out
+    if expr.op == "~":
+        # siblings: some lhs span with the SAME parent and a DIFFERENT
+        # id (pairwise, so `{x} ~ {x}` matches twin x spans)
+        by_parent: dict[bytes, set] = {}
+        for sp, _ in lhs:
+            p = _parent(sp)
+            if p:
+                by_parent.setdefault(p, set()).add(sp.span_id)
+        out = []
+        for sp, r in rhs:
+            sibs = by_parent.get(_parent(sp))
+            if sibs and (sibs - {sp.span_id}):
+                out.append((sp, r))
+        return out
+    raise TypeError(f"unknown spanset op {expr.op!r}")
+
+
+def _union(a, b):
+    seen = set()
+    out = []
+    for sp, r in a + b:
+        if id(sp) not in seen:
+            seen.add(id(sp))
+            out.append((sp, r))
+    return out
+
+
 def _eval_pipeline(q: Pipeline, trace: Trace, tvals: dict) -> bool:
-    """Exact evaluation: matched spans of the filter, folded through
-    every scalar aggregate stage (expr.y scalarFilter semantics)."""
-    matched: list[tuple[Span, Resource]] = []
-    for rs in trace.resource_spans:
-        for ss in rs.scope_spans:
-            for sp in ss.spans:
-                if q.filter.expr is None or _eval_expr(q.filter.expr, sp, rs.resource, tvals):
-                    matched.append((sp, rs.resource))
+    """Exact evaluation: matched spans of the spanset expression, folded
+    through every scalar aggregate stage (expr.y scalarFilter)."""
+    matched = _matched_spans(q.filter, trace, tvals)
     if not matched:
         # an empty spanset never reaches the pipeline (reference drops
         # empty spansets first), so `| count() = 0` matches nothing --
@@ -177,10 +247,12 @@ def _eval_pipeline(q: Pipeline, trace: Trace, tvals: dict) -> bool:
 
 def trace_matches(q, trace: Trace) -> bool:
     """True iff the trace satisfies the query: some span passes a
-    spanset filter; for pipelines, the matched spans also pass every
-    aggregate stage."""
+    spanset filter; structural/combinator expressions select a
+    non-empty spanset; pipelines additionally pass every stage."""
     if isinstance(q, Pipeline):
         return _eval_pipeline(q, trace, _trace_values(trace))
+    if isinstance(q, SpansetOp):
+        return bool(_matched_spans(q, trace, _trace_values(trace)))
     if q.expr is None:
         return True
     tvals = _trace_values(trace)

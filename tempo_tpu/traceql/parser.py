@@ -45,6 +45,7 @@ from .ast import (
     Pipeline,
     Scope,
     SpansetFilter,
+    SpansetOp,
     Static,
 )
 
@@ -54,7 +55,7 @@ _TOKEN_RE = re.compile(
   | (?P<string>"(?:[^"\\]|\\.)*"|`[^`]*`)
   | (?P<duration>\d+(?:\.\d+)?(?:ns|us|µs|ms|s|m|h)(?:\d+(?:\.\d+)?(?:ns|us|µs|ms|s|m|h))*)
   | (?P<number>-?\d+(?:\.\d+)?)
-  | (?P<op>=~|!~|!=|<=|>=|&&|\|\||[{}()=<>.|])
+  | (?P<op>=~|!~|!=|<=|>=|>>|&&|\|\||[{}()=<>.|~])
   | (?P<ident>[a-zA-Z_][a-zA-Z0-9_./-]*)
 """,
     re.VERBOSE,
@@ -107,20 +108,34 @@ class _Parser:
 
     # ---- grammar
     def parse_query(self):
-        self.expect("{")
-        if self.peek()[1] == "}":
-            self.next()
-            sf = SpansetFilter(expr=None)
-        else:
-            expr = self.parse_or()
-            self.expect("}")
-            sf = SpansetFilter(expr=expr)
+        # expr.y precedence: structural (> >> ~) binds tighter than the
+        # spanset combinators (&& ||); both left-associative
+        expr = self.parse_structural()
+        while self.peek()[1] in ("&&", "||"):
+            _, op = self.next()
+            expr = SpansetOp(op, expr, self.parse_structural())
         stages = []
         while self.peek()[1] == "|":
             self.next()
             stages.append(self.parse_aggregate())
         self._expect_eof()
-        return Pipeline(sf, tuple(stages)) if stages else sf
+        return Pipeline(expr, tuple(stages)) if stages else expr
+
+    def parse_structural(self):
+        expr = self.parse_spanset()
+        while self.peek()[1] in (">", ">>", "~"):
+            _, op = self.next()
+            expr = SpansetOp(op, expr, self.parse_spanset())
+        return expr
+
+    def parse_spanset(self) -> SpansetFilter:
+        self.expect("{")
+        if self.peek()[1] == "}":
+            self.next()
+            return SpansetFilter(expr=None)
+        expr = self.parse_or()
+        self.expect("}")
+        return SpansetFilter(expr=expr)
 
     def parse_aggregate(self) -> Aggregate:
         kind, fn = self.next()

@@ -22,7 +22,17 @@ import numpy as np
 
 from ..block.dictionary import Dictionary
 from ..ops.filter import Cond
-from .ast import Comparison, Field, LogicalExpr, ParseError, Scope, SpansetFilter, Static
+from .ast import (
+    Comparison,
+    Field,
+    LogicalExpr,
+    ParseError,
+    Pipeline,
+    Scope,
+    SpansetFilter,
+    SpansetOp,
+    Static,
+)
 
 _IMPOSSIBLE_CODE = -3  # operand code that matches no row (codes are >= -1)
 
@@ -281,6 +291,23 @@ def _plan_comparison(p: Plan, d: Dictionary, cmp: Comparison) -> tuple:
     return _fold("or", alts)
 
 
+def _plan_spanset_expr(p: Plan, d: Dictionary, q) -> tuple[tuple, bool]:
+    """Spanset expression -> (trace-level tree, needs host verification).
+    Each leaf spanset tracifies independently; && / structural ops AND
+    them (a qualifying trace must contain every leaf's spans), || ORs.
+    Structural relations (> >> ~) cannot be checked on device, so those
+    force exact host verification over the surviving candidates."""
+    if isinstance(q, SpansetFilter):
+        if q.expr is None:
+            return TRUE, False
+        return ("tracify", _plan_expr(p, d, q.expr)), False
+    lt, lv = _plan_spanset_expr(p, d, q.lhs)
+    rt, rv = _plan_spanset_expr(p, d, q.rhs)
+    structural = q.op in (">", ">>", "~")
+    fold_op = "or" if q.op == "||" else "and"
+    return _fold(fold_op, [lt, rt]), lv or rv or structural
+
+
 def _plan_expr(p: Plan, d: Dictionary, expr) -> tuple:
     if isinstance(expr, LogicalExpr):
         op = "and" if expr.op == "&&" else "or"
@@ -365,14 +392,21 @@ def plan_search_request(
     force_verify = False
     if query:
         q = parse(query)
-        if not isinstance(q, SpansetFilter):
+        if isinstance(q, Pipeline):
             # pipeline: the device filter prunes by the spanset; the
             # aggregate stages (count/avg/min/max/sum scalar filters)
             # evaluate EXACTLY on host over surviving candidates
             # (hosteval._eval_pipeline), so verification is mandatory
             force_verify = True
             q = q.filter
-        if q.expr is not None:
+        if isinstance(q, SpansetOp):
+            # structural/combinator spansets: the device prunes to traces
+            # whose spanset LEAVES are all (or, for ||, any) present --
+            # conservative for >/>>/~ (relations re-checked on host)
+            tree, sv = _plan_spanset_expr(p, d, q)
+            force_verify = force_verify or sv
+            children.append(tree)
+        elif q.expr is not None:
             children.append(_plan_expr(p, d, q.expr))
     for key, value in tags.items():
         lit = Static("str", value)

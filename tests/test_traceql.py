@@ -373,3 +373,101 @@ def test_pipeline_aggregates_e2e_search(tmp_path):
     resp = db.search("t", SearchRequest(query="{ } | count() <= 2", limit=100))
     assert {t.trace_id for t in resp.traces} == {tid.hex() for tid, _ in few}
     db.close()
+
+
+def test_structural_operators():
+    """`{a} > {b}`, `>>`, `~`, `&&`, `||` between spansets."""
+    from tempo_tpu.traceql.hosteval import trace_matches
+    from tempo_tpu.traceql.parser import parse
+    from tempo_tpu.wire.model import Resource, ResourceSpans, Scope, ScopeSpans, Span, Trace
+
+    def sp(name, sid, parent=b""):
+        return Span(trace_id=b"\x01" * 16, span_id=sid, parent_span_id=parent,
+                    name=name, start_unix_nano=10**18, end_unix_nano=10**18 + 10**6)
+
+    # a -> b -> c, plus sibling d of b
+    a, b, c, d = (bytes([i] * 8) for i in (1, 2, 3, 4))
+    spans = [sp("a", a), sp("b", b, a), sp("c", c, b), sp("d", d, a)]
+    tr = Trace(resource_spans=[ResourceSpans(
+        resource=Resource(attrs={"service.name": "s"}),
+        scope_spans=[ScopeSpans(scope=Scope(), spans=spans)])])
+
+    assert trace_matches(parse('{ name = "a" } > { name = "b" }'), tr)
+    assert not trace_matches(parse('{ name = "a" } > { name = "c" }'), tr)  # not direct
+    assert trace_matches(parse('{ name = "a" } >> { name = "c" }'), tr)  # descendant
+    assert not trace_matches(parse('{ name = "c" } >> { name = "a" }'), tr)
+    assert trace_matches(parse('{ name = "b" } ~ { name = "d" }'), tr)  # siblings
+    assert not trace_matches(parse('{ name = "b" } ~ { name = "c" }'), tr)
+    assert trace_matches(parse('{ name = "a" } && { name = "d" }'), tr)
+    assert not trace_matches(parse('{ name = "a" } && { name = "zzz" }'), tr)
+    assert trace_matches(parse('{ name = "zzz" } || { name = "d" }'), tr)
+    # structural + pipeline: children of a == {b, d}
+    assert trace_matches(parse('{ name = "a" } > { } | count() = 2'), tr)
+    assert not trace_matches(parse('{ name = "a" } > { } | count() > 2'), tr)
+
+
+def test_structural_e2e_search(tmp_path):
+    """Structural queries through the full block search path: device
+    leaf prefilter + exact host relation verification."""
+    from tempo_tpu.backend.mem import MemBackend
+    from tempo_tpu.db import TempoDB, TempoDBConfig
+    from tempo_tpu.db.search import SearchRequest
+    from tempo_tpu.wire.model import Resource, ResourceSpans, Scope, ScopeSpans, Span, Trace
+
+    def mk(tid_byte, parent_child):
+        tid = bytes([tid_byte]) * 16
+        spans = []
+        for i, (name, sid_b, parent_b) in enumerate(parent_child):
+            spans.append(Span(
+                trace_id=tid, span_id=bytes([sid_b] * 8) if isinstance(sid_b, int) else sid_b,
+                parent_span_id=bytes([parent_b] * 8) if parent_b else b"",
+                name=name, start_unix_nano=10**18 + i, end_unix_nano=10**18 + 10**6))
+        return tid, Trace(resource_spans=[ResourceSpans(
+            resource=Resource(attrs={"service.name": "s"}),
+            scope_spans=[ScopeSpans(scope=Scope(), spans=spans)])])
+
+    # t1: gateway -> db (direct); t2: gateway -> mid -> db; t3: db alone
+    t1 = mk(1, [("gateway", 1, 0), ("db", 2, 1)])
+    t2 = mk(2, [("gateway", 1, 0), ("mid", 2, 1), ("db", 3, 2)])
+    t3 = mk(3, [("db", 1, 0)])
+    db = TempoDB(TempoDBConfig(wal_path=str(tmp_path / "wal")), backend=MemBackend())
+    db.write_block("t", sorted([t1, t2, t3], key=lambda t: t[0]))
+
+    def search(q):
+        return {t.trace_id for t in db.search("t", SearchRequest(query=q, limit=10)).traces}
+
+    assert search('{ name = "gateway" } > { name = "db" }') == {t1[0].hex()}
+    assert search('{ name = "gateway" } >> { name = "db" }') == {t1[0].hex(), t2[0].hex()}
+    assert search('{ name = "gateway" } && { name = "mid" }') == {t2[0].hex()}
+    assert search('{ name = "mid" } || { name = "db" }') == {t1[0].hex(), t2[0].hex(), t3[0].hex()}
+    db.close()
+
+
+def test_structural_precedence_and_twins():
+    """expr.y precedence: > binds tighter than && ; ~ matches twin
+    same-name siblings; zero-filled parents are not siblings."""
+    from tempo_tpu.traceql.ast import SpansetOp
+    from tempo_tpu.traceql.hosteval import trace_matches
+    from tempo_tpu.traceql.parser import parse
+    from tempo_tpu.wire.model import Resource, ResourceSpans, Scope, ScopeSpans, Span, Trace
+
+    q = parse('{ name = "a" } && { name = "b" } > { name = "c" }')
+    assert isinstance(q, SpansetOp) and q.op == "&&"
+    assert isinstance(q.rhs, SpansetOp) and q.rhs.op == ">"  # b > c under &&
+
+    def sp(name, sid, parent=b""):
+        return Span(trace_id=b"\x01" * 16, span_id=sid, parent_span_id=parent,
+                    name=name, start_unix_nano=10**18, end_unix_nano=10**18 + 10**6)
+
+    p, x1, x2 = bytes([9] * 8), bytes([1] * 8), bytes([2] * 8)
+    twins = Trace(resource_spans=[ResourceSpans(
+        resource=Resource(attrs={"service.name": "s"}),
+        scope_spans=[ScopeSpans(scope=Scope(), spans=[
+            sp("par", p), sp("x", x1, p), sp("x", x2, p)])])])
+    assert trace_matches(parse('{ name = "x" } ~ { name = "x" }'), twins)
+
+    roots = Trace(resource_spans=[ResourceSpans(
+        resource=Resource(attrs={"service.name": "s"}),
+        scope_spans=[ScopeSpans(scope=Scope(), spans=[
+            sp("a", x1, b"\x00" * 8), sp("b", x2, b"\x00" * 8)])])])
+    assert not trace_matches(parse('{ name = "a" } ~ { name = "b" }'), roots)
