@@ -211,10 +211,19 @@ class ColumnPack:
             k: AxisChunks(v) for k, v in footer.get("axes", {}).items()
         }
         self.bytes_read = _TAIL.size + flen  # inspected-bytes accounting
-        self._dctx = zstandard.ZstdDecompressor()
+        # zstd contexts are NOT thread-safe: concurrent decompress on a
+        # shared context intermittently fails with "data corruption
+        # detected" (readers run in IO pools) -- one context per thread
+        self._dctx_local = threading.local()
         self._cache: OrderedDict[int, bytes] = OrderedDict()  # chunk offset -> raw
         self._cache_bytes = 0
         self._cache_lock = threading.Lock()
+
+    def _dctx(self) -> "zstandard.ZstdDecompressor":
+        d = getattr(self._dctx_local, "d", None)
+        if d is None:
+            d = self._dctx_local.d = zstandard.ZstdDecompressor()
+        return d
 
     @classmethod
     def from_bytes(cls, data: bytes) -> "ColumnPack":
@@ -258,7 +267,7 @@ class ColumnPack:
         data = self._read_range(off, stored_len)
         self.bytes_read += stored_len
         if codec == CODEC_ZSTD:
-            data = self._dctx.decompress(data, max_output_size=raw_len)
+            data = self._dctx().decompress(data, max_output_size=raw_len)
         elif codec != CODEC_RAW:
             data = _EXTRA_CODECS[codec][1](data, raw_len)  # codec matrix
         self._cache_put(off, data)

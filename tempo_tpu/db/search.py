@@ -503,7 +503,16 @@ def search_blocks_fused(
 
     def run_item(t):
         tag, item = t
-        return tag, (stage_and_eval(item) if tag == "dev" else host_eval_collect(item))
+        try:
+            return tag, (stage_and_eval(item) if tag == "dev" else host_eval_collect(item))
+        except Exception as e:
+            # pool futures re-raise with the OUTER stack; carry the real
+            # one along so truncated logs still show the root cause
+            import traceback
+
+            e.add_note(f"search {tag} item on block "
+                       f"{item[0].meta.block_id}: {traceback.format_exc()}")
+            raise
 
     outs = list(pool.map(run_item, tagged)) if pool is not None else [
         run_item(t) for t in tagged

@@ -192,3 +192,29 @@ def test_codec_matrix_roundtrip(codec):
     out = ColumnPack.from_bytes(data).read_all()
     for name, arr in cols.items():
         assert (out[name] == arr).all(), (codec, name)
+
+
+def test_concurrent_chunk_reads_thread_safety():
+    """Concurrent cold reads of many zstd chunks from many threads:
+    zstd contexts are per-thread (a shared context intermittently
+    corrupts; this reproduced ~1-in-4 on a pooled read of 10 blocks)."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    import numpy as np
+
+    from tempo_tpu.block.colio import AxisChunks, ColumnPack, pack_columns
+
+    rng = np.random.default_rng(3)
+    cols = {f"c.x{i}": rng.integers(0, 50, size=200_000, dtype=np.int32)
+            for i in range(12)}
+    axes = {"rows": AxisChunks(list(range(0, 200_001, 20_000)))}
+    data = pack_columns(cols, axes, {n: "rows" for n in cols})
+    for _ in range(6):
+        pack = ColumnPack.from_bytes(data)  # fresh cache: all reads cold
+
+        def read_one(name):
+            return name, pack.read(name)
+
+        with ThreadPoolExecutor(max_workers=12) as ex:
+            for name, arr in ex.map(read_one, list(cols)):
+                assert (arr == cols[name]).all(), name
