@@ -188,6 +188,9 @@ def pack_columns(
     return b"".join(pack_columns_stream(cols, axes, col_axis, level, codec))
 
 
+_DCTX_LOCAL = threading.local()  # per-thread zstd contexts (see _dctx)
+
+
 class ColumnPack:
     """Lazy chunked-column reader over a backend object via range reads."""
 
@@ -211,18 +214,24 @@ class ColumnPack:
             k: AxisChunks(v) for k, v in footer.get("axes", {}).items()
         }
         self.bytes_read = _TAIL.size + flen  # inspected-bytes accounting
-        # zstd contexts are NOT thread-safe: concurrent decompress on a
-        # shared context intermittently fails with "data corruption
-        # detected" (readers run in IO pools) -- one context per thread
-        self._dctx_local = threading.local()
+        self._io_lock = threading.Lock()  # bytes_read is read-modify-write
         self._cache: OrderedDict[int, bytes] = OrderedDict()  # chunk offset -> raw
         self._cache_bytes = 0
         self._cache_lock = threading.Lock()
 
-    def _dctx(self) -> "zstandard.ZstdDecompressor":
-        d = getattr(self._dctx_local, "d", None)
+    def _count_read(self, n: int) -> None:
+        with self._io_lock:
+            self.bytes_read += n
+
+    @staticmethod
+    def _dctx() -> "zstandard.ZstdDecompressor":
+        """zstd contexts are NOT thread-safe: concurrent decompress on a
+        shared context intermittently fails with "data corruption
+        detected" (readers run in IO pools). One context per THREAD,
+        shared across every pack (contexts are stateless between calls)."""
+        d = getattr(_DCTX_LOCAL, "d", None)
         if d is None:
-            d = self._dctx_local.d = zstandard.ZstdDecompressor()
+            d = _DCTX_LOCAL.d = zstandard.ZstdDecompressor()
         return d
 
     @classmethod
@@ -265,7 +274,7 @@ class ColumnPack:
         if hit is not None:
             return hit
         data = self._read_range(off, stored_len)
-        self.bytes_read += stored_len
+        self._count_read(stored_len)
         if codec == CODEC_ZSTD:
             data = self._dctx().decompress(data, max_output_size=raw_len)
         elif codec != CODEC_RAW:
@@ -291,7 +300,7 @@ class ColumnPack:
                     [recs[i][2] for i in zst],
                 )
                 if outs is not None:
-                    self.bytes_read += sum(recs[i][1] for i in zst)
+                    self._count_read(sum(recs[i][1] for i in zst))
                     for i, raw in zip(zst, outs):
                         parts[i] = raw
                         self._cache_put(recs[i][0], raw)
@@ -354,7 +363,7 @@ class ColumnPack:
             [self._read_range(r[0], r[1]) for r in miss], [r[2] for r in miss]
         )
         if outs is not None:
-            self.bytes_read += sum(r[1] for r in miss)
+            self._count_read(sum(r[1] for r in miss))
             for r, raw in zip(miss, outs):
                 self._cache_put(r[0], raw)
 
@@ -384,7 +393,7 @@ class ColumnPack:
                 if raw_len == 0:
                     continue
                 data = self._read_range(off, stored)
-                self.bytes_read += stored
+                self._count_read(stored)
                 if codec == CODEC_ZSTD:
                     z_chunks.append(data)
                     z_offs.append(pos)
