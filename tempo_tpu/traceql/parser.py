@@ -108,12 +108,7 @@ class _Parser:
 
     # ---- grammar
     def parse_query(self):
-        # expr.y precedence: structural (> >> ~) binds tighter than the
-        # spanset combinators (&& ||); both left-associative
-        expr = self.parse_structural()
-        while self.peek()[1] in ("&&", "||"):
-            _, op = self.next()
-            expr = SpansetOp(op, expr, self.parse_structural())
+        expr = self.parse_spanset_expr()
         stages = []
         while self.peek()[1] == "|":
             self.next()
@@ -121,12 +116,29 @@ class _Parser:
         self._expect_eof()
         return Pipeline(expr, tuple(stages)) if stages else expr
 
+    def parse_spanset_expr(self):
+        # expr.y precedence: structural (> >> ~) binds tighter than the
+        # spanset combinators (&& ||); both left-associative
+        expr = self.parse_structural()
+        while self.peek()[1] in ("&&", "||"):
+            _, op = self.next()
+            expr = SpansetOp(op, expr, self.parse_structural())
+        return expr
+
     def parse_structural(self):
-        expr = self.parse_spanset()
+        expr = self.parse_spanset_primary()
         while self.peek()[1] in (">", ">>", "~"):
             _, op = self.next()
-            expr = SpansetOp(op, expr, self.parse_spanset())
+            expr = SpansetOp(op, expr, self.parse_spanset_primary())
         return expr
+
+    def parse_spanset_primary(self):
+        if self.peek()[1] == "(":  # ( spansetExpression ) per expr.y
+            self.next()
+            e = self.parse_spanset_expr()
+            self.expect(")")
+            return e
+        return self.parse_spanset()
 
     def parse_spanset(self) -> SpansetFilter:
         self.expect("{")

@@ -471,3 +471,15 @@ def test_structural_precedence_and_twins():
         scope_spans=[ScopeSpans(scope=Scope(), spans=[
             sp("a", x1, b"\x00" * 8), sp("b", x2, b"\x00" * 8)])])])
     assert not trace_matches(parse('{ name = "a" } ~ { name = "b" }'), roots)
+
+
+def test_parenthesized_spanset_expressions():
+    from tempo_tpu.traceql.ast import SpansetOp
+    from tempo_tpu.traceql.parser import parse
+
+    q = parse('({ name = "a" } || { name = "b" }) > { name = "c" }')
+    assert isinstance(q, SpansetOp) and q.op == ">"
+    assert isinstance(q.lhs, SpansetOp) and q.lhs.op == "||"
+    # without parens, || binds looser: a || (b > c)
+    q2 = parse('{ name = "a" } || { name = "b" } > { name = "c" }')
+    assert q2.op == "||" and q2.rhs.op == ">"
