@@ -379,8 +379,12 @@ def search_block(
         def selector(k):
             return select_topk_device(tm, key, counts, k)
     else:
-        cols = _host_cols(blk, needed, groups_range)
-        n_spans_seen = cols["span.trace_sid"].shape[0]
+        # span_off carries the span->trace grouping: the full-length
+        # trace_sid column never needs to leave disk on the host path
+        host_needed = ([n for n in needed if n != "span.trace_sid"]
+                       if "trace.span_off" in needed else needed)
+        cols = _host_cols(blk, host_needed, groups_range)
+        n_spans_seen = n_rows
         tm, counts = eval_block_host(
             (planned.tree, planned.conds), cols, operands,
             n_spans_seen, blk.meta.total_traces,
@@ -484,8 +488,10 @@ def search_blocks_fused(
         blk, p = item
         operands = Operands.build(p.rows, p.tables or None)
         needed = required_columns(p.conds)
-        cols = _host_cols(blk, needed, None)
-        n_spans = cols["span.trace_sid"].shape[0]
+        host_needed = ([n for n in needed if n != "span.trace_sid"]
+                       if "trace.span_off" in needed else needed)
+        cols = _host_cols(blk, host_needed, None)
+        n_spans = blk.pack.axes[S.AX_SPAN].n_rows
         tm, counts = eval_block_host(
             (p.tree, p.conds), cols, operands, n_spans, blk.meta.total_traces
         )

@@ -124,7 +124,10 @@ def eval_block_host(
     for n, a in cols.items():
         if n.startswith("res."):
             n_res = max(n_res, a.shape[0])
-    tsid = cols["span.trace_sid"]
+    # trace_sid only backs the bincount fallback; when the grouped
+    # span_off offsets are present (the normal case) callers may skip
+    # reading the whole span-length column
+    tsid = cols.get("span.trace_sid")
     span_masks: list[np.ndarray] = []
 
     def ev_span(t):
