@@ -482,8 +482,9 @@ def build_block_from_traces(
     block_id: str | None = None,
     row_group_spans: int = S.DEFAULT_ROW_GROUP_SPANS,
     compaction_level: int = 0,
+    codec: str = "zstd",
 ) -> BlockMeta:
     b = BlockBuilder(tenant, block_id, row_group_spans, compaction_level=compaction_level)
     for tid, t in sorted(traces, key=lambda p: p[0]):
         b.add_trace(tid, t)
-    return write_block(backend, b.finalize())
+    return write_block(backend, b.finalize(), codec=codec)

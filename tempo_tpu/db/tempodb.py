@@ -36,6 +36,9 @@ class TempoDBConfig:
     backend: dict = field(default_factory=lambda: {"backend": "local", "path": "./tempo-data"})
     wal_path: str = "./tempo-wal"
     row_group_spans: int = 1 << 16
+    # chunk codec for ingest-written blocks (colio codec matrix:
+    # zstd | gzip | lzma | raw); compaction output uses compaction.zstd_level
+    block_codec: str = "zstd"
     pool_workers: int = 8
     blocklist_poll_s: float = 15.0
     block_cache_blocks: int = 64
@@ -103,7 +106,8 @@ class TempoDB:
         """Build + flush a complete block from sorted traces (ingester's
         CompleteBlock + WriteBlock path, tempodb.go:199-251)."""
         meta = build_block_from_traces(
-            self.backend, tenant, traces, row_group_spans=self.cfg.row_group_spans
+            self.backend, tenant, traces, row_group_spans=self.cfg.row_group_spans,
+            codec=self.cfg.block_codec,
         )
         self.blocklist.update(tenant, add=[meta])
         return meta

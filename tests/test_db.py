@@ -443,3 +443,26 @@ def test_columnar_compaction_size_cuts(tmp_path):
     for t, original in all_traces:
         got = db.find_trace_by_id(TENANT, t)
         assert got is not None and got.span_count() == original.span_count()
+
+
+def test_block_codec_config(tmp_path):
+    """TempoDBConfig.block_codec writes ingest blocks with the chosen
+    chunk codec; find/search read them back transparently."""
+    from tempo_tpu.backend.mem import MemBackend
+    from tempo_tpu.db.search import SearchRequest
+    from tempo_tpu.db.tempodb import TempoDB, TempoDBConfig
+    from tempo_tpu.util.testdata import make_traces
+
+    db = TempoDB(TempoDBConfig(wal_path=str(tmp_path / "w"), block_codec="gzip"),
+                 backend=MemBackend())
+    traces = make_traces(10, seed=6, n_spans=3)
+    meta = db.write_block("t", sorted(traces, key=lambda t: t[0]))
+    blk = db.open_block(meta)
+    codecs = {rec[3] for col in blk.pack._cols.values() for rec in col["chunks"]
+              if rec[2] >= 128}
+    assert "gzip" in codecs and "zstd" not in codecs
+    tid, tr = traces[2]
+    got = db.find_trace_by_id("t", tid)
+    assert got is not None and got.span_count() == tr.span_count()
+    assert db.search("t", SearchRequest(limit=50)).traces
+    db.close()
