@@ -60,6 +60,10 @@ class AppConfig:
     # processes reach this one at (http://host:port). Empty = single binary
     # with an in-memory ring.
     kv_dir: str = ""
+    # OR true multi-host membership: gossip bind addr (host:port, 0 port =
+    # ephemeral) + comma-separated seed peers (reference: memberlist)
+    gossip_bind: str = ""
+    gossip_seeds: str = ""
     advertise_addr: str = ""
     http_host: str = ""  # default: loopback, or 0.0.0.0 when advertising non-loopback
     # shared secret for /internal/* and remote /flush//shutdown when the
@@ -85,10 +89,13 @@ class App:
                      "compactor", "metrics-generator")
 
     def __init__(self, cfg: AppConfig):
-        if cfg.target == "distributor" and not cfg.kv_dir:
+        shared_ring = bool(cfg.kv_dir or cfg.gossip_bind)
+        if cfg.target == "distributor" and not shared_ring:
             raise ValueError(
-                "standalone distributor needs a shared ring (--kv.dir) to "
-                "reach remote ingesters; or run -target=all (single binary)"
+                "standalone distributor needs a shared ring (--kv.dir for a "
+                "shared filesystem, --memberlist.bind/--memberlist.join for "
+                "multi-host gossip) to reach remote ingesters; or run "
+                "-target=all (single binary)"
             )
         if cfg.target not in self.VALID_TARGETS:
             raise ValueError(f"unknown target {cfg.target!r}; one of {self.VALID_TARGETS}")
@@ -99,12 +106,13 @@ class App:
         def has(role: str) -> bool:
             return cfg.target in ("all", role)
 
-        if cfg.kv_dir and cfg.target in ("all", "ingester") and not cfg.advertise_addr.startswith(
+        if shared_ring and cfg.target in ("all", "ingester") and not cfg.advertise_addr.startswith(
             ("http://", "https://")
         ):
             raise ValueError(
-                "an ingester joining a shared ring (--kv.dir) must advertise an "
-                "http(s):// address (--advertise.addr) for peers to reach it"
+                "an ingester joining a shared ring (--kv.dir or --memberlist.*) "
+                "must advertise an http(s):// address (--advertise.addr) for "
+                "peers to reach it"
             )
         # per-instance WAL dir: ingesters sharing --storage.path must never
         # replay (and delete) each other's live WAL files
@@ -118,7 +126,14 @@ class App:
         )
         self.db.poll_now()
         self.overrides = Overrides(path=cfg.overrides_path)
-        if cfg.kv_dir:
+        if cfg.gossip_bind:
+            from ..transport.gossip import GossipKV
+
+            self.kv = GossipKV(
+                cfg.gossip_bind,
+                seeds=[s.strip() for s in cfg.gossip_seeds.split(",") if s.strip()],
+            )
+        elif cfg.kv_dir:
             from ..transport import FileKV
 
             self.kv = FileKV(cfg.kv_dir)
@@ -151,7 +166,7 @@ class App:
 
             self.generator = MetricsGenerator(self.overrides)
             gen_forward = self.generator.push
-            if cfg.kv_dir and cfg.target == "metrics-generator":
+            if shared_ring and cfg.target == "metrics-generator":
                 # standalone generator joins its own ring so distributors
                 # shuffle-shard tenants across the generator fleet
                 self.generator_lifecycler = Lifecycler(
@@ -164,7 +179,7 @@ class App:
             # no local generator -> shuffle-sharded remote generator ring
             gen_ring = (
                 Ring(self.kv, GENERATOR_RING)
-                if cfg.kv_dir and self.generator is None
+                if shared_ring and self.generator is None
                 else None
             )
             self.distributor = Distributor(
@@ -176,13 +191,13 @@ class App:
         if has("querier") or has("query-frontend"):
             # with a shared KV the ring may hold remote ingesters even when
             # this process hosts none
-            ingester_ring = self.ring if (self._clients or cfg.kv_dir) else None
+            ingester_ring = self.ring if (self._clients or shared_ring) else None
             self.querier = Querier(self.db, ingester_ring, self.client_for)
             # a standalone query-frontend with remote queriers attached is
             # dispatcher-only (v1/frontend.go); every other shape keeps
             # in-process workers draining the same queue
             n_workers = cfg.frontend_workers
-            if cfg.target == "query-frontend" and cfg.kv_dir:
+            if cfg.target == "query-frontend" and shared_ring:
                 n_workers = 0
             self.frontend = Frontend(self.querier, n_workers=n_workers,
                                      overrides=self.overrides)
@@ -269,6 +284,8 @@ class App:
         if self.generator_lifecycler:
             self.generator_lifecycler.leave()
         self.db.close()
+        if hasattr(self.kv, "close"):
+            self.kv.close()  # gossip mode: stop the server + sync loop
         if self.http_server:
             self.http_server.shutdown()
 
@@ -611,6 +628,10 @@ def main(argv=None):
     ap.add_argument("--multitenancy", action="store_const", const=True, default=None)
     ap.add_argument("--kv.dir", dest="kv_dir", default=None,
                     help="shared ring-KV directory for multi-process topologies")
+    ap.add_argument("--memberlist.bind", dest="gossip_bind", default=None,
+                    help="gossip bind addr host:port for multi-HOST rings")
+    ap.add_argument("--memberlist.join", dest="gossip_seeds", default=None,
+                    help="comma-separated gossip seed peers")
     ap.add_argument("--advertise.addr", dest="advertise", default=None,
                     help="address other processes reach this one at (http://host:port)")
     ap.add_argument("--instance.id", dest="instance_id", default=None)
@@ -630,6 +651,8 @@ def main(argv=None):
         "overrides_path": args.overrides,
         "multitenancy": args.multitenancy,
         "kv_dir": args.kv_dir,
+        "gossip_bind": args.gossip_bind,
+        "gossip_seeds": args.gossip_seeds,
         "advertise_addr": args.advertise,
         "instance_id": args.instance_id,
         "replication_factor": args.rf,

@@ -1,0 +1,200 @@
+"""Gossip ring KV: multi-host membership without shared storage.
+
+The role of the reference's memberlist KV (cmd/tempo/app/modules.go:
+288-316): every process binds a gossip port, joins via seed addresses,
+and periodically push-pull syncs FULL ring state with a random known
+peer (memberlist's anti-entropy TCP sync; we skip the UDP probe layer
+-- rings piggyback liveness on heartbeat timestamps anyway).
+
+Merge rules: per (ring, instance) the newer heartbeat_ts wins;
+removals become tombstones stamped at removal time, beat older updates,
+and expire after a grace period. The peer set itself gossips alongside
+ring state, so one seed is enough to find everyone.
+
+Wire format: one JSON object per sync over a TCP connection
+(length-prefixed), answered with the full local state -- both sides
+converge in one round trip.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import socket
+import socketserver
+import struct
+import threading
+import time
+
+from ..ring.ring import InstanceDesc, InstanceState
+
+_TOMBSTONE_TTL_S = 120.0
+_PEER_TTL_S = 120.0  # drop non-seed peers unseen this long (dead addrs)
+_LEN = struct.Struct("<I")
+_MAX_MSG = 16 << 20
+
+
+def _desc_to_dict(d: InstanceDesc) -> dict:
+    return {"instance_id": d.instance_id, "addr": d.addr, "state": d.state.value,
+            "tokens": d.tokens, "heartbeat_ts": d.heartbeat_ts}
+
+
+def _desc_from_dict(v: dict) -> InstanceDesc:
+    return InstanceDesc(
+        instance_id=v["instance_id"], addr=v.get("addr", ""),
+        state=InstanceState(v.get("state", InstanceState.ACTIVE.value)),
+        tokens=v.get("tokens", []), heartbeat_ts=v.get("heartbeat_ts", 0.0),
+    )
+
+
+class GossipKV:
+    def __init__(self, bind: str = "127.0.0.1:0", seeds: list[str] | None = None,
+                 interval_s: float = 1.0):
+        host, _, port = bind.partition(":")
+        self._lock = threading.RLock()
+        # ring_key -> instance_id -> {"desc": dict|None, "ts": float}
+        # (desc None = tombstone; ts orders merges)
+        self._state: dict[str, dict[str, dict]] = {}
+        self._seeds = tuple(seeds or [])  # never expire: rejoin anchors
+        self._peers: dict[str, float] = {a: time.time() for a in self._seeds}
+        self.interval_s = interval_s
+        self.syncs = 0
+
+        kv = self
+
+        class _Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                try:
+                    theirs = _recv_msg(self.request)
+                    mine = kv._merge_and_snapshot(theirs)
+                    _send_msg(self.request, mine)
+                except (OSError, ValueError, ConnectionError):
+                    pass
+
+        socketserver.ThreadingTCPServer.allow_reuse_address = True
+        self._server = socketserver.ThreadingTCPServer((host or "127.0.0.1",
+                                                        int(port or 0)), _Handler)
+        self._server.daemon_threads = True
+        self.addr = f"{self._server.server_address[0]}:{self._server.server_address[1]}"
+        threading.Thread(target=self._server.serve_forever, daemon=True,
+                         name="gossip-server").start()
+        self._stop = threading.Event()
+        threading.Thread(target=self._gossip_loop, daemon=True,
+                         name="gossip-loop").start()
+
+    # --------------------------------------------------------- KV interface
+    def update(self, ring_key: str, desc: InstanceDesc) -> None:
+        with self._lock:
+            self._state.setdefault(ring_key, {})[desc.instance_id] = {
+                "desc": _desc_to_dict(desc), "ts": desc.heartbeat_ts or time.time(),
+            }
+
+    def remove(self, ring_key: str, instance_id: str) -> None:
+        with self._lock:
+            self._state.setdefault(ring_key, {})[instance_id] = {
+                "desc": None, "ts": time.time(),  # tombstone
+            }
+
+    def get_all(self, ring_key: str) -> dict[str, InstanceDesc]:
+        with self._lock:
+            out = {}
+            for iid, ent in self._state.get(ring_key, {}).items():
+                if ent["desc"] is not None:
+                    out[iid] = _desc_from_dict(ent["desc"])
+            return out
+
+    # ------------------------------------------------------------- gossip
+    def _snapshot(self) -> dict:
+        """COPIES under the lock: callers serialize outside it, and the
+        live dicts mutate concurrently (updates / inbound merges)."""
+        with self._lock:
+            now = time.time()
+            # expire old tombstones so state doesn't grow forever
+            for ring in self._state.values():
+                for iid in [i for i, e in ring.items()
+                            if e["desc"] is None and now - e["ts"] > _TOMBSTONE_TTL_S]:
+                    del ring[iid]
+            # prune dead peer addrs (ephemeral rebinds accumulate);
+            # seeds stay forever as rejoin anchors
+            self._peers = {
+                a: t for a, t in self._peers.items()
+                if a != self.addr and (a in self._seeds or now - t < _PEER_TTL_S)
+            }
+            state = {rk: dict(ring) for rk, ring in self._state.items()}
+            return {"state": state, "peers": {**self._peers, self.addr: now}}
+
+    def _merge_and_snapshot(self, theirs: dict) -> dict:
+        self._merge(theirs)
+        return self._snapshot()
+
+    def _merge(self, theirs: dict) -> None:
+        if not isinstance(theirs, dict):
+            return
+        with self._lock:
+            state = theirs.get("state")
+            for ring_key, instances in (state.items() if isinstance(state, dict) else ()):
+                if not isinstance(instances, dict):
+                    continue
+                ring = self._state.setdefault(ring_key, {})
+                for iid, ent in instances.items():
+                    if not isinstance(ent, dict):
+                        continue
+                    cur = ring.get(iid)
+                    if cur is None or ent.get("ts", 0) > cur["ts"]:
+                        ring[iid] = {"desc": ent.get("desc"), "ts": ent.get("ts", 0)}
+            peers = theirs.get("peers")
+            for addr, seen in (peers.items() if isinstance(peers, dict) else ()):
+                if addr != self.addr and isinstance(seen, (int, float)):
+                    self._peers[addr] = max(self._peers.get(addr, 0), seen)
+
+    def sync_once(self, peer: str | None = None) -> bool:
+        """One push-pull with a random (or given) peer."""
+        with self._lock:
+            peers = [a for a in self._peers if a != self.addr]
+        if peer is None:
+            if not peers:
+                return False
+            peer = random.choice(peers)
+        host, _, port = peer.partition(":")
+        try:
+            with socket.create_connection((host, int(port)), timeout=3.0) as s:
+                _send_msg(s, self._snapshot())
+                self._merge(_recv_msg(s))
+            self.syncs += 1
+            return True
+        except (OSError, ValueError, ConnectionError):
+            return False
+
+    def _gossip_loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.sync_once()
+            except Exception:  # noqa: BLE001 - the loop must outlive bugs
+                pass
+
+    def close(self) -> None:
+        self._stop.set()
+        self._server.shutdown()
+
+
+def _send_msg(sock: socket.socket, obj: dict) -> None:
+    data = json.dumps(obj).encode()
+    sock.sendall(_LEN.pack(len(data)) + data)
+
+
+def _recv_msg(sock: socket.socket) -> dict:
+    hdr = _recv_exact(sock, _LEN.size)
+    (n,) = _LEN.unpack(hdr)
+    if n > _MAX_MSG:
+        raise ValueError(f"gossip message too large: {n}")
+    return json.loads(_recv_exact(sock, n))
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    out = bytearray()
+    while len(out) < n:
+        chunk = sock.recv(n - len(out))
+        if not chunk:
+            raise ConnectionError("gossip peer closed")
+        out += chunk
+    return bytes(out)

@@ -1,0 +1,64 @@
+"""Gossip ring KV: multi-host membership convergence without shared
+storage (reference: memberlist anti-entropy sync)."""
+
+import time
+
+from tempo_tpu.ring.ring import Lifecycler, Ring
+from tempo_tpu.transport.gossip import GossipKV
+
+
+def _converge(check, timeout=10.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if check():
+            return True
+        time.sleep(0.1)
+    return False
+
+
+def test_gossip_convergence_and_tombstones():
+    kvs = []
+    try:
+        n1 = GossipKV("127.0.0.1:0", interval_s=0.2)
+        n2 = GossipKV("127.0.0.1:0", seeds=[n1.addr], interval_s=0.2)
+        n3 = GossipKV("127.0.0.1:0", seeds=[n1.addr], interval_s=0.2)
+        kvs = [n1, n2, n3]
+
+        # one instance joins on each node; every node must see all three
+        for i, kv in enumerate(kvs):
+            Lifecycler(kv, "ring", f"inst-{i}", addr=f"http://h{i}").join()
+        assert _converge(lambda: all(len(kv.get_all("ring")) == 3 for kv in kvs)), \
+            [sorted(kv.get_all("ring")) for kv in kvs]
+
+        # n3 discovered n2 transitively through the shared seed
+        ids = {sorted(kv.get_all("ring"))[1] for kv in kvs}
+        assert ids == {"inst-1"}
+
+        # removal tombstones propagate (and beat the stale descriptor)
+        n2.remove("ring", "inst-1")
+        assert _converge(lambda: all(len(kv.get_all("ring")) == 2 for kv in kvs)), \
+            [sorted(kv.get_all("ring")) for kv in kvs]
+
+        # rings over gossip KVs behave like any other KV
+        ring = Ring(n3, "ring")
+        assert {d.instance_id for d in ring.healthy_instances()} == {"inst-0", "inst-2"}
+    finally:
+        for kv in kvs:
+            kv.close()
+
+
+def test_gossip_heartbeats_win_by_recency():
+    n1 = GossipKV("127.0.0.1:0", interval_s=0.2)
+    n2 = GossipKV("127.0.0.1:0", seeds=[n1.addr], interval_s=0.2)
+    try:
+        lc = Lifecycler(n1, "r", "a", addr="http://a")
+        lc.join()
+        assert _converge(lambda: "a" in n2.get_all("r"))
+        ts1 = n2.get_all("r")["a"].heartbeat_ts
+        time.sleep(0.3)
+        lc.desc.heartbeat_ts = time.time()
+        n1.update("r", lc.desc)
+        assert _converge(lambda: n2.get_all("r")["a"].heartbeat_ts > ts1)
+    finally:
+        n1.close()
+        n2.close()
