@@ -64,6 +64,7 @@ class AppConfig:
     # ephemeral) + comma-separated seed peers (reference: memberlist)
     gossip_bind: str = ""
     gossip_seeds: str = ""
+    gossip_advertise: str = ""  # addr peers dial (wildcard binds need it)
     advertise_addr: str = ""
     http_host: str = ""  # default: loopback, or 0.0.0.0 when advertising non-loopback
     # shared secret for /internal/* and remote /flush//shutdown when the
@@ -80,6 +81,9 @@ class AppConfig:
     # the local distributor ("" = off); reference: the app traces its own
     # handlers and ships them like any tenant's (SURVEY.md 5.1)
     self_tracing_tenant: str = ""
+    # metrics-generator remote-write target ("" = expose on /metrics only)
+    remote_write_url: str = ""
+    remote_write_interval_s: float = 15.0
 
 
 class App:
@@ -132,6 +136,7 @@ class App:
             self.kv = GossipKV(
                 cfg.gossip_bind,
                 seeds=[s.strip() for s in cfg.gossip_seeds.split(",") if s.strip()],
+                advertise=cfg.gossip_advertise,
             )
         elif cfg.kv_dir:
             from ..transport import FileKV
@@ -234,6 +239,7 @@ class App:
         self.usage = UsageReporter(self.db.backend, cfg.target)
         self._started = False
         self.otlp_grpc = None
+        self.remote_writer = None
         self.http_server: ThreadingHTTPServer | None = None
 
     # ------------------------------------------------------------ lifecycle
@@ -251,6 +257,14 @@ class App:
         if self.querier_worker:
             self.querier_worker.start()
         self.overrides.start_reloader()  # hot-reload per-tenant limits
+        if self.generator is not None and self.cfg.remote_write_url:
+            from .remotewrite import RemoteWriter
+
+            self.remote_writer = RemoteWriter(
+                self.generator, self.cfg.remote_write_url,
+                interval_s=self.cfg.remote_write_interval_s,
+            )
+            self.remote_writer.start()
         if self.distributor is not None and self.cfg.otlp_grpc_port != 0:
             from .otlp_grpc import OTLPGrpcReceiver
 
@@ -266,6 +280,8 @@ class App:
         self._started = True
 
     def stop(self) -> None:
+        if self.remote_writer is not None:
+            self.remote_writer.stop()
         self.overrides.stop()
         if self.otlp_grpc is not None:
             self.otlp_grpc.stop()
@@ -382,7 +398,13 @@ def _make_handler(app: App):
                 if u.path == "/ready":
                     return self._send(200 if app.ready() else 503, "ready" if app.ready() else "starting", "text/plain")
                 if u.path == "/metrics":
-                    return self._send(200, _metrics_text(app), "text/plain")
+                    # OpenMetrics: exemplars on histogram buckets are only
+                    # legal in this format (classic text parsers reject
+                    # the `# {...}` suffix), and it requires the EOF marker
+                    return self._send(
+                        200, _metrics_text(app) + "# EOF\n",
+                        "application/openmetrics-text; version=1.0.0; charset=utf-8",
+                    )
                 if u.path == "/status/config":
                     return self._send(200, json.dumps(_config_dict(app.cfg), indent=2))
                 if u.path == "/status/usage-stats":
@@ -632,6 +654,8 @@ def main(argv=None):
                     help="gossip bind addr host:port for multi-HOST rings")
     ap.add_argument("--memberlist.join", dest="gossip_seeds", default=None,
                     help="comma-separated gossip seed peers")
+    ap.add_argument("--memberlist.advertise", dest="gossip_advertise", default=None,
+                    help="gossip addr peers dial (needed for 0.0.0.0 binds)")
     ap.add_argument("--advertise.addr", dest="advertise", default=None,
                     help="address other processes reach this one at (http://host:port)")
     ap.add_argument("--instance.id", dest="instance_id", default=None)
@@ -653,6 +677,7 @@ def main(argv=None):
         "kv_dir": args.kv_dir,
         "gossip_bind": args.gossip_bind,
         "gossip_seeds": args.gossip_seeds,
+        "gossip_advertise": args.gossip_advertise,
         "advertise_addr": args.advertise,
         "instance_id": args.instance_id,
         "replication_factor": args.rf,

@@ -57,6 +57,8 @@ class SpanMetricsProcessor:
         # pending span columns
         self._sid: list[int] = []
         self._dur_s: list[float] = []
+        # exemplars: last observed (trace_id hex, duration s) per series
+        self.exemplars: dict[int, tuple[str, float]] = {}
         # aggregated state
         self.calls = np.zeros(0, dtype=np.int64)
         self.lat_sum = np.zeros(0, dtype=np.float64)
@@ -82,9 +84,12 @@ class SpanMetricsProcessor:
                         else:
                             sid = self.keys[k] = len(self.key_list)
                             self.key_list.append(SeriesKey(*k))
+                    dur_s = max(0, sp.duration_nanos) / 1e9
                     self._sid.append(sid)
-                    self._dur_s.append(max(0, sp.duration_nanos) / 1e9)
+                    self._dur_s.append(dur_s)
                     self.last_update[sid] = time.time()
+                    if sp.trace_id:
+                        self.exemplars[sid] = (sp.trace_id.hex(), dur_s)
 
     def collect(self) -> None:
         """Fold pending spans into series state with the device reduce."""
@@ -125,6 +130,7 @@ class SpanMetricsProcessor:
                 self.keys.pop((key.service, key.span_name, key.kind, key.status), None)
                 # zero the counter rows so a reused slot starts fresh,
                 # then free the sid for the next new series
+                self.exemplars.pop(s, None)
                 if s < len(self.calls):
                     self.calls[s] = 0
                     self.lat_sum[s] = 0.0
@@ -150,14 +156,20 @@ class SpanMetricsProcessor:
                 out.append(
                     f"traces_spanmetrics_latency_count{{{lab}}} {int(self.lat_count[sid])}"
                 )
+                ex = self.exemplars.get(sid)
                 cum = 0
                 for bi, edge in enumerate(LATENCY_BUCKETS):
                     cum += int(self.lat_buckets[sid, bi])
-                    out.append(
-                        f'traces_spanmetrics_latency_bucket{{{lab},le="{edge}"}} {cum}'
-                    )
+                    line = f'traces_spanmetrics_latency_bucket{{{lab},le="{edge}"}} {cum}'
+                    if ex is not None and ex[1] <= edge and (bi == 0 or ex[1] > LATENCY_BUCKETS[bi - 1]):
+                        # OpenMetrics exemplar: the trace behind this bucket
+                        line += f' # {{trace_id="{ex[0]}"}} {ex[1]:.6f}'
+                    out.append(line)
                 cum += int(self.lat_buckets[sid, -1])
-                out.append(f'traces_spanmetrics_latency_bucket{{{lab},le="+Inf"}} {cum}')
+                line = f'traces_spanmetrics_latency_bucket{{{lab},le="+Inf"}} {cum}'
+                if ex is not None and ex[1] > LATENCY_BUCKETS[-1]:
+                    line += f' # {{trace_id="{ex[0]}"}} {ex[1]:.6f}'
+                out.append(line)
         return out
 
 

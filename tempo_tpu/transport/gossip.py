@@ -49,7 +49,9 @@ def _desc_from_dict(v: dict) -> InstanceDesc:
 
 class GossipKV:
     def __init__(self, bind: str = "127.0.0.1:0", seeds: list[str] | None = None,
-                 interval_s: float = 1.0):
+                 interval_s: float = 1.0, advertise: str = ""):
+        """advertise: the addr OTHER nodes dial (required when binding
+        0.0.0.0/ephemeral across hosts; defaults to the bound addr)."""
         host, _, port = bind.partition(":")
         self._lock = threading.RLock()
         # ring_key -> instance_id -> {"desc": dict|None, "ts": float}
@@ -65,6 +67,8 @@ class GossipKV:
         class _Handler(socketserver.BaseRequestHandler):
             def handle(self):
                 try:
+                    # a stalled peer must not pin a handler thread forever
+                    self.request.settimeout(5.0)
                     theirs = _recv_msg(self.request)
                     mine = kv._merge_and_snapshot(theirs)
                     _send_msg(self.request, mine)
@@ -75,7 +79,13 @@ class GossipKV:
         self._server = socketserver.ThreadingTCPServer((host or "127.0.0.1",
                                                         int(port or 0)), _Handler)
         self._server.daemon_threads = True
-        self.addr = f"{self._server.server_address[0]}:{self._server.server_address[1]}"
+        bound = f"{self._server.server_address[0]}:{self._server.server_address[1]}"
+        if not advertise and bound.startswith(("0.0.0.0:", ":")):
+            raise ValueError(
+                "gossip bound to a wildcard address: peers cannot dial "
+                "0.0.0.0 -- pass an advertise addr (--memberlist.advertise)"
+            )
+        self.addr = advertise or bound
         threading.Thread(target=self._server.serve_forever, daemon=True,
                          name="gossip-server").start()
         self._stop = threading.Event()

@@ -1,0 +1,164 @@
+"""Generator remote-write: snappy(protobuf WriteRequest) verified by an
+INDEPENDENT decoder in the test (snappy block format + prompb reader),
+so the hand-rolled encoders are checked against the specs."""
+
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+from tempo_tpu.services.generator import MetricsGenerator
+from tempo_tpu.services.overrides import Overrides
+from tempo_tpu.services.remotewrite import (
+    RemoteWriter,
+    encode_write_request,
+    parse_exposition,
+    snappy_block_encode,
+)
+from tempo_tpu.util.testdata import make_traces
+from tempo_tpu.wire import pbwire as w
+
+
+def snappy_decode(data: bytes) -> bytes:
+    """Spec decoder: varint length + literal/copy tags (tests only)."""
+    n, pos = w.read_varint(data, 0)
+    out = bytearray()
+    while pos < len(data):
+        tag = data[pos]
+        pos += 1
+        t = tag & 3
+        if t == 0:  # literal
+            ln = tag >> 2
+            if ln < 60:
+                ln += 1
+            else:
+                nbytes = ln - 59
+                ln = int.from_bytes(data[pos : pos + nbytes], "little") + 1
+                pos += nbytes
+            out += data[pos : pos + ln]
+            pos += ln
+        else:  # copy
+            if t == 1:
+                ln = ((tag >> 2) & 7) + 4
+                off = ((tag >> 5) << 8) | data[pos]
+                pos += 1
+            elif t == 2:
+                ln = (tag >> 2) + 1
+                off = int.from_bytes(data[pos : pos + 2], "little")
+                pos += 2
+            else:
+                ln = (tag >> 2) + 1
+                off = int.from_bytes(data[pos : pos + 4], "little")
+                pos += 4
+            for _ in range(ln):
+                out.append(out[-off])
+    assert len(out) == n
+    return bytes(out)
+
+
+def decode_write_request(data: bytes):
+    series = []
+    pos = 0
+    while pos < len(data):
+        key, pos = w.read_varint(data, pos)
+        assert key >> 3 == 1 and key & 7 == 2
+        ln, pos = w.read_varint(data, pos)
+        ts_msg = data[pos : pos + ln]
+        pos += ln
+        labels, samples = {}, []
+        p = 0
+        while p < len(ts_msg):
+            k, p = w.read_varint(ts_msg, p)
+            ln2, p = w.read_varint(ts_msg, p)
+            body = ts_msg[p : p + ln2]
+            p += ln2
+            if k >> 3 == 1:  # label
+                q = 0
+                name = value = ""
+                while q < len(body):
+                    lk, q = w.read_varint(body, q)
+                    lln, q = w.read_varint(body, q)
+                    s = body[q : q + lln].decode()
+                    q += lln
+                    if lk >> 3 == 1:
+                        name = s
+                    else:
+                        value = s
+                labels[name] = value
+            else:  # sample
+                import struct
+                val = struct.unpack("<d", body[1:9])[0]
+                samples.append(val)
+        series.append((labels, samples))
+    return series
+
+
+def test_snappy_block_roundtrip():
+    for blob in (b"", b"x", b"hello" * 100, bytes(range(256)) * 700):
+        assert snappy_decode(snappy_block_encode(blob)) == blob
+
+
+def test_remote_write_e2e():
+    received = []
+
+    class H(BaseHTTPRequestHandler):
+        def do_POST(self):
+            ln = int(self.headers["Content-Length"])
+            body = self.rfile.read(ln)
+            assert self.headers["Content-Encoding"] == "snappy"
+            received.append(decode_write_request(snappy_decode(body)))
+            self.send_response(200)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+
+        def log_message(self, *a):
+            pass
+
+    srv = HTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        gen = MetricsGenerator(Overrides())
+        traces = make_traces(12, seed=9, n_spans=4)
+        gen.push("t1", [t for _, t in traces])
+        rw = RemoteWriter(gen, f"http://127.0.0.1:{srv.server_address[1]}/api/v1/push")
+        assert rw.push_once()
+        assert rw.pushes == 1
+        (series,) = received
+        names = {lab["__name__"] for lab, _ in series}
+        assert "traces_spanmetrics_calls_total" in names
+        assert "traces_spanmetrics_latency_bucket" in names
+        # counts survive the trip
+        total = sum(s[0] for lab, s in series
+                    if lab["__name__"] == "traces_spanmetrics_calls_total")
+        assert total == sum(t.span_count() for _, t in traces)
+        # bucket labels include le
+        assert any("le" in lab for lab, _ in series)
+    finally:
+        srv.shutdown()
+
+
+def test_exemplars_in_exposition():
+    gen = MetricsGenerator(Overrides())
+    traces = make_traces(5, seed=4, n_spans=3)
+    gen.push("t1", [t for _, t in traces])
+    text = "\n".join(gen.metrics_text())
+    assert '# {trace_id="' in text  # OpenMetrics exemplar attached
+    # exemplars don't break remote-write parsing
+    series = parse_exposition(text.splitlines())
+    assert any(lab["__name__"] == "traces_spanmetrics_latency_bucket"
+               for lab, _ in series)
+
+
+def test_parse_exposition_hostile_labels():
+    """Label values with braces, spaces and ' # ' parse correctly; the
+    exemplar suffix is dropped without truncating series."""
+    lines = [
+        'm_total{span_name="GET # users",svc="a}b"} 3',
+        'bucket{le="0.5",span_name="x y"} 7 # {trace_id="ab"} 0.2',
+        "plain_total 9",
+        "# EOF",
+    ]
+    series = parse_exposition(lines)
+    assert (dict(series[0][0]), series[0][1]) == (
+        {"__name__": "m_total", "span_name": "GET # users", "svc": "a}b"}, 3.0)
+    assert series[1][0]["le"] == "0.5" and series[1][1] == 7.0
+    assert series[2] == ({"__name__": "plain_total"}, 9.0)
+    assert len(series) == 3
