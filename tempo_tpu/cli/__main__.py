@@ -82,6 +82,89 @@ def cmd_gen(args):
     print(f"wrote block {m.block_id}: {m.total_traces} traces, {m.total_spans} spans")
 
 
+def cmd_gen_bloom(args):
+    """Regenerate a block's bloom filter from its trace-id index
+    (reference: tempo-cli gen bloom) -- the recovery path for corrupted
+    or lost bloom shards."""
+    from ..block.bloom import ShardedBloom
+    from ..block.builder import BLOOM_PREFIX
+
+    db = _open_db(args.backend)
+    metas = db.blocklist.metas_by_id(args.tenant, [args.block_id])
+    if not metas:
+        print(f"block {args.block_id} not found", file=sys.stderr)
+        db.close()
+        sys.exit(1)
+    blk = db.open_block(metas[0])
+    ids = blk.trace_index["trace.id"]
+    bloom = ShardedBloom.for_estimated_items(max(1, ids.shape[0]))
+    bloom.add_array(ids)
+    for i in range(bloom.n_shards):
+        db.backend.write(args.tenant, args.block_id, f"{BLOOM_PREFIX}{i}",
+                         bloom.shard_bytes(i))
+    m = metas[0]
+    m.bloom_shards, m.bloom_shard_bits = bloom.n_shards, bloom.shard_bits
+    db.backend.write(args.tenant, args.block_id, "meta.json", m.to_json())
+    db.close()
+    print(f"regenerated bloom: {bloom.n_shards} shard(s), "
+          f"{bloom.shard_bits} bits/shard, {ids.shape[0]} ids")
+
+
+def cmd_dump_columns(args):
+    """Per-column layout of a block's data object (reference: tempo-cli
+    column dump): dtype, rows, chunks, stored vs raw bytes, codecs."""
+    db = _open_db(args.backend)
+    metas = db.blocklist.metas_by_id(args.tenant, [args.block_id])
+    if not metas:
+        print(f"block {args.block_id} not found", file=sys.stderr)
+        db.close()
+        sys.exit(1)
+    pack = db.open_block(metas[0]).pack
+    total_stored = total_raw = 0
+    print(f"{'column':24} {'dtype':8} {'rows':>10} {'chunks':>6} "
+          f"{'stored':>12} {'raw':>12} {'codecs'}")
+    for name in pack.names():
+        meta = pack._cols[name]
+        stored = sum(rec[1] for rec in meta["chunks"])
+        raw = sum(rec[2] for rec in meta["chunks"])
+        codecs = ",".join(sorted({rec[3] for rec in meta["chunks"]}))
+        total_stored += stored
+        total_raw += raw
+        print(f"{name:24} {meta['dtype']:8} {meta['shape'][0]:>10} "
+              f"{len(meta['chunks']):>6} {stored:>12} {raw:>12} {codecs}")
+    ratio = total_raw / total_stored if total_stored else 0
+    print(f"{'TOTAL':24} {'':8} {'':>10} {'':>6} {total_stored:>12} "
+          f"{total_raw:>12} ratio={ratio:.2f}x")
+    db.close()
+
+
+def cmd_rewrite_block(args):
+    """Rewrite a block at the CURRENT encoding version/codec (reference:
+    tempo-cli's convert/migrate role): materialize every trace, rebuild
+    through the builder, atomically swap the blocklist entry."""
+    from ..block.builder import build_block_from_traces
+
+    db = _open_db(args.backend)
+    metas = db.blocklist.metas_by_id(args.tenant, [args.block_id])
+    if not metas:
+        print(f"block {args.block_id} not found", file=sys.stderr)
+        db.close()
+        sys.exit(1)
+    blk = db.open_block(metas[0])
+    n = metas[0].total_traces
+    ids = blk.trace_index["trace.id"]
+    traces = [(ids[s].tobytes(), t)
+              for s, t in zip(range(n), blk.materialize_traces(list(range(n))))]
+    new = build_block_from_traces(db.backend, args.tenant, traces,
+                                  codec=args.codec,
+                                  compaction_level=metas[0].compaction_level)
+    db.backend.mark_compacted(args.tenant, args.block_id)
+    db.close()
+    print(f"rewrote {args.block_id} -> {new.block_id} "
+          f"(codec={args.codec}, {new.total_traces} traces); "
+          f"old block marked compacted")
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(prog="tempo-tpu-cli")
     ap.add_argument("--backend.path", dest="backend", default="./tempo-data")
@@ -114,6 +197,23 @@ def main(argv=None):
     p.add_argument("--spans", type=int, default=10)
     p.add_argument("--seed", type=int, default=0)
     p.set_defaults(fn=cmd_gen)
+
+    p = sub.add_parser("gen-bloom", help="regenerate a block's bloom filter")
+    p.add_argument("tenant")
+    p.add_argument("block_id")
+    p.set_defaults(fn=cmd_gen_bloom)
+
+    p = sub.add_parser("dump-columns", help="per-column layout of a block")
+    p.add_argument("tenant")
+    p.add_argument("block_id")
+    p.set_defaults(fn=cmd_dump_columns)
+
+    p = sub.add_parser("rewrite-block",
+                       help="rewrite a block at the current version/codec")
+    p.add_argument("tenant")
+    p.add_argument("block_id")
+    p.add_argument("--codec", default="zstd")
+    p.set_defaults(fn=cmd_rewrite_block)
 
     args = ap.parse_args(argv)
     try:

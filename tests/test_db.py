@@ -466,3 +466,47 @@ def test_block_codec_config(tmp_path):
     assert got is not None and got.span_count() == tr.span_count()
     assert db.search("t", SearchRequest(limit=50)).traces
     db.close()
+
+
+def test_cli_block_ops(tmp_path, capsys):
+    """gen-bloom / dump-columns / rewrite-block (tempo-cli's bloom
+    regen, column dump and convert roles)."""
+    import glob
+    import os
+
+    from tempo_tpu.cli.__main__ import main as cli
+
+    store = str(tmp_path / "store")
+    cli(["--backend.path", store, "gen", "t1", "--traces", "20", "--spans", "3"])
+    bid = capsys.readouterr().out.split()[2].rstrip(":")
+
+    cli(["--backend.path", store, "dump-columns", "t1", bid])
+    out = capsys.readouterr().out
+    assert "span.trace_sid" in out and "TOTAL" in out and "zstd" in out
+
+    # nuke the bloom; regen restores find
+    for f in glob.glob(os.path.join(store, "t1", bid, "bloom-*")):
+        os.remove(f)
+    cli(["--backend.path", store, "gen-bloom", "t1", bid])
+    assert "regenerated bloom" in capsys.readouterr().out
+    from tempo_tpu.backend.local import LocalBackend
+    from tempo_tpu.db.tempodb import TempoDB, TempoDBConfig
+
+    db = TempoDB(TempoDBConfig(wal_path=str(tmp_path / "w")),
+                 backend=LocalBackend(store))
+    db.poll_now()
+    blk = db.open_block(db.blocklist.metas("t1")[0])
+    tid = blk.trace_index["trace.id"][3].tobytes()
+    assert db.find_trace_by_id("t1", tid) is not None
+
+    cli(["--backend.path", store, "rewrite-block", "t1", bid, "--codec", "gzip"])
+    assert "rewrote" in capsys.readouterr().out
+    db2 = TempoDB(TempoDBConfig(wal_path=str(tmp_path / "w2")),
+                  backend=LocalBackend(store))
+    db2.poll_now()
+    metas = db2.blocklist.metas("t1")
+    assert len(metas) == 1 and metas[0].block_id != bid
+    got = db2.find_trace_by_id("t1", tid)
+    assert got is not None
+    db.close()
+    db2.close()
