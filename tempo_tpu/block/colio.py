@@ -367,6 +367,23 @@ class ColumnPack:
             for r, raw in zip(miss, outs):
                 self._cache_put(r[0], raw)
 
+    def column_stats(self) -> list[dict]:
+        """Per-column layout summary (name, dtype, rows, chunks, stored/
+        raw bytes, codecs) -- defined beside the footer format so layout
+        knowledge never leaks to callers."""
+        out = []
+        for name, meta in self._cols.items():
+            out.append({
+                "name": name,
+                "dtype": meta["dtype"],
+                "rows": meta["shape"][0],
+                "chunks": len(meta["chunks"]),
+                "stored": sum(rec[1] for rec in meta["chunks"]),
+                "raw": sum(rec[2] for rec in meta["chunks"]),
+                "codecs": sorted({rec[3] for rec in meta["chunks"]}),
+            })
+        return out
+
     def read_all(self) -> dict[str, np.ndarray]:
         """Every column, zero-copy: ONE destination buffer laid out
         column-after-column, every zstd chunk decompressed straight into

@@ -82,6 +82,15 @@ def cmd_gen(args):
     print(f"wrote block {m.block_id}: {m.total_traces} traces, {m.total_spans} spans")
 
 
+def _require_block(db, tenant: str, block_id: str):
+    metas = db.blocklist.metas_by_id(tenant, [block_id])
+    if not metas:
+        print(f"block {block_id} not found for tenant {tenant}", file=sys.stderr)
+        db.close()
+        sys.exit(1)
+    return metas[0]
+
+
 def cmd_gen_bloom(args):
     """Regenerate a block's bloom filter from its trace-id index
     (reference: tempo-cli gen bloom) -- the recovery path for corrupted
@@ -90,19 +99,15 @@ def cmd_gen_bloom(args):
     from ..block.builder import BLOOM_PREFIX
 
     db = _open_db(args.backend)
-    metas = db.blocklist.metas_by_id(args.tenant, [args.block_id])
-    if not metas:
-        print(f"block {args.block_id} not found", file=sys.stderr)
-        db.close()
-        sys.exit(1)
-    blk = db.open_block(metas[0])
+    meta = _require_block(db, args.tenant, args.block_id)
+    blk = db.open_block(meta)
     ids = blk.trace_index["trace.id"]
     bloom = ShardedBloom.for_estimated_items(max(1, ids.shape[0]))
     bloom.add_array(ids)
     for i in range(bloom.n_shards):
         db.backend.write(args.tenant, args.block_id, f"{BLOOM_PREFIX}{i}",
                          bloom.shard_bytes(i))
-    m = metas[0]
+    m = meta
     m.bloom_shards, m.bloom_shard_bits = bloom.n_shards, bloom.shard_bits
     db.backend.write(args.tenant, args.block_id, "meta.json", m.to_json())
     db.close()
@@ -114,24 +119,17 @@ def cmd_dump_columns(args):
     """Per-column layout of a block's data object (reference: tempo-cli
     column dump): dtype, rows, chunks, stored vs raw bytes, codecs."""
     db = _open_db(args.backend)
-    metas = db.blocklist.metas_by_id(args.tenant, [args.block_id])
-    if not metas:
-        print(f"block {args.block_id} not found", file=sys.stderr)
-        db.close()
-        sys.exit(1)
-    pack = db.open_block(metas[0]).pack
+    meta = _require_block(db, args.tenant, args.block_id)
+    pack = db.open_block(meta).pack
     total_stored = total_raw = 0
     print(f"{'column':24} {'dtype':8} {'rows':>10} {'chunks':>6} "
           f"{'stored':>12} {'raw':>12} {'codecs'}")
-    for name in pack.names():
-        meta = pack._cols[name]
-        stored = sum(rec[1] for rec in meta["chunks"])
-        raw = sum(rec[2] for rec in meta["chunks"])
-        codecs = ",".join(sorted({rec[3] for rec in meta["chunks"]}))
-        total_stored += stored
-        total_raw += raw
-        print(f"{name:24} {meta['dtype']:8} {meta['shape'][0]:>10} "
-              f"{len(meta['chunks']):>6} {stored:>12} {raw:>12} {codecs}")
+    for st in pack.column_stats():
+        total_stored += st["stored"]
+        total_raw += st["raw"]
+        print(f"{st['name']:24} {st['dtype']:8} {st['rows']:>10} "
+              f"{st['chunks']:>6} {st['stored']:>12} {st['raw']:>12} "
+              f"{','.join(st['codecs'])}")
     ratio = total_raw / total_stored if total_stored else 0
     print(f"{'TOTAL':24} {'':8} {'':>10} {'':>6} {total_stored:>12} "
           f"{total_raw:>12} ratio={ratio:.2f}x")
@@ -140,24 +138,23 @@ def cmd_dump_columns(args):
 
 def cmd_rewrite_block(args):
     """Rewrite a block at the CURRENT encoding version/codec (reference:
-    tempo-cli's convert/migrate role): materialize every trace, rebuild
-    through the builder, atomically swap the blocklist entry."""
-    from ..block.builder import build_block_from_traces
+    tempo-cli's convert/migrate role). Writes the new block fully, then
+    marks the old one compacted; between the two writes pollers may
+    briefly see both (the same transient-duplicate window normal
+    compaction has -- result dedupe covers it)."""
+    from ..block.builder import BlockBuilder, write_block
 
     db = _open_db(args.backend)
-    metas = db.blocklist.metas_by_id(args.tenant, [args.block_id])
-    if not metas:
-        print(f"block {args.block_id} not found", file=sys.stderr)
-        db.close()
-        sys.exit(1)
-    blk = db.open_block(metas[0])
-    n = metas[0].total_traces
+    meta = _require_block(db, args.tenant, args.block_id)
+    blk = db.open_block(meta)
+    n = meta.total_traces
     ids = blk.trace_index["trace.id"]
-    traces = [(ids[s].tobytes(), t)
-              for s, t in zip(range(n), blk.materialize_traces(list(range(n))))]
-    new = build_block_from_traces(db.backend, args.tenant, traces,
-                                  codec=args.codec,
-                                  compaction_level=metas[0].compaction_level)
+    b = BlockBuilder(args.tenant, compaction_level=meta.compaction_level)
+    for lo in range(0, n, 1024):  # bounded memory: one batch decoded at a time
+        sids = list(range(lo, min(lo + 1024, n)))
+        for s, t in zip(sids, blk.materialize_traces(sids)):
+            b.add_trace(ids[s].tobytes(), t)
+    new = write_block(db.backend, b.finalize(), codec=args.codec)
     db.backend.mark_compacted(args.tenant, args.block_id)
     db.close()
     print(f"rewrote {args.block_id} -> {new.block_id} "

@@ -497,7 +497,8 @@ def test_cli_block_ops(tmp_path, capsys):
     db.poll_now()
     blk = db.open_block(db.blocklist.metas("t1")[0])
     tid = blk.trace_index["trace.id"][3].tobytes()
-    assert db.find_trace_by_id("t1", tid) is not None
+    before = db.find_trace_by_id("t1", tid)
+    assert before is not None
 
     cli(["--backend.path", store, "rewrite-block", "t1", bid, "--codec", "gzip"])
     assert "rewrote" in capsys.readouterr().out
@@ -507,6 +508,11 @@ def test_cli_block_ops(tmp_path, capsys):
     metas = db2.blocklist.metas("t1")
     assert len(metas) == 1 and metas[0].block_id != bid
     got = db2.find_trace_by_id("t1", tid)
-    assert got is not None
+    assert got is not None and got.span_count() == before.span_count()
+    # attributes survive the lossless conversion
+    def attr_sets(t):
+        return sorted((sp.name, tuple(sorted(sp.attrs.items())))
+                      for _, _, sp in t.all_spans())
+    assert attr_sets(got) == attr_sets(before)
     db.close()
     db2.close()
