@@ -40,15 +40,19 @@ _MIN_COMPRESS = 128
 
 
 def _gzip_c(data: bytes, level: int) -> bytes:
-    import zlib
+    import gzip
 
-    return zlib.compress(data, min(level, 9))
+    # mtime=0 keeps output deterministic (chunk bytes are content-addressed
+    # by tests and dedupe-friendly in object stores)
+    return gzip.compress(data, compresslevel=min(level, 9), mtime=0)
 
 
 def _gzip_d(data: bytes, raw_len: int) -> bytes:
     import zlib
 
-    return zlib.decompress(data)
+    # wbits=47 auto-detects gzip (RFC1952) and zlib (RFC1950) framing:
+    # blocks written before the codec emitted true gzip used zlib framing
+    return zlib.decompress(data, 47)
 
 
 def _lzma_c(data: bytes, level: int) -> bytes:
@@ -401,7 +405,7 @@ class ColumnPack:
         z_offs: list[int] = []
         z_lens: list[int] = []
         raw_parts: list[tuple[int, bytes]] = []
-        bytes_read0 = self.bytes_read
+        counted = 0  # this attempt's IO accounting, for relative rollback
         pos = 0
         for name, meta in self._cols.items():
             pos = (pos + 15) & ~15  # keep every column view 16B-aligned
@@ -411,6 +415,7 @@ class ColumnPack:
                     continue
                 data = self._read_range(off, stored)
                 self._count_read(stored)
+                counted += stored
                 if codec == CODEC_ZSTD:
                     z_chunks.append(data)
                     z_offs.append(pos)
@@ -425,8 +430,10 @@ class ColumnPack:
             z_chunks, dst, np.asarray(z_offs), np.asarray(z_lens)
         ):
             # native refused mid-flight: fall back wholesale (and undo
-            # this attempt's IO accounting -- the fallback re-counts)
-            self.bytes_read = bytes_read0
+            # this attempt's IO accounting -- the fallback re-counts).
+            # Relative subtraction under the lock: a plain reset would
+            # clobber concurrent readers' increments.
+            self._count_read(-counted)
             self.warm([(n, None) for n in self._cols])
             return {n: self.read(n) for n in self._cols}
         for p, data in raw_parts:

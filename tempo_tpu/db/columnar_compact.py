@@ -239,6 +239,12 @@ def _assemble(tenant: str, sources: list[_Source], chunks: list[tuple[int, int, 
         re-encode into the same pass (per-run remap-table addresses)."""
         from ..native import gather_runs_addr, gather_runs_remap
 
+        if any(sources[si].cols[col].dtype != out.dtype
+               or sources[si].cols[col].shape[1:] != out.shape[1:]
+               for si in src_order):
+            return False  # raw row-byte copy needs uniform layout; caller
+            # falls back to the dtype-converting numpy path (_run_copy guard)
+
         alo, ahi, ab = axis_ranges[axis]
         arrs = [np.ascontiguousarray(sources[si].cols[col]) for si in src_order]
         row_bytes = out.dtype.itemsize * int(np.prod(out.shape[1:], dtype=np.int64))

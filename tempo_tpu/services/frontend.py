@@ -289,9 +289,15 @@ class Frontend:
         if job.done.is_set():
             return
         if ok:
-            job.result = decode_job_result(job.kind, result or {})
-            self.stats_jobs_remote += 1
-        else:
+            try:
+                job.result = decode_job_result(job.kind, result or {})
+            except Exception as e:  # malformed result from a buggy worker:
+                # treat as a retryable failure so the request doesn't hang
+                # until the dispatch deadline with its lease already popped
+                ok, retryable, error = False, True, f"undecodable result: {e}"
+            else:
+                self.stats_jobs_remote += 1
+        if not ok:
             job.tries += 1
             if retryable and job.tries < MAX_RETRIES:
                 try:

@@ -28,7 +28,13 @@ import time
 
 from ..ring.ring import InstanceDesc, InstanceState
 
-_TOMBSTONE_TTL_S = 120.0
+# Tombstones must outlive any plausible partition: a node cut off longer
+# than this TTL that still holds the removed instance's descriptor would
+# resurrect it cluster-wide on rejoin (memberlist keeps tombstones until
+# state sync confirms). 1h >> HEARTBEAT_TIMEOUT_S, so even a resurrected
+# descriptor would already be unhealthy (stale heartbeat) by the time its
+# tombstone could have been GC'd.
+_TOMBSTONE_TTL_S = 3600.0
 _PEER_TTL_S = 120.0  # drop non-seed peers unseen this long (dead addrs)
 _LEN = struct.Struct("<I")
 _MAX_MSG = 16 << 20
