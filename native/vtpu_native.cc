@@ -158,7 +158,8 @@ int vtpu_zstd_compress_batch(const uint8_t* src, const int64_t* in_offsets,
   };
   int nt = std::max(1, std::min(n_threads, n_chunks));
   std::vector<std::thread> ts;
-  for (int t = 0; t < nt; t++) ts.emplace_back(work);
+  for (int t = 1; t < nt; t++) ts.emplace_back(work);
+  work();  // calling thread is worker 0 (no spawn cost when nt == 1)
   for (auto& t : ts) t.join();
   return failed.load();
 }
@@ -184,9 +185,12 @@ int vtpu_zstd_decompress_batch(const uint8_t* src, const int64_t* in_offsets,
     }
     ZSTD_freeDCtx(ctx);
   };
+  // calling thread is worker 0: single-threaded calls (1-core hosts,
+  // small batches) pay zero spawn/join overhead
   int nt = std::max(1, std::min(n_threads, n_chunks));
   std::vector<std::thread> ts;
-  for (int t = 0; t < nt; t++) ts.emplace_back(work);
+  for (int t = 1; t < nt; t++) ts.emplace_back(work);
+  work();
   for (auto& t : ts) t.join();
   return failed.load();
 }
@@ -278,6 +282,77 @@ int64_t vtpu_gather_runs_remap(const int64_t* src_addrs, int32_t* dst,
     }
   }
   return oob;
+}
+
+// ------------------------------------------------------------ search eval
+//
+// Host filter primitives for the one-shot/cold search engine
+// (ops/hostfilter.py): single-pass C loops replacing multi-pass numpy
+// (mask materialization + astype + concatenate + reduceat). The repo's
+// counterpart of the reference's hand-tuned parquetquery predicate
+// loops (pkg/parquetquery/predicates.go), shaped for a 1-2 core host
+// feeding a TPU: memory-bandwidth-bound streaming, no allocation.
+
+// op codes shared with tempo_tpu/native/__init__.py mask_cmp()
+enum { CMP_EQ = 0, CMP_NE, CMP_LT, CMP_LE, CMP_GT, CMP_GE, CMP_RANGE, CMP_NE_PRESENT };
+
+}  // pause extern "C": templates cannot carry C language linkage
+
+template <typename T>
+static inline void mask_cmp_t(const T* x, int64_t n, int op, int64_t a64,
+                              int64_t b64, uint8_t* out) {
+  const T a = (T)a64, b = (T)b64;
+  switch (op) {
+    case CMP_EQ: for (int64_t i = 0; i < n; i++) out[i] = x[i] == a; break;
+    case CMP_NE: for (int64_t i = 0; i < n; i++) out[i] = x[i] != a; break;
+    case CMP_LT: for (int64_t i = 0; i < n; i++) out[i] = x[i] < a; break;
+    case CMP_LE: for (int64_t i = 0; i < n; i++) out[i] = x[i] <= a; break;
+    case CMP_GT: for (int64_t i = 0; i < n; i++) out[i] = x[i] > a; break;
+    case CMP_GE: for (int64_t i = 0; i < n; i++) out[i] = x[i] >= a; break;
+    case CMP_RANGE:
+      for (int64_t i = 0; i < n; i++) out[i] = x[i] >= a && x[i] <= b;
+      break;
+    case CMP_NE_PRESENT:
+      for (int64_t i = 0; i < n; i++) out[i] = x[i] != a && x[i] >= 0;
+      break;
+  }
+}
+
+extern "C" {
+
+void vtpu_mask_cmp_i32(const int32_t* x, int64_t n, int op, int64_t a,
+                       int64_t b, uint8_t* out) {
+  mask_cmp_t<int32_t>(x, n, op, a, b, out);
+}
+
+void vtpu_mask_cmp_i64(const int64_t* x, int64_t n, int op, int64_t a,
+                       int64_t b, uint8_t* out) {
+  mask_cmp_t<int64_t>(x, n, op, a, b, out);
+}
+
+// res->span mask through a lookup table: out[j] = lut[idx[j]] for valid
+// indices, 0 for negative/out-of-range (absent-resource sentinel).
+void vtpu_mask_lut_i32(const int32_t* idx, int64_t n, const uint8_t* lut,
+                       int64_t n_lut, uint8_t* out) {
+  for (int64_t j = 0; j < n; j++) {
+    const int32_t v = idx[j];
+    out[j] = ((uint32_t)v < (uint32_t)n_lut) ? lut[v] : 0;
+  }
+}
+
+// Matched spans per trace: out[t] = sum(mask[off[t] .. off[t+1])), with
+// offsets clipped to n_spans (sliced row-group shards clip trailing
+// offsets legally).
+void vtpu_seg_count_mask(const uint8_t* mask, const int32_t* span_off,
+                         int64_t n_traces, int64_t n_spans, int32_t* out) {
+  for (int64_t t = 0; t < n_traces; t++) {
+    int64_t lo = span_off[t], hi = span_off[t + 1];
+    if (lo > n_spans) lo = n_spans;
+    if (hi > n_spans) hi = n_spans;
+    int32_t c = 0;
+    for (int64_t j = lo; j < hi; j++) c += mask[j];
+    out[t] = c;
+  }
 }
 
 // ------------------------------------------------------- dictionary union

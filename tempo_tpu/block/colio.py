@@ -222,6 +222,12 @@ class ColumnPack:
         self._cache: OrderedDict[int, bytes] = OrderedDict()  # chunk offset -> raw
         self._cache_bytes = 0
         self._cache_lock = threading.Lock()
+        # assembled full-column LRU (name -> readonly ndarray): repeat
+        # full-column readers (the host search engine, trace_index) skip
+        # the per-chunk join + frombuffer copy entirely; chunks decode
+        # straight into the final buffer (native batch) on first touch
+        self._arrays: OrderedDict[str, np.ndarray] = OrderedDict()
+        self._arrays_bytes = 0
 
     def _count_read(self, n: int) -> None:
         with self._io_lock:
@@ -313,10 +319,131 @@ class ColumnPack:
                 parts[i] = self._chunk(recs[i])
         return b"".join(parts)
 
+    def has_cached_array(self, name: str) -> bool:
+        """True when a full-column read of `name` is a cache hit (used by
+        the search engine's host-vs-device cost estimate)."""
+        with self._cache_lock:
+            return name in self._arrays
+
+    def _arrays_get(self, name: str) -> np.ndarray | None:
+        with self._cache_lock:
+            hit = self._arrays.get(name)
+            if hit is not None:
+                self._arrays.move_to_end(name)
+            return hit
+
+    def _arrays_put(self, name: str, arr: np.ndarray) -> None:
+        # shares the chunk cache's byte budget (the two caches together
+        # are the pack's RAM footprint). Over-budget eviction drops
+        # chunk bytes first (the array holds the same data assembled),
+        # then other arrays LRU -- never the entry just inserted, so a
+        # single large column always stays cached for its repeat readers
+        if arr.nbytes > self.CHUNK_CACHE_BYTES:
+            return
+        with self._cache_lock:
+            if name in self._arrays:
+                return
+            self._arrays[name] = arr
+            self._arrays_bytes += arr.nbytes
+            while (self._arrays_bytes + self._cache_bytes > self.CHUNK_CACHE_BYTES
+                   and self._cache):
+                _, old = self._cache.popitem(last=False)
+                self._cache_bytes -= len(old)
+            while (self._arrays_bytes + self._cache_bytes > self.CHUNK_CACHE_BYTES
+                   and len(self._arrays) > 1):
+                n, old = next(iter(self._arrays.items()))
+                if n == name:
+                    break
+                del self._arrays[n]
+                self._arrays_bytes -= old.nbytes
+
+    def _read_column_into(self, meta: dict) -> np.ndarray | None:
+        """Decode a whole column straight into its final buffer. A
+        column's chunks sit ADJACENT in the pack, so every run of
+        uncached zstd chunks is fetched with ONE ranged read and
+        decompressed from that buffer in place -- no per-chunk bytes
+        objects, no joins, no per-chunk file opens. None -> caller falls
+        back to the chunk-join path."""
+        from ..native import available, zstd_decompress_ranges
+
+        if not available():
+            return None
+        recs = [r for r in meta["chunks"] if r[2] > 0]
+        dst = np.empty(int(sum(r[2] for r in recs)), dtype=np.uint8)
+        # classify chunks, then coalesce stored-adjacent zstd misses
+        z_miss: list[tuple[int, int, int, int]] = []  # (off, stored, raw, dst_pos)
+        other: list[tuple[list, int]] = []  # (rec, dst_pos)
+        pos = 0
+        for rec in recs:
+            off, stored, raw_len, codec = rec
+            hit = self._cache_get(off)
+            if hit is not None:
+                dst[pos : pos + raw_len] = np.frombuffer(hit, dtype=np.uint8)
+            elif codec == CODEC_ZSTD:
+                z_miss.append((off, stored, raw_len, pos))
+            else:
+                other.append((rec, pos))
+            pos += raw_len
+        counted = 0
+        if z_miss:
+            in_offs = np.empty(len(z_miss), np.int64)
+            in_lens = np.empty(len(z_miss), np.int64)
+            out_offs = np.empty(len(z_miss), np.int64)
+            out_lens = np.empty(len(z_miss), np.int64)
+            runs: list[tuple[int, int, int]] = []  # (file_off, length, first_idx)
+            for i, (off, stored, raw_len, dpos) in enumerate(z_miss):
+                in_lens[i] = stored
+                out_offs[i] = dpos
+                out_lens[i] = raw_len
+                if runs and runs[-1][0] + runs[-1][1] == off:
+                    fo, ln, fi = runs[-1]
+                    runs[-1] = (fo, ln + stored, fi)
+                else:
+                    runs.append((off, stored, i))
+                in_offs[i] = off - runs[-1][0]  # provisional, rebased below
+            bufs = []
+            base = 0
+            for fo, ln, fi in runs:
+                bufs.append(self._read_range(fo, ln))
+                counted += ln
+                # rebase this run's chunk offsets to the joined buffer
+                hi = fi
+                while hi < len(z_miss) and z_miss[hi][0] >= fo and z_miss[hi][0] < fo + ln:
+                    in_offs[hi] = base + (z_miss[hi][0] - fo)
+                    hi += 1
+                base += ln
+            src = (np.frombuffer(bufs[0], dtype=np.uint8) if len(bufs) == 1
+                   else np.frombuffer(b"".join(bufs), dtype=np.uint8))
+            if not zstd_decompress_ranges(src, in_offs, in_lens, dst, out_offs, out_lens):
+                # the ranged reads above really happened: account them
+                # before falling back (the fallback counts only its own)
+                self._count_read(counted)
+                return None
+        for (off, stored, raw_len, codec), dpos in other:
+            data = self._read_range(off, stored)
+            counted += stored
+            if codec != CODEC_RAW:
+                data = _EXTRA_CODECS[codec][1](data, raw_len)
+            dst[dpos : dpos + raw_len] = np.frombuffer(data, dtype=np.uint8)
+        self._count_read(counted)
+        out = dst.view(np.dtype(meta["dtype"])).reshape(meta["shape"])
+        out.flags.writeable = False  # cached entries are shared across readers
+        return out
+
     def read(self, name: str) -> np.ndarray:
         meta = self._cols[name]
-        raw = self._chunks(meta["chunks"])
-        return np.frombuffer(raw, dtype=np.dtype(meta["dtype"])).reshape(meta["shape"])
+        hit = self._arrays_get(name)
+        if hit is not None:
+            return hit
+        arr = self._read_column_into(meta)
+        if arr is None:
+            # fallback already populated the CHUNK cache (old behavior);
+            # caching the assembled array too would charge the same bytes
+            # to the shared budget twice
+            raw = self._chunks(meta["chunks"])
+            return np.frombuffer(raw, dtype=np.dtype(meta["dtype"])).reshape(meta["shape"])
+        self._arrays_put(name, arr)
+        return arr
 
     def read_groups(self, name: str, groups: list[int]) -> np.ndarray:
         """Concatenated rows of the given row groups (in the given order).
@@ -324,12 +451,20 @@ class ColumnPack:
         meta = self._cols[name]
         if meta["axis"] is None:
             raise ValueError(f"column {name} is not axis-chunked")
+        full = self._arrays_get(name)
+        if full is not None:
+            # a full-column read already paid for these rows: slice the
+            # cached array instead of re-fetching chunks from the backend
+            offs = self.axes[meta["axis"]].offsets
+            parts = [full[offs[g] : offs[g + 1]] for g in groups]
+            return parts[0] if len(parts) == 1 else np.concatenate(parts)
         raw = self._chunks([meta["chunks"][g] for g in groups])
         shape = [-1] + meta["shape"][1:]
         return np.frombuffer(raw, dtype=np.dtype(meta["dtype"])).reshape(shape)
 
     def read_many(self, names: list[str]) -> dict[str, np.ndarray]:
-        self.warm([(n, None) for n in names if n in self._cols])
+        # read() decodes each full column natively into its final buffer
+        # (and caches the array), so no chunk-level warm pass is needed
         return {n: self.read(n) for n in names if n in self._cols}
 
     def read_groups_many(
@@ -340,7 +475,9 @@ class ColumnPack:
         so a trace materialization that touches 20 columns pays one
         parallel decode instead of 20 serial ones."""
         wants = [(n, g) for n, g in wants if n in self._cols]
-        self.warm(wants)
+        # full-column wants decode natively inside read(); only the
+        # row-group-sliced wants benefit from the chunk-level warm batch
+        self.warm([(n, g) for n, g in wants if g is not None])
         out: dict[str, np.ndarray] = {}
         for name, groups in wants:
             out[name] = self.read(name) if groups is None else self.read_groups(name, groups)
@@ -352,8 +489,8 @@ class ColumnPack:
         recs = []
         for name, groups in wants:
             meta = self._cols.get(name)
-            if meta is None:
-                continue
+            if meta is None or self.has_cached_array(name):
+                continue  # read/read_groups serve it from the array cache
             chunks = meta["chunks"]
             recs.extend(chunks if groups is None else [chunks[g] for g in groups])
         miss = [r for r in recs if r[3] == CODEC_ZSTD and self._cache_get(r[0]) is None]

@@ -58,6 +58,45 @@ _WELL_KNOWN_RES = {
 # shared across queries -- each read is one ranged GET + zstd decode)
 _host_io_pool = ThreadPoolExecutor(max_workers=8, thread_name_prefix="search-io")
 
+# ---------------------------------------------------------------- engine cost
+# The device engine costs ~one link round trip per query (fused select's
+# single fetch) regardless of block count; the host engine costs
+# bytes/rate with ZERO round trips. On a datacenter TPU the RTT is
+# sub-millisecond and staged device eval wins from the first megabyte;
+# through a high-latency tunnel (~100 ms/sync) the host engine wins for
+# working sets into the hundreds of MB. Measure, don't assume: one tiny
+# put+compute+fetch round trip at first use, plus a host-rate EMA
+# updated by every host-engine block scan.
+_LINK_RTT_MS: float | None = None
+_HOST_RATE_BPS: float = 1.5e9  # EMA, seeded at DDR-ish single-core scan rate
+
+
+def _link_rtt_ms() -> float:
+    global _LINK_RTT_MS
+    if _LINK_RTT_MS is None:
+        try:
+            import time as _time
+
+            import jax
+            import jax.numpy as jnp
+
+            probe = np.zeros(8, np.int32)
+            best = float("inf")
+            for _ in range(3):  # first rep absorbs the +1 kernel compile
+                t0 = _time.perf_counter()
+                np.asarray(jnp.asarray(probe) + 1)
+                best = min(best, _time.perf_counter() - t0)
+            _LINK_RTT_MS = best * 1e3
+        except Exception:
+            _LINK_RTT_MS = 0.0
+    return _LINK_RTT_MS
+
+
+def _note_host_rate(n_bytes: int, seconds: float) -> None:
+    global _HOST_RATE_BPS
+    if seconds > 1e-5 and n_bytes > (1 << 20):
+        _HOST_RATE_BPS = 0.7 * _HOST_RATE_BPS + 0.3 * (n_bytes / seconds)
+
 
 @dataclass
 class SearchRequest:
@@ -450,13 +489,32 @@ def search_blocks_fused(
     if not live:
         return resp
 
+    # whole-query engine choice first: if scanning every live block on
+    # host is estimated cheaper than ONE device round trip, promotion is
+    # a loss no matter how hot the blocks are (the tunnel-latency case);
+    # per-block temperature only matters when the device can win at all
+    scan_bytes = 0
+    for blk, p in live:
+        span_cols = [n for n in required_columns(p.conds)
+                     if n.startswith(("span.", "sattr."))]
+        # a block whose span columns sit in the host array cache scans at
+        # memory speed -- its bytes don't count against the host engine
+        if span_cols and all(blk.pack.has_cached_array(n) for n in span_cols
+                             if blk.pack.has(n)):
+            continue
+        scan_bytes += blk.pack.axes[S.AX_SPAN].n_rows * 4 * max(1, len(span_cols))
+    host_est_ms = scan_bytes / _HOST_RATE_BPS * 1e3
+    prefer_host = host_est_ms < _link_rtt_ms()
+
     dev_items: list[tuple[BackendBlock, object]] = []
     host_items: list[tuple[BackendBlock, object]] = []
     est = 0
     for blk, p in live:
         blk.search_touches = getattr(blk, "search_touches", 0) + 1
         needed = tuple(required_columns(p.conds)) + ("trace@gkey_s",)
-        hot = _staged_hit(blk, needed) or blk.search_touches >= promote_touches
+        hot = not prefer_host and (
+            _staged_hit(blk, needed) or blk.search_touches >= promote_touches
+        )
         if hot:
             n_span_cols = max(1, sum(
                 1 for n in needed if n.startswith(("span.", "sattr."))
@@ -485,16 +543,28 @@ def search_blocks_fused(
         return tm, counts, staged.cols["trace@gkey_s"], staged.n_spans
 
     def host_eval_collect(item):
+        import time as _time
+
         blk, p = item
         operands = Operands.build(p.rows, p.tables or None)
         needed = required_columns(p.conds)
         host_needed = ([n for n in needed if n != "span.trace_sid"]
                        if "trace.span_off" in needed else needed)
+        # cold-scan detection BEFORE reading: cache-hit timings would
+        # inflate the rate EMA and mislead the engine choice for
+        # genuinely cold blocks (and the shared bytes_read counter can't
+        # distinguish this thread's IO from concurrent readers')
+        cold = not all(blk.pack.has_cached_array(n)
+                       for n in host_needed if blk.pack.has(n))
+        t0 = _time.perf_counter()
         cols = _host_cols(blk, host_needed, None)
         n_spans = blk.pack.axes[S.AX_SPAN].n_rows
         tm, counts = eval_block_host(
             (p.tree, p.conds), cols, operands, n_spans, blk.meta.total_traces
         )
+        if cold:
+            _note_host_rate(sum(a.nbytes for a in cols.values()),
+                            _time.perf_counter() - t0)
         key = _start_key_host(blk)
 
         def selector(k):

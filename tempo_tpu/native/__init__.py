@@ -97,6 +97,19 @@ def _bind(lib):
         ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
     ]
     lib.vtpu_gather_runs_remap.restype = ctypes.c_int64
+    lib.vtpu_mask_cmp_i32.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_int,
+        ctypes.c_int64, ctypes.c_int64, ctypes.c_void_p,
+    ]
+    lib.vtpu_mask_cmp_i64.argtypes = lib.vtpu_mask_cmp_i32.argtypes
+    lib.vtpu_mask_lut_i32.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
+        ctypes.c_int64, ctypes.c_void_p,
+    ]
+    lib.vtpu_seg_count_mask.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+        ctypes.c_int64, ctypes.c_void_p,
+    ]
     return lib
 
 
@@ -171,7 +184,12 @@ def varint_frames(data: bytes) -> tuple[np.ndarray, np.ndarray, bool, int] | Non
 
 
 # --------------------------------------------------------------------- zstd
-_N_THREADS = max(2, (os.cpu_count() or 4) // 2)
+# worker 0 runs on the calling thread, so 1 here means "no threads
+# spawned at all" -- right on 1-core hosts where extra decode threads
+# only add spawn/join and scheduler churn; multi-core hosts keep at
+# least 2 workers so batch codecs overlap
+_CPUS = os.cpu_count() or 4
+_N_THREADS = 1 if _CPUS <= 1 else max(2, _CPUS // 2)
 
 
 def zstd_compress_chunks(chunks: list[bytes], level: int = 3) -> list[bytes] | None:
@@ -197,11 +215,12 @@ def gather_runs(src: np.ndarray, dst: np.ndarray, src_offs: np.ndarray,
     if not (src.flags.c_contiguous and dst.flags.c_contiguous):
         return False
     itemsize = src.dtype.itemsize * int(np.prod(src.shape[1:], dtype=np.int64))
+    src_offs = np.ascontiguousarray(src_offs, dtype=np.int64)
+    dst_offs = np.ascontiguousarray(dst_offs, dtype=np.int64)
+    lens = np.ascontiguousarray(lens, dtype=np.int64)
     lib.vtpu_gather_runs(
         src.ctypes.data, dst.ctypes.data,
-        np.ascontiguousarray(src_offs, dtype=np.int64).ctypes.data,
-        np.ascontiguousarray(dst_offs, dtype=np.int64).ctypes.data,
-        np.ascontiguousarray(lens, dtype=np.int64).ctypes.data,
+        src_offs.ctypes.data, dst_offs.ctypes.data, lens.ctypes.data,
         len(src_offs), itemsize,
     )
     return True
@@ -216,11 +235,12 @@ def gather_runs_addr(src_addrs: np.ndarray, dst: np.ndarray,
     if lib is None or not dst.flags.c_contiguous:
         return False
     itemsize = dst.dtype.itemsize * int(np.prod(dst.shape[1:], dtype=np.int64))
+    src_addrs = np.ascontiguousarray(src_addrs, dtype=np.int64)
+    dst_offs = np.ascontiguousarray(dst_offs, dtype=np.int64)
+    lens = np.ascontiguousarray(lens, dtype=np.int64)
     lib.vtpu_gather_runs_addr(
-        np.ascontiguousarray(src_addrs, dtype=np.int64).ctypes.data,
-        dst.ctypes.data,
-        np.ascontiguousarray(dst_offs, dtype=np.int64).ctypes.data,
-        np.ascontiguousarray(lens, dtype=np.int64).ctypes.data,
+        src_addrs.ctypes.data, dst.ctypes.data,
+        dst_offs.ctypes.data, lens.ctypes.data,
         len(src_addrs), itemsize,
     )
     return True
@@ -237,13 +257,15 @@ def gather_runs_remap(src_addrs: np.ndarray, dst: np.ndarray,
     lib = _load()
     if lib is None or dst.dtype != np.int32 or not dst.flags.c_contiguous:
         return False
+    src_addrs = np.ascontiguousarray(src_addrs, dtype=np.int64)
+    dst_offs = np.ascontiguousarray(dst_offs, dtype=np.int64)
+    lens = np.ascontiguousarray(lens, dtype=np.int64)
+    remap_addrs = np.ascontiguousarray(remap_addrs, dtype=np.int64)
+    remap_lens = np.ascontiguousarray(remap_lens, dtype=np.int64)
     oob = lib.vtpu_gather_runs_remap(
-        np.ascontiguousarray(src_addrs, dtype=np.int64).ctypes.data,
-        dst.ctypes.data,
-        np.ascontiguousarray(dst_offs, dtype=np.int64).ctypes.data,
-        np.ascontiguousarray(lens, dtype=np.int64).ctypes.data,
-        np.ascontiguousarray(remap_addrs, dtype=np.int64).ctypes.data,
-        np.ascontiguousarray(remap_lens, dtype=np.int64).ctypes.data,
+        src_addrs.ctypes.data, dst.ctypes.data,
+        dst_offs.ctypes.data, lens.ctypes.data,
+        remap_addrs.ctypes.data, remap_lens.ctypes.data,
         len(src_addrs),
     )
     return oob == 0
@@ -255,19 +277,38 @@ def zstd_decompress_into(chunks: list[bytes], dst: np.ndarray,
     """Batch-decompress chunks straight into caller-provided positions of
     one destination buffer (uint8) -- no per-chunk bytes objects, no
     joins. Returns False -> caller falls back."""
-    lib = _load()
     n = len(chunks)
-    if lib is None or n == 0:
+    if _load() is None or n == 0:
         return False
     src = np.frombuffer(b"".join(chunks), dtype=np.uint8)
     in_lens = np.asarray([len(c) for c in chunks], dtype=np.int64)
     in_offs = np.zeros(n, dtype=np.int64)
     np.cumsum(in_lens[:-1], out=in_offs[1:]) if n > 1 else None
+    return zstd_decompress_ranges(src, in_offs, in_lens, dst, out_offs, out_lens)
+
+
+def zstd_decompress_ranges(src: np.ndarray, in_offs: np.ndarray,
+                           in_lens: np.ndarray, dst: np.ndarray,
+                           out_offs: np.ndarray, out_lens: np.ndarray) -> bool:
+    """Decompress frames at in_offs/in_lens of one contiguous source
+    buffer into out_offs/out_lens of dst. The zero-copy shape: callers
+    that fetch a column's adjacent chunks with ONE ranged read pass the
+    buffer straight through (no per-chunk bytes, no join)."""
+    lib = _load()
+    n = len(in_offs)
+    if lib is None or n == 0 or src.dtype != np.uint8 or not src.flags.c_contiguous:
+        return False
+    # bind conversions to locals: .ctypes.data of an expression temporary
+    # can be freed before the foreign call runs (dangling pointer)
+    in_offs = np.ascontiguousarray(in_offs, dtype=np.int64)
+    in_lens = np.ascontiguousarray(in_lens, dtype=np.int64)
+    out_offs = np.ascontiguousarray(out_offs, dtype=np.int64)
+    out_lens = np.ascontiguousarray(out_lens, dtype=np.int64)
     rc = lib.vtpu_zstd_decompress_batch(
-        src.ctypes.data if len(src) else None, in_offs.ctypes.data, in_lens.ctypes.data,
+        src.ctypes.data if len(src) else None,
+        in_offs.ctypes.data, in_lens.ctypes.data,
         dst.ctypes.data,
-        np.ascontiguousarray(out_offs, dtype=np.int64).ctypes.data,
-        np.ascontiguousarray(out_lens, dtype=np.int64).ctypes.data,
+        out_offs.ctypes.data, out_lens.ctypes.data,
         n, _N_THREADS,
     )
     return rc == 0
@@ -361,6 +402,62 @@ def _dict_union_py(raws, counts):
     if merged:
         np.cumsum([len(s) for s in merged], out=offs[1:])
     return blob, offs, remaps
+
+
+# --------------------------------------------------------- search eval
+# op codes mirror native's CMP_* enum; hostfilter maps its op strings here
+CMP_CODES = {"eq": 0, "ne": 1, "lt": 2, "le": 3, "gt": 4, "ge": 5,
+             "range": 6, "ne_present": 7}
+
+
+def mask_cmp(x: np.ndarray, op: str, a: int, b: int = 0) -> np.ndarray | None:
+    """Single-pass comparison mask (uint8 0/1) over an int32/int64
+    column. None -> caller falls back to numpy."""
+    lib = _load()
+    code = CMP_CODES.get(op)
+    if lib is None or code is None or not x.flags.c_contiguous or x.ndim != 1:
+        return None
+    out = np.empty(x.shape[0], dtype=np.uint8)
+    if x.dtype == np.int32:
+        lib.vtpu_mask_cmp_i32(x.ctypes.data, x.shape[0], code, int(a), int(b),
+                              out.ctypes.data)
+    elif x.dtype == np.int64:
+        lib.vtpu_mask_cmp_i64(x.ctypes.data, x.shape[0], code, int(a), int(b),
+                              out.ctypes.data)
+    else:
+        return None
+    return out
+
+
+def mask_lut(idx: np.ndarray, lut: np.ndarray) -> np.ndarray | None:
+    """out[j] = lut[idx[j]] with negative/out-of-range idx -> 0: the
+    res-table -> span mask gather in one pass."""
+    lib = _load()
+    if (lib is None or idx.dtype != np.int32 or not idx.flags.c_contiguous
+            or lut.dtype != np.uint8 or not lut.flags.c_contiguous):
+        return None
+    out = np.empty(idx.shape[0], dtype=np.uint8)
+    lib.vtpu_mask_lut_i32(idx.ctypes.data, idx.shape[0], lut.ctypes.data,
+                          lut.shape[0], out.ctypes.data)
+    return out
+
+
+def seg_count_mask(mask: np.ndarray, span_off: np.ndarray,
+                   n_spans: int) -> np.ndarray | None:
+    """Per-trace count of set mask bytes: out[t] = sum(mask[off[t]:off[t+1]])
+    with offsets clipped to n_spans. mask may be bool or uint8."""
+    lib = _load()
+    if lib is None or span_off.dtype != np.int32 or not span_off.flags.c_contiguous:
+        return None
+    if mask.dtype == np.bool_:
+        mask = mask.view(np.uint8)
+    if mask.dtype != np.uint8 or not mask.flags.c_contiguous:
+        return None
+    n_traces = span_off.shape[0] - 1
+    out = np.empty(n_traces, dtype=np.int32)
+    lib.vtpu_seg_count_mask(mask.ctypes.data, span_off.ctypes.data,
+                            n_traces, n_spans, out.ctypes.data)
+    return out
 
 
 def zstd_decompress_chunks(chunks: list[bytes], out_sizes: list[int]) -> list[bytes] | None:

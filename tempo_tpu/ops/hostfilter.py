@@ -35,6 +35,14 @@ from .filter import (
 
 def _cmp_np(op: str, x: np.ndarray, v0, v1, f0, f1, is_float: bool, table):
     a, b = (f0, f1) if is_float else (v0, v1)
+    if not is_float and x.ndim == 1 and x.dtype in (np.int32, np.int64):
+        # single-pass native compare (native/vtpu_native.cc mask_cmp):
+        # one C loop instead of numpy's compare + combine temporaries
+        from ..native import mask_cmp
+
+        m = mask_cmp(x, op, a, b)
+        if m is not None:
+            return m.view(np.bool_)
     if op == "eq":
         return x == a
     if op == "ne":
@@ -82,7 +90,7 @@ def _cond_mask_np(c: Cond, i: int, cols, ops_i, ops_f, tables, n_spans, n_res):
     if c.target == T_RES:
         rm = _cmp_np(c.op, cols[c.col], v0, v1, f0, f1, c.is_float, table)
         idx = cols["span.res_idx"]
-        return rm[np.clip(idx, 0, rm.shape[0] - 1)] & (idx >= 0)
+        return _lut_gather(rm, idx)
     if c.target in (T_SATTR, T_RATTR):
         pre = c.target
         key_match = cols[f"{pre}.key_id"] == key
@@ -96,8 +104,21 @@ def _cond_mask_np(c: Cond, i: int, cols, ops_i, ops_f, tables, n_spans, n_res):
             return _scatter_owner(row_hit, cols["sattr.span"], n_spans)
         res_hit = _scatter_owner(row_hit, cols["rattr.res"], n_res)
         idx = cols["span.res_idx"]
-        return res_hit[np.clip(idx, 0, n_res - 1)] & (idx >= 0)
+        return _lut_gather(res_hit, idx)
     raise ValueError(f"bad target {c.target}")
+
+
+def _lut_gather(table_mask: np.ndarray, idx: np.ndarray) -> np.ndarray:
+    """res-table mask -> span mask through span.res_idx; negative /
+    out-of-range indices (absent resource) never match."""
+    from ..native import mask_lut
+
+    if idx.dtype == np.int32 and idx.flags.c_contiguous:
+        lut = np.ascontiguousarray(table_mask, dtype=np.uint8)
+        m = mask_lut(idx, lut)
+        if m is not None:
+            return m.view(np.bool_)
+    return table_mask[np.clip(idx, 0, table_mask.shape[0] - 1)] & (idx >= 0)
 
 
 def eval_block_host(
@@ -154,9 +175,21 @@ def eval_block_host(
             if m is span_mask:
                 return c
         if span_off is not None:
+            out = None
             if n_spans == 0 or span_off.shape[0] <= 1:
                 out = np.zeros(n_traces, dtype=np.int64)
-            else:
+            elif span_off.shape[0] - 1 == n_traces:
+                # one-pass native fold (no astype/concatenate temps);
+                # int64 keeps the documented counts dtype uniform across
+                # the three branches
+                from ..native import seg_count_mask
+
+                out = seg_count_mask(np.ascontiguousarray(span_mask),
+                                     np.ascontiguousarray(span_off, np.int32),
+                                     n_spans)
+                if out is not None:
+                    out = out.astype(np.int64)
+            if out is None:
                 # sentinel-padded reduceat: starts may legally equal
                 # n_spans (sliced row-group shards clip trailing
                 # offsets), and reduceat yields mask[start] for empty
