@@ -50,6 +50,17 @@ def _emit(metric: str, value: float, unit: str, vs_baseline: float) -> None:
 
 
 # ------------------------------------------------------------------ synth
+def _trace_local_res(rng: np.random.Generator, n_traces: int, spans_per: int,
+                     n_res: int) -> np.ndarray:
+    """Per-span resource indices with per-trace locality: each trace
+    draws 2-4 resources and its spans choose among them."""
+    k = 4  # palette size per trace (first 2 always used, rest maybe)
+    palette = rng.integers(0, n_res, size=(n_traces, k))
+    pick = rng.integers(0, k, size=(n_traces, spans_per))
+    pick = np.minimum(pick, rng.integers(1, k, size=(n_traces, 1)))
+    return np.take_along_axis(palette, pick, axis=1).reshape(-1).astype(np.int32)
+
+
 def synth_block(backend, tenant: str, rng: np.random.Generator, n_traces: int,
                 spans_per: int, n_res: int = 1024, attrs_per_span: int = 2):
     """Fast numpy construction of a realistic vtpu block (same column set
@@ -104,7 +115,11 @@ def synth_block(backend, tenant: str, rng: np.random.Generator, n_traces: int,
         "span.http_status": rng.choice(np.asarray([200, 200, 200, 404, 500], np.int32), size=n_spans),
         "span.http_method_id": np.full(n_spans, -1, np.int32),
         "span.http_url_id": np.full(n_spans, -1, np.int32),
-        "span.res_idx": rng.integers(0, n_res, size=n_spans).astype(np.int32),
+        # realistic resource locality: a trace's spans come from a
+        # handful of services (2-4 resources per trace), the shape the
+        # reference's nested ResourceSpans model assumes -- NOT one
+        # random resource per span, which no tracing workload produces
+        "span.res_idx": _trace_local_res(rng, n_traces, spans_per, n_res),
         "span.start_ns": start_ns,
         "span.end_ns": end_ns,
         "span.id": rng.integers(0, 256, size=(n_spans, 8), dtype=np.uint8),
@@ -157,6 +172,9 @@ def synth_block(backend, tenant: str, rng: np.random.Generator, n_traces: int,
             cols[col] = rng.choice(svc_codes, size=n_res).astype(np.int32)
         else:
             cols[col] = np.full(n_res, -1, np.int32)
+    from tempo_tpu.block.builder import build_tres
+
+    cols.update(build_tres(cols["span.trace_sid"], cols["span.res_idx"], n_traces))
 
     axes, col_axis, row_groups = compute_row_groups(
         cols, cols["span.start_ms"], cols["span.dur_us"], S.DEFAULT_ROW_GROUP_SPANS

@@ -351,6 +351,16 @@ class BlockBuilder:
         }
         for col, vals in self.res_dedicated.items():
             cols[col] = rm(vals)
+
+        # trace-resource membership summary (tres axis): one row per
+        # (trace, resource) pair with the span count, offsets per trace.
+        # Res-scoped queries (service.name etc., the dominant search
+        # shape) evaluate over ~resources-per-trace rows instead of the
+        # full span axis -- a ~10x smaller cold decode than span.res_idx.
+        # No reference analog: vparquet nests spans under ResourceSpans so
+        # its res predicates skip span pages for free (schema.go:75-172);
+        # this is the SoA equivalent of that skip.
+        cols.update(build_tres(cols["span.trace_sid"], cols["span.res_idx"], n_traces))
         for table, prefix, owner in (
             (self.sattr, "sattr", "span"),
             (self.rattr, "rattr", "res"),
@@ -387,6 +397,30 @@ class BlockBuilder:
 
     def _compute_row_groups(self, cols, start_ms, dur_us):
         return compute_row_groups(cols, start_ms, dur_us, self.row_group_spans)
+
+
+def build_tres(trace_sid: np.ndarray, res_idx: np.ndarray, n_traces: int) -> dict[str, np.ndarray]:
+    """tres columns from the span axis: unique (trace, res) pairs with
+    span counts, plus per-trace offsets. Vectorized: one 64-bit
+    composite-key unique."""
+    if len(trace_sid) == 0:
+        return {
+            "tres.res": np.empty(0, np.int32),
+            "tres.nspans": np.empty(0, np.int32),
+            "trace.tres_off": np.zeros(n_traces + 1, np.int32),
+        }
+    key = (trace_sid.astype(np.int64) << 32) | (
+        res_idx.astype(np.int64) & 0xFFFFFFFF
+    )
+    uniq, counts = np.unique(key, return_counts=True)
+    tres_sid = (uniq >> 32).astype(np.int32)
+    tres_res = (uniq & 0xFFFFFFFF).astype(np.uint32).view(np.int32)
+    off = np.searchsorted(tres_sid, np.arange(n_traces + 1, dtype=np.int64)).astype(np.int32)
+    return {
+        "tres.res": np.ascontiguousarray(tres_res),
+        "tres.nspans": counts.astype(np.int32),
+        "trace.tres_off": off,
+    }
 
 
 def compute_row_groups(cols, start_ms, dur_us, row_group_spans):

@@ -128,7 +128,16 @@ def _combine_collision(sources: list[_Source], blocks: list[BackendBlock],
     b = BlockBuilder(tenant)
     b.add_trace(tid, combined)
     fin = b.finalize()
-    return _Source(fin.cols, fin.dictionary)
+    # today's builder may emit columns (e.g. tres.*) that pre-upgrade
+    # input blocks lack; the merge machinery requires every source to
+    # share one column set, so shape the collision source to the blocks'
+    base_names = set(sources[members[0][0]].cols)
+    cols = {k: v for k, v in fin.cols.items() if k in base_names}
+    if base_names - set(cols):
+        raise UnsupportedColumnar(
+            f"collision rebuild lacks columns {sorted(base_names - set(cols))}"
+        )
+    return _Source(cols, fin.dictionary)
 
 
 def _ranges_to_idx(los: np.ndarray, his: np.ndarray) -> np.ndarray:
@@ -206,6 +215,19 @@ def _assemble(tenant: str, sources: list[_Source], chunks: list[tuple[int, int, 
             ax_lo[a][ii] = np.searchsorted(owner, ax_lo[parent][ii], "left")
             ax_hi[a][ii] = np.searchsorted(owner, ax_hi[parent][ii], "left")
 
+    # tres (trace-resource membership, builder.build_tres) is a
+    # trace-child axis whose per-chunk ranges come straight from the
+    # source's offsets column -- no searchsorted needed
+    has_tres = "tres.res" in names
+    tres_lo = np.zeros(len(chunks), np.int64)
+    tres_hi = np.zeros(len(chunks), np.int64)
+    if has_tres:
+        for si in src_order:
+            toff = sources[si].cols["trace.tres_off"].astype(np.int64)
+            ii = by_src[si]
+            tres_lo[ii] = toff[clo[ii]]
+            tres_hi[ii] = toff[chi[ii]]
+
     # per-chunk output bases per axis
     def bases(lens: np.ndarray) -> tuple[np.ndarray, int]:
         cs = np.cumsum(lens)
@@ -217,6 +239,7 @@ def _assemble(tenant: str, sources: list[_Source], chunks: list[tuple[int, int, 
     ax_n = {}
     for a in child_axes:
         ax_b[a], ax_n[a] = bases(ax_hi[a] - ax_lo[a])
+    tres_b, n_tres = bases(tres_hi - tres_lo)
 
     # per (source, axis) RUN tables: (src row starts, dst row starts,
     # lens). Data moves by per-run memcpy (_run_copy); element-level
@@ -225,6 +248,8 @@ def _assemble(tenant: str, sources: list[_Source], chunks: list[tuple[int, int, 
     axis_ranges = {"trace": (clo, chi, tr_b), "span": (span_lo, span_hi, sp_b)}
     for a in child_axes:
         axis_ranges[a] = (ax_lo[a], ax_hi[a], ax_b[a])
+    if has_tres:
+        axis_ranges["tres"] = (tres_lo, tres_hi, tres_b)
     for si in src_order:
         ii = by_src[si]
         for a, (alo, ahi, ab) in axis_ranges.items():
@@ -311,6 +336,8 @@ def _assemble(tenant: str, sources: list[_Source], chunks: list[tuple[int, int, 
         return np.where(old >= 0, new, old).astype(np.int32)
 
     axis_rows = {"trace": n_traces, "span": n_spans, **ax_n}
+    if has_tres:
+        axis_rows["tres"] = n_tres
     _OWNER_COLS = frozenset(
         {"sattr.span", "ev.span", "ln.span", "evattr.ev", "lnattr.ln"}
     )
@@ -320,7 +347,7 @@ def _assemble(tenant: str, sources: list[_Source], chunks: list[tuple[int, int, 
         pref = n.split(".", 1)[0]
         like = sources[src_order[0]].cols[n]
         if n in ("span.trace_sid", "span.start_ms", "trace.span_off",
-                 "trace.start_ms", "trace.end_ms"):
+                 "trace.start_ms", "trace.end_ms", "trace.tres_off"):
             continue  # recomputed below
         if pref in axis_rows:
             out = np.empty((axis_rows[pref],) + like.shape[1:], dtype=like.dtype)
@@ -328,6 +355,10 @@ def _assemble(tenant: str, sources: list[_Source], chunks: list[tuple[int, int, 
                 if n == "span.res_idx":
                     packed_scatter(si, pref, _translate(
                         si, span_resvals[si], used_res, res_base), out)
+                elif n == "tres.res":
+                    packed_scatter(si, pref, _translate(
+                        si, packed_gather(si, pref, sources[si].cols[n]),
+                        used_res, res_base), out)
                 elif n == "span.scope_idx":
                     packed_scatter(si, pref, _translate(
                         si, span_scopevals[si], used_scope, scope_base), out)
@@ -377,6 +408,15 @@ def _assemble(tenant: str, sources: list[_Source], chunks: list[tuple[int, int, 
     cols["span.trace_sid"] = np.repeat(
         np.arange(n_traces, dtype=np.int32), span_counts
     )
+    if has_tres:
+        tres_counts = np.empty(n_traces, dtype=np.int64)
+        for si in src_order:
+            td = np.diff(sources[si].cols["trace.tres_off"].astype(np.int64))
+            s_offs, d_offs, lens = runs_of[(si, "trace")]
+            _run_copy(td, tres_counts, s_offs, d_offs, lens)
+        cols["trace.tres_off"] = np.concatenate(
+            [[0], np.cumsum(tres_counts)]
+        ).astype(np.int32)
 
     start_ns = cols["span.start_ns"].astype(np.int64)
     base_ns = int(start_ns.min()) if start_ns.size else 0

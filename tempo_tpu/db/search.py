@@ -26,7 +26,16 @@ import numpy as np
 
 from ..block import schema as S
 from ..block.reader import BackendBlock
-from ..ops.filter import Operands, T_RES, T_SPAN, T_TRACE, eval_block, required_columns
+from ..ops.filter import (
+    Operands,
+    T_RATTR,
+    T_RES,
+    T_SPAN,
+    T_TRACE,
+    _ATTR_VALUE_COL,
+    eval_block,
+    required_columns,
+)
 from ..ops.hostfilter import eval_block_host
 from ..ops.select import (
     k_bucket,
@@ -325,6 +334,70 @@ def _collect_topk(blk: BackendBlock, req: SearchRequest, needs_verify: bool,
 # ---------------------------------------------------- per-block search
 
 
+def _tres_eligible(blk: BackendBlock, p) -> bool:
+    """Res/trace-only condition trees can evaluate over the tres
+    membership axis (one row per (trace, resource) pair, builder.py
+    build_tres) instead of the span axis: identical trace mask and
+    matched-span counts from a ~10x smaller decode."""
+    return (blk.pack.has("tres.res") and bool(p.conds)
+            and all(c.target in (T_RES, T_RATTR, T_TRACE) for c in p.conds))
+
+
+def _tres_needed(conds) -> list[str]:
+    need = {"tres.res", "tres.nspans", "trace.tres_off"}
+    for c in conds:
+        if c.target in (T_TRACE, T_RES):
+            need.add(c.col)
+        elif c.target == T_RATTR:
+            need.update({"rattr.res", "rattr.key_id", "rattr.vtype", "res.service_id"})
+            if c.col in _ATTR_VALUE_COL:
+                need.add(f"rattr.{_ATTR_VALUE_COL[c.col]}")
+    return sorted(need)
+
+
+def _host_plan(blk: BackendBlock, p, groups_range) -> tuple[list[str], bool]:
+    """(columns the host engine will read, tres-mode flag). tres mode is
+    whole-block only -- row-group shards slice the span axis."""
+    if groups_range is None and _tres_eligible(blk, p):
+        return _tres_needed(p.conds), True
+    needed = required_columns(p.conds)
+    host_needed = ([n for n in needed if n != "span.trace_sid"]
+                   if "trace.span_off" in needed else needed)
+    return host_needed, False
+
+
+def _host_eval(blk: BackendBlock, p, operands, groups_range):
+    """Run the host engine under the chosen axis: returns
+    (trace_mask, counts, cols_read). Covered spans are the caller's to
+    report: tres mode still inspects every span's data (via its
+    membership summary), so inspected_spans stays the span-axis count."""
+    host_needed, tres = _host_plan(blk, p, groups_range)
+    cols = _host_cols(blk, host_needed, groups_range)
+    if tres:
+        # evaluate the same condition tree over the tres axis: entries
+        # play the role of spans (res conds LUT through tres.res), and
+        # per-entry span counts weight the segment fold so matched-span
+        # counts stay exact
+        ecols = dict(cols)
+        ecols["span.res_idx"] = cols["tres.res"]
+        ecols["trace.span_off"] = cols["trace.tres_off"]
+        ecols["@seg_weights"] = cols["tres.nspans"]
+        tm, counts = eval_block_host(
+            (p.tree, p.conds), ecols, operands,
+            int(cols["tres.res"].shape[0]), blk.meta.total_traces,
+        )
+        return tm, counts, cols
+    span_ax = blk.pack.axes.get(S.AX_SPAN)
+    if groups_range is not None and span_ax is not None:
+        n_rows = sum(span_ax.offsets[g + 1] - span_ax.offsets[g] for g in groups_range)
+    else:
+        n_rows = span_ax.n_rows if span_ax else 0
+    tm, counts = eval_block_host(
+        (p.tree, p.conds), cols, operands, n_rows, blk.meta.total_traces
+    )
+    return tm, counts, cols
+
+
 def _host_cols(blk: BackendBlock, needed: list[str], groups_range):
     """Raw (unpadded) host columns for the numpy evaluator; span/sattr
     axis columns cover only groups_range when given, with sattr owners
@@ -420,14 +493,8 @@ def search_block(
     else:
         # span_off carries the span->trace grouping: the full-length
         # trace_sid column never needs to leave disk on the host path
-        host_needed = ([n for n in needed if n != "span.trace_sid"]
-                       if "trace.span_off" in needed else needed)
-        cols = _host_cols(blk, host_needed, groups_range)
+        tm, counts, _ = _host_eval(blk, planned, operands, groups_range)
         n_spans_seen = n_rows
-        tm, counts = eval_block_host(
-            (planned.tree, planned.conds), cols, operands,
-            n_spans_seen, blk.meta.total_traces,
-        )
         key = _start_key_host(blk)
 
         def selector(k):
@@ -495,14 +562,19 @@ def search_blocks_fused(
     # per-block temperature only matters when the device can win at all
     scan_bytes = 0
     for blk, p in live:
-        span_cols = [n for n in required_columns(p.conds)
-                     if n.startswith(("span.", "sattr."))]
-        # a block whose span columns sit in the host array cache scans at
+        host_cols_n, tres = _host_plan(blk, p, None)
+        # a block whose host columns sit in the array cache scans at
         # memory speed -- its bytes don't count against the host engine
-        if span_cols and all(blk.pack.has_cached_array(n) for n in span_cols
-                             if blk.pack.has(n)):
+        if all(blk.pack.has_cached_array(n) for n in host_cols_n
+               if blk.pack.has(n)):
             continue
-        scan_bytes += blk.pack.axes[S.AX_SPAN].n_rows * 4 * max(1, len(span_cols))
+        if tres:
+            # tres axis rows ~= resources-per-trace * traces, tiny next
+            # to the span axis; 3 int32 columns is the honest estimate
+            scan_bytes += blk.meta.total_traces * 4 * 12
+        else:
+            n_span = sum(1 for n in host_cols_n if n.startswith(("span.", "sattr.")))
+            scan_bytes += blk.pack.axes[S.AX_SPAN].n_rows * 4 * max(1, n_span)
     host_est_ms = scan_bytes / _HOST_RATE_BPS * 1e3
     prefer_host = host_est_ms < _link_rtt_ms()
 
@@ -547,25 +619,20 @@ def search_blocks_fused(
 
         blk, p = item
         operands = Operands.build(p.rows, p.tables or None)
-        needed = required_columns(p.conds)
-        host_needed = ([n for n in needed if n != "span.trace_sid"]
-                       if "trace.span_off" in needed else needed)
         # cold-scan detection BEFORE reading: cache-hit timings would
         # inflate the rate EMA and mislead the engine choice for
         # genuinely cold blocks (and the shared bytes_read counter can't
         # distinguish this thread's IO from concurrent readers')
+        host_needed, _ = _host_plan(blk, p, None)
         cold = not all(blk.pack.has_cached_array(n)
                        for n in host_needed if blk.pack.has(n))
         t0 = _time.perf_counter()
-        cols = _host_cols(blk, host_needed, None)
-        n_spans = blk.pack.axes[S.AX_SPAN].n_rows
-        tm, counts = eval_block_host(
-            (p.tree, p.conds), cols, operands, n_spans, blk.meta.total_traces
-        )
+        tm, counts, cols = _host_eval(blk, p, operands, None)
         if cold:
             _note_host_rate(sum(a.nbytes for a in cols.values()),
                             _time.perf_counter() - t0)
         key = _start_key_host(blk)
+        n_spans = blk.pack.axes[S.AX_SPAN].n_rows
 
         def selector(k):
             return select_topk_host(tm, key, counts, k)

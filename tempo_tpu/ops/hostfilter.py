@@ -162,6 +162,10 @@ def eval_block_host(
         return out
 
     span_off = cols.get("trace.span_off")
+    # optional per-row fold weights ("@seg_weights"): when rows are tres
+    # membership entries rather than spans, the weight is the entry's
+    # span count, keeping matched-span counts exact (db/search._host_eval)
+    weights = cols.get("@seg_weights")
 
     # (mask, counts) memo holding STRONG refs: tracify and the final
     # counts usually fold the same union mask; identity on live objects
@@ -178,7 +182,7 @@ def eval_block_host(
             out = None
             if n_spans == 0 or span_off.shape[0] <= 1:
                 out = np.zeros(n_traces, dtype=np.int64)
-            elif span_off.shape[0] - 1 == n_traces:
+            elif weights is None and span_off.shape[0] - 1 == n_traces:
                 # one-pass native fold (no astype/concatenate temps);
                 # int64 keeps the documented counts dtype uniform across
                 # the three branches
@@ -192,11 +196,12 @@ def eval_block_host(
             if out is None:
                 # sentinel-padded reduceat: starts may legally equal
                 # n_spans (sliced row-group shards clip trailing
-                # offsets), and reduceat yields mask[start] for empty
-                # segments -- the zero sentinel makes both exact
-                padded = np.concatenate(
-                    [span_mask.astype(np.int64), np.zeros(1, np.int64)]
-                )
+                # offsets), and reduceat yields vals[start] for empty
+                # segments -- the zero sentinel makes both exact. With
+                # fold weights, rows contribute their weight instead of 1
+                vals = (span_mask.astype(np.int64) if weights is None
+                        else np.where(span_mask, weights.astype(np.int64), 0))
+                padded = np.concatenate([vals, np.zeros(1, np.int64)])
                 starts = np.minimum(span_off[:-1], n_spans)
                 out = np.add.reduceat(padded, starts)
                 empty = span_off[1:] == span_off[:-1]

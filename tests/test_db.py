@@ -516,3 +516,71 @@ def test_cli_block_ops(tmp_path, capsys):
     assert attr_sets(got) == attr_sets(before)
     db.close()
     db2.close()
+
+
+def test_tres_membership_axis(tmp_path):
+    """The tres axis (builder.build_tres) is consistent with the span
+    axis, drives the res-only host fast path to identical results, and
+    survives compaction with remapped res indices."""
+    from tempo_tpu.block.builder import build_tres
+    from tempo_tpu.db.search import (
+        SearchRequest,
+        _host_plan,
+        _plan_for_block,
+        search_block,
+    )
+
+    db = _db(tmp_path)
+    db.cfg.compaction.min_input_blocks = 2
+    all_traces = make_traces(40, seed=21, n_spans=6)
+    db.write_block(TENANT, all_traces[:20])
+    db.write_block(TENANT, all_traces[20:])
+    metas = db.blocklist.metas(TENANT)
+    blk = db.open_block(metas[0])
+
+    # tres columns match a recompute from the span axis
+    sid = blk.pack.read("span.trace_sid")
+    ri = blk.pack.read("span.res_idx")
+    want = build_tres(sid, ri, blk.meta.total_traces)
+    for n in ("tres.res", "tres.nspans", "trace.tres_off"):
+        np.testing.assert_array_equal(blk.pack.read(n), want[n])
+
+    # res-only queries take the tres plan and agree with a span-axis run
+    svc = None
+    d = blk.dictionary
+    for code in blk.pack.read("res.service_id"):
+        if code >= 0:
+            svc = d.string(int(code))
+            break
+    assert svc is not None
+    req = SearchRequest(tags={"service.name": svc}, limit=100)
+    p = _plan_for_block(blk, req)
+    host_needed, tres_mode = _host_plan(blk, p, None)
+    assert tres_mode and "tres.res" in host_needed
+    got = search_block(blk, req, mode="host")
+
+    class _NoTresPack:
+        def __init__(self, pack):
+            self._p = pack
+        def has(self, name):
+            return False if name.startswith("tres.") else self._p.has(name)
+        def __getattr__(self, a):
+            return getattr(self._p, a)
+
+    blk2 = db.open_block(metas[0])
+    blk2.__dict__["pack"] = _NoTresPack(blk2.pack)  # cached_property slot
+    base = search_block(blk2, req, mode="host")
+    assert {(t.trace_id, t.matched_spans) for t in got.traces} == \
+           {(t.trace_id, t.matched_spans) for t in base.traces}
+    assert len(got.traces) > 0
+
+    # compaction: merged tres equals a recompute from merged span columns
+    db.compact_once(TENANT)
+    db.poll_now()
+    cmeta = [m for m in db.blocklist.metas(TENANT) if m.compaction_level >= 1]
+    assert cmeta
+    cblk = db.open_block(cmeta[0])
+    want2 = build_tres(cblk.pack.read("span.trace_sid"),
+                       cblk.pack.read("span.res_idx"), cblk.meta.total_traces)
+    for n in ("tres.res", "tres.nspans", "trace.tres_off"):
+        np.testing.assert_array_equal(cblk.pack.read(n), want2[n])
