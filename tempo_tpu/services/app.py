@@ -457,9 +457,20 @@ def _make_handler(app: App):
                     if "=" in part:
                         k, v = part.split("=", 1)
                         tags[k] = v.strip('"')
+            query = q.get("q", "")
+            if query:
+                # parse + type-check once at the API boundary so a bad
+                # query is a 400, not a per-block failure downstream
+                from ..traceql.ast import ParseError
+                from ..traceql.parser import parse as parse_traceql
+
+                try:
+                    parse_traceql(query)
+                except ParseError as e:
+                    return self._err(400, f"invalid TraceQL: {e}")
             req = SearchRequest(
                 tags=tags,
-                query=q.get("q", ""),
+                query=query,
                 min_duration_ms=int(float(q["minDuration"]) * 1000) if "minDuration" in q else 0,
                 max_duration_ms=int(float(q["maxDuration"]) * 1000) if "maxDuration" in q else 0,
                 start=int(q.get("start", 0)),

@@ -24,7 +24,8 @@ class Scope(enum.Enum):
     INTRINSIC = "intrinsic"
 
 
-INTRINSICS = ("name", "duration", "status", "kind", "rootName", "rootServiceName", "traceDuration")
+INTRINSICS = ("name", "duration", "status", "kind", "childCount", "parent",
+              "rootName", "rootServiceName", "traceDuration")
 
 STATUS_NAMES = {"unset": 0, "ok": 1, "error": 2}
 KIND_NAMES = {
@@ -41,13 +42,18 @@ KIND_NAMES = {
 class Field:
     scope: Scope
     name: str
+    # parent-scoped attribute lookup: `parent.x`, `parent.span.x`,
+    # `parent.resource.x`, `parent.duration` read the value off the
+    # span's PARENT (expr.y:256-261 NewScopedAttribute parent flag)
+    parent: bool = False
 
 
 @dataclass(frozen=True)
 class Static:
-    """A literal: str, int, float, bool, duration-nanos, status, kind."""
+    """A literal: str, int, float, bool, duration-nanos, status, kind,
+    or nil (expr.y statics incl. NIL)."""
 
-    kind: str  # 'str','int','float','bool','duration','status','kind'
+    kind: str  # 'str','int','float','bool','duration','status','kind','nil'
     value: object
 
 
@@ -59,13 +65,33 @@ class Comparison:
 
 
 @dataclass(frozen=True)
+class BinaryOp:
+    """General field-expression algebra (expr.y fieldExpression:
+    arithmetic + - * / % ^, comparisons between arbitrary expressions,
+    regex between expressions). The parser emits Comparison for the
+    planner-friendly `field op literal` shape and BinaryOp otherwise."""
+
+    op: str  # '+','-','*','/','%','^','=','!=','<','<=','>','>=','=~','!~'
+    lhs: "Expr"
+    rhs: "Expr"
+
+
+@dataclass(frozen=True)
+class UnaryOp:
+    """`-expr` (numeric negate) / `!expr` (boolean not)."""
+
+    op: str  # '-' or '!'
+    operand: "Expr"
+
+
+@dataclass(frozen=True)
 class LogicalExpr:
     op: str  # '&&' or '||'
     lhs: "Expr"
     rhs: "Expr"
 
 
-Expr = Union[Comparison, LogicalExpr]
+Expr = Union[Comparison, LogicalExpr, BinaryOp, UnaryOp, Field, Static]
 
 
 @dataclass(frozen=True)
@@ -92,26 +118,71 @@ class SpansetOp:
 
 @dataclass(frozen=True)
 class Aggregate:
-    """One pipeline stage: `| fn(field?) op literal` -- a scalar filter
-    over the spanset's matched spans (expr.y's scalarFilter over
-    aggregate expressions). count() takes no field; the others fold a
-    numeric field (duration or a numeric attribute) of matched spans."""
+    """A scalar aggregate EXPRESSION: `count()`, `avg(fieldExpr)`, ...
+    (expr.y aggregate). Appears inside ScalarFilter operands: the
+    `| fn(field) op literal` stage is ScalarFilter(op,
+    Aggregate(fn, expr), Static)."""
 
     fn: str  # one of AGGREGATE_FNS
-    field: Field | None
+    field: "Expr | None"  # fieldExpression argument (None for count)
+
+
+@dataclass(frozen=True)
+class ScalarOp:
+    """Arithmetic between scalar expressions (expr.y scalarExpression:
+    + - * / % ^ over aggregates and statics)."""
+
+    op: str
+    lhs: "Scalar"
+    rhs: "Scalar"
+
+
+@dataclass(frozen=True)
+class ScalarFilter:
+    """`scalar op scalar` -- a pipeline stage keeping spansets whose
+    folded scalars satisfy the comparison (expr.y scalarFilter)."""
+
     op: str  # '=', '!=', '<', '<=', '>', '>='
-    value: Static
+    lhs: "Scalar"
+    rhs: "Scalar"
+
+
+@dataclass(frozen=True)
+class GroupBy:
+    """`by(fieldExpr)`: split each spanset into groups keyed by the
+    expression's per-span value (expr.y groupOperation)."""
+
+    expr: "Expr"
+
+
+@dataclass(frozen=True)
+class Coalesce:
+    """`coalesce()`: merge grouped spansets back into one."""
+
+
+@dataclass(frozen=True)
+class ScalarPipeline:
+    """`({ ... } | scalarExpr)` -- a wrapped pipeline whose value is a
+    scalar (expr.y scalarPipeline); operand of pipeline-expression
+    arithmetic like `({a}|count()) + ({b}|count()) = 1`."""
+
+    filter: "PipelineExpr"
+    scalar: "Scalar"
+
+
+Scalar = Union[Aggregate, Static, ScalarOp, ScalarPipeline]
 
 
 @dataclass(frozen=True)
 class Pipeline:
-    """`{ ... } | agg ...` -- a spanset expression piped through scalar
-    aggregate filters; a trace matches when its matched spans pass
-    every stage."""
+    """`{ ... } | stage | ...`: a spanset expression piped through
+    filter / scalar-filter / by / coalesce stages; a trace matches when
+    some spanset (group) survives every stage."""
 
-    filter: "SpansetExpr"
-    stages: tuple[Aggregate, ...]
+    filter: "PipelineExpr"
+    stages: tuple
 
 
 SpansetExpr = Union[SpansetFilter, SpansetOp]
+PipelineExpr = Union[SpansetFilter, SpansetOp, Pipeline]
 Query = Union[SpansetFilter, SpansetOp, Pipeline]

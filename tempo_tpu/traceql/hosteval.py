@@ -1,15 +1,20 @@
-"""Exact host-side evaluation of a TraceQL spanset filter over a
-materialized wire-model trace.
+"""Exact host-side evaluation of TraceQL over a materialized wire-model
+trace.
 
 The device filter is allowed to over-match (clamped int32/f32 encodings,
-mixed OR trees -- ops/filter.py docstring); queries whose plan sets
-needs_verify re-check every surviving candidate here before it reaches
-the user, the same role the final proto-level Matches() check plays in
-the reference (pkg/model/object_decoder.go Matches).
+mixed OR trees, and every construct the planner can't compile -- field
+arithmetic, parent scope, childCount, pipelines); queries whose plan
+sets needs_verify re-check every surviving candidate here before it
+reaches the user, the same role the final proto-level Matches() check
+plays in the reference (pkg/model/object_decoder.go Matches).
 
-Semantics: `{ expr }` matches a trace iff some single span satisfies
-every span-level predicate, with trace intrinsics (traceDuration,
-rootName, rootServiceName) evaluated trace-wide.
+Evaluation is VALUE-typed (the reference's Static runtime): field
+expressions produce str/int/float/bool/duration(ns int)/status/kind
+values or None (missing); comparisons and arithmetic follow
+pkg/traceql/ast.go execute semantics. Pipelines carry a list of span
+GROUPS (by() splits, coalesce() merges, scalar filters keep groups
+whose fold passes); a trace matches when some group survives every
+stage non-empty.
 """
 
 from __future__ import annotations
@@ -18,31 +23,162 @@ import re
 
 from ..wire.model import Resource, Span, Trace
 from .ast import (
+    Aggregate,
+    BinaryOp,
+    Coalesce,
     Comparison,
     Field,
+    GroupBy,
     LogicalExpr,
     Pipeline,
+    ScalarFilter,
+    ScalarOp,
+    ScalarPipeline,
     Scope,
     SpansetFilter,
     SpansetOp,
     Static,
+    UnaryOp,
 )
 
 _STATUS_NAMES = {0: "unset", 1: "ok", 2: "error"}
 _KIND_NAMES = {0: "unspecified", 1: "internal", 2: "server", 3: "client", 4: "producer", 5: "consumer"}
 
 
+class _Nil:
+    """The nil literal's runtime value: distinct from None (missing) so
+    `x = nil` can match absent attributes explicitly."""
+
+    __slots__ = ()
+
+
+_NIL = _Nil()
+
+
+class _TraceCtx:
+    """Per-trace evaluation context: trace intrinsics, span parent links
+    and child counts (parent./childCount/parent-intrinsic support)."""
+
+    def __init__(self, trace: Trace):
+        self.trace = trace
+        lo, hi = trace.time_range_nanos()
+        self.spans: list[tuple[Span, Resource]] = []
+        self.by_id: dict[bytes, tuple[Span, Resource]] = {}
+        self.child_count: dict[bytes, int] = {}
+        root = first = None
+        for rs in trace.resource_spans:
+            for ss in rs.scope_spans:
+                for sp in ss.spans:
+                    pair = (sp, rs.resource)
+                    self.spans.append(pair)
+                    if sp.span_id:
+                        self.by_id[sp.span_id] = pair
+                    if first is None:
+                        first = pair
+                    if root is None and not sp.parent_span_id.strip(b"\x00"):
+                        root = pair
+        for sp, _ in self.spans:
+            p = sp.parent_span_id
+            if p and p.strip(b"\x00"):
+                self.child_count[p] = self.child_count.get(p, 0) + 1
+        pick = root or first
+        self.tvals = {
+            "traceDuration": (hi or 0) - (lo or 0),
+            "rootName": pick[0].name if pick else "",
+            "rootServiceName": pick[1].service_name if pick else "",
+        }
+
+    def parent_of(self, sp: Span) -> tuple[Span, Resource] | None:
+        p = sp.parent_span_id
+        if not p or not p.strip(b"\x00"):
+            return None
+        return self.by_id.get(p)
+
+
+# ------------------------------------------------------------- values
+
+
+def _field_value(f: Field, span: Span, res: Resource, ctx: _TraceCtx):
+    """Typed value of a field for one span; None = missing."""
+    if f.parent:
+        parent = ctx.parent_of(span)
+        if parent is None:
+            return None  # roots have no parent: parent.x is undefined
+        span, res = parent
+        f = Field(f.scope, f.name)
+    if f.scope == Scope.INTRINSIC:
+        n = f.name
+        if n == "name":
+            return span.name
+        if n == "duration":
+            return span.duration_nanos
+        if n == "status":
+            return ("status", int(span.status_code))
+        if n == "kind":
+            return ("kind", int(span.kind))
+        if n == "childCount":
+            return ctx.child_count.get(span.span_id, 0)
+        if n == "parent":
+            return ctx.parent_of(span)  # None for roots -> `parent = nil`
+        if n in ("traceDuration", "rootName", "rootServiceName"):
+            return ctx.tvals[n]
+        return None
+    if f.scope == Scope.SPAN:
+        return span.attrs.get(f.name)
+    if f.scope == Scope.RESOURCE:
+        return res.attrs.get(f.name)
+    # EITHER: span wins, falls back to resource (reference precedence)
+    if f.name in span.attrs:
+        return span.attrs[f.name]
+    return res.attrs.get(f.name)
+
+
+def _static_value(s: Static):
+    if s.kind == "nil":
+        return _NIL
+    if s.kind == "status":
+        return ("status", int(s.value))
+    if s.kind == "kind":
+        return ("kind", int(s.value))
+    return s.value
+
+
+def _is_num(v) -> bool:
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
 def _cmp_values(op: str, actual, want) -> bool:
+    """Comparison semantics over runtime values. None (missing) never
+    matches except `= nil`; nil matches None and only None."""
     if op == "exists":
         return actual is not None
-    if actual is None:
+    if want is _NIL or actual is _NIL:
+        other = actual if want is _NIL else want
+        missing = other is None or other is _NIL
+        return missing if op == "=" else (not missing) if op == "!=" else False
+    if actual is None or want is None:
         return False
+    # status/kind enums compare only against their own tag
+    if isinstance(actual, tuple) or isinstance(want, tuple):
+        if (isinstance(actual, tuple) and isinstance(want, tuple)
+                and actual[0] == want[0]):
+            if op == "=":
+                return actual[1] == want[1]
+            if op == "!=":
+                return actual[1] != want[1]
+        # number literals also compare against enums (legacy surface);
+        # no int() truncation -- 1.7 must not equal status code 1
+        if isinstance(actual, tuple) and _is_num(want):
+            return _cmp_values(op, actual[1], want)
+        if isinstance(want, tuple) and _is_num(actual):
+            return _cmp_values(op, actual, want[1])
+        return op == "!="
     if isinstance(want, bool) or isinstance(actual, bool):
         if not isinstance(actual, bool) or not isinstance(want, bool):
             return op == "!="
         return (actual == want) if op == "=" else (actual != want) if op == "!=" else False
-    if isinstance(want, str):
-        if not isinstance(actual, str):
+    if isinstance(want, str) or isinstance(actual, str):
+        if not (isinstance(actual, str) and isinstance(want, str)):
             return op == "!="
         if op == "=~":
             return re.search(want, actual) is not None
@@ -53,109 +189,89 @@ def _cmp_values(op: str, actual, want) -> bool:
         if op == "!=":
             return actual != want
         return False
-    # numeric
-    if isinstance(actual, str):
+    if not (_is_num(actual) and _is_num(want)):
         return op == "!="
-    try:
-        a, w = float(actual), float(want)
-    except (TypeError, ValueError):
-        return op == "!="
+    a, w = float(actual), float(want)
     return {
         "=": a == w, "!=": a != w, "<": a < w, "<=": a <= w, ">": a > w, ">=": a >= w,
     }.get(op, False)
 
 
-def _trace_values(trace: Trace):
-    lo, hi = trace.time_range_nanos()
-    # root = first span (document order) with an empty parent id, falling
-    # back to the first span -- same rule as block/builder.py:267-274
-    root = None
-    first = None
-    for rs in trace.resource_spans:
-        for ss in rs.scope_spans:
-            for sp in ss.spans:
-                if first is None:
-                    first = (sp, rs.resource)
-                if root is None and not sp.parent_span_id.strip(b"\x00"):
-                    root = (sp, rs.resource)
-    pick = root or first
-    return {
-        "traceDuration": (hi or 0) - (lo or 0),
-        "rootName": pick[0].name if pick else "",
-        "rootServiceName": pick[1].service_name if pick else "",
-    }
+def _arith(op: str, a, b):
+    if not (_is_num(a) and _is_num(b)):
+        return None
+    try:
+        if op == "+":
+            return a + b
+        if op == "-":
+            return a - b
+        if op == "*":
+            return a * b
+        if op == "/":
+            return a / b
+        if op == "%":
+            return a % b
+        if op == "^":
+            return a ** b
+    except (ZeroDivisionError, OverflowError, ValueError):
+        return None
+    return None
 
 
-def _eval_cmp(cmp: Comparison, span: Span, res: Resource, tvals: dict) -> bool:
-    f, op, lit = cmp.field, cmp.op, cmp.value
-    want = lit.value if lit is not None else None
-    if f.scope == Scope.INTRINSIC:
-        if f.name == "name":
-            return _cmp_values(op, span.name, want)
-        if f.name == "duration":
-            return _cmp_values(op, span.duration_nanos, want)
-        if f.name == "status":
-            return _cmp_values(op, int(span.status_code), int(want))
-        if f.name == "kind":
-            return _cmp_values(op, int(span.kind), int(want))
-        if f.name == "traceDuration":
-            return _cmp_values(op, tvals["traceDuration"], want)
-        if f.name == "rootName":
-            return _cmp_values(op, tvals["rootName"], want)
-        if f.name == "rootServiceName":
-            return _cmp_values(op, tvals["rootServiceName"], want)
-        return False
-    if f.scope == Scope.SPAN:
-        return _cmp_values(op, span.attrs.get(f.name), want)
-    if f.scope == Scope.RESOURCE:
-        return _cmp_values(op, res.attrs.get(f.name), want)
-    # EITHER: span wins, falls back to resource (reference precedence,
-    # vparquet/block_traceql.go attribute scopes)
-    if f.name in span.attrs:
-        return _cmp_values(op, span.attrs.get(f.name), want)
-    return _cmp_values(op, res.attrs.get(f.name), want)
-
-
-def _eval_expr(expr, span: Span, res: Resource, tvals: dict) -> bool:
-    if isinstance(expr, LogicalExpr):
-        if expr.op == "&&":
-            return _eval_expr(expr.lhs, span, res, tvals) and _eval_expr(expr.rhs, span, res, tvals)
-        return _eval_expr(expr.lhs, span, res, tvals) or _eval_expr(expr.rhs, span, res, tvals)
+def _value(expr, span: Span, res: Resource, ctx: _TraceCtx):
+    """Evaluate a field expression to a runtime value (None = undefined)."""
+    if isinstance(expr, Static):
+        return _static_value(expr)
+    if isinstance(expr, Field):
+        return _field_value(expr, span, res, ctx)
     if isinstance(expr, Comparison):
-        return _eval_cmp(expr, span, res, tvals)
+        want = _static_value(expr.value)
+        actual = _field_value(expr.field, span, res, ctx)
+        return _cmp_values(expr.op, actual, want)
+    if isinstance(expr, LogicalExpr):
+        lv = _value(expr.lhs, span, res, ctx)
+        rv = _value(expr.rhs, span, res, ctx)
+        lb = lv is True
+        rb = rv is True
+        return (lb and rb) if expr.op == "&&" else (lb or rb)
+    if isinstance(expr, UnaryOp):
+        v = _value(expr.operand, span, res, ctx)
+        if expr.op == "-":
+            return -v if _is_num(v) else None
+        return (not v) if isinstance(v, bool) else None
+    if isinstance(expr, BinaryOp):
+        a = _value(expr.lhs, span, res, ctx)
+        b = _value(expr.rhs, span, res, ctx)
+        if expr.op in ("+", "-", "*", "/", "%", "^"):
+            return _arith(expr.op, a, b)
+        return _cmp_values(expr.op, a, b)
     raise TypeError(f"cannot evaluate {expr!r}")
 
 
-def _agg_field_value(f: Field, span: Span, res: Resource):
-    """Numeric value of the aggregate's field for one span (None = the
-    span contributes nothing to the fold)."""
-    if f.scope == Scope.INTRINSIC:
-        if f.name == "duration":
-            return span.duration_nanos
-        return None
-    if f.scope == Scope.SPAN:
-        v = span.attrs.get(f.name)
-    elif f.scope == Scope.RESOURCE:
-        v = res.attrs.get(f.name)
-    else:  # EITHER
-        v = span.attrs.get(f.name, res.attrs.get(f.name))
-    return v if isinstance(v, (int, float)) and not isinstance(v, bool) else None
+def _eval_expr(expr, span: Span, res: Resource, ctx: _TraceCtx) -> bool:
+    """Boolean position: the expression's value must be True."""
+    return _value(expr, span, res, ctx) is True
 
 
-def _matched_spans(expr, trace: Trace, tvals: dict) -> list[tuple[Span, Resource]]:
+# ------------------------------------------------------------ spansets
+
+
+def _matched_spans(expr, ctx: _TraceCtx) -> list[tuple[Span, Resource]]:
     """The spanset an expression selects from one trace: filter matches,
-    or the structural/combinator result of two spansets
-    (expr.y spansetExpression semantics)."""
-    if isinstance(expr, SpansetFilter):
+    the structural/combinator result of two spansets (expr.y
+    spansetExpression semantics), or a pipeline's surviving spans."""
+    if isinstance(expr, Pipeline):
+        groups = _eval_pipeline_groups(expr, ctx)
         out = []
-        for rs in trace.resource_spans:
-            for ss in rs.scope_spans:
-                for sp in ss.spans:
-                    if expr.expr is None or _eval_expr(expr.expr, sp, rs.resource, tvals):
-                        out.append((sp, rs.resource))
+        for g in groups:
+            out = _union(out, g)
         return out
-    lhs = _matched_spans(expr.lhs, trace, tvals)
-    rhs = _matched_spans(expr.rhs, trace, tvals)
+    if isinstance(expr, SpansetFilter):
+        if expr.expr is None:
+            return list(ctx.spans)
+        return [(sp, r) for sp, r in ctx.spans if _eval_expr(expr.expr, sp, r, ctx)]
+    lhs = _matched_spans(expr.lhs, ctx)
+    rhs = _matched_spans(expr.rhs, ctx)
     if expr.op == "&&":
         # both present: result is the union of both sides' spans
         return _union(lhs, rhs) if lhs and rhs else []
@@ -172,12 +288,7 @@ def _matched_spans(expr, trace: Trace, tvals: dict) -> list[tuple[Span, Resource
     if expr.op == ">":
         return [(sp, r) for sp, r in rhs if _parent(sp) in lhs_ids]
     if expr.op == ">>":
-        parent_of: dict[bytes, bytes] = {}
-        for rs in trace.resource_spans:
-            for ss in rs.scope_spans:
-                for sp in ss.spans:
-                    if sp.span_id:
-                        parent_of[sp.span_id] = _parent(sp)
+        parent_of = {sp.span_id: _parent(sp) for sp, _ in ctx.spans if sp.span_id}
         out = []
         for sp, r in rhs:
             anc = _parent(sp)
@@ -216,49 +327,109 @@ def _union(a, b):
     return out
 
 
-def _eval_pipeline(q: Pipeline, trace: Trace, tvals: dict) -> bool:
-    """Exact evaluation: matched spans of the spanset expression, folded
-    through every scalar aggregate stage (expr.y scalarFilter)."""
-    matched = _matched_spans(q.filter, trace, tvals)
-    if not matched:
-        # an empty spanset never reaches the pipeline (reference drops
+# ------------------------------------------------------------ scalars
+
+
+def _scalar_value(s, group: list, ctx: _TraceCtx):
+    """Value of a scalar expression over one span group (None =
+    undefined: empty fold, missing fields, arithmetic on non-numbers)."""
+    if isinstance(s, Static):
+        v = _static_value(s)
+        return v if _is_num(v) else None
+    if isinstance(s, Aggregate):
+        if s.fn == "count":
+            return len(group)
+        vals = []
+        for sp, res in group:
+            v = _value(s.field, sp, res, ctx)
+            if _is_num(v):
+                vals.append(v)
+        if not vals:
+            return None
+        if s.fn == "avg":
+            return sum(vals) / len(vals)
+        if s.fn == "min":
+            return min(vals)
+        if s.fn == "max":
+            return max(vals)
+        return sum(vals)
+    if isinstance(s, ScalarOp):
+        return _arith(s.op, _scalar_value(s.lhs, group, ctx),
+                      _scalar_value(s.rhs, group, ctx))
+    if isinstance(s, ScalarPipeline):
+        # wrapped pipeline: its scalar folds over the spans its OWN
+        # pipeline selects from the whole trace
+        sub = _matched_spans(s.filter, ctx)
+        return _scalar_value(s.scalar, sub, ctx)
+    raise TypeError(f"cannot evaluate scalar {s!r}")
+
+
+# ----------------------------------------------------------- pipelines
+
+
+def _eval_pipeline_groups(q: Pipeline, ctx: _TraceCtx) -> list[list]:
+    """Run a pipeline: start from the filter's spanset as one group,
+    apply stages in order; returns the surviving (non-empty) groups."""
+    start = _matched_spans(q.filter, ctx)
+    if not start:
+        # an empty spanset never enters the pipeline (reference drops
         # empty spansets first), so `| count() = 0` matches nothing --
         # identically to the device prefilter path
-        return False
+        return []
+    groups: list[list] = [start]
     for st in q.stages:
-        if st.fn == "count":
-            actual: float | int | None = len(matched)
+        if isinstance(st, (SpansetFilter, SpansetOp)):
+            if isinstance(st, SpansetFilter):
+                groups = [
+                    [(sp, r) for sp, r in g
+                     if st.expr is None or _eval_expr(st.expr, sp, r, ctx)]
+                    for g in groups
+                ]
+            else:
+                # structural stage: relations resolve against the whole
+                # trace, membership restricted to the group
+                sel = _matched_spans(st, ctx)
+                keep = {id(sp) for sp, _ in sel}
+                groups = [[(sp, r) for sp, r in g if id(sp) in keep]
+                          for g in groups]
+        elif isinstance(st, ScalarFilter):
+            out = []
+            for g in groups:
+                lv = _scalar_value(st.lhs, g, ctx)
+                rv = _scalar_value(st.rhs, g, ctx)
+                if lv is not None and rv is not None and _cmp_values(st.op, lv, rv):
+                    out.append(g)
+            groups = out
+        elif isinstance(st, GroupBy):
+            regrouped: dict = {}
+            for g in groups:
+                for sp, r in g:
+                    k = _value(st.expr, sp, r, ctx)
+                    if k is None:
+                        continue  # nil group keys drop the span
+                    if (isinstance(k, tuple) and len(k) == 2
+                            and isinstance(k[0], Span)):
+                        k = ("span", k[0].span_id)  # by(parent): identity key
+                    regrouped.setdefault(k, []).append((sp, r))
+            groups = list(regrouped.values())
+        elif isinstance(st, Coalesce):
+            merged: list = []
+            for g in groups:
+                merged = _union(merged, g)
+            groups = [merged] if merged else []
         else:
-            vals = [v for sp, res in matched
-                    if (v := _agg_field_value(st.field, sp, res)) is not None]
-            if not vals:
-                return False  # nothing to fold: the scalar is undefined
-            actual = {
-                "avg": sum(vals) / len(vals),
-                "min": min(vals),
-                "max": max(vals),
-                "sum": sum(vals),
-            }[st.fn]
-        want = st.value.value
-        if not _cmp_values(st.op, actual, want):
-            return False
-    return True
+            raise TypeError(f"unknown pipeline stage {st!r}")
+        groups = [g for g in groups if g]
+        if not groups:
+            return []
+    return groups
 
 
 def trace_matches(q, trace: Trace) -> bool:
     """True iff the trace satisfies the query: some span passes a
     spanset filter; structural/combinator expressions select a
     non-empty spanset; pipelines additionally pass every stage."""
+    ctx = _TraceCtx(trace)
     if isinstance(q, Pipeline):
-        return _eval_pipeline(q, trace, _trace_values(trace))
-    if isinstance(q, SpansetOp):
-        return bool(_matched_spans(q, trace, _trace_values(trace)))
-    if q.expr is None:
-        return True
-    tvals = _trace_values(trace)
-    for rs in trace.resource_spans:
-        for ss in rs.scope_spans:
-            for sp in ss.spans:
-                if _eval_expr(q.expr, sp, rs.resource, tvals):
-                    return True
-    return False
+        return bool(_eval_pipeline_groups(q, ctx))
+    return bool(_matched_spans(q, ctx))

@@ -1,21 +1,29 @@
 """TraceQL lexer + recursive-descent parser.
 
-Grammar subset (the executable class of the reference snapshot, whose
-goyacc grammar lives at pkg/traceql/expr.y; ours is hand-rolled, no
-parser generator needed at this size):
+Covers the full grammar of the reference snapshot (goyacc grammar at
+pkg/traceql/expr.y; ours is hand-rolled with one-token lookahead plus
+cheap backtracking at the two genuinely ambiguous '(' positions):
 
-    query      := '{' expr? '}'
-    expr       := or_expr
-    or_expr    := and_expr ( '||' and_expr )*
-    and_expr   := unary ( '&&' unary )*
-    unary      := '(' expr ')' | comparison
-    comparison := field op literal | literal op field | field
-    field      := 'span.' ident | 'resource.' ident | '.' ident
-                | 'name' | 'duration' | 'status' | 'kind' | ...
-    literal    := string | number | duration | bool | status | kind
+  root        := spansetPipeline | spansetPipelineExpression
+               | scalarPipelineExpressionFilter
+  pipeline    := stage ('|' stage)*          stage kinds per expr.y:
+                 spansetExpression, scalarFilter, by(fieldExpr),
+                 coalesce() (not first)
+  spanset ops := && || > >> ~ over spansets and wrapped pipelines
+  fieldExpr   := full algebra: + - * / % ^, comparisons (incl. regex),
+                 && || ! unary -, parent-scoped attributes
+                 (parent.x / parent.span.x / parent.resource.x),
+                 intrinsics incl. childCount and parent, nil statics
+  scalarExpr  := arithmetic over aggregates (count/avg/min/max/sum) and
+                 statics; pipeline-expression scalars range only over
+                 wrapped pipelines (expr.y scalarPipelineExpression),
+                 with a bare static allowed as the comparison RHS
 
-A bare field is an existence test. Duration literals: 10ns 5us 3ms 2s
-1m 1h (combinable like 1h30m).
+Type checking lives in validate.py (the analog of the reference AST's
+validate()); parse() runs it so callers get reference behavior --
+parse errors and validation errors both surface as ParseError
+subclasses. Duration literals: 10ns 5us 3ms 2s 1m 1h, combinable
+(1h30m).
 """
 
 from __future__ import annotations
@@ -34,19 +42,26 @@ def _unescape(s: str) -> str:
 
 from .ast import (
     AGGREGATE_FNS,
-    INTRINSICS,
-    KIND_NAMES,
-    STATUS_NAMES,
     Aggregate,
+    BinaryOp,
+    Coalesce,
     Comparison,
     Field,
+    GroupBy,
+    INTRINSICS,
+    KIND_NAMES,
     LogicalExpr,
     ParseError,
     Pipeline,
+    Scalar,
+    ScalarFilter,
+    ScalarOp,
+    ScalarPipeline,
     Scope,
     SpansetFilter,
     SpansetOp,
     Static,
+    UnaryOp,
 )
 
 _TOKEN_RE = re.compile(
@@ -54,21 +69,31 @@ _TOKEN_RE = re.compile(
     (?P<ws>\s+)
   | (?P<string>"(?:[^"\\]|\\.)*"|`[^`]*`)
   | (?P<duration>\d+(?:\.\d+)?(?:ns|us|µs|ms|s|m|h)(?:\d+(?:\.\d+)?(?:ns|us|µs|ms|s|m|h))*)
-  | (?P<number>-?\d+(?:\.\d+)?)
-  | (?P<op>=~|!~|!=|<=|>=|>>|&&|\|\||[{}()=<>.|~])
+  | (?P<number>\d+(?:\.\d+)?)
+  | (?P<op>=~|!~|!=|<=|>=|>>|&&|\|\||[{}()=<>.|~+\-*/%^!])
   | (?P<ident>[a-zA-Z_][a-zA-Z0-9_./-]*)
 """,
     re.VERBOSE,
 )
 
-_DUR_UNIT_NS = {"ns": 1, "us": 10**3, "µs": 10**3, "ms": 10**6, "s": 10**9, "m": 60 * 10**9, "h": 3600 * 10**9}
-_DUR_PART = re.compile(r"(\d+(?:\.\d+)?)(ns|us|µs|ms|s|m|h)")
+_DUR_NS = {"ns": 1, "us": 1_000, "µs": 1_000, "ms": 1_000_000,
+           "s": 1_000_000_000, "m": 60_000_000_000, "h": 3_600_000_000_000}
+
+_CMP_OPS = ("=", "!=", "<", "<=", ">", ">=", "=~", "!~")
+_SCALAR_CMP_OPS = ("=", "!=", "<", "<=", ">", ">=")
+_ARITH_OPS = ("+", "-", "*", "/", "%", "^")
+_COMBINATORS = ("&&", "||", ">", ">>", "~")
+
+# internal match-all spanset (the node `{}` would have produced; the
+# SYNTAX `{ }` is a parse error per the reference, but pipelines whose
+# first stage is a scalar filter or by() still need an initial spanset)
+MATCH_ALL = SpansetFilter(expr=None)
 
 
 def _parse_duration_ns(text: str) -> int:
     total = 0.0
-    for m in _DUR_PART.finditer(text):
-        total += float(m.group(1)) * _DUR_UNIT_NS[m.group(2)]
+    for m in re.finditer(r"(\d+(?:\.\d+)?)(ns|us|µs|ms|s|m|h)", text):
+        total += float(m.group(1)) * _DUR_NS[m.group(2)]
     return int(total)
 
 
@@ -77,13 +102,12 @@ def tokenize(src: str) -> list[tuple[str, str]]:
     pos = 0
     while pos < len(src):
         m = _TOKEN_RE.match(src, pos)
-        if not m:
-            raise ParseError(f"unexpected character {src[pos]!r} at {pos}")
+        if m is None:
+            raise ParseError(f"bad character {src[pos]!r} at {pos}")
         pos = m.end()
         kind = m.lastgroup
-        if kind == "ws":
-            continue
-        out.append((kind, m.group()))
+        if kind != "ws":
+            out.append((kind, m.group()))
     out.append(("eof", ""))
     return out
 
@@ -93,8 +117,8 @@ class _Parser:
         self.toks = tokens
         self.i = 0
 
-    def peek(self):
-        return self.toks[self.i]
+    def peek(self, ahead: int = 0):
+        return self.toks[min(self.i + ahead, len(self.toks) - 1)]
 
     def next(self):
         t = self.toks[self.i]
@@ -106,19 +130,120 @@ class _Parser:
         if val != text:
             raise ParseError(f"expected {text!r}, got {val!r}")
 
-    # ---- grammar
+    def _expect_eof(self):
+        kind, val = self.peek()
+        if kind != "eof":
+            raise ParseError(f"unsupported trailing content {val!r}")
+
+    # ------------------------------------------------------------ root
     def parse_query(self):
-        expr = self.parse_spanset_expr()
-        stages = []
+        kind, val = self.peek()
+        if val == "(":
+            # ambiguous: wrapped spanset pipeline vs scalar pipeline
+            # expression filter (`({a}|count()) + ... = 1`)
+            mark = self.i
+            try:
+                q = self.parse_scalar_pipeline_filter()
+                self._expect_eof()
+                return q
+            except ParseError:
+                self.i = mark
+            q = self.parse_pipeline_chain()
+        elif val == "{" or val == "by":
+            q = self.parse_pipeline_chain()
+        else:
+            # scalar filter root: `3 = 2`, `avg(.f) > 1`,
+            # `count() = 1 | { true }`
+            q = self.parse_pipeline(first_scalar=True)
+        self._expect_eof()
+        return q
+
+    # spansetPipelineExpression: combinators over pipelines / wrapped
+    # pipeline expressions
+    def parse_pipeline_chain(self):
+        lhs = self.parse_pipeline_term()
+        while self.peek()[1] in _COMBINATORS:
+            _, op = self.next()
+            lhs = SpansetOp(op, lhs, self.parse_pipeline_term())
+        return lhs
+
+    def parse_pipeline_term(self):
+        if self.peek()[1] == "(":
+            self.next()
+            inner = self.parse_pipeline_chain()
+            self.expect(")")
+            return inner
+        return self.parse_pipeline()
+
+    def parse_pipeline(self, first_scalar: bool = False, allow_scalar_tail: bool = False):
+        """One spansetPipeline: stages joined by '|'. Returns the bare
+        spanset expression when there is just one spanset stage, else a
+        Pipeline. With allow_scalar_tail (wrapped scalar pipelines), a
+        trailing naked scalar expression is legal and returned via
+        ScalarPipeline."""
+        stages: list = []
+        first = self.parse_stage(first=True, scalar_ok=first_scalar,
+                                 allow_scalar_tail=False)
+        stages.append(first)
+        scalar_tail: Scalar | None = None
         while self.peek()[1] == "|":
             self.next()
-            stages.append(self.parse_aggregate())
-        self._expect_eof()
-        return Pipeline(expr, tuple(stages)) if stages else expr
+            last_ok = allow_scalar_tail
+            st = self.parse_stage(first=False, scalar_ok=True,
+                                  allow_scalar_tail=last_ok)
+            if isinstance(st, tuple) and st[0] == "scalar_tail":
+                scalar_tail = st[1]
+                break
+            stages.append(st)
+        if scalar_tail is not None:
+            filt = self._stages_to_query(stages)
+            return ScalarPipeline(filt, scalar_tail)
+        return self._stages_to_query(stages)
 
+    def _stages_to_query(self, stages: list):
+        if len(stages) == 1 and isinstance(stages[0], (SpansetFilter, SpansetOp)):
+            return stages[0]
+        if isinstance(stages[0], (SpansetFilter, SpansetOp)):
+            return Pipeline(stages[0], tuple(stages[1:]))
+        return Pipeline(MATCH_ALL, tuple(stages))
+
+    def parse_stage(self, first: bool, scalar_ok: bool, allow_scalar_tail: bool):
+        kind, val = self.peek()
+        if val == "{" or val == "(":
+            return self.parse_spanset_expr()
+        if kind == "ident" and val == "by" and self.peek(1)[1] == "(":
+            self.next()
+            self.expect("(")
+            if self.peek()[1] == ")":
+                raise ParseError("by() needs a field expression")
+            e = self.parse_or()
+            self.expect(")")
+            return GroupBy(e)
+        if kind == "ident" and val == "coalesce" and self.peek(1)[1] == "(":
+            if first:
+                raise ParseError("pipelines can't start with coalesce()")
+            self.next()
+            self.expect("(")
+            self.expect(")")
+            return Coalesce()
+        if not scalar_ok and not first:
+            raise ParseError(f"unexpected pipeline stage at {val!r}")
+        # scalar filter (or a naked scalar tail inside wrapped pipelines)
+        lhs = self.parse_scalar_expr()
+        nkind, nval = self.peek()
+        if nval in _SCALAR_CMP_OPS:
+            self.next()
+            rhs = self.parse_scalar_expr()
+            return ScalarFilter(nval, lhs, rhs)
+        if allow_scalar_tail and nval == ")":
+            return ("scalar_tail", lhs)
+        raise ParseError(
+            "naked scalar pipelines not allowed (scalar stages must compare)"
+        )
+
+    # spansetExpression: combinators over braced spansets; parens here
+    # wrap spanset expressions only (stage-level grammar)
     def parse_spanset_expr(self):
-        # expr.y precedence: structural (> >> ~) binds tighter than the
-        # spanset combinators (&& ||); both left-associative
         expr = self.parse_structural()
         while self.peek()[1] in ("&&", "||"):
             _, op = self.next()
@@ -133,7 +258,7 @@ class _Parser:
         return expr
 
     def parse_spanset_primary(self):
-        if self.peek()[1] == "(":  # ( spansetExpression ) per expr.y
+        if self.peek()[1] == "(":
             self.next()
             e = self.parse_spanset_expr()
             self.expect(")")
@@ -143,51 +268,16 @@ class _Parser:
     def parse_spanset(self) -> SpansetFilter:
         self.expect("{")
         if self.peek()[1] == "}":
-            self.next()
-            return SpansetFilter(expr=None)
+            # `{ }` is a parse error in the reference grammar
+            # (test_examples.yaml parse_fails); use `{ true }`
+            raise ParseError("empty spanset filter { } (use { true })")
         expr = self.parse_or()
         self.expect("}")
         return SpansetFilter(expr=expr)
 
-    def parse_aggregate(self) -> Aggregate:
-        kind, fn = self.next()
-        if fn not in AGGREGATE_FNS:
-            raise ParseError(
-                f"unsupported pipeline stage {fn!r} (supported: {AGGREGATE_FNS})"
-            )
-        self.expect("(")
-        field = None
-        if self.peek()[1] != ")":
-            if fn == "count":
-                raise ParseError("count() takes no argument")
-            field = self.try_field()
-            if field is None:
-                raise ParseError(f"{fn}() needs a field argument")
-            if field.scope == Scope.INTRINSIC and field.name != "duration":
-                # the other intrinsics are strings/enums: folding them
-                # can never match, so fail at parse time
-                raise ParseError(
-                    f"{fn}() needs a numeric field; intrinsic {field.name!r} is not"
-                )
-        elif fn != "count":
-            raise ParseError(f"{fn}() needs a field argument")
-        self.expect(")")
-        kind, op = self.next()
-        if op not in ("=", "!=", "<", "<=", ">", ">="):
-            raise ParseError(f"bad aggregate comparison operator {op!r}")
-        value = self.parse_literal(field)
-        allowed = ("int",) if fn == "count" else ("int", "float", "duration")
-        if value.kind not in allowed:
-            raise ParseError(
-                f"{fn}() comparisons need a {' / '.join(allowed)} literal, got {value.kind}"
-            )
-        return Aggregate(fn=fn, field=field, op=op, value=value)
-
-    def _expect_eof(self):
-        kind, val = self.peek()
-        if kind != "eof":
-            raise ParseError(f"unsupported trailing content {val!r}")
-
+    # -------------------------------------------------- field algebra
+    # precedence (expr.y): || < && < comparisons < + - < unary ! - <
+    # * / % < ^ (right-assoc) < primary
     def parse_or(self):
         lhs = self.parse_and()
         while self.peek()[1] == "||":
@@ -196,39 +286,78 @@ class _Parser:
         return lhs
 
     def parse_and(self):
-        lhs = self.parse_unary()
+        lhs = self.parse_cmp()
         while self.peek()[1] == "&&":
             self.next()
-            lhs = LogicalExpr("&&", lhs, self.parse_unary())
+            lhs = LogicalExpr("&&", lhs, self.parse_cmp())
         return lhs
 
-    def parse_unary(self):
-        if self.peek()[1] == "(":
+    def parse_cmp(self):
+        lhs = self.parse_addsub()
+        while self.peek()[1] in _CMP_OPS:
+            _, op = self.next()
+            rhs = self.parse_addsub()
+            lhs = self._make_cmp(lhs, op, rhs)
+        return lhs
+
+    @staticmethod
+    def _make_cmp(lhs, op: str, rhs):
+        """Planner-friendly normalization: `field op literal` (either
+        order) becomes the legacy Comparison node; everything else is a
+        general BinaryOp."""
+        if isinstance(lhs, Field) and isinstance(rhs, Static) and not lhs.parent:
+            return Comparison(lhs, op, rhs)
+        if isinstance(lhs, Static) and isinstance(rhs, Field) and not rhs.parent:
+            flip = {"<": ">", ">": "<", "<=": ">=", ">=": "<="}
+            if op in flip or op in ("=", "!="):
+                return Comparison(rhs, flip.get(op, op), lhs)
+        return BinaryOp(op, lhs, rhs)
+
+    def parse_addsub(self):
+        lhs = self.parse_unary_level()
+        while self.peek()[1] in ("+", "-"):
+            _, op = self.next()
+            lhs = BinaryOp(op, lhs, self.parse_unary_level())
+        return lhs
+
+    def parse_unary_level(self):
+        kind, val = self.peek()
+        if val in ("-", "!"):
+            self.next()
+            inner = self.parse_unary_level()
+            if (val == "-" and isinstance(inner, Static)
+                    and inner.kind in ("int", "float", "duration")):
+                # fold negative literals so `{ .a = -3 }` stays the
+                # planner-compilable Comparison shape
+                return Static(inner.kind, -inner.value)
+            return UnaryOp(val, inner)
+        return self.parse_muldiv()
+
+    def parse_muldiv(self):
+        lhs = self.parse_pow()
+        while self.peek()[1] in ("*", "/", "%"):
+            _, op = self.next()
+            lhs = BinaryOp(op, lhs, self.parse_pow())
+        return lhs
+
+    def parse_pow(self):
+        lhs = self.parse_field_primary()
+        if self.peek()[1] == "^":
+            self.next()
+            return BinaryOp("^", lhs, self.parse_pow())  # right-assoc
+        return lhs
+
+    def parse_field_primary(self):
+        kind, val = self.peek()
+        if val == "(":
             self.next()
             e = self.parse_or()
             self.expect(")")
             return e
-        return self.parse_comparison()
-
-    def parse_comparison(self) -> Comparison:
-        field = self.try_field()
-        if field is not None:
-            kind, val = self.peek()
-            if val in ("=", "!=", "<", "<=", ">", ">=", "=~", "!~"):
-                self.next()
-                lit = self.parse_literal(field)
-                return Comparison(field, val, lit)
-            return Comparison(field, "exists", Static("bool", True))
-        # literal op field (reversed operands)
-        lit = self.parse_literal(None)
-        kind, val = self.next()
-        if val not in ("=", "!=", "<", "<=", ">", ">=", "=~", "!~"):
-            raise ParseError(f"expected comparison operator, got {val!r}")
-        field = self.try_field()
-        if field is None:
-            raise ParseError("expected attribute field after literal comparison")
-        flip = {"<": ">", ">": "<", "<=": ">=", ">=": "<="}
-        return Comparison(field, flip.get(val, val), lit)
+        f = self.try_field()
+        if f is not None:
+            return f
+        return self.parse_literal(None)
 
     def try_field(self) -> Field | None:
         """The lexer folds dots into idents, so `span.http.method` is one
@@ -241,6 +370,16 @@ class _Parser:
                 raise ParseError(f"expected attribute name after '.', got {v2!r}")
             return Field(Scope.EITHER, v2)
         if kind == "ident":
+            if val.startswith("parent.") and len(val) > 7:
+                self.next()
+                rest = val[7:]
+                if rest.startswith("span.") and len(rest) > 5:
+                    return Field(Scope.SPAN, rest[5:], parent=True)
+                if rest.startswith("resource.") and len(rest) > 9:
+                    return Field(Scope.RESOURCE, rest[9:], parent=True)
+                if rest in INTRINSICS:
+                    return Field(Scope.INTRINSIC, rest, parent=True)
+                return Field(Scope.EITHER, rest, parent=True)
             if val.startswith("span.") and len(val) > 5:
                 self.next()
                 return Field(Scope.SPAN, val[5:])
@@ -250,6 +389,10 @@ class _Parser:
             if val in INTRINSICS:
                 self.next()
                 return Field(Scope.INTRINSIC, val)
+            if val.endswith("."):
+                # the lexer folds `span.` into one ident; a scope prefix
+                # with no attribute after it is malformed
+                raise ParseError(f"malformed scoped attribute {val!r}")
             return None
         return None
 
@@ -270,6 +413,10 @@ class _Parser:
         if kind == "ident":
             if val in ("true", "false"):
                 return Static("bool", val == "true")
+            if val == "nil":
+                return Static("nil", None)
+            from .ast import STATUS_NAMES
+
             if val in STATUS_NAMES and (field is None or field.name == "status"):
                 return Static("status", STATUS_NAMES[val])
             if val in KIND_NAMES and (field is None or field.name == "kind"):
@@ -277,7 +424,106 @@ class _Parser:
             raise ParseError(f"unexpected literal {val!r}")
         raise ParseError(f"expected literal, got {val!r}")
 
+    # ------------------------------------------------- scalar algebra
+    def parse_scalar_expr(self) -> Scalar:
+        lhs = self.parse_scalar_muldiv()
+        while self.peek()[1] in ("+", "-"):
+            _, op = self.next()
+            lhs = ScalarOp(op, lhs, self.parse_scalar_muldiv())
+        return lhs
+
+    def parse_scalar_muldiv(self) -> Scalar:
+        lhs = self.parse_scalar_pow()
+        while self.peek()[1] in ("*", "/", "%"):
+            _, op = self.next()
+            lhs = ScalarOp(op, lhs, self.parse_scalar_pow())
+        return lhs
+
+    def parse_scalar_pow(self) -> Scalar:
+        lhs = self.parse_scalar_primary()
+        if self.peek()[1] == "^":
+            self.next()
+            return ScalarOp("^", lhs, self.parse_scalar_pow())
+        return lhs
+
+    def parse_scalar_primary(self) -> Scalar:
+        kind, val = self.peek()
+        if val == "-":
+            self.next()
+            inner = self.parse_scalar_primary()
+            if isinstance(inner, Static) and inner.kind in ("int", "float", "duration"):
+                return Static(inner.kind, -inner.value)
+            return ScalarOp("-", Static("int", 0), inner)
+        if val == "(":
+            self.next()
+            e = self.parse_scalar_expr()
+            self.expect(")")
+            return e
+        if kind == "ident" and val in AGGREGATE_FNS and self.peek(1)[1] == "(":
+            self.next()
+            self.expect("(")
+            arg = None
+            if self.peek()[1] != ")":
+                if val == "count":
+                    raise ParseError("count() takes no argument")
+                arg = self.parse_or()
+            elif val != "count":
+                raise ParseError(f"{val}() needs a field expression argument")
+            self.expect(")")
+            return Aggregate(fn=val, field=arg)
+        if kind == "ident" and self.peek(1)[1] == "(" and val not in ("by", "coalesce"):
+            raise ParseError(f"{val!r} is not an aggregate "
+                             f"(supported: {AGGREGATE_FNS})")
+        return self.parse_literal(None)
+
+    # scalarPipelineExpression filter: arithmetic over WRAPPED pipelines
+    # only; a bare static is allowed as the whole comparison RHS
+    # (expr.y:160-186 -- statics are not scalarPipelineExpressions,
+    # which is why `(p) * 2 > 2` and `2 < (p)` are parse errors there)
+    def parse_scalar_pipeline_filter(self):
+        lhs = self.parse_scalar_pipe_expr()
+        nkind, nval = self.peek()
+        if nval not in _SCALAR_CMP_OPS:
+            raise ParseError(f"expected scalar comparison, got {nval!r}")
+        self.next()
+        mark = self.i
+        try:
+            rhs: Scalar = self.parse_scalar_pipe_expr()
+        except ParseError:
+            self.i = mark
+            rhs = self.parse_literal(None)
+        return Pipeline(MATCH_ALL, (ScalarFilter(nval, lhs, rhs),))
+
+    def parse_scalar_pipe_expr(self) -> Scalar:
+        lhs = self.parse_scalar_pipe_term()
+        while self.peek()[1] in _ARITH_OPS:
+            _, op = self.next()
+            lhs = ScalarOp(op, lhs, self.parse_scalar_pipe_term())
+        return lhs
+
+    def parse_scalar_pipe_term(self) -> Scalar:
+        if self.peek()[1] != "(":
+            raise ParseError("pipeline-expression scalars must wrap pipelines")
+        if self.peek(1)[1] == "(":
+            self.next()
+            e = self.parse_scalar_pipe_expr()
+            self.expect(")")
+            return e
+        self.next()
+        inner = self.parse_pipeline(first_scalar=False, allow_scalar_tail=True)
+        self.expect(")")
+        if not isinstance(inner, ScalarPipeline):
+            raise ParseError("wrapped pipeline used as a scalar must end "
+                             "in a scalar expression (e.g. `| count()`)")
+        return inner
+
 
 def parse(src: str):
-    """-> SpansetFilter, or Pipeline when `| agg() op N` stages follow."""
-    return _Parser(tokenize(src)).parse_query()
+    """-> SpansetFilter | SpansetOp | Pipeline. Parses the full expr.y
+    surface and runs the reference's validate() analog; both failure
+    modes raise ParseError subclasses."""
+    q = _Parser(tokenize(src)).parse_query()
+    from .validate import validate
+
+    validate(q)
+    return q

@@ -59,6 +59,10 @@ class Plan:
     conds: list[Cond] = field(default_factory=list)
     rows: list[tuple] = field(default_factory=list)
     tables: dict[int, np.ndarray] = field(default_factory=dict)
+    # set when some construct couldn't be compiled to device conds (field
+    # arithmetic, parent scope, childCount, ...): the plan over-matches
+    # (TRUE leaf) and every candidate is exactly re-checked on host
+    force_verify: bool = False
 
     def cond(self, c: Cond, key: int = 0, v0: int = 0, v1: int = 0, f0: float = 0.0,
              f1: float = 0.0, table: np.ndarray | None = None):
@@ -300,7 +304,15 @@ def _plan_spanset_expr(p: Plan, d: Dictionary, q) -> tuple[tuple, bool]:
     if isinstance(q, SpansetFilter):
         if q.expr is None:
             return TRUE, False
-        return ("tracify", _plan_expr(p, d, q.expr)), False
+        t = _plan_expr(p, d, q.expr)
+        if t in (TRUE, FALSE):
+            return t, False
+        return ("tracify", t), False
+    if isinstance(q, Pipeline):
+        # wrapped-pipeline operand ((...|count()>1|{false}) && ...):
+        # prefilter by its first spanset; the stages are exact-host-only
+        t, _ = _plan_spanset_expr(p, d, q.filter)
+        return t, True
     lt, lv = _plan_spanset_expr(p, d, q.lhs)
     rt, rv = _plan_spanset_expr(p, d, q.rhs)
     structural = q.op in (">", ">>", "~")
@@ -309,11 +321,43 @@ def _plan_spanset_expr(p: Plan, d: Dictionary, q) -> tuple[tuple, bool]:
 
 
 def _plan_expr(p: Plan, d: Dictionary, expr) -> tuple:
+    from .ast import BinaryOp, Field, Static, UnaryOp
+
     if isinstance(expr, LogicalExpr):
         op = "and" if expr.op == "&&" else "or"
         return _fold(op, [_plan_expr(p, d, expr.lhs), _plan_expr(p, d, expr.rhs)])
     if isinstance(expr, Comparison):
+        f, lit = expr.field, expr.value
+        if f.parent or (f.scope == Scope.INTRINSIC
+                        and f.name in ("childCount", "parent")):
+            p.force_verify = True  # host re-checks exactly (hosteval)
+            return TRUE
+        if lit.kind == "nil":
+            if f.scope == Scope.INTRINSIC:
+                # non-parent intrinsics (duration, name, status, ...)
+                # always carry a value: nil compares resolve statically
+                # (the parent intrinsic is caught by the branch above)
+                return TRUE if expr.op == "!=" else FALSE
+            if expr.op == "!=":
+                # existence: != nil <=> the attribute is present
+                return _plan_comparison(p, d, Comparison(f, "exists", lit))
+            p.force_verify = True  # `= nil` (absence) has no device cond
+            return TRUE
         return _plan_comparison(p, d, expr)
+    if isinstance(expr, Field):
+        # bare field in boolean position: value must be boolean true
+        if expr.parent or expr.scope == Scope.INTRINSIC:
+            p.force_verify = True
+            return TRUE
+        return _plan_comparison(p, d, Comparison(expr, "=", Static("bool", True)))
+    if isinstance(expr, Static):
+        # constant in boolean position ({ true }, { false })
+        return TRUE if expr.value is True else FALSE
+    if isinstance(expr, (BinaryOp, UnaryOp)):
+        # general field algebra: no device compilation (yet); scan
+        # conservatively and verify candidates exactly on host
+        p.force_verify = True
+        return TRUE
     raise ParseError(f"cannot plan {expr!r}")
 
 
@@ -356,7 +400,7 @@ def _finish(p: Plan, children: list) -> PlannedQuery:
         return PlannedQuery(None, (), [], {}, prune=True)
     if tree == TRUE:
         tree = None
-    nv = any(c.needs_verify for c in p.conds)
+    nv = p.force_verify or any(c.needs_verify for c in p.conds)
     if tree is not None and _mixed_or(tree, tuple(p.conds)):
         nv = True
     return PlannedQuery(tree, tuple(p.conds), p.rows, p.tables, needs_verify=nv)

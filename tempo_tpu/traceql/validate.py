@@ -1,0 +1,230 @@
+"""TraceQL static type checking -- the analog of the reference AST's
+validate() pass (pkg/traceql/ast_validate.go semantics, exercised by
+test_examples.yaml's validate_fails section).
+
+Types form a tiny lattice: statics carry their literal type, attribute
+lookups are UNKNOWN (dynamically typed at execution), intrinsics are
+fully typed. Rules:
+
+* a spanset filter expression must be boolean-typed (UNKNOWN allowed);
+* arithmetic needs numeric operands (int/float/duration mix freely,
+  per the reference's "we just accept it all" note);
+* ordering comparisons need numeric operands; = and != additionally
+  accept equal types, nil, and `parent` vs nil;
+* regex needs strings; && || need booleans; unary - numeric, ! boolean;
+* aggregate arguments must be numeric AND reference span data;
+* by() expressions must reference span data;
+* scalar filter operand types must be comparable.
+"""
+
+from __future__ import annotations
+
+from .ast import (
+    Aggregate,
+    BinaryOp,
+    Coalesce,
+    Comparison,
+    Field,
+    GroupBy,
+    LogicalExpr,
+    ParseError,
+    Pipeline,
+    ScalarFilter,
+    ScalarOp,
+    ScalarPipeline,
+    Scope,
+    SpansetFilter,
+    SpansetOp,
+    Static,
+    UnaryOp,
+)
+
+
+class ValidationError(ParseError):
+    """Parsed fine, but the types don't line up (reference: the error
+    .validate() returns)."""
+
+
+# type tags
+T_INT, T_FLOAT, T_DUR, T_BOOL, T_STR, T_STATUS, T_KIND, T_NIL, T_SPAN_PTR, T_UNK = (
+    "int", "float", "duration", "bool", "str", "status", "kind", "nil",
+    "spanptr", "unknown",
+)
+
+_NUMERIC = {T_INT, T_FLOAT, T_DUR, T_UNK}
+
+_STATIC_T = {"int": T_INT, "float": T_FLOAT, "duration": T_DUR, "bool": T_BOOL,
+             "str": T_STR, "status": T_STATUS, "kind": T_KIND, "nil": T_NIL}
+
+_INTRINSIC_T = {
+    "duration": T_DUR, "name": T_STR, "status": T_STATUS, "kind": T_KIND,
+    "childCount": T_INT, "parent": T_SPAN_PTR,
+    "rootName": T_STR, "rootServiceName": T_STR, "traceDuration": T_DUR,
+}
+
+
+def _field_type(f: Field) -> str:
+    if f.scope == Scope.INTRINSIC:
+        return _INTRINSIC_T.get(f.name, T_UNK)
+    return T_UNK
+
+
+def _expr_type(e) -> str:
+    """Type of a field expression; raises ValidationError on mismatch."""
+    if isinstance(e, Static):
+        return _STATIC_T.get(e.kind, T_UNK)
+    if isinstance(e, Field):
+        return _field_type(e)
+    if isinstance(e, Comparison):
+        _check_cmp(e.op, _field_type(e.field), _expr_type(e.value))
+        return T_BOOL
+    if isinstance(e, LogicalExpr):
+        for side in (e.lhs, e.rhs):
+            t = _expr_type(side)
+            if t not in (T_BOOL, T_UNK):
+                raise ValidationError(f"{e.op} needs boolean operands, got {t}")
+        return T_BOOL
+    if isinstance(e, UnaryOp):
+        t = _expr_type(e.operand)
+        if e.op == "-":
+            if t not in _NUMERIC:
+                raise ValidationError(f"unary - needs a numeric operand, got {t}")
+            return t
+        if t not in (T_BOOL, T_UNK):
+            raise ValidationError(f"! needs a boolean operand, got {t}")
+        return T_BOOL
+    if isinstance(e, BinaryOp):
+        lt, rt = _expr_type(e.lhs), _expr_type(e.rhs)
+        if e.op in ("+", "-", "*", "/", "%", "^"):
+            for t in (lt, rt):
+                if t not in _NUMERIC:
+                    raise ValidationError(f"{e.op} needs numeric operands, got {t}")
+            if T_UNK in (lt, rt):
+                return T_UNK
+            return T_FLOAT if T_FLOAT in (lt, rt) else (
+                T_DUR if T_DUR in (lt, rt) else T_INT)
+        if e.op in ("&&", "||"):
+            for t in (lt, rt):
+                if t not in (T_BOOL, T_UNK):
+                    raise ValidationError(f"{e.op} needs boolean operands, got {t}")
+            return T_BOOL
+        _check_cmp(e.op, lt, rt)
+        return T_BOOL
+    raise ValidationError(f"cannot type {e!r}")
+
+
+def _check_cmp(op: str, lt: str, rt: str) -> None:
+    if op in ("=~", "!~"):
+        for t in (lt, rt):
+            if t not in (T_STR, T_UNK):
+                raise ValidationError(f"{op} needs string operands, got {t}")
+        return
+    if T_UNK in (lt, rt):
+        return
+    if op in ("=", "!="):
+        if lt == rt:
+            if lt == T_SPAN_PTR:
+                raise ValidationError("parent compares only against nil")
+            return
+        if T_NIL in (lt, rt):
+            return  # x = nil / parent = nil / .foo != nil
+        if lt in _NUMERIC and rt in _NUMERIC:
+            return
+        raise ValidationError(f"cannot {op}-compare {lt} with {rt}")
+    # ordering
+    if lt in _NUMERIC and rt in _NUMERIC:
+        return
+    raise ValidationError(f"{op} needs numeric operands, got {lt} and {rt}")
+
+
+def _references_span(e) -> bool:
+    """True when the expression reads per-span data (reference rule:
+    aggregates and by() must 'reference the span')."""
+    if isinstance(e, Field):
+        return True
+    if isinstance(e, Static):
+        return False
+    if isinstance(e, (BinaryOp, LogicalExpr)):
+        return _references_span(e.lhs) or _references_span(e.rhs)
+    if isinstance(e, UnaryOp):
+        return _references_span(e.operand)
+    if isinstance(e, Comparison):
+        return True
+    return False
+
+
+def _validate_scalar(s, *, in_filter: bool) -> str:
+    """Type of a scalar expression; enforces aggregate argument rules."""
+    if isinstance(s, Static):
+        return _STATIC_T.get(s.kind, T_UNK)
+    if isinstance(s, Aggregate):
+        if s.fn == "count":
+            return T_INT
+        t = _expr_type(s.field)
+        if t not in _NUMERIC:
+            raise ValidationError(f"{s.fn}() needs a numeric argument, got {t}")
+        if not _references_span(s.field):
+            raise ValidationError(f"{s.fn}() must reference span data")
+        return t
+    if isinstance(s, ScalarOp):
+        lt = _validate_scalar(s.lhs, in_filter=in_filter)
+        rt = _validate_scalar(s.rhs, in_filter=in_filter)
+        for t in (lt, rt):
+            if t not in _NUMERIC:
+                raise ValidationError(f"{s.op} needs numeric scalars, got {t}")
+        if T_UNK in (lt, rt):
+            return T_UNK
+        return T_FLOAT if T_FLOAT in (lt, rt) else (
+            T_DUR if T_DUR in (lt, rt) else T_INT)
+    if isinstance(s, ScalarPipeline):
+        validate(s.filter)
+        return _validate_scalar(s.scalar, in_filter=in_filter)
+    raise ValidationError(f"cannot type scalar {s!r}")
+
+
+def _contains_aggregate(s) -> bool:
+    if isinstance(s, Aggregate):
+        return True
+    if isinstance(s, ScalarOp):
+        return _contains_aggregate(s.lhs) or _contains_aggregate(s.rhs)
+    if isinstance(s, ScalarPipeline):
+        return True
+    return False
+
+
+def _validate_scalar_filter(sf: ScalarFilter) -> None:
+    lt = _validate_scalar(sf.lhs, in_filter=True)
+    rt = _validate_scalar(sf.rhs, in_filter=True)
+    _check_cmp(sf.op, lt, rt)
+
+
+def validate(q) -> None:
+    """Raises ValidationError when the parsed query is ill-typed."""
+    if isinstance(q, SpansetFilter):
+        if q.expr is not None:
+            t = _expr_type(q.expr)
+            if t not in (T_BOOL, T_UNK):
+                raise ValidationError(
+                    f"spanset expression must be boolean, got {t}")
+        return
+    if isinstance(q, SpansetOp):
+        validate(q.lhs)
+        validate(q.rhs)
+        return
+    if isinstance(q, Pipeline):
+        validate(q.filter)
+        for st in q.stages:
+            if isinstance(st, (SpansetFilter, SpansetOp)):
+                validate(st)
+            elif isinstance(st, ScalarFilter):
+                _validate_scalar_filter(st)
+            elif isinstance(st, GroupBy):
+                _expr_type(st.expr)
+                if not _references_span(st.expr):
+                    raise ValidationError("by() must reference span data")
+            elif isinstance(st, Coalesce):
+                pass
+            else:
+                raise ValidationError(f"unknown pipeline stage {st!r}")
+        return
+    raise ValidationError(f"cannot validate {q!r}")

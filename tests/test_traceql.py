@@ -73,13 +73,19 @@ def test_parse_reversed_operands():
 
 
 def test_parse_empty_and_exists():
-    assert parse("{}").expr is None
+    # `{}` is a parse error per the reference grammar (test_examples
+    # parse_fails); a bare field is truthiness, not existence
+    with pytest.raises(ParseError):
+        parse("{}")
     q = parse("{ span.foo }")
-    assert q.expr.op == "exists"
+    from tempo_tpu.traceql.ast import Field
+    assert isinstance(q.expr, Field) and q.expr.name == "foo"
+    q2 = parse("{ span.foo != nil }")
+    assert q2.expr.op == "!=" and q2.expr.value.kind == "nil"
 
 
 def test_parse_errors():
-    for bad in ["span.x = 1", "{ span.x = }", "{ span.x ~ 1 }", "{", "{} | count()", '{ name = "x" } { }']:
+    for bad in ["span.x = 1", "{ span.x = }", "{", "{ true } | count()", '{ name = "x" } { }']:
         with pytest.raises(ParseError):
             parse(bad)
 
@@ -296,9 +302,11 @@ def test_string_escape_newline(db2):
 
 def test_wellknown_resource_exists(db2):
     d, _ = db2
-    # service.name is set on every trace; k8s.pod.name on none
-    assert len(_run(d, "{ resource.service.name }")) == 4
-    assert _run(d, "{ resource.k8s.pod.name }") == set()
+    # existence is `!= nil` (reference semantics: a BARE field is
+    # boolean truthiness, so `{ resource.service.name }` matches nothing)
+    assert len(_run(d, "{ resource.service.name != nil }")) == 4
+    assert _run(d, "{ resource.k8s.pod.name != nil }") == set()
+    assert _run(d, "{ resource.service.name }") == set()
 
 
 def test_pipeline_aggregates_parse_and_eval():
@@ -320,7 +328,7 @@ def test_pipeline_aggregates_parse_and_eval():
             resource=Resource(attrs={"service.name": svc}),
             scope_spans=[ScopeSpans(scope=Scope(), spans=spans)])])
 
-    q = parse("{ } | count() > 2")
+    q = parse("{ true } | count() > 2")
     assert isinstance(q, Pipeline)
     assert trace_matches(q, mk_trace([1, 2, 3]))
     assert not trace_matches(q, mk_trace([1, 2]))
@@ -330,15 +338,15 @@ def test_pipeline_aggregates_parse_and_eval():
     assert trace_matches(q, mk_trace([1, 10, 20]))
     assert not trace_matches(q, mk_trace([10, 20, 30]))
 
-    q = parse("{ } | avg(duration) >= 10ms")
+    q = parse("{ true } | avg(duration) >= 10ms")
     assert trace_matches(q, mk_trace([5, 15]))
     assert not trace_matches(q, mk_trace([5, 5]))
 
-    q = parse("{ } | max(duration) < 10ms | min(duration) > 1ms")
+    q = parse("{ true } | max(duration) < 10ms | min(duration) > 1ms")
     assert trace_matches(q, mk_trace([2, 9]))
     assert not trace_matches(q, mk_trace([2, 19]))
 
-    q = parse("{ } | sum(span.n) = 3")
+    q = parse("{ true } | sum(span.n) = 3")
     assert trace_matches(q, mk_trace([1, 1, 1]))  # n = 0+1+2
 
     # empty spansets never reach the pipeline (reference semantics):
@@ -348,10 +356,10 @@ def test_pipeline_aggregates_parse_and_eval():
 
     import pytest as _pytest
     from tempo_tpu.traceql.ast import ParseError
-    for bad in ("{ } | count(duration) > 1", "{ } | avg() > 1",
-                "{ } | p99() > 1", '{ } | count() > "x"',
-                "{ } | count() > 5ms", "{ } | avg(name) > 0",
-                "{ } | max(status) = 2"):
+    for bad in ("{ true } | count(duration) > 1", "{ true } | avg() > 1",
+                "{ true } | p99() > 1", '{ true } | count() > "x"',
+                "{ true } | avg(name) > 0",
+                "{ true } | max(status) = 2"):
         with _pytest.raises(ParseError):
             parse(bad)
 
@@ -368,9 +376,9 @@ def test_pipeline_aggregates_e2e_search(tmp_path):
     few = make_traces(6, seed=18, n_spans=2)  # 2 spans each
     db.write_block("t", sorted(traces + few, key=lambda t: t[0]))
 
-    resp = db.search("t", SearchRequest(query="{ } | count() > 3", limit=100))
+    resp = db.search("t", SearchRequest(query="{ true } | count() > 3", limit=100))
     assert {t.trace_id for t in resp.traces} == {tid.hex() for tid, _ in traces}
-    resp = db.search("t", SearchRequest(query="{ } | count() <= 2", limit=100))
+    resp = db.search("t", SearchRequest(query="{ true } | count() <= 2", limit=100))
     assert {t.trace_id for t in resp.traces} == {tid.hex() for tid, _ in few}
     db.close()
 
@@ -402,8 +410,8 @@ def test_structural_operators():
     assert not trace_matches(parse('{ name = "a" } && { name = "zzz" }'), tr)
     assert trace_matches(parse('{ name = "zzz" } || { name = "d" }'), tr)
     # structural + pipeline: children of a == {b, d}
-    assert trace_matches(parse('{ name = "a" } > { } | count() = 2'), tr)
-    assert not trace_matches(parse('{ name = "a" } > { } | count() > 2'), tr)
+    assert trace_matches(parse('{ name = "a" } > { true } | count() = 2'), tr)
+    assert not trace_matches(parse('{ name = "a" } > { true } | count() > 2'), tr)
 
 
 def test_structural_e2e_search(tmp_path):
@@ -483,3 +491,66 @@ def test_parenthesized_spanset_expressions():
     # without parens, || binds looser: a || (b > c)
     q2 = parse('{ name = "a" } || { name = "b" } > { name = "c" }')
     assert q2.op == "||" and q2.rhs.op == ">"
+
+
+def test_grammar_tail_execution():
+    """Execution semantics of the expr.y grammar tail: parent scope,
+    childCount, field arithmetic, field-to-field compares, nil, bare
+    fields, by()/coalesce(), scalar-pipeline expressions."""
+    from tempo_tpu.traceql.hosteval import trace_matches
+    from tempo_tpu.traceql.parser import parse
+    from tempo_tpu.wire.model import Resource, ResourceSpans, Scope, ScopeSpans, Span, Trace
+
+    def sp(name, sid, parent=b"", dur_ms=10, attrs=None):
+        return Span(trace_id=b"\x01" * 16, span_id=sid, parent_span_id=parent,
+                    name=name, start_unix_nano=10**18,
+                    end_unix_nano=10**18 + dur_ms * 10**6, attrs=attrs or {})
+
+    a, b, c, d = (bytes([i] * 8) for i in (1, 2, 3, 4))
+    spans = [
+        sp("root", a, dur_ms=100, attrs={"x": 10, "flag": True, "svc": "api"}),
+        sp("mid", b, a, dur_ms=50, attrs={"x": 4, "y": 4}),
+        sp("leaf", c, b, dur_ms=5, attrs={"x": 7}),
+        sp("leaf", d, b, dur_ms=5, attrs={"x": 3, "flag": False}),
+    ]
+    tr = Trace(resource_spans=[ResourceSpans(
+        resource=Resource(attrs={"service.name": "s", "env": "prod"}),
+        scope_spans=[ScopeSpans(scope=Scope(), spans=spans)])])
+
+    m = lambda q: trace_matches(parse(q), tr)  # noqa: E731
+
+    # childCount: root has 1 child (mid), mid has 2
+    assert m("{ childCount = 2 }")
+    assert m("{ 1 = childCount }")
+    assert not m("{ childCount > 2 }")
+    # parent intrinsic and parent-scoped attrs
+    assert m("{ parent = nil }")  # the root
+    assert m('{ parent.name = "mid" }')  # parent's intrinsic name
+    assert m("{ parent.x = 4 }")  # leaf's parent is mid (x=4)
+    assert m("{ parent.span.x = 10 }")  # mid's parent is root
+    assert m('{ parent.resource.env = "prod" }')
+    assert not m("{ parent.x = 99 }")
+    # field arithmetic + field-to-field
+    assert m("{ .x + 1 = 5 }")  # mid: 4+1
+    assert m("{ .x * 2 = 20 }")  # root
+    assert m("{ .x ^ 2 = 49 }")  # leaf: 7^2
+    assert m("{ .x = .y }")  # mid: x=4, y=4
+    assert not m("{ .x + .y = 999 }")
+    assert m("{ -.x = -10 }")
+    assert m("{ duration > 40ms && .x = 4 }")
+    # nil and bare fields
+    assert m("{ .flag }")  # root's flag is true
+    assert not m("{ .y && .x = 10 }")  # y absent on root
+    assert m("{ .y != nil }")  # mid has y
+    assert m("{ .missing = nil }")
+    assert not m("{ .x = nil }")
+    # by()/coalesce(): group by name -> 2 leaf spans in one group
+    assert m('{ true } | by(name) | count() = 2')
+    assert m('{ true } | by(.x) | count() = 1 | coalesce() | count() = 4')
+    assert not m('{ true } | by(name) | count() = 3')
+    # scalar-pipeline expressions
+    assert m('({ name =~ "leaf.*" } | count()) + ({ name = "mid" } | count()) = 3')
+    assert m('({ true } | count()) > ({ name = "mid" } | count())')
+    assert m('{ true } | count() + count() = 8')
+    assert m('max(duration) - min(duration) > 90ms')
+    assert m('avg(.x) = 6')  # (10+4+7+3)/4

@@ -1,0 +1,270 @@
+"""TraceQL grammar conformance: every query vector from the reference's
+pkg/traceql/test_examples.yaml, in the same three buckets — valid
+(parse + validate), parse_fails (lexer/grammar error), validate_fails
+(parses, then type checking rejects). The vectors are the reference's
+own test DATA (a spec of the language surface), exercised here against
+our hand-rolled parser + validator."""
+
+import pytest
+
+from tempo_tpu.traceql.ast import ParseError
+from tempo_tpu.traceql.parser import _Parser, tokenize
+from tempo_tpu.traceql.validate import ValidationError, validate
+
+VALID = [
+    # spanset filters
+    '{ true }',
+    '{ !true }',
+    '{ true && false }',
+    '{ true || false }',
+    '{ 1 = 2 }',
+    '{ 1 != 2 }',
+    '{ 1 > 2 }',
+    '{ 1 >= 2 }',
+    '{ 1 < 2 }',
+    '{ 1 <= 2 }',
+    '{ 1 + 1 = 2 }',
+    '{ 1 - 1 = 2 }',
+    '{ 1 * 1 = 2 }',
+    '{ 1 / 1 = 2 }',
+    '{ 1 ^ 1 = 2 }',
+    '{ -1 = 2 }',
+    '{ "test" =~ "test" }',
+    '{ "test" !~ "test" }',
+    '{ "test" = "test" }',
+    '{ "test" != "test" }',
+    '{ .a }',
+    '{ !.a }',
+    '{ .a && false }',
+    '{ .a || true }',
+    '{ .a = 2 }',
+    '{ .a != 2 }',
+    '{ .a > 2 }',
+    '{ .a >= 2 }',
+    '{ .a < 2 }',
+    '{ .a <= 2 }',
+    '{ .a + 1 = 2 }',
+    '{ .a - 1 = 2 }',
+    '{ .a * 1 = 2 }',
+    '{ .a / 1 = 2 }',
+    '{ .a ^ 1 = 2 }',
+    '{ -.a = 2 }',
+    '{ .a =~ "test" }',
+    '{ .a !~ "test" }',
+    '{ .a = "test" }',
+    '{ .a != "test" }',
+    '{ parent.a != 3 }',
+    '{ parent.resource.a && true }',
+    '{ parent.span.a > 3 }',
+    '{ parent.duration = 1h }',
+    '{ resource.a != 3 }',
+    '{ span.a != 3 }',
+    '{ !("test" != .c || ((true && .b) || 3 < .a)) }',
+    '{ parent = nil }',
+    '{ status = ok }',
+    '{ status = unset }',
+    '{ status = error }',
+    '{ status != error }',
+    '{ duration > 1s }',
+    '{ duration > 1s * 2s }',
+    '{ .foo = nil }',
+    '{ 1 = childCount }',
+    '{ 1 * 1h = 1 }',
+    '{ 1 / 1.1 = 1 }',
+    '{ 1 < 1h }',
+    '{ 1 <= 1.1 }',
+    # spanset expressions
+    '{ true } && { true }',
+    '{ true } || { true }',
+    '{ true } >> { true }',
+    '{ true } > { true }',
+    '{ true } ~ { true }',
+    # scalar filters
+    'avg(.field) > 1',
+    'min(childCount) < 2',
+    'max(duration) >= 1s',
+    'min(.field) < max(duration)',
+    'sum(.field) = min(.field)',
+    'max(duration) > 1',
+    'min(.field) + max(.field) > 1',
+    'min(.field) + max(childCount) > max(duration) - min(.field)',
+    'avg(.field) > 1 - 3',
+    'min(childCount) < 2 / 6',
+    'max(1 - (2 + .field)) < avg(3 * duration ^ 2)',
+    '3 = 2',
+    # pipelines
+    '{ true } | { .a }',
+    '{ true } | count() = 1',
+    '{ true } | max(duration) = 1h',
+    '{ true } | min(duration) = 1h',
+    '{ true } | avg(duration) = 1h',
+    '{ true } | sum(duration) = 1h',
+    '{ true } | count() + count() = 1',
+    'count() = 1 | { true }',
+    '{ true } | max(.a) = 1',
+    '{ true } | max(parent.a) = 1',
+    '{ true } | max(span.a) = 1',
+    '{ true } | max(resource.a) = 1',
+    '{ true } | max(1 + .a) = 1',
+    '{ true } | max((1 + .a) * 2) = 1',
+    '{ true } | coalesce()',
+    '{ true } | by(.a)',
+    '{ true } | by(1 + .a)',
+    'by(.a) | { true }',
+    '{ true } | by(1 + .a) | coalesce()',
+    '{ true } | by(name) | count() > 2',
+    '{ true } | by(.field) | avg(.b) = 2',
+    '{ true } | by(3 * .field - 2) | max(duration) < 1s',
+    '{ true } | count() = 1 | { true }',
+    # pipeline expressions
+    '({ true } | count()) + ({ true } | count()) = 1',
+    '({ true } | count()) - ({ true } | count()) <= 1',
+    '({ true } | count()) / ({ true } | count()) > ({ true } | count()) / ({ true } | count())',
+    '({ true } | count()) * ({ true } | count()) < ({ true } | count()) / ({ true } | count())',
+    '({ true } | count() > 1 | { false }) && ({ true } | count() > 1 | { false })',
+    '({ true } | count() > 1 | { false }) || ({ true } | count() > 1 | { false })',
+    '({ true } | count() > 1 | { false }) >> ({ true } | count() > 1 | { false })',
+    '({ true } | count() > 1 | { false }) > ({ true } | count() > 1 | { false })',
+    '({ true } | count() > 1 | { false }) ~ ({ true } | count() > 1 | { false })',
+    # random
+    'max(duration) > 3s | { status = error || .http.status = 500 }',
+    '{ .http.status = 200 } | max(.field) - min(.field) > 3',
+    '({ .http.status = 200 } | count()) + ({ name = `foo` } | avg(duration)) = 2',
+    '{ (-(3 / 2) * .test - parent.blerg + .other)^3 = 2 }',
+    '({ .a } | count()) > ({ .b } | count())',
+]
+
+PARSE_FAILS = [
+    'true',
+    '[ true ]',
+    '( true )',
+    # spanset filters
+    '{ }',
+    '{ . }',
+    '{ < }',
+    '{ .a < }',
+    '{ .a < 3',
+    '{ (.a < 3 }',
+    '{ attribute = 4 }',
+    '{ .attribute == 4 }',
+    '{ span. }',
+    # spanset expressions
+    '{ true } + { true }',
+    '{ true } - { true }',
+    '{ true } * { true }',
+    '{ true } / { true }',
+    '{ true } ^ { true }',
+    '{ true } = { true }',
+    '{ true } <= { true }',
+    '{ true } >= { true }',
+    '{ true } < { true }',
+    # scalar filters
+    'avg(.field) + 1',
+    'sum(3) - 2',
+    'min(childCount) && 2',
+    # pipelines
+    'coalesce() | { true }',
+    'count() > 3 && { true }',
+    '{ true } | count()',
+    '{ true } | notAnAggregate() = 1',
+    '{ true } | count = 1',
+    '{ true } | max() = 1',
+    '{ true } | by()',
+    # pipeline expressions
+    '({ true }) + (count()) = 1',
+    '({ true }) && (count())',
+    '({ true } | count()) && ({ true } | count()) = 1',
+    '({ true }) + ({ true }) = 1',
+    '({ true } | count()) + ({ true } | count())',
+    '(by(namespace) | count()) > 2 * 2',
+    '(by(namespace) | count()) * 2 > 2',
+    '2 < (by(namespace) | count())',
+]
+
+VALIDATE_FAILS = [
+    # span expressions must evaluate to a boolean
+    '{ 1 + 1 }',
+    '{ parent }',
+    '{ status }',
+    '{ ok }',
+    '{ 1.1 }',
+    '{ 1h }',
+    '{ "foo" }',
+    # binary operators - incorrect types
+    '{ 1 + "foo" = 1 }',
+    '{ 1 - true = 1 }',
+    '{ 1 / ok = 1 }',
+    '{ 1 % parent = 1 }',
+    '{ 1 ^ name = 1 }',
+    '{ 1 = "foo" }',
+    '{ 1 != true }',
+    '{ 1 > ok }',
+    '{ 1 >= parent }',
+    '{ 1 = name }',
+    '{ 1 =~ 2}',
+    '{ 1 && "foo" }',
+    '{ 1 || ok }',
+    '{ true || 1.1 }',
+    '{ "foo" = childCount }',
+    '{ status > ok }',
+    # unary operators - incorrect types
+    '{ -true }',
+    '{ -"foo" = "bar" }',
+    '{ -ok = status }',
+    '{ -parent = nil }',
+    '{ -name = "foo" }',
+    '{ !"foo" = "bar" }',
+    '{ !ok = status }',
+    '{ !parent = nil }',
+    '{ !name = "foo" }',
+    '{ !1 = 1 }',
+    '{ !1h = 1 }',
+    '{ !1.1 = 1.1 }',
+    # scalar expressions must evaluate to a number
+    'max(name) = "foo"',
+    'min(parent) = nil',
+    'avg("foo") = "bar"',
+    'max(status) = ok',
+    'min(1 = 3) = 1',
+    # scalar expressions must reference the span
+    'sum(3) = 2',
+    'sum(3) = min(14)',
+    'min(2h) < max(duration)',
+    'max(1h + 2h) > 1',
+    'min(1.1 - 3) > 1',
+    'min(3) = max(duration)',
+    'min(1) = max(2) + 3',
+    # group expressions must reference the span
+    '{ true } | by(1)',
+    '{ true } | by("foo")',
+    # scalar filters have to match types
+    'min(1) = "foo"',
+    'avg(childCount) > "foo"',
+    'max(duration) < ok',
+]
+
+
+def _parse_only(src: str):
+    """Parse without validation (validate_fails vectors must get PAST
+    the grammar)."""
+    p = _Parser(tokenize(src))
+    return p.parse_query()
+
+
+@pytest.mark.parametrize("q", VALID)
+def test_valid(q):
+    ast = _parse_only(q)
+    validate(ast)
+
+
+@pytest.mark.parametrize("q", PARSE_FAILS)
+def test_parse_fails(q):
+    with pytest.raises(ParseError):
+        _parse_only(q)
+
+
+@pytest.mark.parametrize("q", VALIDATE_FAILS)
+def test_validate_fails(q):
+    ast = _parse_only(q)  # must parse...
+    with pytest.raises(ValidationError):
+        validate(ast)  # ...and fail type checking
