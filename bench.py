@@ -99,6 +99,7 @@ def synth_block(backend, tenant: str, rng: np.random.Generator, n_traces: int,
     tmax = np.maximum.reduceat(end_ns.astype(np.int64), span_off[:-1])
     blk_base = int(start_ns.min())
 
+    span_ids = rng.integers(0, 256, size=(n_spans, 8), dtype=np.uint8)
     sat_owner = np.repeat(np.arange(n_spans, dtype=np.int32), attrs_per_span)
     n_sat = sat_owner.shape[0]
     e_i32 = np.empty(0, np.int32)
@@ -122,8 +123,17 @@ def synth_block(backend, tenant: str, rng: np.random.Generator, n_traces: int,
         "span.res_idx": _trace_local_res(rng, n_traces, spans_per, n_res),
         "span.start_ns": start_ns,
         "span.end_ns": end_ns,
-        "span.id": rng.integers(0, 256, size=(n_spans, 8), dtype=np.uint8),
-        "span.parent_id": np.zeros((n_spans, 8), np.uint8),
+        "span.id": span_ids,
+        # simple chain topology: span k's parent is span k-1 of the same
+        # trace (first span is the root) -- gives structural queries a
+        # real tree to walk; parent_id bytes mirror parent_idx so host
+        # verification over materialized traces agrees with the device
+        "span.parent_id": np.where(
+            (np.arange(n_spans) % spans_per == 0)[:, None],
+            np.zeros((1, 8), np.uint8), np.roll(span_ids, 1, axis=0)),
+        "span.parent_idx": np.where(
+            np.arange(n_spans, dtype=np.int32) % spans_per == 0,
+            np.int32(-1), np.arange(n_spans, dtype=np.int32) - 1),
         "span.trace_state_id": np.zeros(n_spans, np.int32),
         "span.status_msg_id": np.zeros(n_spans, np.int32),
         "span.dropped_attrs": np.zeros(n_spans, np.int32),

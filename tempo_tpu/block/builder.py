@@ -136,6 +136,7 @@ class BlockBuilder:
         self.sp_scope_idx: list[int] = []
         self.sp_id: list[bytes] = []
         self.sp_parent_id: list[bytes] = []
+        self.sp_parent_idx: list[int] = []  # block row of the parent, -1 = root
         self.sp_trace_state: list[int] = []
         self.sp_status_msg: list[int] = []
         self.sp_dropped: list[int] = []
@@ -212,6 +213,26 @@ class BlockBuilder:
                     rows.append((sp.start_unix_nano, res_idx, scope_idx, svc_code, sp))
 
         rows.sort(key=lambda r: (r[0], r[4].span_id))
+        # parent ROW index within the block (span.parent_idx): parents
+        # resolve within the trace, so one pass over the sorted rows
+        # suffices; -1 = root / parent span not in this trace. Backs the
+        # device/host structural operators (> >> ~) as exact gather /
+        # segment ops (ops/filter 'struct' nodes) -- the reference
+        # evaluates these relations row-by-row in its engine instead
+        # (pkg/traceql/enum_operators.go OpSpansetChild/Descendant/Sibling).
+        base = len(self.sp_trace_sid)
+        local_of = {r[4].span_id: j for j, r in enumerate(rows) if r[4].span_id}
+        # -1 = root (no parent id); -2 = ORPHAN (parent id set but that
+        # span is absent from the trace -- dropped/partial ingest). The
+        # distinction keeps the sibling operator exact-able: orphans can
+        # still be siblings by shared parent ID, which the row-index
+        # kernels over-match and host verification settles.
+        for start_ns, res_idx, scope_idx, svc_code, sp in rows:
+            pid = sp.parent_span_id
+            has_pid = bool(pid and pid.strip(b"\x00"))
+            j = local_of.get(pid) if has_pid else None
+            self.sp_parent_idx.append(
+                base + j if j is not None else (-2 if has_pid else -1))
         for start_ns, res_idx, scope_idx, svc_code, sp in rows:
             row = len(self.sp_trace_sid)
             self.sp_trace_sid.append(sid)
@@ -323,6 +344,7 @@ class BlockBuilder:
             "span.end_ns": end_ns,
             "span.id": np.frombuffer(b"".join(self.sp_id) or b"", dtype=np.uint8).reshape(n_spans, 8),
             "span.parent_id": np.frombuffer(b"".join(self.sp_parent_id) or b"", dtype=np.uint8).reshape(n_spans, 8),
+            "span.parent_idx": np.asarray(self.sp_parent_idx, dtype=np.int32),
             "span.trace_state_id": rm(self.sp_trace_state),
             "span.status_msg_id": rm(self.sp_status_msg),
             "span.dropped_attrs": np.asarray(self.sp_dropped, dtype=np.int32),

@@ -64,6 +64,7 @@ DEVICE_SPAN_COLS = [
     "span.http_method_id",
     "span.http_url_id",
     "span.res_idx",
+    "span.parent_idx",  # parent's block row (-1 root): structural ops
 ]
 DEVICE_SATTR_COLS = [
     "sattr.span",

@@ -359,6 +359,17 @@ def _assemble(tenant: str, sources: list[_Source], chunks: list[tuple[int, int, 
                     packed_scatter(si, pref, _translate(
                         si, packed_gather(si, pref, sources[si].cols[n]),
                         used_res, res_base), out)
+                elif n == "span.parent_idx":
+                    # parent rows live in the SAME trace, so the chunk's
+                    # span-base shift rebases them; negative sentinels
+                    # (-1 root, -2 orphan) pass through unchanged
+                    packed = packed_gather(si, pref, sources[si].cols[n])
+                    ii = by_src[si]
+                    off = np.repeat((sp_b[ii] - span_lo[ii]).astype(np.int64),
+                                    (span_hi - span_lo)[ii])
+                    packed = np.where(
+                        packed >= 0, packed + off, packed).astype(like.dtype)
+                    packed_scatter(si, pref, packed, out)
                 elif n == "span.scope_idx":
                     packed_scatter(si, pref, _translate(
                         si, span_scopevals[si], used_scope, scope_base), out)

@@ -209,7 +209,7 @@ def response_from_dict(d: dict) -> SearchResponse:
     return resp
 
 
-def _plan_for_block(blk: BackendBlock, req: SearchRequest):
+def _plan_for_block(blk: BackendBlock, req: SearchRequest, allow_struct: bool = True):
     start_rel = None
     if req.start or req.end:
         base_ms = blk.meta.start_time_unix_nano // 1_000_000
@@ -219,6 +219,9 @@ def _plan_for_block(blk: BackendBlock, req: SearchRequest):
             int(np.clip(lo, -(2**31), 2**31 - 1)),
             int(np.clip(hi, -(2**31), 2**31 - 1)),
         )
+    # struct nodes need the block to carry the parent-row column
+    # (pre-upgrade blocks don't)
+    allow_struct = allow_struct and blk.pack.has("span.parent_idx")
     return plan_search_request(
         blk.dictionary,
         req.tags,
@@ -226,6 +229,7 @@ def _plan_for_block(blk: BackendBlock, req: SearchRequest):
         min_duration_ms=req.min_duration_ms,
         max_duration_ms=req.max_duration_ms,
         start_rel_ms=start_rel,
+        allow_struct=allow_struct,
     )
 
 
@@ -340,6 +344,7 @@ def _tres_eligible(blk: BackendBlock, p) -> bool:
     build_tres) instead of the span axis: identical trace mask and
     matched-span counts from a ~10x smaller decode."""
     return (blk.pack.has("tres.res") and bool(p.conds)
+            and not getattr(p, "has_struct", False)  # struct needs span rows
             and all(c.target in (T_RES, T_RATTR, T_TRACE) for c in p.conds))
 
 
@@ -360,7 +365,7 @@ def _host_plan(blk: BackendBlock, p, groups_range) -> tuple[list[str], bool]:
     whole-block only -- row-group shards slice the span axis."""
     if groups_range is None and _tres_eligible(blk, p):
         return _tres_needed(p.conds), True
-    needed = required_columns(p.conds)
+    needed = required_columns(p.conds) + list(getattr(p, "extra_cols", ()))
     host_needed = ([n for n in needed if n != "span.trace_sid"]
                    if "trace.span_off" in needed else needed)
     return host_needed, False
@@ -443,9 +448,16 @@ def search_block(
     planned = _plan_for_block(blk, req)
     if planned.prune:
         return resp
+    if groups_range is not None and planned.has_struct:
+        # struct nodes resolve parent links by GLOBAL row index; a
+        # row-group slice would sever links across group boundaries, so
+        # shards take the conservative plan (trace-AND + host verify)
+        planned = _plan_for_block(blk, req, allow_struct=False)
+        if planned.prune:  # the conservative fold may prove "no match"
+            return resp
     limit = req.limit or DEFAULT_LIMIT
     operands = Operands.build(planned.rows, planned.tables or None)
-    needed = required_columns(planned.conds)
+    needed = required_columns(planned.conds) + list(planned.extra_cols)
     pack = blk.pack
     io0 = pack.bytes_read  # per-query IO delta (pack counts lifetime bytes)
     span_ax = pack.axes.get(S.AX_SPAN)
@@ -465,6 +477,12 @@ def search_block(
         if n_rows * 4 * n_span_cols > _STREAM_MIN_STAGE_BYTES:
             # large scan: stream row-group chunks, prefetching the next
             # chunk's IO while the device filters the current one
+            if planned.has_struct:  # streaming slices the span axis too
+                planned = _plan_for_block(blk, req, allow_struct=False)
+                if planned.prune:
+                    return resp
+                operands = Operands.build(planned.rows, planned.tables or None)
+                needed = required_columns(planned.conds)
             from ..ops.stream import eval_block_streamed
 
             tm, counts, n_spans_seen = eval_block_streamed(
@@ -583,7 +601,8 @@ def search_blocks_fused(
     est = 0
     for blk, p in live:
         blk.search_touches = getattr(blk, "search_touches", 0) + 1
-        needed = tuple(required_columns(p.conds)) + ("trace@gkey_s",)
+        needed = (tuple(required_columns(p.conds)) + tuple(p.extra_cols)
+                  + ("trace@gkey_s",))
         hot = not prefer_host and (
             _staged_hit(blk, needed) or blk.search_touches >= promote_touches
         )
@@ -604,7 +623,7 @@ def search_blocks_fused(
     def stage_and_eval(item):
         blk, p = item
         operands = Operands.build(p.rows, p.tables or None)
-        needed = required_columns(p.conds) + ["trace@gkey_s"]
+        needed = required_columns(p.conds) + list(p.extra_cols) + ["trace@gkey_s"]
         staged = stage_block(blk, needed)
         tm, counts = eval_block(
             (p.tree, p.conds), staged.cols, operands,
@@ -766,6 +785,8 @@ def search_blocks_device(
             continue
         if any(c.target not in (T_SPAN, T_RES, T_TRACE) for c in p.conds):
             return None  # generic-attr tables stay on the per-block path
+        if p.has_struct:
+            return None  # struct trees run on the per-block engines
         live.append((blk, p))
     if not live:
         return resp

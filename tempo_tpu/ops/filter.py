@@ -287,15 +287,59 @@ def _compiled(tree: CondTree | None, conds: tuple[Cond, ...], table_idxs: tuple[
         span_masks: list = []  # union for reporting/counts
 
         def ev_span(t):
+            if t == ("true",):
+                return valid_span
+            if t == ("false",):
+                return jnp.zeros_like(valid_span)
             if t[0] == "cond":
                 i = t[1]
                 return _cond_mask(conds[i], i, cols, ops_i, ops_f, tables,
                                   n_spans_b, n_res_b, valid_span)
+            if t[0] == "struct":
+                return ev_struct(t[1], ev_span(t[2]), ev_span(t[3]))
             masks = [ev_span(ch) for ch in t[1:]]
             out = masks[0]
             for m in masks[1:]:
                 out = (out & m) if t[0] == "and" else (out | m)
             return out
+
+        def ev_struct(op, lm, rm):
+            """Exact structural relation over the parent-row column:
+            result = rhs spans standing in `op` relation to an lhs span
+            (enum_operators.go OpSpansetChild/Descendant/Sibling).
+            `>` is one parent gather; `>>` is pointer-doubling (log2
+            passes of gather, all fused on device); `~` is one
+            segment-sum + gather."""
+            pidx = cols["span.parent_idx"]
+            has_p = (pidx >= 0) & valid_span
+            safe = jnp.clip(pidx, 0, n_spans_b - 1)
+            if op == ">":
+                return rm & has_p & lm[safe]
+            if op == ">>":
+                # acc[i] = any lhs match among ancestors reached so far;
+                # ptr doubles the jump distance every iteration
+                acc = has_p & lm[safe]
+                ptr = jnp.where(has_p, safe, -1)
+                for _ in range(max(1, (n_spans_b - 1).bit_length())):
+                    psafe = jnp.clip(ptr, 0, n_spans_b - 1)
+                    alive = ptr >= 0
+                    acc = acc | (alive & acc[psafe])
+                    ptr = jnp.where(alive, jnp.where(ptr[psafe] >= 0, ptr[psafe], -1), -1)
+                return rm & acc
+            # '~': some DIFFERENT lhs span with the same parent. Orphans
+            # (parent_idx == -2: parent id set but its span absent) can
+            # still be siblings by shared parent ID; the row kernel can't
+            # resolve that, so orphan-orphan pairs OVER-match (any lhs
+            # orphan in the batch) and host verification settles them
+            # (the plan flags '~' trees needs_verify).
+            lhs_child = (lm & has_p).astype(jnp.int32)
+            owner = jnp.where(has_p & lm, safe, n_spans_b)
+            cnt = jax.ops.segment_sum(
+                lhs_child, owner, num_segments=n_spans_b + 1)[:n_spans_b]
+            sibs = cnt[safe] - (lm & has_p).astype(jnp.int32)
+            orphan = (pidx == -2) & valid_span
+            any_lhs_orphan = jnp.any(lm & orphan)
+            return (rm & has_p & (sibs > 0)) | (rm & orphan & any_lhs_orphan)
 
         def seg_counts(span_mask):
             """Matched-span count per trace."""

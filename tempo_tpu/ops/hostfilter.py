@@ -121,6 +121,40 @@ def _lut_gather(table_mask: np.ndarray, idx: np.ndarray) -> np.ndarray:
     return table_mask[np.clip(idx, 0, table_mask.shape[0] - 1)] & (idx >= 0)
 
 
+def _struct_mask_np(op: str, lm: np.ndarray, rm: np.ndarray,
+                    pidx: np.ndarray, n_spans: int) -> np.ndarray:
+    """Exact structural relation over the parent-row column (numpy twin
+    of ops.filter's ev_struct): result spans = rhs matches standing in
+    `op` relation to some lhs match."""
+    has_p = pidx >= 0
+    safe = np.clip(pidx, 0, max(n_spans - 1, 0))
+    if op == ">":
+        return rm & has_p & lm[safe]
+    if op == ">>":
+        acc = has_p & lm[safe]
+        ptr = np.where(has_p, safe, -1)
+        for _ in range(max(1, int(n_spans - 1).bit_length())):
+            alive = ptr >= 0
+            if not alive.any():
+                break
+            psafe = np.clip(ptr, 0, n_spans - 1)
+            new_acc = acc | (alive & acc[psafe])
+            ptr = np.where(alive, ptr[psafe], -1)
+            if (new_acc == acc).all() and (ptr < 0).all():
+                acc = new_acc
+                break
+            acc = new_acc
+        return rm & acc
+    # '~': some DIFFERENT lhs span with the same parent. Orphan rows
+    # (parent_idx == -2) over-match when any lhs orphan exists; the plan
+    # flags '~' trees needs_verify so the host settles the exact pairs
+    lhs_child = (lm & has_p)
+    cnt = np.bincount(safe[lhs_child], minlength=n_spans) if n_spans else np.zeros(0, int)
+    sibs = cnt[safe] - lhs_child.astype(np.int64)
+    orphan = pidx == -2
+    return (rm & has_p & (sibs > 0)) | (rm & orphan & bool((lm & orphan).any()))
+
+
 def eval_block_host(
     query,
     cols: dict[str, np.ndarray],
@@ -152,9 +186,16 @@ def eval_block_host(
     span_masks: list[np.ndarray] = []
 
     def ev_span(t):
+        if t == ("true",):
+            return np.ones(n_spans, dtype=bool)
+        if t == ("false",):
+            return np.zeros(n_spans, dtype=bool)
         if t[0] == "cond":
             i = t[1]
             return _cond_mask_np(conds[i], i, cols, ops_i, ops_f, tables, n_spans, n_res)
+        if t[0] == "struct":
+            return _struct_mask_np(t[1], ev_span(t[2]), ev_span(t[3]),
+                                   cols["span.parent_idx"], n_spans)
         ms = [ev_span(ch) for ch in t[1:]]
         out = ms[0]
         for m in ms[1:]:

@@ -159,10 +159,19 @@ class _Parser:
         return q
 
     # spansetPipelineExpression: combinators over pipelines / wrapped
-    # pipeline expressions
+    # pipeline expressions. Structural ops (> >> ~) bind tighter than
+    # && / || at this level too (expr.y precedence, mirroring
+    # parse_spanset_expr / parse_structural for plain spansets)
     def parse_pipeline_chain(self):
+        lhs = self.parse_pipeline_structural()
+        while self.peek()[1] in ("&&", "||"):
+            _, op = self.next()
+            lhs = SpansetOp(op, lhs, self.parse_pipeline_structural())
+        return lhs
+
+    def parse_pipeline_structural(self):
         lhs = self.parse_pipeline_term()
-        while self.peek()[1] in _COMBINATORS:
+        while self.peek()[1] in (">", ">>", "~"):
             _, op = self.next()
             lhs = SpansetOp(op, lhs, self.parse_pipeline_term())
         return lhs
@@ -304,7 +313,16 @@ class _Parser:
     def _make_cmp(lhs, op: str, rhs):
         """Planner-friendly normalization: `field op literal` (either
         order) becomes the legacy Comparison node; everything else is a
-        general BinaryOp."""
+        general BinaryOp. Regex literals compile here so a bad pattern
+        is a parse-time error (400 at the API), not a per-block plan or
+        mid-verification failure."""
+        if op in ("=~", "!~"):
+            for side in (lhs, rhs):
+                if isinstance(side, Static) and side.kind == "str":
+                    try:
+                        re.compile(side.value)
+                    except re.error as e:
+                        raise ParseError(f"bad regex {side.value!r}: {e}") from None
         if isinstance(lhs, Field) and isinstance(rhs, Static) and not lhs.parent:
             return Comparison(lhs, op, rhs)
         if isinstance(lhs, Static) and isinstance(rhs, Field) and not rhs.parent:

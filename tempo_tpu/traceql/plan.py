@@ -295,12 +295,57 @@ def _plan_comparison(p: Plan, d: Dictionary, cmp: Comparison) -> tuple:
     return _fold("or", alts)
 
 
-def _plan_spanset_expr(p: Plan, d: Dictionary, q) -> tuple[tuple, bool]:
+def _tree_has_sibling(t) -> bool:
+    if not isinstance(t, tuple) or t in (TRUE, FALSE) or t[0] == "cond":
+        return False
+    if t[0] == "struct":
+        return t[1] == "~" or any(_tree_has_sibling(ch) for ch in t[2:])
+    return any(_tree_has_sibling(ch) for ch in t[1:])
+
+
+def _tree_has_trace_cond(t, conds) -> bool:
+    if t in (TRUE, FALSE):
+        return False
+    if t[0] == "cond":
+        return conds[t[1]].target == "trace"
+    if t[0] == "struct":
+        return any(_tree_has_trace_cond(ch, conds) for ch in t[2:])
+    return any(_tree_has_trace_cond(ch, conds) for ch in t[1:])
+
+
+def _span_tree(p: Plan, d: Dictionary, q):
+    """Span-level tree for a spanset expression, or None when it can't
+    be expressed purely at span level (trace-target conds, pipelines,
+    unplannable constructs, && / || combinators whose result spanset is
+    trace-dependent)."""
+    if isinstance(q, SpansetFilter):
+        if q.expr is None:
+            return TRUE
+        fv0 = p.force_verify
+        t = _plan_expr(p, d, q.expr)
+        if (p.force_verify and not fv0) or _tree_has_trace_cond(t, p.conds):
+            return None
+        return t
+    if isinstance(q, SpansetOp) and q.op in (">", ">>", "~"):
+        lt = _span_tree(p, d, q.lhs)
+        rt = _span_tree(p, d, q.rhs)
+        if lt is None or rt is None:
+            return None
+        return ("struct", q.op, lt, rt)
+    return None
+
+
+def _plan_spanset_expr(p: Plan, d: Dictionary, q, allow_struct: bool = True) -> tuple[tuple, bool]:
     """Spanset expression -> (trace-level tree, needs host verification).
-    Each leaf spanset tracifies independently; && / structural ops AND
-    them (a qualifying trace must contain every leaf's spans), || ORs.
-    Structural relations (> >> ~) cannot be checked on device, so those
-    force exact host verification over the surviving candidates."""
+    Each leaf spanset tracifies independently; && combinators AND them
+    (a qualifying trace must contain every leaf's spans), || ORs.
+
+    Structural relations (> >> ~) over pure span-level sides compile to
+    EXACT ('struct', op, lhs, rhs) span trees: the engines resolve the
+    relation with parent-row gathers / segment sums over
+    span.parent_idx, so no host verification is needed. Anything the
+    struct compiler can't express falls back to the conservative
+    trace-level AND of both sides + exact host verification."""
     if isinstance(q, SpansetFilter):
         if q.expr is None:
             return TRUE, False
@@ -311,10 +356,25 @@ def _plan_spanset_expr(p: Plan, d: Dictionary, q) -> tuple[tuple, bool]:
     if isinstance(q, Pipeline):
         # wrapped-pipeline operand ((...|count()>1|{false}) && ...):
         # prefilter by its first spanset; the stages are exact-host-only
-        t, _ = _plan_spanset_expr(p, d, q.filter)
+        t, _ = _plan_spanset_expr(p, d, q.filter, allow_struct)
         return t, True
-    lt, lv = _plan_spanset_expr(p, d, q.lhs)
-    rt, rv = _plan_spanset_expr(p, d, q.rhs)
+    if allow_struct and q.op in (">", ">>", "~"):
+        # snapshot the accumulator: a failed struct compile must not
+        # leave half-planned conds behind (the fallback re-plans both
+        # sides, and duplicates cost a device mask evaluation each)
+        n0, fv0 = len(p.conds), p.force_verify
+        st = _span_tree(p, d, q)
+        if st is not None:
+            # `~` over-matches orphan siblings (shared parent id whose
+            # span is absent from the trace); exact host re-check needed
+            return ("tracify", st), _tree_has_sibling(st)
+        del p.conds[n0:]
+        del p.rows[n0:]
+        for k in [k for k in p.tables if k >= n0]:
+            del p.tables[k]
+        p.force_verify = fv0  # the fallback re-plans and re-flags
+    lt, lv = _plan_spanset_expr(p, d, q.lhs, allow_struct)
+    rt, rv = _plan_spanset_expr(p, d, q.rhs, allow_struct)
     structural = q.op in (">", ">>", "~")
     fold_op = "or" if q.op == "||" else "and"
     return _fold(fold_op, [lt, rt]), lv or rv or structural
@@ -369,6 +429,13 @@ class PlannedQuery:
     tables: dict[int, np.ndarray]
     prune: bool = False  # statically false for this block
     needs_verify: bool = False
+    # extra engine columns the TREE (not the conds) requires -- e.g.
+    # span.parent_idx for compiled ('struct', ...) nodes
+    extra_cols: tuple = ()
+
+    @property
+    def has_struct(self) -> bool:
+        return "span.parent_idx" in self.extra_cols
 
 
 def _mixed_or(tree, conds) -> bool:
@@ -394,6 +461,14 @@ def _mixed_or(tree, conds) -> bool:
     return walk(tree)
 
 
+def _has_struct_node(t) -> bool:
+    if not isinstance(t, tuple) or t in (TRUE, FALSE) or t[0] == "cond":
+        return False
+    if t[0] == "struct":
+        return True
+    return any(_has_struct_node(ch) for ch in t[1:])
+
+
 def _finish(p: Plan, children: list) -> PlannedQuery:
     tree = _fold("and", children)
     if tree == FALSE:
@@ -403,7 +478,9 @@ def _finish(p: Plan, children: list) -> PlannedQuery:
     nv = p.force_verify or any(c.needs_verify for c in p.conds)
     if tree is not None and _mixed_or(tree, tuple(p.conds)):
         nv = True
-    return PlannedQuery(tree, tuple(p.conds), p.rows, p.tables, needs_verify=nv)
+    extra = ("span.parent_idx",) if tree is not None and _has_struct_node(tree) else ()
+    return PlannedQuery(tree, tuple(p.conds), p.rows, p.tables,
+                        needs_verify=nv, extra_cols=extra)
 
 
 def plan_query(q: SpansetFilter, d: Dictionary) -> PlannedQuery:
@@ -423,6 +500,7 @@ def plan_search_request(
     min_duration_ms: int = 0,
     max_duration_ms: int = 0,
     start_rel_ms: tuple[int, int] | None = None,
+    allow_struct: bool = True,
 ) -> PlannedQuery:
     """Tag-search / TraceQL request -> trace-level plan.
 
@@ -444,10 +522,11 @@ def plan_search_request(
             force_verify = True
             q = q.filter
         if isinstance(q, SpansetOp):
-            # structural/combinator spansets: the device prunes to traces
-            # whose spanset LEAVES are all (or, for ||, any) present --
-            # conservative for >/>>/~ (relations re-checked on host)
-            tree, sv = _plan_spanset_expr(p, d, q)
+            # structural/combinator spansets: > >> ~ over pure span
+            # sides compile to exact struct nodes (no verification);
+            # everything else prunes to traces whose spanset LEAVES are
+            # all (or, for ||, any) present and re-checks on host
+            tree, sv = _plan_spanset_expr(p, d, q, allow_struct)
             force_verify = force_verify or sv
             children.append(tree)
         elif q.expr is not None:
