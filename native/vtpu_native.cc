@@ -216,6 +216,12 @@ void vtpu_gather_runs(const uint8_t* src, uint8_t* dst,
 // with K source arrays order runs by destination (dst writes stream
 // sequentially, each source reads stream too) and pass per-run
 // src pointers computed host-side. dst_offs/lens in rows.
+// K-way merges read each run from a RANDOM source position while dst
+// streams sequentially: per-run cost is one DRAM round trip (~100 ns),
+// which dominates the copy itself for trace-axis runs (one 4-byte row).
+// Prefetching a few runs ahead overlaps those misses.
+#define VTPU_RUN_PREFETCH 8
+
 void vtpu_gather_runs_addr(const int64_t* src_addrs, uint8_t* dst,
                            const int64_t* dst_offs, const int64_t* lens,
                            int64_t n_runs, int64_t itemsize) {
@@ -225,6 +231,8 @@ void vtpu_gather_runs_addr(const int64_t* src_addrs, uint8_t* dst,
   if (itemsize == 4) {
     uint32_t* d32 = (uint32_t*)dst;
     for (int64_t i = 0; i < n_runs; i++) {
+      if (i + VTPU_RUN_PREFETCH < n_runs)
+        __builtin_prefetch((const void*)(uintptr_t)src_addrs[i + VTPU_RUN_PREFETCH], 0, 1);
       const uint32_t* s = (const uint32_t*)(uintptr_t)src_addrs[i];
       uint32_t* d = d32 + dst_offs[i];
       int64_t n = lens[i];
@@ -235,6 +243,8 @@ void vtpu_gather_runs_addr(const int64_t* src_addrs, uint8_t* dst,
   if (itemsize == 8) {
     uint64_t* d64 = (uint64_t*)dst;
     for (int64_t i = 0; i < n_runs; i++) {
+      if (i + VTPU_RUN_PREFETCH < n_runs)
+        __builtin_prefetch((const void*)(uintptr_t)src_addrs[i + VTPU_RUN_PREFETCH], 0, 1);
       const uint64_t* s = (const uint64_t*)(uintptr_t)src_addrs[i];
       uint64_t* d = d64 + dst_offs[i];
       int64_t n = lens[i];
@@ -243,6 +253,8 @@ void vtpu_gather_runs_addr(const int64_t* src_addrs, uint8_t* dst,
     return;
   }
   for (int64_t i = 0; i < n_runs; i++) {
+    if (i + VTPU_RUN_PREFETCH < n_runs)
+      __builtin_prefetch((const void*)(uintptr_t)src_addrs[i + VTPU_RUN_PREFETCH], 0, 1);
     memcpy(dst + dst_offs[i] * itemsize, (const void*)(uintptr_t)src_addrs[i],
            (size_t)(lens[i] * itemsize));
   }
@@ -262,6 +274,8 @@ int64_t vtpu_gather_runs_remap(const int64_t* src_addrs, int32_t* dst,
                                const int64_t* remap_lens, int64_t n_runs) {
   int64_t oob = 0;
   for (int64_t i = 0; i < n_runs; i++) {
+    if (i + VTPU_RUN_PREFETCH < n_runs)
+      __builtin_prefetch((const void*)(uintptr_t)src_addrs[i + VTPU_RUN_PREFETCH], 0, 1);
     const int32_t* s = (const int32_t*)(uintptr_t)src_addrs[i];
     const int32_t* remap = (const int32_t*)(uintptr_t)remap_addrs[i];
     const int64_t rlen = remap_lens[i];
@@ -352,6 +366,31 @@ void vtpu_seg_count_mask(const uint8_t* mask, const int32_t* span_off,
     int32_t c = 0;
     for (int64_t j = lo; j < hi; j++) c += mask[j];
     out[t] = c;
+  }
+}
+
+// --------------------------------------------------------- span metrics
+
+// Fused span-metrics fold (the metrics-generator's per-collection
+// reduce): one pass scattering into per-series histogram + latency-sum
+// accumulators. The (series x bucket) table is ~KBs, so the random
+// scatters stay in cache; bucket search is a linear scan (<= ~16
+// edges, branch-predictable). Matches numpy's
+// searchsorted(edges, dur, side='left') bucketing exactly.
+void vtpu_span_metrics(const int32_t* sid, const float* dur, int64_t n,
+                       const float* edges, int n_edges, int64_t n_series,
+                       int64_t* hist, double* lat_sum) {
+  const int nb = n_edges + 1;
+  for (int64_t i = 0; i < n; i++) {
+    const int32_t s = sid[i];
+    if ((uint64_t)s >= (uint64_t)n_series) continue;
+    const float d = dur[i];
+    int b = 0;
+    // !(d <= e) instead of (d > e): NaN then falls through to the LAST
+    // bucket, matching searchsorted's "NaN sorts after everything"
+    while (b < n_edges && !(d <= edges[b])) b++;
+    hist[(int64_t)s * nb + b]++;
+    lat_sum[s] += (double)d;
   }
 }
 

@@ -233,6 +233,16 @@ class ColumnPack:
         with self._io_lock:
             self.bytes_read += n
 
+    def preload(self) -> None:
+        """Fetch the WHOLE pack with one ranged read and serve later
+        reads from memory. For small blocks (compaction inputs, the
+        many-tiny-blocks shape) this replaces dozens of per-chunk
+        backend reads/opens with one."""
+        data = self._read_range(0, self._size)
+        self._count_read(len(data))
+        self._read_range = lambda off, ln: data[off : off + ln]
+        self._count_read = lambda n: None  # already counted in full
+
     @staticmethod
     def _dctx() -> "zstandard.ZstdDecompressor":
         """zstd contexts are NOT thread-safe: concurrent decompress on a

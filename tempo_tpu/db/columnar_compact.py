@@ -75,8 +75,15 @@ class _Source:
         self.remap: np.ndarray | None = None
         self.fused_remap = False
 
+    # below this size the whole pack is fetched with ONE ranged read
+    # before decode (kills the per-chunk open/read fixed costs that
+    # dominate the many-tiny-blocks compaction shape)
+    PRELOAD_MAX_BYTES = 32 << 20
+
     @classmethod
     def from_block(cls, blk: BackendBlock) -> "_Source":
+        if blk.meta.size_bytes and blk.meta.size_bytes <= cls.PRELOAD_MAX_BYTES:
+            blk.pack.preload()
         return cls(blk.pack.read_all(), blk.dictionary)
 
     def remap_codes(self, remap: np.ndarray, fused: bool = False) -> None:

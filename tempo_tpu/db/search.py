@@ -70,35 +70,12 @@ _host_io_pool = ThreadPoolExecutor(max_workers=8, thread_name_prefix="search-io"
 # ---------------------------------------------------------------- engine cost
 # The device engine costs ~one link round trip per query (fused select's
 # single fetch) regardless of block count; the host engine costs
-# bytes/rate with ZERO round trips. On a datacenter TPU the RTT is
-# sub-millisecond and staged device eval wins from the first megabyte;
-# through a high-latency tunnel (~100 ms/sync) the host engine wins for
-# working sets into the hundreds of MB. Measure, don't assume: one tiny
-# put+compute+fetch round trip at first use, plus a host-rate EMA
-# updated by every host-engine block scan.
-_LINK_RTT_MS: float | None = None
+# bytes/rate with ZERO round trips (cost model shared with the
+# generator's reduce: util/linkcost.py). A host-rate EMA updated by
+# every cold host-engine block scan completes the estimate.
+from ..util.linkcost import link_rtt_ms as _link_rtt_ms
+
 _HOST_RATE_BPS: float = 1.5e9  # EMA, seeded at DDR-ish single-core scan rate
-
-
-def _link_rtt_ms() -> float:
-    global _LINK_RTT_MS
-    if _LINK_RTT_MS is None:
-        try:
-            import time as _time
-
-            import jax
-            import jax.numpy as jnp
-
-            probe = np.zeros(8, np.int32)
-            best = float("inf")
-            for _ in range(3):  # first rep absorbs the +1 kernel compile
-                t0 = _time.perf_counter()
-                np.asarray(jnp.asarray(probe) + 1)
-                best = min(best, _time.perf_counter() - t0)
-            _LINK_RTT_MS = best * 1e3
-        except Exception:
-            _LINK_RTT_MS = 0.0
-    return _LINK_RTT_MS
 
 
 def _note_host_rate(n_bytes: int, seconds: float) -> None:

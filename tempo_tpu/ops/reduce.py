@@ -36,15 +36,48 @@ def _reduce_kernel(sid, dur, n_valid, edges, n_series_b: int, n_buckets: int):
     return calls, lat_sum, hist.reshape(n_series_b, n_buckets)
 
 
+def _reduce_host(sid: np.ndarray, dur_s: np.ndarray, n_series: int,
+                 bucket_edges: tuple):
+    """Host twin of the device kernel: one fused native pass (the
+    series x bucket table stays cache-resident), numpy fallback of one
+    searchsorted + two bincounts. Exact same outputs."""
+    edges = np.asarray(bucket_edges, np.float32)
+    from ..native import span_metrics_fold
+
+    out = span_metrics_fold(np.ascontiguousarray(sid, np.int32),
+                            np.ascontiguousarray(dur_s, np.float32),
+                            edges, n_series)
+    if out is not None:
+        hist, lsum = out
+        return hist.sum(axis=1).astype(np.int64), lsum, hist
+    nb = len(bucket_edges) + 1
+    bidx = np.searchsorted(edges, dur_s.astype(np.float32))
+    combo = sid.astype(np.int64) * nb + bidx
+    hist = np.bincount(combo, minlength=n_series * nb)[: n_series * nb]
+    hist = hist.reshape(n_series, nb)
+    lsum = np.bincount(sid, weights=dur_s.astype(np.float64), minlength=n_series)[:n_series]
+    return (hist.sum(axis=1).astype(np.int64), lsum.astype(np.float64),
+            hist.astype(np.int64))
+
+
 def span_metrics_reduce(sid: np.ndarray, dur_s: np.ndarray, n_series: int,
                         bucket_edges: tuple) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
     """-> (calls (n_series,), latency_sum (n_series,),
-    histogram (n_series, len(edges)+1)) as numpy."""
+    histogram (n_series, len(edges)+1)) as numpy.
+
+    Engine choice mirrors search: the device fold is one fused program
+    but costs an upload of 8 bytes/span plus sync round trips -- through
+    a high-latency tunnel the host bincount fold wins outright, on a
+    real interconnect the device does (util/linkcost.py)."""
     n = sid.shape[0]
     if n == 0 or n_series == 0:
         nb = len(bucket_edges) + 1
         return (np.zeros(n_series, np.int64), np.zeros(n_series, np.float64),
                 np.zeros((n_series, nb), np.int64))
+    from ..util.linkcost import link_rtt_ms
+
+    if link_rtt_ms() > 2.0:
+        return _reduce_host(sid, dur_s, n_series, bucket_edges)
     nb = len(bucket_edges) + 1
     Np = pow2(n)
     Sb = pow2(n_series)

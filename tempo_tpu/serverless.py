@@ -15,7 +15,7 @@ from collections import OrderedDict
 from .backend import open_backend
 from .block.meta import BlockMeta
 from .block.reader import BackendBlock
-from .db.search import SearchRequest, search_block
+from .db.search import request_from_dict, response_to_dict, search_block
 
 _lock = threading.Lock()
 _backends: dict = {}
@@ -38,8 +38,8 @@ def _backend(cfg: dict):
 
 def handler(event: dict) -> dict:
     """event: {backend: {...}, tenant, block_id, groups: [lo, hi) | null,
-    search: {tags, query, minDurationMs, maxDurationMs, start, end, limit}}
-    -> {traces: [...], metrics: {...}}"""
+    search: <db.search.request_to_dict form>}
+    -> db.search.response_to_dict form."""
     backend = _backend(event["backend"])
     tenant = event["tenant"]
     block_id = event["block_id"]
@@ -62,23 +62,55 @@ def handler(event: dict) -> dict:
             while len(_blocks) > _MAX_CACHED_BLOCKS:
                 _blocks.popitem(last=False)
 
-    s = event.get("search", {})
-    req = SearchRequest(
-        tags=s.get("tags", {}),
-        query=s.get("query", ""),
-        min_duration_ms=s.get("minDurationMs", 0),
-        max_duration_ms=s.get("maxDurationMs", 0),
-        start=s.get("start", 0),
-        end=s.get("end", 0),
-        limit=s.get("limit", 20),
-    )
+    # the search payload and the response both reuse the internal job
+    # plane's wire helpers (db/search request/response dicts) so the
+    # serverless hop can never drift from the frontend's format
+    req = request_from_dict(event.get("search", {}))
     groups = event.get("groups")
     groups_range = list(range(groups[0], groups[1])) if groups else None
     resp = search_block(blk, req, groups_range=groups_range)
-    return {
-        "traces": [t.to_dict() for t in resp.traces],
-        "metrics": {
-            "inspectedBytes": resp.inspected_bytes,
-            "inspectedSpans": resp.inspected_spans,
-        },
-    }
+    return response_to_dict(resp)
+
+
+def serve(port: int, host: str = "127.0.0.1"):
+    """HTTP front for the handler: POST / with the event JSON (the
+    Cloud-Run flavor of the reference's serverless deploys; Lambda would
+    wrap `handler` directly). Returns the bound server (threaded)."""
+    import json
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class _H(BaseHTTPRequestHandler):
+        def do_POST(self):
+            try:
+                body = self.rfile.read(int(self.headers.get("Content-Length", 0)))
+                out = handler(json.loads(body))
+                data = json.dumps(out).encode()
+                self.send_response(200)
+            except Exception as e:  # one bad event must not kill the worker
+                data = json.dumps({"error": f"{type(e).__name__}: {e}"}).encode()
+                self.send_response(500)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def log_message(self, *a):
+            pass
+
+    return ThreadingHTTPServer((host, port), _H)
+
+
+def main(argv=None):
+    import argparse
+
+    ap = argparse.ArgumentParser("tempo-serverless")
+    ap.add_argument("--port", type=int, default=8077)
+    ap.add_argument("--host", default="0.0.0.0")
+    args = ap.parse_args(argv)
+    srv = serve(args.port, args.host)
+    print(f"tempo-serverless listening on {args.host}:{args.port}", flush=True)
+    srv.serve_forever()
+
+
+if __name__ == "__main__":
+    main()

@@ -84,6 +84,11 @@ class AppConfig:
     # metrics-generator remote-write target ("" = expose on /metrics only)
     remote_write_url: str = ""
     remote_write_interval_s: float = 15.0
+    # comma-separated serverless search endpoints: block-shard jobs POST
+    # there with hedging, local execution as fallback (reference:
+    # querier.search.external_endpoints, querier.go:401-458)
+    search_external_endpoints: str = ""
+    search_external_hedge_after_s: float = 4.0
 
 
 class App:
@@ -197,7 +202,13 @@ class App:
             # with a shared KV the ring may hold remote ingesters even when
             # this process hosts none
             ingester_ring = self.ring if (self._clients or shared_ring) else None
-            self.querier = Querier(self.db, ingester_ring, self.client_for)
+            ext = [e.strip() for e in cfg.search_external_endpoints.split(",")
+                   if e.strip()]
+            self.querier = Querier(
+                self.db, ingester_ring, self.client_for,
+                external_endpoints=ext,
+                external_hedge_after_s=cfg.search_external_hedge_after_s,
+            )
             # a standalone query-frontend with remote queriers attached is
             # dispatcher-only (v1/frontend.go); every other shape keeps
             # in-process workers draining the same queue
@@ -677,6 +688,9 @@ def main(argv=None):
                     help="frontend addr(s) a standalone querier pulls jobs from")
     ap.add_argument("--distributor.otlp-grpc-port", dest="otlp_grpc_port", type=int,
                     default=None, help="OTLP gRPC receiver port (0=off, -1=ephemeral)")
+    ap.add_argument("--querier.search-external-endpoints", dest="search_external",
+                    default=None,
+                    help="comma-separated serverless search handler URLs")
     args = ap.parse_args(argv)
     base = load_config_file(args.config_file) if args.config_file else {}
     flag_vals = {
@@ -695,6 +709,7 @@ def main(argv=None):
         "internal_token": args.internal_token,
         "frontend_addr": args.frontend_addr,
         "otlp_grpc_port": args.otlp_grpc_port,
+        "search_external_endpoints": args.search_external,
     }
     base.update({k: v for k, v in flag_vals.items() if v is not None})
     cfg = AppConfig(**base)

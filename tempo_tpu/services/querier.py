@@ -22,17 +22,34 @@ from ..wire.model import Trace
 class QuerierStats:
     traces_found: int = 0
     searches: int = 0
+    external_searches: int = 0  # shard jobs served by serverless endpoints
+    external_failures: int = 0  # external legs that fell back to local
 
 
 class Querier:
-    def __init__(self, db: TempoDB, ring: Ring | None, client_for, workers: int = 8):
+    def __init__(self, db: TempoDB, ring: Ring | None, client_for, workers: int = 8,
+                 external_endpoints: list[str] | None = None,
+                 external_hedge_after_s: float = 4.0):
         """client_for(addr) -> object with ingester read methods
-        (find_trace_by_id / search)."""
+        (find_trace_by_id / search). external_endpoints: serverless
+        search handlers (tempo_tpu.serverless HTTP mode); block-shard
+        jobs POST there with hedged re-dispatch and fall back to local
+        execution (querier.go:401-458 searchExternalEndpoints)."""
         self.db = db
         self.ring = ring
         self.client_for = client_for
         self.pool = ThreadPoolExecutor(max_workers=workers, thread_name_prefix="querier")
         self.stats = QuerierStats()
+        self.external_endpoints = list(external_endpoints or [])
+        self.external_hedge_after_s = external_hedge_after_s
+        self._external_rr = 0
+        # per-endpoint circuit breaker: N consecutive failures skip the
+        # endpoint for a cooldown instead of paying the hedge window on
+        # every shard (reference: hedged client + endpoint weighting)
+        self._external_fails: dict[str, int] = {}
+        self._external_skip_until: dict[str, float] = {}
+        self.external_breaker_fails = 3
+        self.external_breaker_cooldown_s = 30.0
 
     def _ingester_clients(self):
         if self.ring is None:
@@ -88,9 +105,105 @@ class Querier:
 
     def search_block_shard(self, tenant: str, meta, req: SearchRequest, groups) -> SearchResponse:
         """One backend search job: a row-group range of one block
-        (the reference's SearchBlock page-shard job, querier.go:401-458)."""
+        (the reference's SearchBlock page-shard job, querier.go:401-458).
+        With external endpoints configured, the shard ships to a
+        serverless handler (hedged); local execution is the fallback."""
         self.stats.searches += 1
+        if self.external_endpoints:
+            resp = self._search_external(tenant, meta, req, groups)
+            if resp is not None:
+                return resp
         return self.db.search_block_shard(tenant, meta, req, groups)
+
+    def _external_candidates(self) -> list[str]:
+        """Endpoints not in breaker cooldown (all of them when every
+        breaker is open -- a dead fleet still gets probed)."""
+        import time
+
+        now = time.monotonic()
+        ok = [e for e in self.external_endpoints
+              if self._external_skip_until.get(e, 0.0) <= now]
+        return ok or self.external_endpoints
+
+    def _note_external(self, endpoint: str, ok: bool) -> None:
+        import time
+
+        if ok:
+            self._external_fails[endpoint] = 0
+            return
+        n = self._external_fails.get(endpoint, 0) + 1
+        self._external_fails[endpoint] = n
+        if n >= self.external_breaker_fails:
+            self._external_skip_until[endpoint] = (
+                time.monotonic() + self.external_breaker_cooldown_s)
+
+    def _search_external(self, tenant: str, meta, req: SearchRequest,
+                         groups) -> SearchResponse | None:
+        """POST the shard job to a serverless endpoint; if no response
+        within external_hedge_after_s, hedge to the NEXT endpoint and
+        take the first success. None -> caller runs locally."""
+        from ..db.search import request_to_dict
+
+        event = {
+            "backend": self.db.cfg.backend,
+            "tenant": tenant,
+            "block_id": meta.block_id,
+            "groups": ([int(groups[0]), int(groups[-1]) + 1]
+                       if groups is not None and len(groups) else None),
+            "search": request_to_dict(req),
+        }
+        eps = self._external_candidates()
+        first = eps[self._external_rr % len(eps)]
+        self._external_rr += 1
+        futs = {self.pool.submit(self._post_external, first, event): first}
+        try:
+            out = next(iter(futs)).result(timeout=self.external_hedge_after_s)
+            self._note_external(first, out is not None)
+            if out is not None:
+                self.stats.external_searches += 1
+                return out
+        except TimeoutError:
+            if len(eps) > 1:  # hedge on a different endpoint
+                second = eps[self._external_rr % len(eps)]
+                self._external_rr += 1
+                futs[self.pool.submit(self._post_external, second, event)] = second
+            # await ALL legs up to one more hedge window: a slow first
+            # leg failing must not discard a still-pending hedge winner
+            from concurrent.futures import as_completed
+
+            try:
+                for f in as_completed(futs, timeout=self.external_hedge_after_s):
+                    out = f.exception() is None and f.result()
+                    self._note_external(futs[f], bool(out))
+                    if out:
+                        self.stats.external_searches += 1
+                        return out
+            except TimeoutError:
+                for f, ep in futs.items():
+                    if not f.done():
+                        self._note_external(ep, False)
+        except Exception:
+            self._note_external(first, False)
+        self.stats.external_failures += 1
+        return None
+
+    def _post_external(self, endpoint: str, event: dict) -> SearchResponse | None:
+        import json
+        import urllib.request
+
+        from ..db.search import response_from_dict
+
+        try:
+            r = urllib.request.urlopen(
+                urllib.request.Request(
+                    endpoint, data=json.dumps(event).encode(),
+                    headers={"Content-Type": "application/json"},
+                ),
+                timeout=max(self.external_hedge_after_s * 4, 10.0),
+            )
+            return response_from_dict(json.loads(r.read()))
+        except Exception:
+            return None
 
     def search_blocks(self, tenant: str, metas: list, req: SearchRequest) -> SearchResponse:
         """One block-BATCH job: many whole blocks searched as one fused

@@ -1,0 +1,35 @@
+"""Measured host<->device link cost, shared by every engine-choice
+site (search's host-vs-staged decision, the generator's reduce).
+
+On a datacenter TPU the round trip is sub-millisecond and device
+execution wins from the first megabyte; through a high-latency tunnel
+(~100 ms/sync) host execution wins for anything the host can scan
+faster than one round trip. Measure once per process, don't assume."""
+
+from __future__ import annotations
+
+import numpy as np
+
+_LINK_RTT_MS: float | None = None
+
+
+def link_rtt_ms() -> float:
+    """One tiny put+compute+fetch round trip, measured at first use
+    (first rep absorbs backend init + the +1 kernel compile)."""
+    global _LINK_RTT_MS
+    if _LINK_RTT_MS is None:
+        try:
+            import time as _time
+
+            import jax.numpy as jnp
+
+            probe = np.zeros(8, np.int32)
+            best = float("inf")
+            for _ in range(3):
+                t0 = _time.perf_counter()
+                np.asarray(jnp.asarray(probe) + 1)
+                best = min(best, _time.perf_counter() - t0)
+            _LINK_RTT_MS = best * 1e3
+        except Exception:
+            _LINK_RTT_MS = 0.0
+    return _LINK_RTT_MS

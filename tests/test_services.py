@@ -470,3 +470,60 @@ def test_ingester_flush_backoff(tmp_path):
     assert len(db.blocklist.metas(TENANT)) == 1
     assert TENANT not in ing._flush_backoff  # state cleared
     db.close()
+
+
+def test_serverless_external_search(tmp_path):
+    """Block-shard search jobs dispatch to an external serverless
+    handler (tempo_tpu.serverless HTTP mode) with local fallback
+    (querier.go:401-458 searchExternalEndpoints): results match local
+    execution, a frontend search rides the external path for oversized
+    blocks, and a dead endpoint degrades to local, never failing."""
+    import threading
+
+    from tempo_tpu import serverless
+    from tempo_tpu.backend.local import LocalBackend
+    from tempo_tpu.services.frontend import Frontend
+    from tempo_tpu.services.querier import Querier
+
+    store = str(tmp_path / "store")
+    db = TempoDB(
+        TempoDBConfig(backend={"backend": "local", "path": store},
+                      wal_path=str(tmp_path / "wal")),
+        backend=LocalBackend(store),
+    )
+    traces = make_traces(40, seed=9, n_spans=6)
+    db.write_block(TENANT, traces)
+    db.poll_now()
+    meta = db.blocklist.metas(TENANT)[0]
+
+    srv = serverless.serve(0)
+    port = srv.server_address[1]
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    url = f"http://127.0.0.1:{port}/"
+
+    req = SearchRequest(limit=100)
+    local = db.search_block_shard(TENANT, meta, req, None)
+
+    q = Querier(db, None, None, external_endpoints=[url],
+                external_hedge_after_s=2.0)
+    ext = q.search_block_shard(TENANT, meta, req, None)
+    assert q.stats.external_searches == 1 and q.stats.external_failures == 0
+    assert {t.trace_id for t in ext.traces} == {t.trace_id for t in local.traces}
+    assert ext.inspected_spans == local.inspected_spans > 0
+
+    # frontend e2e: tiny batch budget forces row-group shard jobs, which
+    # all ride the external endpoint
+    fe = Frontend(q, n_workers=2, batch_bytes=1)
+    before = q.stats.external_searches
+    resp = fe.search(TENANT, SearchRequest(limit=100))
+    assert len(resp.traces) == 40
+    assert q.stats.external_searches > before
+    fe.close() if hasattr(fe, "close") else None
+
+    # dead endpoint: falls back to local, still correct
+    qdead = Querier(db, None, None, external_endpoints=["http://127.0.0.1:1/"],
+                    external_hedge_after_s=0.2)
+    got = qdead.search_block_shard(TENANT, meta, req, None)
+    assert qdead.stats.external_failures == 1
+    assert {t.trace_id for t in got.traces} == {t.trace_id for t in local.traces}
+    srv.shutdown()
