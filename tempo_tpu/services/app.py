@@ -77,6 +77,11 @@ class AppConfig:
     # OTLP gRPC receiver port (reference receiver default 4317);
     # 0 = disabled, -1 = ephemeral (tests)
     otlp_grpc_port: int = 0
+    # Kafka receiver (reference shim.go:100): host:port of a broker, ""
+    # = disabled; messages are OTLP-proto ExportTraceServiceRequest
+    kafka_brokers: str = ""
+    kafka_topic: str = ""
+    kafka_tenant: str = ""  # required when multitenancy is on
     # self-tracing: query operations emit spans into this tenant through
     # the local distributor ("" = off); reference: the app traces its own
     # handlers and ships them like any tenant's (SURVEY.md 5.1)
@@ -250,6 +255,7 @@ class App:
         self.usage = UsageReporter(self.db.backend, cfg.target)
         self._started = False
         self.otlp_grpc = None
+        self.kafka = None
         self.remote_writer = None
         self.http_server: ThreadingHTTPServer | None = None
 
@@ -287,6 +293,22 @@ class App:
             local = ("127.0.0.1" in adv) or ("localhost" in adv) or not adv
             host = self.cfg.http_host or ("127.0.0.1" if local else "0.0.0.0")
             self.cfg.otlp_grpc_port = self.otlp_grpc.start(port, host=host)
+        if self.distributor is not None and self.cfg.kafka_brokers:
+            from .kafka_receiver import DEFAULT_TOPIC, KafkaReceiver
+
+            if self.cfg.multitenancy and not self.cfg.kafka_tenant:
+                # fail at startup, not by silently dropping every message
+                raise ValueError(
+                    "the kafka receiver needs --distributor.kafka-tenant "
+                    "when multitenancy is enabled (messages carry no "
+                    "X-Scope-OrgID)"
+                )
+            self.kafka = KafkaReceiver(
+                self, self.cfg.kafka_brokers,
+                topic=self.cfg.kafka_topic or DEFAULT_TOPIC,
+                tenant=self.cfg.kafka_tenant or DEFAULT_TENANT,
+            )
+            self.kafka.start()
         self.db.enable_polling()
         self._started = True
 
@@ -296,6 +318,8 @@ class App:
         self.overrides.stop()
         if self.otlp_grpc is not None:
             self.otlp_grpc.stop()
+        if self.kafka is not None:
+            self.kafka.stop()
         if self.querier_worker:
             self.querier_worker.stop()
         if self.compactor:
@@ -512,8 +536,17 @@ def _make_handler(app: App):
                     if not self._authorized_internal():
                         return self._err(401, "missing or wrong internal token")
                     from ..transport.client import handle_internal
+                    from ..transport.frames import CONTENT_TYPE as FRAMES_CT
 
-                    code, out = handle_internal(app, u.path, json.loads(body or b"{}"))
+                    ctype = self.headers.get("Content-Type", "")
+                    payload = ({} if ctype.startswith(FRAMES_CT)
+                               else json.loads(body or b"{}"))
+                    code, out = handle_internal(
+                        app, u.path, payload, raw_body=body, content_type=ctype,
+                        accept=self.headers.get("Accept", ""),
+                    )
+                    if isinstance(out, tuple):  # (bytes, content_type)
+                        return self._send(code, out[0], out[1])
                     return self._send(code, json.dumps(out))
                 if u.path == "/v1/traces":  # OTLP HTTP ingest
                     if app.distributor is None:
@@ -582,6 +615,12 @@ def _metrics_text(app: App) -> str:
             f"tempo_distributor_traces_refused_size_total {d.traces_refused_size}",
         ]
         lines += app.distributor.push_latency.text()
+    if app.kafka is not None:
+        lines += [
+            f"tempo_kafka_receiver_messages_total {app.kafka.messages}",
+            f"tempo_kafka_receiver_spans_total {app.kafka.spans}",
+            f"tempo_kafka_receiver_failures_total {app.kafka.failures}",
+        ]
     if app.ingester:
         from .ingester import FLUSH_DURATION, FLUSH_FAILURES, WAL_REPLAYS
 
@@ -691,6 +730,11 @@ def main(argv=None):
     ap.add_argument("--querier.search-external-endpoints", dest="search_external",
                     default=None,
                     help="comma-separated serverless search handler URLs")
+    ap.add_argument("--distributor.kafka-brokers", dest="kafka_brokers", default=None,
+                    help="Kafka broker host:port for the kafka receiver ('' = off)")
+    ap.add_argument("--distributor.kafka-topic", dest="kafka_topic", default=None)
+    ap.add_argument("--distributor.kafka-tenant", dest="kafka_tenant", default=None,
+                    help="tenant kafka messages ingest into (required with multitenancy)")
     args = ap.parse_args(argv)
     base = load_config_file(args.config_file) if args.config_file else {}
     flag_vals = {
@@ -710,6 +754,9 @@ def main(argv=None):
         "frontend_addr": args.frontend_addr,
         "otlp_grpc_port": args.otlp_grpc_port,
         "search_external_endpoints": args.search_external,
+        "kafka_brokers": args.kafka_brokers,
+        "kafka_topic": args.kafka_topic,
+        "kafka_tenant": args.kafka_tenant,
     }
     base.update({k: v for k, v in flag_vals.items() if v is not None})
     cfg = AppConfig(**base)

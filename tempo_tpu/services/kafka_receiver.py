@@ -1,0 +1,349 @@
+"""Kafka receiver: consume OTLP-proto span messages from a topic and
+push them through the distributor.
+
+The reference registers the OTel collector's kafka receiver beside OTLP
+and Jaeger (modules/distributor/receiver/shim.go:100); its default
+contract is topic "otlp_spans" carrying serialized
+ExportTraceServiceRequest messages. Same contract here, with a
+hand-rolled minimal Kafka wire client (the pattern every backend client
+in this repo follows -- S3 SigV4, Azure SharedKey, GCS: speak the
+protocol subset we need, no SDK):
+
+* Metadata v0 (api 3) -- partition discovery,
+* ListOffsets v0 (api 2) -- earliest/latest start position,
+* Fetch v0 (api 1) -- message sets (v0/v1 message format).
+
+Single-consumer (no group coordination): each receiver instance owns
+the whole topic, offsets live in memory and start at `latest` by
+default. Multi-instance partition balancing rides the distributor ring
+above this layer, not Kafka groups.
+"""
+
+from __future__ import annotations
+
+import io
+import logging
+import socket
+import struct
+import threading
+import time
+
+from ..wire import otlp_pb
+
+log = logging.getLogger("tempo_tpu")
+
+DEFAULT_TOPIC = "otlp_spans"
+
+# ---------------------------------------------------------------- wire enc
+
+_I16 = struct.Struct(">h")
+_I32 = struct.Struct(">i")
+_I64 = struct.Struct(">q")
+
+
+def enc_str(s: str | None) -> bytes:
+    if s is None:
+        return _I16.pack(-1)
+    b = s.encode()
+    return _I16.pack(len(b)) + b
+
+
+def enc_bytes(b: bytes | None) -> bytes:
+    if b is None:
+        return _I32.pack(-1)
+    return _I32.pack(len(b)) + b
+
+
+class Reader:
+    def __init__(self, data: bytes):
+        self.b = io.BytesIO(data)
+
+    def i16(self) -> int:
+        return _I16.unpack(self.b.read(2))[0]
+
+    def i32(self) -> int:
+        return _I32.unpack(self.b.read(4))[0]
+
+    def i64(self) -> int:
+        return _I64.unpack(self.b.read(8))[0]
+
+    def string(self) -> str | None:
+        n = self.i16()
+        return None if n < 0 else self.b.read(n).decode()
+
+    def bytes(self) -> bytes | None:
+        n = self.i32()
+        return None if n < 0 else self.b.read(n)
+
+    def raw(self, n: int) -> bytes:
+        return self.b.read(n)
+
+
+class OffsetOutOfRange(Exception):
+    """Fetch error 1: the stored offset fell off retention."""
+
+
+def parse_message_set(data: bytes) -> list[tuple[int, bytes]]:
+    """v0/v1 MessageSet -> [(offset, value)]. Tolerates a trailing
+    partial message (brokers truncate at max_bytes). Compressed wrapper
+    messages fail LOUDLY: silently feeding compressed bytes downstream
+    would drop every message with no signal."""
+    out: list[tuple[int, bytes]] = []
+    pos = 0
+    n = len(data)
+    while pos + 12 <= n:
+        (offset,) = _I64.unpack_from(data, pos)
+        (size,) = _I32.unpack_from(data, pos + 8)
+        if size < 0 or pos + 12 + size > n:
+            break  # partial tail
+        msg = data[pos + 12 : pos + 12 + size]
+        # crc(4) magic(1) attrs(1) [v1: timestamp(8)] key value
+        if len(msg) < 6:
+            break
+        magic = msg[4]
+        if msg[5] & 0x07:
+            raise ValueError(
+                "compressed Kafka message sets are not supported; configure "
+                "the producer with compression.type=none"
+            )
+        body = msg[6 + (8 if magic >= 1 else 0) :]
+        r = Reader(body)
+        r.bytes()  # key, unused
+        value = r.bytes()
+        if value is not None:
+            out.append((offset, value))
+        pos += 12 + size
+    return out
+
+
+class KafkaClient:
+    """One broker connection speaking the v0 subset."""
+
+    def __init__(self, host: str, port: int, client_id: str = "tempo-tpu",
+                 timeout_s: float = 10.0):
+        self.addr = (host, port)
+        self.client_id = client_id
+        self.timeout_s = timeout_s
+        self._sock: socket.socket | None = None
+        self._corr = 0
+
+    def close(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            finally:
+                self._sock = None
+
+    def _conn(self) -> socket.socket:
+        if self._sock is None:
+            self._sock = socket.create_connection(self.addr, timeout=self.timeout_s)
+        return self._sock
+
+    def _call(self, api_key: int, body: bytes) -> Reader:
+        self._corr += 1
+        hdr = _I16.pack(api_key) + _I16.pack(0) + _I32.pack(self._corr) + enc_str(self.client_id)
+        msg = hdr + body
+        s = self._conn()
+        try:
+            s.sendall(_I32.pack(len(msg)) + msg)
+            raw = self._read_exact(s, 4)
+            (ln,) = _I32.unpack(raw)
+            resp = self._read_exact(s, ln)
+        except Exception:
+            self.close()  # poisoned stream: next call reconnects
+            raise
+        r = Reader(resp)
+        corr = r.i32()
+        if corr != self._corr:
+            self.close()
+            raise ConnectionError(f"kafka correlation mismatch {corr} != {self._corr}")
+        return r
+
+    @staticmethod
+    def _read_exact(s: socket.socket, n: int) -> bytes:
+        out = b""
+        while len(out) < n:
+            chunk = s.recv(n - len(out))
+            if not chunk:
+                raise ConnectionError("kafka broker closed connection")
+            out += chunk
+        return out
+
+    # ---- apis
+    def partitions(self, topic: str) -> list[int]:
+        body = _I32.pack(1) + enc_str(topic)
+        r = self._call(3, body)
+        for _ in range(r.i32()):  # brokers
+            r.i32()
+            r.string()
+            r.i32()
+        parts: list[int] = []
+        for _ in range(r.i32()):  # topics
+            r.i16()  # topic error
+            r.string()
+            for _ in range(r.i32()):
+                r.i16()  # partition error
+                parts.append(r.i32())
+                r.i32()  # leader
+                for _ in range(r.i32()):
+                    r.i32()  # replicas
+                for _ in range(r.i32()):
+                    r.i32()  # isr
+        return sorted(parts)
+
+    def list_offset(self, topic: str, partition: int, latest: bool) -> int:
+        ts = -1 if latest else -2
+        body = (_I32.pack(-1) + _I32.pack(1) + enc_str(topic) + _I32.pack(1)
+                + _I32.pack(partition) + _I64.pack(ts) + _I32.pack(1))
+        r = self._call(2, body)
+        for _ in range(r.i32()):
+            r.string()
+            for _ in range(r.i32()):
+                r.i32()  # partition
+                err = r.i16()
+                offs = [r.i64() for _ in range(r.i32())]
+                if err == 0 and offs:
+                    return offs[0]
+        return 0
+
+    def fetch(self, topic: str, partition: int, offset: int,
+              max_bytes: int = 4 << 20, max_wait_ms: int = 500) -> list[tuple[int, bytes]]:
+        body = (_I32.pack(-1) + _I32.pack(max_wait_ms) + _I32.pack(1)
+                + _I32.pack(1) + enc_str(topic) + _I32.pack(1)
+                + _I32.pack(partition) + _I64.pack(offset) + _I32.pack(max_bytes))
+        r = self._call(1, body)
+        out: list[tuple[int, bytes]] = []
+        for _ in range(r.i32()):
+            r.string()
+            for _ in range(r.i32()):
+                r.i32()  # partition
+                err = r.i16()
+                r.i64()  # high watermark
+                ms = r.bytes() or b""
+                if err == 1:
+                    raise OffsetOutOfRange(f"{topic}/{partition}@{offset}")
+                if err == 0:
+                    out.extend(parse_message_set(ms))
+        return out
+
+
+class KafkaReceiver:
+    """Poll loop: fetch OTLP messages from every partition, decode, push
+    through the distributor (the shim's receiver -> distributor.push
+    contract, shim.go:116)."""
+
+    def __init__(self, app, brokers: str, topic: str = DEFAULT_TOPIC,
+                 tenant: str = "", start_latest: bool = True,
+                 poll_interval_s: float = 0.2):
+        # comma-separated broker list: connect to the first, rotate to
+        # the next on connection failure (bootstrap failover)
+        self.brokers: list[tuple[str, int]] = []
+        for part in brokers.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            host, _, port = part.partition(":")
+            self.brokers.append((host, int(port or 9092)))
+        if not self.brokers:
+            raise ValueError("kafka receiver needs at least one broker addr")
+        self._broker_i = 0
+        self.client = KafkaClient(*self.brokers[0])
+        self.app = app
+        self.topic = topic
+        self.tenant = tenant
+        self.start_latest = start_latest
+        self.poll_interval_s = poll_interval_s
+        self.offsets: dict[int, int] = {}
+        self.messages = 0
+        self.spans = 0
+        self.failures = 0
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def _rotate_broker(self) -> None:
+        self.client.close()
+        self._broker_i = (self._broker_i + 1) % len(self.brokers)
+        self.client = KafkaClient(*self.brokers[self._broker_i])
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="kafka-receiver")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        self.client.close()
+
+    def poll_once(self) -> int:
+        """One fetch round over all partitions; returns messages
+        consumed. Poison messages (undecodable, rejected payloads) are
+        skipped with their offset advanced; TRANSIENT failures (rate
+        limits, no healthy ingesters) rewind the offset and retry next
+        poll -- the at-least-once contract the OTLP receivers give
+        clients via 429s."""
+        from .distributor import PushError
+
+        got = 0
+        if not self.offsets:
+            for p in self.client.partitions(self.topic):
+                self.offsets[p] = self.client.list_offset(
+                    self.topic, p, latest=self.start_latest)
+            log.info("kafka receiver: topic %s partitions %s",
+                     self.topic, sorted(self.offsets))
+        for p, off in list(self.offsets.items()):
+            try:
+                records = self.client.fetch(self.topic, p, off)
+            except OffsetOutOfRange:
+                # fell off retention: restart from the earliest retained
+                new = self.client.list_offset(self.topic, p, latest=False)
+                log.warning("kafka receiver: %s/%d offset %d out of range, "
+                            "resetting to %d", self.topic, p, off, new)
+                self.offsets[p] = new
+                continue
+            for offset, value in records:
+                try:
+                    tr = otlp_pb.decode_trace(value)
+                except Exception as e:
+                    self.failures += 1  # poison: skip it, advance
+                    self.offsets[p] = offset + 1
+                    log.warning("kafka receiver: undecodable message at "
+                                "%s/%d@%d: %s", self.topic, p, offset, e)
+                    continue
+                tenant = self.tenant or self.app.tenant_of({})
+                try:
+                    self.app.distributor.push(tenant, tr.resource_spans)
+                except PushError as e:
+                    if e.status in (400, 401):  # rejected payload: poison
+                        self.failures += 1
+                        self.offsets[p] = offset + 1
+                        log.warning("kafka receiver: push rejected (%d) at "
+                                    "%s/%d@%d: %s", e.status, self.topic, p, offset, e)
+                        continue
+                    log.warning("kafka receiver: transient push failure (%d) "
+                                "at %s/%d@%d, will retry: %s",
+                                e.status, self.topic, p, offset, e)
+                    break  # transient: offset NOT advanced, retry next poll
+                except Exception as e:
+                    log.warning("kafka receiver: transient push failure at "
+                                "%s/%d@%d, will retry: %s", self.topic, p, offset, e)
+                    break
+                self.offsets[p] = offset + 1
+                got += 1
+                self.messages += 1
+                self.spans += sum(1 for _ in tr.all_spans())
+        return got
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.poll_once()
+            except Exception as e:
+                self.failures += 1
+                log.warning("kafka receiver: poll failed against %s:%d, "
+                            "rotating broker: %s", *self.client.addr, e)
+                self._rotate_broker()
+                self._stop.wait(min(5.0, self.poll_interval_s * 10))
+                continue
+            self._stop.wait(self.poll_interval_s)

@@ -5,10 +5,12 @@ objects for the single binary, HTTPIngesterClient for `http://...`
 addrs (the reference's gRPC ingester client seam,
 modules/distributor/distributor.go:148-153 factory).
 
-Wire format: JSON with base64 segments. Deliberately simple -- the
-payload is already compact proto-wire segment bytes; framing overhead
-is the base64 33%, acceptable for the multi-process topology this
-serves (same-host or LAN).
+Wire format: the DATA plane (segment push, generator forward, find
+responses) runs on length-prefixed binary frames (transport/frames.py,
+<5% overhead, optional whole-body zstd -- the reference's gRPC+snappy
+analog); small control payloads stay JSON. Legacy JSON+base64 remains
+accepted server-side, and pushes retry as JSON once when a pre-frames
+server rejects the binary body (rolling upgrades).
 """
 
 from __future__ import annotations
@@ -27,6 +29,18 @@ class TransportError(Exception):
     def __init__(self, status: int, msg: str):
         super().__init__(msg)
         self.status = status
+
+
+def _raise_http_error(e: urllib.error.HTTPError):
+    """Shared HTTPError -> typed exception mapping (ingester-side limit
+    errors keep their real status for the caller's retry policy)."""
+    try:
+        msg = json.loads(e.read()).get("error", "")
+    except Exception:
+        msg = str(e)
+    from ..services.distributor import PushError
+
+    raise PushError(e.code, msg) if e.code in (400, 429) else TransportError(e.code, msg)
 
 
 class HTTPIngesterClient:
@@ -49,39 +63,77 @@ class HTTPIngesterClient:
                 body = r.read()
                 return json.loads(body) if body else {}
         except urllib.error.HTTPError as e:
-            try:
-                msg = json.loads(e.read()).get("error", "")
-            except Exception:
-                msg = str(e)
-            # re-raise ingester-side limit errors with their real status
-            from ..services.distributor import PushError
+            _raise_http_error(e)
 
-            raise PushError(e.code, msg) if e.code in (400, 429) else TransportError(e.code, msg)
+    def _post_frames(self, path: str, body: bytes) -> None:
+        from . import frames
+
+        headers = {"Content-Type": frames.CONTENT_TYPE}
+        if self.token:
+            headers["X-Tempo-Internal-Token"] = self.token
+        req = urllib.request.Request(self.addr + path, data=body, headers=headers)
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as r:
+                r.read()
+        except urllib.error.HTTPError as e:
+            _raise_http_error(e)
+        except urllib.error.URLError as e:
+            raise TransportError(0, str(e))
 
     # ------------------------------------------------- Pusher (write path)
     def push_segments(self, tenant: str, batch) -> None:
-        self._post(
-            "/internal/push",
-            {
-                "tenant": tenant,
-                "batch": [
-                    [tid.hex(), s, e, base64.b64encode(seg).decode()]
-                    for tid, s, e, seg in batch
-                ],
-            },
-        )
+        from . import frames
+
+        try:
+            self._post_frames("/internal/push", frames.encode_push(tenant, batch))
+        except TransportError:
+            # rolling-upgrade interop: a pre-frames server 500s on the
+            # binary body; retry once as legacy JSON+base64
+            self._post(
+                "/internal/push",
+                {"tenant": tenant,
+                 "batch": [[tid.hex(), s, e, base64.b64encode(seg).decode()]
+                           for tid, s, e, seg in batch]},
+            )
 
     def push_generator(self, tenant: str, traces) -> None:
         """Forward traces to a remote metrics-generator (the shuffle-
         sharded generator write path, distributor.go:410-442)."""
-        self._post(
-            "/internal/genpush",
-            {"tenant": tenant, "traces": [otlp_json.dumps(t) for t in traces]},
-        )
+        from . import frames
+
+        try:
+            self._post_frames("/internal/genpush", frames.encode_traces(tenant, traces))
+        except TransportError:
+            self._post(
+                "/internal/genpush",
+                {"tenant": tenant, "traces": [otlp_json.dumps(t) for t in traces]},
+            )
 
     # ------------------------------------------------ Querier (read path)
     def find_trace_by_id(self, tenant: str, trace_id: bytes) -> Trace | None:
-        out = self._post("/internal/find", {"tenant": tenant, "trace_id": trace_id.hex()})
+        """Find over the binary plane: the response body is the raw
+        otlp-proto trace (Accept negotiation keeps old servers working)."""
+        from ..wire import otlp_pb
+
+        headers = {"Content-Type": "application/json",
+                   "Accept": "application/x-protobuf"}
+        if self.token:
+            headers["X-Tempo-Internal-Token"] = self.token
+        req = urllib.request.Request(
+            self.addr + "/internal/find",
+            data=json.dumps({"tenant": tenant, "trace_id": trace_id.hex()}).encode(),
+            headers=headers,
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as r:
+                body = r.read()
+                if r.headers.get("Content-Type", "").startswith("application/x-protobuf"):
+                    return otlp_pb.decode_trace(body) if body else None
+                out = json.loads(body) if body else {}
+        except urllib.error.HTTPError as e:
+            _raise_http_error(e)
+        except urllib.error.URLError as e:
+            raise TransportError(0, str(e))
         if not out.get("trace"):
             return None
         return otlp_json.loads(out["trace"])
@@ -115,9 +167,28 @@ def client_registry(local: dict, token: str = ""):
 # ----------------------------------------------------------- server side
 
 
-def handle_internal(app, path: str, payload: dict):
+def handle_internal(app, path: str, payload: dict, raw_body: bytes = b"",
+                    content_type: str = "", accept: str = ""):
     """Dispatch one internal-API request against this process's modules.
-    Returns (status, dict)."""
+    Returns (status, dict) or (status, (bytes, content_type)) for binary
+    responses. Binary-frame bodies (transport/frames.py) arrive with
+    payload={} and the raw body; JSON bodies keep the legacy dict path
+    so mixed-version fleets interoperate."""
+    from . import frames
+
+    binary = content_type.startswith(frames.CONTENT_TYPE)
+    if binary and path == "/internal/push":
+        if app.ingester is None:
+            return 404, {"error": f"target {app.cfg.target} hosts no ingester"}
+        tenant, batch = frames.decode_push(raw_body)
+        app.ingester.push_segments(tenant, batch)
+        return 200, {}
+    if binary and path == "/internal/genpush":
+        if app.generator is None:
+            return 404, {"error": f"target {app.cfg.target} hosts no generator"}
+        tenant, traces = frames.decode_traces(raw_body)
+        app.generator.push(tenant, traces)
+        return 200, {}
     if path == "/internal/jobs/poll":
         # remote querier pull (services/worker.py) against this frontend
         if app.frontend is None:
@@ -152,6 +223,11 @@ def handle_internal(app, path: str, payload: dict):
         return 200, {}
     if path == "/internal/find":
         tr = app.ingester.find_trace_by_id(tenant, bytes.fromhex(payload["trace_id"]))
+        if "application/x-protobuf" in accept:
+            from ..wire import otlp_pb
+
+            body = otlp_pb.encode_trace(tr) if tr is not None else b""
+            return 200, (body, "application/x-protobuf")
         return 200, {"trace": otlp_json.dumps(tr) if tr is not None else None}
     if path == "/internal/search":
         from ..db.search import request_from_dict, response_to_dict

@@ -1,0 +1,142 @@
+"""Binary internal data plane: length-prefixed proto-wire frames.
+
+The round-3 internal API shipped segment bytes as JSON + base64 -- a
+self-acknowledged 33% framing tax. The payloads already ARE compact
+proto-wire bytes (wire/segment.py), so the data plane now frames them
+raw: a tiny varint-framed envelope (<1% overhead), optionally
+zstd-compressed as a whole body. The reference's internal plane is
+gRPC + snappy (cmd/tempo/app/config.go:103-106); same shape, no gRPC
+dependency on the hot path.
+
+Envelope (all integers uvarint unless noted):
+
+    magic "TBF1" | flags u8 (bit0: zstd body follows)   -- outer header
+    body := tenant_len tenant | n_records | records...
+    push record  := 16B trace id | start_s | end_s | seg_len | seg bytes
+    trace record := blob_len | otlp-proto Trace bytes
+
+JSON + base64 remains accepted server-side for mixed-version fleets;
+clients of this version always send frames.
+"""
+
+from __future__ import annotations
+
+import io
+
+MAGIC = b"TBF1"
+CONTENT_TYPE = "application/x-tempo-frames"
+_FLAG_ZSTD = 1
+_COMPRESS_MIN = 4 << 10
+
+
+def _w_uvarint(out: io.BytesIO, v: int) -> None:
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out.write(bytes([b | 0x80]))
+        else:
+            out.write(bytes([b]))
+            return
+
+
+def _r_uvarint(b: memoryview, pos: int) -> tuple[int, int]:
+    shift = v = 0
+    while True:
+        byte = b[pos]
+        pos += 1
+        v |= (byte & 0x7F) << shift
+        if not (byte & 0x80):
+            return v, pos
+        shift += 7
+        if shift > 63:
+            raise ValueError("uvarint overflow")
+
+
+def _seal(body: bytes) -> bytes:
+    if len(body) >= _COMPRESS_MIN:
+        import zstandard
+
+        comp = zstandard.ZstdCompressor(level=1).compress(body)
+        if len(comp) < len(body):
+            return MAGIC + bytes([_FLAG_ZSTD]) + comp
+    return MAGIC + bytes([0]) + body
+
+
+def _open(data: bytes) -> memoryview:
+    if data[:4] != MAGIC:
+        raise ValueError("not a tempo binary frame body (bad magic)")
+    flags = data[4]
+    body = data[5:]
+    if flags & _FLAG_ZSTD:
+        import zstandard
+
+        body = zstandard.ZstdDecompressor().decompress(body)
+    return memoryview(body)
+
+
+def encode_push(tenant: str, batch) -> bytes:
+    """batch: [(trace_id 16B, start_s, end_s, segment bytes)]."""
+    out = io.BytesIO()
+    t = tenant.encode()
+    _w_uvarint(out, len(t))
+    out.write(t)
+    _w_uvarint(out, len(batch))
+    for tid, s, e, seg in batch:
+        out.write(tid.rjust(16, b"\x00")[:16])
+        _w_uvarint(out, int(s))
+        _w_uvarint(out, int(e))
+        _w_uvarint(out, len(seg))
+        out.write(seg)
+    return _seal(out.getvalue())
+
+
+def decode_push(data: bytes) -> tuple[str, list[tuple[bytes, int, int, bytes]]]:
+    b = _open(data)
+    n, pos = _r_uvarint(b, 0)
+    tenant = bytes(b[pos : pos + n]).decode()
+    pos += n
+    count, pos = _r_uvarint(b, pos)
+    batch = []
+    for _ in range(count):
+        tid = bytes(b[pos : pos + 16])
+        pos += 16
+        s, pos = _r_uvarint(b, pos)
+        e, pos = _r_uvarint(b, pos)
+        ln, pos = _r_uvarint(b, pos)
+        batch.append((tid, s, e, bytes(b[pos : pos + ln])))
+        pos += ln
+    return tenant, batch
+
+
+def encode_traces(tenant: str, traces) -> bytes:
+    """traces: wire-model Trace objects, shipped as otlp-proto blobs
+    (the generator forward path)."""
+    from ..wire import otlp_pb
+
+    out = io.BytesIO()
+    t = tenant.encode()
+    _w_uvarint(out, len(t))
+    out.write(t)
+    blobs = [otlp_pb.encode_trace(tr) for tr in traces]
+    _w_uvarint(out, len(blobs))
+    for blob in blobs:
+        _w_uvarint(out, len(blob))
+        out.write(blob)
+    return _seal(out.getvalue())
+
+
+def decode_traces(data: bytes) -> tuple[str, list]:
+    from ..wire import otlp_pb
+
+    b = _open(data)
+    n, pos = _r_uvarint(b, 0)
+    tenant = bytes(b[pos : pos + n]).decode()
+    pos += n
+    count, pos = _r_uvarint(b, pos)
+    traces = []
+    for _ in range(count):
+        ln, pos = _r_uvarint(b, pos)
+        traces.append(otlp_pb.decode_trace(bytes(b[pos : pos + ln])))
+        pos += ln
+    return tenant, traces

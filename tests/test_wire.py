@@ -123,3 +123,41 @@ def test_make_traces_sorted_unique():
     ids = [tid for tid, _ in traces]
     assert ids == sorted(ids)
     assert len(set(ids)) == 20
+
+
+def test_binary_frames_roundtrip_and_overhead():
+    """The internal data plane's frame envelope (transport/frames.py):
+    lossless round-trip and <5% framing overhead on realistic segment
+    batches (VERDICT r3 item 8; replaces JSON+base64's 33% tax)."""
+    import os as _os
+
+    from tempo_tpu.transport import frames
+    from tempo_tpu.util.testdata import make_traces
+    from tempo_tpu.wire import segment
+
+    batch = []
+    for tid, t in make_traces(50, seed=3, n_spans=8):
+        batch.append((tid, 100, 200, segment.segment_for_write(t, 100, 200)))
+    body = frames.encode_push("tenant-1", batch)
+    tenant, got = frames.decode_push(body)
+    assert tenant == "tenant-1" and got == [
+        (tid.rjust(16, b"\x00")[:16], s, e, seg) for tid, s, e, seg in batch
+    ]
+    payload = sum(len(seg) for _, _, _, seg in batch)
+    # overhead vs raw segment bytes (compressible bodies may come out
+    # SMALLER than the payload thanks to whole-body zstd)
+    assert len(body) < payload * 1.05, (len(body), payload)
+
+    # incompressible segments still stay under the envelope budget
+    rnd = [( _os.urandom(16), 1, 2, _os.urandom(4096)) for _ in range(64)]
+    body2 = frames.encode_push("t", rnd)
+    payload2 = sum(len(s) for _, _, _, s in rnd)
+    assert len(body2) < payload2 * 1.05
+    assert frames.decode_push(body2)[1] == rnd
+
+    # trace blobs: generator forward path
+    traces = [t for _, t in make_traces(5, seed=4, n_spans=3)]
+    tb = frames.encode_traces("t2", traces)
+    t2, got_traces = frames.decode_traces(tb)
+    assert t2 == "t2" and len(got_traces) == 5
+    assert got_traces[0].span_count() == traces[0].span_count()
