@@ -114,7 +114,14 @@ class RawBackend(abc.ABC):
         import json
         import time as _time
 
-        data = self.read(tenant, block_id, META_NAME)
+        try:
+            data = self.read(tenant, block_id, META_NAME)
+        except DoesNotExist:
+            # idempotent: a concurrent compactor/retention sweep (or a
+            # grace-window double-selection) already marked this block
+            if self.has_object(tenant, block_id, COMPACTED_META_NAME):
+                return
+            raise
         try:
             d = json.loads(data)
             d["compacted_at_unix"] = _time.time()

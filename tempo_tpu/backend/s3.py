@@ -31,14 +31,30 @@ class SigV4:
         self.region = region
         self.service = service
 
+    def signing_key(self, datestamp: str) -> bytes:
+        """kSigning = HMAC-chain over date/region/service/aws4_request
+        (verified against the AWS-documented derived-key vector in
+        tests/test_backend_auth.py)."""
+
+        def _hmac(key: bytes, msg: str) -> bytes:
+            return hmac.new(key, msg.encode(), hashlib.sha256).digest()
+
+        k = _hmac(("AWS4" + self.secret_key).encode(), datestamp)
+        k = _hmac(k, self.region)
+        k = _hmac(k, self.service)
+        return _hmac(k, "aws4_request")
+
     def sign(self, method: str, url: str, payload_sha: str, now=None) -> dict[str, str]:
         u = urllib.parse.urlsplit(url)
         now = now or datetime.datetime.now(datetime.timezone.utc)
         amz_date = now.strftime("%Y%m%dT%H%M%SZ")
         datestamp = now.strftime("%Y%m%d")
+        # sort by encoded NAME then value (spec order) -- sorting whole
+        # "k=v" strings would misorder names that prefix each other
+        # ('%' < '=' puts "a%20x=" before "a=1")
         canonical_query = "&".join(
-            sorted(
-                f"{urllib.parse.quote(k, safe='')}={urllib.parse.quote(v, safe='')}"
+            f"{k}={v}" for k, v in sorted(
+                (urllib.parse.quote(k, safe=""), urllib.parse.quote(v, safe=""))
                 for k, v in urllib.parse.parse_qsl(u.query, keep_blank_values=True)
             )
         )
@@ -57,13 +73,7 @@ class SigV4:
              hashlib.sha256(canonical.encode()).hexdigest()]
         )
 
-        def _hmac(key: bytes, msg: str) -> bytes:
-            return hmac.new(key, msg.encode(), hashlib.sha256).digest()
-
-        k = _hmac(("AWS4" + self.secret_key).encode(), datestamp)
-        k = _hmac(k, self.region)
-        k = _hmac(k, self.service)
-        k = _hmac(k, "aws4_request")
+        k = self.signing_key(datestamp)
         sig = hmac.new(k, to_sign.encode(), hashlib.sha256).hexdigest()
         return {
             "x-amz-content-sha256": payload_sha,

@@ -21,6 +21,11 @@ from ..backend.base import DoesNotExist, RawBackend, TENANT_INDEX_NAME
 from ..block.meta import BlockMeta
 
 
+# blocks compacted this recently stay searchable: rides out the
+# lister-vs-swap race window (two poll cycles' worth by default)
+COMPACTED_GRACE_S = 60.0
+
+
 class Blocklist:
     def __init__(self):
         self._lock = threading.Lock()
@@ -155,6 +160,19 @@ class Poller:
                 if meta is None:
                     continue
                 (compacted if is_compacted else metas).append(meta)
+        # swap-window grace: a scan can race a compaction/rewrite swap --
+        # the directory listing snapshot predates the REPLACEMENT block
+        # while the old one is already marked compacted, so the torn view
+        # would drop both. Recently-compacted blocks therefore stay
+        # SEARCHABLE for a grace window; trace-level dedupe makes the
+        # double visibility harmless (the reference keeps serving
+        # compacted blocks until queriers complete two poll cycles).
+        now = time.time()
+        ids = {m.block_id for m in metas}
+        metas += [m for m in compacted
+                  if m.compacted_at_unix
+                  and now - m.compacted_at_unix < COMPACTED_GRACE_S
+                  and m.block_id not in ids]
         metas.sort(key=lambda m: m.block_id)
         compacted.sort(key=lambda m: m.block_id)
         return metas, compacted

@@ -74,6 +74,11 @@ def select_jobs(tenant: str, metas: list[BlockMeta], cfg: CompactorConfig, now: 
     now = now or time.time()
     buckets: dict[tuple, list[BlockMeta]] = {}
     for m in metas:
+        if m.compacted_at_unix:
+            # the blocklist keeps freshly-compacted blocks SEARCHABLE for
+            # a grace window (blocklist.COMPACTED_GRACE_S); they are not
+            # compaction inputs -- their data already lives in an output
+            continue
         if m.compaction_level >= cfg.max_compaction_level:
             continue
         end_s = m.end_time_unix_nano / 1e9
@@ -234,6 +239,8 @@ def apply_retention(
     out = RetentionResult()
     cutoff_ns = (now - cfg.retention_s) * 1e9
     for m in metas:
+        if m.compacted_at_unix:
+            continue  # grace-listed (already compacted): not a live block
         if m.end_time_unix_nano < cutoff_ns and owns(m.block_id):
             backend.mark_compacted(tenant, m.block_id)
             out.marked.append(m.block_id)

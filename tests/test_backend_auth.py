@@ -1,0 +1,205 @@
+"""Known-answer + tamper tests for the hand-rolled backend auth
+(VERDICT r3 item 9): the signing code is exactly the code most likely
+to break against a real endpoint, and the in-process fakes used to
+accept anything. Now:
+
+* SigV4 key derivation checks against the AWS-documented derived-key
+  vector (docs.aws.amazon.com "Example: derived signing key");
+* the canonical request / string-to-sign layouts check against
+  hand-transcribed spec literals;
+* a server-side verifier (reused by the fake S3) recomputes the
+  signature from the RAW request with the shared secret -- a corrupted
+  string-to-sign must fail it.
+"""
+
+import hashlib
+import hmac
+import urllib.parse
+
+from tempo_tpu.backend.azure import AzureBackend
+from tempo_tpu.backend.s3 import SigV4
+
+
+def test_sigv4_derived_key_vector():
+    """AWS documentation vector ("Example: derived signing key"):
+    20150830/us-east-1/iam with the documented example secret must
+    produce the documented kSigning hex -- an ABSOLUTE check of the
+    HMAC chain against AWS, not against our own code."""
+    s = SigV4("AKIDEXAMPLE", "wJalrXUtnFEMI/K7MDENG+bPxRfiCYEXAMPLEKEY",
+              "us-east-1", service="iam")
+    assert s.signing_key("20150830").hex() == (
+        "c4afb1cc5771d871763a393e44b703571b55cc28424d1a5e86da6ed3c154a4b9"
+    )
+
+
+def test_sigv4_canonical_layout():
+    """The canonical request and string-to-sign must follow the spec
+    byte-for-byte: sorted+encoded query, lowercase sorted headers each
+    ending in \\n, signed-headers list, payload hash; string-to-sign =
+    algorithm, date, scope, hash(canonical)."""
+    import datetime
+
+    s = SigV4("AK", "SK", "us-east-1")
+    now = datetime.datetime(2015, 8, 30, 12, 36, 0, tzinfo=datetime.timezone.utc)
+    payload_sha = hashlib.sha256(b"").hexdigest()
+    url = "https://examplebucket.s3.amazonaws.com/key%20name?b=2&a=1&a%20x="
+    hdrs = s.sign("GET", url, payload_sha, now=now)
+
+    canonical = "\n".join([
+        "GET",
+        "/key%20name",
+        "a=1&a%20x=&b=2",  # sorted, strict percent-encoding, blank kept
+        "host:examplebucket.s3.amazonaws.com\n"
+        f"x-amz-content-sha256:{payload_sha}\n"
+        "x-amz-date:20150830T123600Z\n",
+        "host;x-amz-content-sha256;x-amz-date",
+        payload_sha,
+    ])
+    to_sign = "\n".join([
+        "AWS4-HMAC-SHA256",
+        "20150830T123600Z",
+        "20150830/us-east-1/s3/aws4_request",
+        hashlib.sha256(canonical.encode()).hexdigest(),
+    ])
+    expect = hmac.new(s.signing_key("20150830"), to_sign.encode(),
+                      hashlib.sha256).hexdigest()
+    assert hdrs["Authorization"].endswith(f"Signature={expect}")
+    assert "SignedHeaders=host;x-amz-content-sha256;x-amz-date" in hdrs["Authorization"]
+    assert hdrs["x-amz-date"] == "20150830T123600Z"
+
+
+def verify_sigv4_request(method: str, path_qs: str, headers: dict,
+                         secret_key: str) -> bool:
+    """Server-side SigV4 verification from a RAW request (independent
+    reconstruction: parses Authorization for scope + signed headers,
+    rebuilds the canonical request from what was actually sent). Used
+    by the fake S3 server so a signer/sender mismatch fails tests."""
+    auth = headers.get("Authorization", "")
+    if not auth.startswith("AWS4-HMAC-SHA256 "):
+        return False
+    fields = dict(p.strip().split("=", 1) for p in
+                  auth[len("AWS4-HMAC-SHA256 "):].split(","))
+    scope = fields["Credential"].split("/", 1)[1]  # date/region/service/aws4_request
+    datestamp, region, service, _ = scope.split("/")
+    signed = fields["SignedHeaders"].split(";")
+    u = urllib.parse.urlsplit(path_qs)
+    lower = {k.lower(): v for k, v in headers.items()}
+    canonical_query = "&".join(
+        f"{k}={v}" for k, v in sorted(
+            (urllib.parse.quote(k, safe=""), urllib.parse.quote(v, safe=""))
+            for k, v in urllib.parse.parse_qsl(u.query, keep_blank_values=True)
+        )
+    )
+    canonical = "\n".join([
+        method, u.path or "/", canonical_query,
+        "".join(f"{h}:{lower[h]}\n" for h in signed),
+        ";".join(signed),
+        lower.get("x-amz-content-sha256", ""),
+    ])
+    to_sign = "\n".join([
+        "AWS4-HMAC-SHA256", lower["x-amz-date"], scope,
+        hashlib.sha256(canonical.encode()).hexdigest(),
+    ])
+
+    def _h(key: bytes, msg: str) -> bytes:
+        return hmac.new(key, msg.encode(), hashlib.sha256).digest()
+
+    k = _h(("AWS4" + secret_key).encode(), datestamp)
+    k = _h(k, region)
+    k = _h(k, service)
+    k = _h(k, "aws4_request")
+    expect = hmac.new(k, to_sign.encode(), hashlib.sha256).hexdigest()
+    return hmac.compare_digest(expect, fields["Signature"])
+
+
+def test_sigv4_server_side_verify_and_tamper():
+    s = SigV4("AK", "wrong-or-right", "us-east-1")
+    url = "https://h.example/bkt/obj%20x?versions=&prefix=a%2Fb"
+    sha = hashlib.sha256(b"body").hexdigest()
+    hdrs = s.sign("PUT", url, sha)
+    u = urllib.parse.urlsplit(url)
+    req_headers = {"Host": u.netloc, **hdrs}
+    path_qs = u.path + ("?" + u.query if u.query else "")
+    assert verify_sigv4_request("PUT", path_qs, req_headers, "wrong-or-right")
+    # tampered string-to-sign: ANY canonical ingredient change must fail
+    assert not verify_sigv4_request("GET", path_qs, req_headers, "wrong-or-right")
+    assert not verify_sigv4_request("PUT", u.path + "?prefix=a%2Fc", req_headers,
+                                    "wrong-or-right")
+    assert not verify_sigv4_request("PUT", path_qs, req_headers, "other-secret")
+    bad = dict(req_headers)
+    bad["x-amz-content-sha256"] = hashlib.sha256(b"evil").hexdigest()
+    assert not verify_sigv4_request("PUT", path_qs, bad, "wrong-or-right")
+
+
+def test_azure_shared_key_layout_and_tamper():
+    """SharedKey string-to-sign layout per the Azure spec: VERB + 12
+    header slots + canonicalized x-ms-* headers + canonicalized
+    resource; corrupting any slot changes the MAC."""
+    import base64
+
+    key = base64.b64encode(b"0123456789abcdef0123456789abcdef").decode()
+    be = AzureBackend.__new__(AzureBackend)
+    be.account = "acct"
+    be.key = base64.b64decode(key)
+
+    url = "https://acct.blob.core.windows.net/container/blob%20name?comp=list&restype=container"
+    hdrs = {"x-ms-version": "2021-08-06",
+            "x-ms-date": "Sun, 30 Aug 2015 12:36:00 GMT"}
+    auth = be._sign("PUT", url, hdrs, "42", "application/octet-stream")
+    assert auth.startswith("SharedKey acct:")
+
+    # 2015-04-05 scheme: VERB, Content-Encoding, Content-Language,
+    # Content-Length, Content-MD5, Content-Type, Date (empty: x-ms-date
+    # wins), If-Modified-Since, If-Match, If-None-Match,
+    # If-Unmodified-Since, Range; then canonicalized x-ms-* headers
+    # (lexicographic, one per line) and the canonicalized resource
+    # (/account/path + sorted decoded query as name:value lines)
+    to_sign = "\n".join([
+        "PUT", "", "", "42", "", "application/octet-stream",
+        "", "", "", "", "", "",
+    ]) + "\n" + (
+        "x-ms-date:Sun, 30 Aug 2015 12:36:00 GMT\n"
+        "x-ms-version:2021-08-06\n"
+    ) + "/acct/container/blob%20name\ncomp:list\nrestype:container"
+    import hmac as _hmac
+
+    expect = base64.b64encode(
+        _hmac.new(be.key, to_sign.encode(), hashlib.sha256).digest()).decode()
+    assert auth == f"SharedKey acct:{expect}", (
+        "SharedKey string-to-sign drifted from the spec layout"
+    )
+    # tamper: different verb / length -> different MAC
+    assert be._sign("GET", url, hdrs, "42", "application/octet-stream") != auth
+    assert be._sign("PUT", url, hdrs, "43", "application/octet-stream") != auth
+
+
+def test_fake_s3_rejects_bad_signature(tmp_path):
+    """End to end: the verifying fake S3 403s a client signing with the
+    wrong secret (the 'deliberately corrupted string-to-sign fails'
+    acceptance check), while the right secret round-trips."""
+    import threading
+    from http.server import ThreadingHTTPServer
+
+    from test_backend_s3 import _FakeS3
+
+    import tempo_tpu.backend.s3 as s3mod
+
+    _FakeS3.store = {}
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _FakeS3)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    url = f"http://127.0.0.1:{srv.server_port}"
+    try:
+        good = s3mod.S3Backend(url, "bkt", access_key="ak", secret_key="sk")
+        good.write("t", "b1", "meta.json", b"ok")
+        assert good.read("t", "b1", "meta.json") == b"ok"
+
+        bad = s3mod.S3Backend(url, "bkt", access_key="ak", secret_key="WRONG")
+    
+        import pytest as _pytest
+
+        with _pytest.raises(Exception):
+            bad.write("t", "b2", "meta.json", b"x")
+        # and nothing landed
+        assert not any(k.endswith("b2/meta.json") for k in _FakeS3.store)
+    finally:
+        srv.shutdown()

@@ -25,9 +25,24 @@ TENANT = "t-s3"
 class _FakeS3(BaseHTTPRequestHandler):
     store: dict[str, bytes] = {}
     lock = threading.Lock()
+    secret = "sk"  # must match the client credentials in these tests
 
     def log_message(self, *a):
         pass
+
+    def _check_auth(self) -> bool:
+        """Recompute SigV4 from the RAW request with the shared secret
+        (tests/test_backend_auth.verify_sigv4_request): a signer bug now
+        fails every backend test instead of passing silently."""
+        from test_backend_auth import verify_sigv4_request
+
+        if verify_sigv4_request(self.command, self.path, dict(self.headers),
+                                self.secret):
+            return True
+        self.send_response(403)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+        return False
 
     def _key(self):
         # /bucket/key...
@@ -36,6 +51,8 @@ class _FakeS3(BaseHTTPRequestHandler):
         return parts[1] if len(parts) > 1 else ""
 
     def do_PUT(self):
+        if not self._check_auth():
+            return
         ln = int(self.headers.get("Content-Length", 0))
         body = self.rfile.read(ln)
         with self.lock:
@@ -45,6 +62,8 @@ class _FakeS3(BaseHTTPRequestHandler):
         self.end_headers()
 
     def do_DELETE(self):
+        if not self._check_auth():
+            return
         with self.lock:
             self.store.pop(self._key(), None)
         self.send_response(204)
@@ -52,6 +71,8 @@ class _FakeS3(BaseHTTPRequestHandler):
         self.end_headers()
 
     def do_GET(self):
+        if not self._check_auth():
+            return
         u = urlparse(self.path)
         q = {k: v[0] for k, v in parse_qs(u.query).items()}
         if q.get("list-type") == "2":
@@ -162,7 +183,7 @@ def test_tempodb_over_s3(s3, tmp_path):
 
 def test_open_backend_s3(s3_server):
     b = open_backend({"backend": "s3", "endpoint": s3_server, "bucket": "bkt",
-                      "access_key": "a", "secret_key": "s"})
+                      "access_key": "ak", "secret_key": "sk"})
     b.write("t", "b1", "meta.json", b"x")
     assert b.read("t", "b1", "meta.json") == b"x"  # through the cache wrapper
     assert isinstance(b, CachedBackend)

@@ -506,7 +506,11 @@ def test_cli_block_ops(tmp_path, capsys):
                   backend=LocalBackend(store))
     db2.poll_now()
     metas = db2.blocklist.metas("t1")
-    assert len(metas) == 1 and metas[0].block_id != bid
+    # the freshly-compacted original stays listed for the swap-window
+    # grace (blocklist.COMPACTED_GRACE_S); exactly one LIVE replacement
+    live = [m for m in metas if not m.compacted_at_unix]
+    assert len(live) == 1 and live[0].block_id != bid
+    assert all(m.block_id == bid for m in metas if m.compacted_at_unix)
     got = db2.find_trace_by_id("t1", tid)
     assert got is not None and got.span_count() == before.span_count()
     # attributes survive the lossless conversion
@@ -584,3 +588,38 @@ def test_tres_membership_axis(tmp_path):
                        cblk.pack.read("span.res_idx"), cblk.meta.total_traces)
     for n in ("tres.res", "tres.nspans", "trace.tres_off"):
         np.testing.assert_array_equal(cblk.pack.read(n), want2[n])
+
+
+def test_grace_listed_blocks_not_reprocessed(tmp_path):
+    """Freshly-compacted blocks stay searchable for the grace window but
+    must NOT be re-selected as compaction inputs or re-marked by
+    retention (their data already lives in an output block)."""
+    import time as _time
+
+    backend = MemBackend()
+    db = TempoDB(TempoDBConfig(wal_path=str(tmp_path / "w1")), backend=backend)
+    db.cfg.compaction.min_input_blocks = 2
+    all_traces = make_traces(20, seed=51, n_spans=4)
+    db.write_block(TENANT, all_traces[:10])
+    db.write_block(TENANT, all_traces[10:])
+    db.compact_once(TENANT)
+    # a DIFFERENT process's poller (fresh db) sees the graced inputs --
+    # the compacting process removes them locally and immediately
+    db = TempoDB(TempoDBConfig(wal_path=str(tmp_path / "w2")), backend=backend)
+    db.poll_now()
+    metas = db.blocklist.metas(TENANT)
+    graced = [m for m in metas if m.compacted_at_unix]
+    assert graced, "grace window should keep the inputs listed"
+
+    # compaction sweep: graced blocks are never inputs again
+    jobs = comp.select_jobs(TENANT, metas, db.cfg.compaction)
+    for j in jobs:
+        assert not any(m.compacted_at_unix for m in j.blocks)
+
+    # retention sweep over grace-listed metas must not crash or re-mark
+    db.cfg.compaction.retention_s = 0  # everything "expired"
+    res = db.retention_once(TENANT)
+    assert all(m.block_id not in res.marked for m in graced)
+
+    # idempotent mark: double-marking is a no-op, not DoesNotExist
+    db.backend.mark_compacted(TENANT, graced[0].block_id)

@@ -1,0 +1,272 @@
+"""Race/stress harness: N-thread hammers with invariant checks over the
+shared mutable structures (VERDICT r3 item 10 -- the repo's analog of
+the reference running every test under `go test -race`; round 3's
+shared-zstd-context corruption proved the class of bug is real).
+
+Each test runs a bounded burst (thousands of ops across 8 threads),
+asserting structural invariants the whole way and re-raising any worker
+exception; CPython's GIL doesn't serialize the C-extension sections
+(zstd, numpy, native lib), which is exactly where the round-3 race
+lived."""
+
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+from tempo_tpu.backend import MemBackend
+from tempo_tpu.db import TempoDB, TempoDBConfig
+from tempo_tpu.util.testdata import make_traces
+
+TENANT = "t-race"
+N_THREADS = 8
+
+
+def _hammer(fns, seconds=1.5):
+    """Run callables round-robin across N_THREADS for a time budget,
+    re-raising the first worker exception."""
+    stop = time.monotonic() + seconds
+    errors: list[BaseException] = []
+
+    def run(i):
+        k = 0
+        try:
+            while time.monotonic() < stop and not errors:
+                fns[(i + k) % len(fns)]()
+                k += 1
+        except BaseException as e:  # pragma: no cover - failure path
+            errors.append(e)
+        return k
+
+    with ThreadPoolExecutor(max_workers=N_THREADS) as ex:
+        done = list(ex.map(run, range(N_THREADS)))
+    if errors:
+        raise errors[0]
+    assert sum(done) > 100  # the hammer actually hammered
+
+
+def test_blocklist_concurrent_update_read(tmp_path):
+    """Pollers, ingesters (add), compactors (remove) and readers share
+    the blocklist; list invariants must hold at every observation."""
+    from tempo_tpu.block.meta import BlockMeta
+    from tempo_tpu.db.blocklist import Blocklist
+
+    bl = Blocklist()
+    base = [BlockMeta.new(TENANT) for _ in range(50)]
+    bl.update(TENANT, add=base)
+    lock = threading.Lock()
+    live_ids = {m.block_id: m for m in base}
+
+    def reader():
+        metas = bl.metas(TENANT)
+        ids = [m.block_id for m in metas]
+        assert len(ids) == len(set(ids)), "duplicate metas observed"
+
+    def adder():
+        m = BlockMeta.new(TENANT)
+        with lock:
+            live_ids[m.block_id] = m
+        bl.update(TENANT, add=[m])
+
+    def remover():
+        with lock:
+            if len(live_ids) <= 10:
+                return
+            bid, m = next(iter(live_ids.items()))
+            del live_ids[bid]
+        bl.update(TENANT, remove=[bid])
+
+    def repoller():
+        with lock:
+            snapshot = list(live_ids.values())
+        bl.apply_poll_results({TENANT: snapshot}, {TENANT: []})
+
+    _hammer([reader, adder, remover, repoller, reader])
+    # convergence: one final poll must reconcile exactly to live state
+    with lock:
+        snapshot = list(live_ids.values())
+    bl.apply_poll_results({TENANT: snapshot}, {TENANT: []})
+    assert {m.block_id for m in bl.metas(TENANT)} == set(
+        m.block_id for m in snapshot
+    )
+
+
+def test_columnpack_cache_concurrent_readers(tmp_path):
+    """The column ARRAY cache + chunk cache (round-4 code) under
+    concurrent full reads, group reads and cache-pressure eviction:
+    every read must return exactly the written bytes."""
+    from tempo_tpu.block import build_block_from_traces
+    from tempo_tpu.block.reader import BackendBlock
+
+    be = MemBackend()
+    meta = build_block_from_traces(be, TENANT, make_traces(300, seed=7, n_spans=12))
+    blk = BackendBlock(be, meta)
+    pack = blk.pack
+    pack.CHUNK_CACHE_BYTES = 64 << 10  # force constant eviction churn
+    names = [n for n in pack.names() if pack.has(n)]
+    want = {n: pack.read(n).copy() for n in names}
+    span_groups = list(range(pack.axes["span"].n_groups))
+
+    def full_reader():
+        n = names[np.random.randint(len(names))]
+        got = pack.read(n)
+        assert np.array_equal(got, want[n]), f"corrupt read of {n}"
+
+    def group_reader():
+        if not span_groups:
+            return
+        col = "span.name_id"
+        g = int(np.random.randint(len(span_groups)))
+        got = pack.read_groups(col, [g])
+        off = pack.axes["span"].offsets
+        assert np.array_equal(got, want[col][off[g]:off[g + 1]])
+
+    def read_all_reader():
+        out = pack.read_all()
+        assert np.array_equal(out["trace.span_off"], want["trace.span_off"])
+
+    _hammer([full_reader, group_reader, full_reader, read_all_reader])
+
+
+def test_ring_kv_concurrent_membership():
+    """Heartbeats, joins, leaves and readers hammer one ring KV; the
+    token map must always reflect a consistent instance set (no ghost
+    instances, tokens sorted/unique per observation)."""
+    from tempo_tpu.ring.ring import InMemoryKV, Lifecycler, Ring
+
+    kv = InMemoryKV()
+    ring = Ring(kv, "r", replication_factor=2)
+    cyclers = [Lifecycler(kv, "r", f"inst-{i}", addr=f"http://h{i}") for i in range(4)]
+    for c in cyclers:
+        c.join()
+    extra_lock = threading.Lock()
+    extra: list = []
+    counter = [100]
+
+    def heartbeat():
+        cyclers[int(np.random.randint(len(cyclers)))].heartbeat()
+
+    def join_leave():
+        from tempo_tpu.ring.ring import Lifecycler as L
+
+        with extra_lock:
+            counter[0] += 1
+            name = f"ghost-{counter[0]}"
+        lc = L(kv, "r", name, addr="http://ghost")
+        lc.heartbeat()
+        lc.leave()
+
+    def reader():
+        descs = ring.healthy_instances()
+        ids = [d.instance_id for d in descs]
+        assert len(ids) == len(set(ids))
+        if descs:
+            rs = ring.get(12345)
+            assert rs.instances and all(d.instance_id for d in rs.instances)
+            assert len({d.instance_id for d in rs.instances}) == len(rs.instances)
+
+    def shard_reader():
+        descs = ring.healthy_instances()
+        if descs:
+            rs = ring.shuffle_shard(TENANT, 2)
+            assert len({d.instance_id for d in rs}) == len(rs)
+
+    _hammer([heartbeat, join_leave, reader, shard_reader])
+    # all ghosts left: only the 4 long-lived instances remain healthy
+    alive = {d.instance_id for d in ring.healthy_instances()}
+    assert alive == {f"inst-{i}" for i in range(4)}
+
+
+def test_gossip_store_concurrent_merge():
+    """Concurrent local updates + remote-state merges on one gossip
+    store must never resurrect removed instances or lose newer
+    heartbeats (transport/gossip.py merge rules)."""
+    from tempo_tpu.ring.ring import InstanceDesc, InstanceState
+    from tempo_tpu.transport.gossip import GossipKV
+
+    kv = GossipKV("127.0.0.1:0", seeds=[])
+    try:
+        t0 = time.time()
+
+        def writer():
+            i = int(np.random.randint(8))
+            kv.update("ring", InstanceDesc(
+                instance_id=f"w-{i}", addr="http://x", state=InstanceState.ACTIVE,
+                tokens=[1, 2, 3], heartbeat_ts=time.time()))
+
+        def merger():
+            # a peer snapshot carrying older heartbeats must not clobber
+            state = kv._snapshot()
+            time.sleep(0.001)
+            kv._merge(state)
+
+        def remover_rejoiner():
+            kv.remove("ring", "flapper")
+            kv.update("ring", InstanceDesc(
+                instance_id="flapper", addr="http://f",
+                state=InstanceState.ACTIVE, tokens=[9], heartbeat_ts=time.time()))
+
+        def reader():
+            insts = kv.get_all("ring")
+            for d in insts.values():
+                assert d.heartbeat_ts >= t0 - 1
+
+        _hammer([writer, merger, remover_rejoiner, reader], seconds=1.2)
+        # no removed-but-present ghosts; recent writers all present
+        insts = kv.get_all("ring")
+        for i in range(8):
+            assert f"w-{i}" in insts
+    finally:
+        kv.close()
+
+
+def test_search_during_block_swap(tmp_path):
+    """Concurrent searches while rewrite-block swaps the block out from
+    under them (the CLI's documented exposure window): every search
+    either sees the old or the new block, never an error or a torn
+    result."""
+    from tempo_tpu.backend.local import LocalBackend
+    from tempo_tpu.cli.__main__ import main as cli
+    from tempo_tpu.db.search import SearchRequest
+
+    store = str(tmp_path / "store")
+    db = TempoDB(
+        TempoDBConfig(backend={"backend": "local", "path": store},
+                      wal_path=str(tmp_path / "wal")),
+        backend=LocalBackend(store),
+    )
+    traces = make_traces(80, seed=11, n_spans=6)
+    db.write_block(TENANT, traces)
+    db.poll_now()
+    want = len(db.search(TENANT, SearchRequest(limit=1000)).traces)
+    stop = threading.Event()
+    errors: list = []
+
+    def searcher():
+        while not stop.is_set():
+            try:
+                db.poll_now()
+                got = len(db.search(TENANT, SearchRequest(limit=1000)).traces)
+                assert got == want, f"torn result: {got} != {want}"
+            except Exception as e:
+                errors.append(e)
+                return
+
+    threads = [threading.Thread(target=searcher) for _ in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        for codec in ("gzip", "zstd", "zstd"):
+            live = [m for m in db.blocklist.metas(TENANT)
+                    if not m.compacted_at_unix]  # grace keeps old ones listed
+            cli(["--backend.path", store, "rewrite-block", TENANT,
+                 live[0].block_id, "--codec", codec])
+            db.poll_now()
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+    if errors:
+        raise errors[0]
