@@ -1,0 +1,222 @@
+"""Load/soak rig: sustained concurrent write+query against a running
+tempo-tpu instance with latency assertions.
+
+The reference drives this with k6 (integration/bench/smoke_test.js:
+checked write/read cycles; stress_test_write_path.js: sustained write
+load with p95 thresholds). Same contract here, self-contained: N writer
+threads push OTLP batches, M reader threads search + read back ids
+that were written, for a wall-clock duration; the run FAILS (exit 1)
+on any error, any written-then-unfindable trace at the end, or
+latency percentiles above thresholds.
+
+Run against a live instance:
+    python soak.py --target http://localhost:3200 --duration 60
+or self-hosted (spawns a single-binary app on an ephemeral port):
+    python soak.py --self-host --duration 30
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import sys
+import threading
+import time
+import urllib.request
+
+
+def _pct(xs, p):
+    if not xs:
+        return 0.0
+    xs = sorted(xs)
+    return xs[min(len(xs) - 1, int(len(xs) * p))]
+
+
+class Soak:
+    def __init__(self, target: str, writers: int, readers: int,
+                 spans_per_trace: int = 8, batch: int = 5):
+        self.target = target.rstrip("/")
+        self.writers = writers
+        self.readers = readers
+        self.spans_per_trace = spans_per_trace
+        self.batch = batch
+        self.lock = threading.Lock()
+        self.written: list[str] = []  # hex trace ids pushed (ack'd)
+        self.errors: list[str] = []
+        self.write_lat: list[float] = []
+        self.search_lat: list[float] = []
+        self.find_lat: list[float] = []
+        self.found = 0
+        self.not_yet = 0  # reads that raced ingest (retried at the end)
+
+    def _post(self, path: str, body: bytes, ctype="application/json"):
+        req = urllib.request.Request(self.target + path, data=body,
+                                     headers={"Content-Type": ctype})
+        with urllib.request.urlopen(req, timeout=15) as r:
+            return r.read()
+
+    def _get(self, path: str):
+        with urllib.request.urlopen(self.target + path, timeout=15) as r:
+            return r.read()
+
+    def _trace_json(self, tid_hex: str, svc: str) -> dict:
+        now = time.time_ns()
+        spans = []
+        for i in range(self.spans_per_trace):
+            spans.append({
+                "traceId": tid_hex,
+                "spanId": os.urandom(8).hex(),
+                "parentSpanId": spans[0]["spanId"] if spans else "",
+                "name": f"op-{i % 4}",
+                "startTimeUnixNano": str(now + i * 1000),
+                "endTimeUnixNano": str(now + i * 1000 + 2_000_000),
+                "attributes": [{"key": "i", "value": {"intValue": str(i)}}],
+            })
+        return {"resourceSpans": [{
+            "resource": {"attributes": [
+                {"key": "service.name", "value": {"stringValue": svc}}]},
+            "scopeSpans": [{"scope": {"name": "soak"}, "spans": spans}],
+        }]}
+
+    def _writer(self, stop: threading.Event, wid: int):
+        svc = f"soak-svc-{wid % 4}"
+        while not stop.is_set():
+            ids = [os.urandom(16).hex() for _ in range(self.batch)]
+            try:
+                t0 = time.perf_counter()
+                for tid in ids:
+                    self._post("/v1/traces",
+                               json.dumps(self._trace_json(tid, svc)).encode())
+                dt = (time.perf_counter() - t0) / self.batch
+                with self.lock:
+                    self.write_lat.append(dt)
+                    self.written.extend(ids)
+            except Exception as e:
+                with self.lock:
+                    self.errors.append(f"write: {type(e).__name__}: {e}")
+                return
+
+    def _reader(self, stop: threading.Event):
+        while not stop.is_set():
+            with self.lock:
+                tid = random.choice(self.written) if self.written else None
+            try:
+                if tid is not None:
+                    t0 = time.perf_counter()
+                    try:
+                        self._get(f"/api/traces/{tid}")
+                        with self.lock:
+                            self.found += 1
+                    except urllib.error.HTTPError as e:
+                        if e.code != 404:
+                            raise
+                        with self.lock:  # raced ingest; re-checked at the end
+                            self.not_yet += 1
+                    with self.lock:
+                        self.find_lat.append(time.perf_counter() - t0)
+                t0 = time.perf_counter()
+                self._get("/api/search?tags=service.name%3Dsoak-svc-1&limit=20")
+                with self.lock:
+                    self.search_lat.append(time.perf_counter() - t0)
+            except Exception as e:
+                with self.lock:
+                    self.errors.append(f"read: {type(e).__name__}: {e}")
+                return
+            time.sleep(0.01)
+
+    def run(self, duration_s: float, settle_s: float = 5.0,
+            max_write_p95_s: float = 1.0, max_search_p95_s: float = 3.0,
+            sample_verify: int = 50) -> dict:
+        stop = threading.Event()
+        threads = [threading.Thread(target=self._writer, args=(stop, i), daemon=True)
+                   for i in range(self.writers)]
+        threads += [threading.Thread(target=self._reader, args=(stop,), daemon=True)
+                    for _ in range(self.readers)]
+        for t in threads:
+            t.start()
+        time.sleep(duration_s)
+        stop.set()
+        for t in threads:
+            t.join(timeout=20)
+
+        time.sleep(settle_s)  # let live traces become queryable
+        missing = []
+        sample = random.sample(self.written, min(sample_verify, len(self.written)))
+        for tid in sample:
+            try:
+                self._get(f"/api/traces/{tid}")
+            except Exception:
+                missing.append(tid)
+
+        report = {
+            "written": len(self.written),
+            "found_live": self.found,
+            "raced_reads": self.not_yet,
+            "errors": self.errors[:5],
+            "error_count": len(self.errors),
+            "write_p50_ms": round(_pct(self.write_lat, 0.5) * 1e3, 2),
+            "write_p95_ms": round(_pct(self.write_lat, 0.95) * 1e3, 2),
+            "search_p50_ms": round(_pct(self.search_lat, 0.5) * 1e3, 2),
+            "search_p95_ms": round(_pct(self.search_lat, 0.95) * 1e3, 2),
+            "find_p50_ms": round(_pct(self.find_lat, 0.5) * 1e3, 2),
+            "verified_sample": len(sample),
+            "missing_after_settle": missing,
+        }
+        report["ok"] = (
+            not self.errors
+            and not missing
+            and len(self.written) > 0
+            and _pct(self.write_lat, 0.95) <= max_write_p95_s
+            and _pct(self.search_lat, 0.95) <= max_search_p95_s
+        )
+        return report
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser("tempo-tpu-soak")
+    ap.add_argument("--target", default="", help="base URL of a running instance")
+    ap.add_argument("--self-host", action="store_true",
+                    help="spawn a single-binary app for the run")
+    ap.add_argument("--duration", type=float, default=30.0)
+    ap.add_argument("--writers", type=int, default=4)
+    ap.add_argument("--readers", type=int, default=2)
+    ap.add_argument("--write-p95", type=float, default=1.0)
+    ap.add_argument("--search-p95", type=float, default=3.0)
+    args = ap.parse_args(argv)
+
+    proc = None
+    target = args.target
+    if args.self_host or not target:
+        import subprocess
+        import tempfile
+
+        port = random.randint(20000, 40000)
+        d = tempfile.mkdtemp(prefix="soak-")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "tempo_tpu.services.app", "--target=all",
+             f"--storage.path={d}", f"--http.port={port}"],
+            env={**os.environ, "JAX_PLATFORMS": os.environ.get("JAX_PLATFORMS", "cpu")},
+        )
+        target = f"http://127.0.0.1:{port}"
+        for _ in range(100):
+            try:
+                urllib.request.urlopen(target + "/ready", timeout=1)
+                break
+            except Exception:
+                time.sleep(0.2)
+
+    try:
+        soak = Soak(target, args.writers, args.readers)
+        report = soak.run(args.duration, max_write_p95_s=args.write_p95,
+                          max_search_p95_s=args.search_p95)
+        print(json.dumps(report, indent=2))
+        return 0 if report["ok"] else 1
+    finally:
+        if proc is not None:
+            proc.terminate()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

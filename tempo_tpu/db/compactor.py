@@ -248,8 +248,13 @@ def apply_retention(
         if m.compacted_at_unix:
             # delete only once compacted_retention has elapsed SINCE THE
             # MARK (retention.go:70-90): a block compacted long after its
-            # data window still gets its full grace period
-            expired = m.compacted_at_unix < now - cfg.compacted_retention_s
+            # data window still gets its full grace period. Never sooner
+            # than the blocklist's searchable-grace window, or a search
+            # could open a block retention just deleted.
+            from .blocklist import COMPACTED_GRACE_S
+
+            hold = max(cfg.compacted_retention_s, COMPACTED_GRACE_S)
+            expired = m.compacted_at_unix < now - hold
         else:  # legacy marker without a stamp: fall back to block end
             expired = m.end_time_unix_nano < (
                 now - cfg.retention_s - cfg.compacted_retention_s

@@ -1,0 +1,165 @@
+"""tempo-query: Jaeger gRPC storage-plugin shim.
+
+The reference's cmd/tempo-query is a separate process implementing the
+Jaeger storage API (jaeger.storage.v1.SpanReaderPlugin) against Tempo's
+HTTP API, so a stock Jaeger query/UI uses Tempo as its span store. Same
+shape here: a grpc generic handler (no generated stubs, like
+services/otlp_grpc.py) serving GetTrace / FindTraces / GetServices /
+GetOperations / FindTraceIDs, translating to /api/traces + /api/search
++ /api/search/tag/... on a tempo-tpu instance and encoding jaeger
+api_v2 spans with wire/jaeger_pb.
+
+Run: python -m tempo_tpu.tempo_query --backend http://host:3200 --grpc-port 7777
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.parse
+import urllib.request
+
+from .wire import jaeger_pb, otlp_json
+
+_SERVICE = "jaeger.storage.v1.SpanReaderPlugin"
+
+
+class TempoHTTP:
+    """Minimal client for the public query API."""
+
+    def __init__(self, base: str, tenant: str = "", timeout: float = 10.0):
+        self.base = base.rstrip("/")
+        self.tenant = tenant
+        self.timeout = timeout
+
+    def _get(self, path: str) -> bytes:
+        req = urllib.request.Request(self.base + path)
+        if self.tenant:
+            req.add_header("X-Scope-OrgID", self.tenant)
+        with urllib.request.urlopen(req, timeout=self.timeout) as r:
+            return r.read()
+
+    def trace(self, trace_id_hex: str):
+        try:
+            return otlp_json.loads(self._get(f"/api/traces/{trace_id_hex}"))
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                return None
+            raise
+
+    def search(self, q: dict) -> list[str]:
+        out = json.loads(self._get("/api/search?" + urllib.parse.urlencode(q)))
+        return [t["traceID"] for t in out.get("traces", [])]
+
+    def tag_values(self, tag: str) -> list[str]:
+        out = json.loads(self._get(f"/api/search/tag/{urllib.parse.quote(tag)}/values"))
+        return out.get("tagValues", [])
+
+
+class JaegerStoragePlugin:
+    def __init__(self, tempo: TempoHTTP):
+        self.tempo = tempo
+        self.requests = 0
+
+    # each handler: bytes in -> iterator/bytes out (server streaming for
+    # the span-chunk responses, unary for the rest)
+    def get_trace(self, request: bytes, context):
+        self.requests += 1
+        tid = jaeger_pb.decode_get_trace_request(request)
+        tr = self.tempo.trace(tid.hex())
+        if tr is None:
+            import grpc
+
+            context.abort(grpc.StatusCode.NOT_FOUND, "trace not found")
+        yield jaeger_pb.encode_spans_chunk(tr)
+
+    def find_traces(self, request: bytes, context):
+        self.requests += 1
+        q = jaeger_pb.decode_find_traces_request(request)
+        params: dict = {"limit": q["num_traces"] or 20}
+        tags = dict(q["tags"])
+        if q["service_name"]:
+            tags["service.name"] = q["service_name"]
+        if q["operation_name"]:
+            tags["name"] = q["operation_name"]
+        if tags:
+            params["tags"] = " ".join(f"{k}={v}" for k, v in tags.items())
+        if q["start_min"]:
+            params["start"] = q["start_min"]
+        if q["start_max"]:
+            params["end"] = q["start_max"]
+        if q["dur_min_ms"]:
+            params["minDuration"] = q["dur_min_ms"] / 1000.0
+        if q["dur_max_ms"]:
+            params["maxDuration"] = q["dur_max_ms"] / 1000.0
+        for tid_hex in self.tempo.search(params):
+            tr = self.tempo.trace(tid_hex)
+            if tr is not None:
+                yield jaeger_pb.encode_spans_chunk(tr)
+
+    def find_trace_ids(self, request: bytes, context) -> bytes:
+        self.requests += 1
+        q = jaeger_pb.decode_find_traces_request(request)
+        params: dict = {"limit": q["num_traces"] or 20}
+        if q["service_name"]:
+            params["tags"] = f"service.name={q['service_name']}"
+        ids = self.tempo.search(params)
+        return jaeger_pb.encode_trace_ids_response([bytes.fromhex(t) for t in ids])
+
+    def get_services(self, request: bytes, context) -> bytes:
+        self.requests += 1
+        return jaeger_pb.encode_services_response(
+            self.tempo.tag_values("service.name"))
+
+    def get_operations(self, request: bytes, context) -> bytes:
+        self.requests += 1
+        return jaeger_pb.encode_operations_response(self.tempo.tag_values("name"))
+
+    def capabilities(self, request: bytes, context) -> bytes:
+        return b""  # no archive/streaming writer capabilities
+
+
+def serve(tempo: TempoHTTP, port: int = 0, host: str = "127.0.0.1",
+          max_workers: int = 8):
+    """-> (grpc server, bound port, plugin)."""
+    from concurrent import futures
+
+    import grpc
+
+    plugin = JaegerStoragePlugin(tempo)
+    handler = grpc.method_handlers_generic_handler(_SERVICE, {
+        "GetTrace": grpc.unary_stream_rpc_method_handler(plugin.get_trace),
+        "FindTraces": grpc.unary_stream_rpc_method_handler(plugin.find_traces),
+        "FindTraceIDs": grpc.unary_unary_rpc_method_handler(plugin.find_trace_ids),
+        "GetServices": grpc.unary_unary_rpc_method_handler(plugin.get_services),
+        "GetOperations": grpc.unary_unary_rpc_method_handler(plugin.get_operations),
+    })
+    cap_handler = grpc.method_handlers_generic_handler(
+        "jaeger.storage.v1.PluginCapabilities",
+        {"Capabilities": grpc.unary_unary_rpc_method_handler(plugin.capabilities)},
+    )
+    server = grpc.server(futures.ThreadPoolExecutor(
+        max_workers=max_workers, thread_name_prefix="tempo-query"))
+    server.add_generic_rpc_handlers((handler, cap_handler))
+    bound = server.add_insecure_port(f"{host}:{port}")
+    server.start()
+    return server, bound, plugin
+
+
+def main(argv=None):
+    import argparse
+
+    ap = argparse.ArgumentParser("tempo-query")
+    ap.add_argument("--backend", required=True, help="tempo-tpu base URL")
+    ap.add_argument("--grpc-port", type=int, default=7777)
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--tenant", default="")
+    args = ap.parse_args(argv)
+    server, port, _ = serve(TempoHTTP(args.backend, tenant=args.tenant),
+                            args.grpc_port, args.host)
+    print(f"tempo-query (jaeger storage grpc) listening on {args.host}:{port}",
+          flush=True)
+    server.wait_for_termination()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,191 @@
+"""Jaeger api_v2 model + storage_v1 plugin protobuf codec.
+
+The reference ships cmd/tempo-query: a separate process implementing
+the Jaeger gRPC storage-plugin API so a stock Jaeger UI/query can use
+Tempo as its backing store. The wire surface (hand-rolled over
+wire/pbwire, like every proto in this repo):
+
+* jaeger.api_v2.Span / Process / KeyValue / SpanRef with
+  google.protobuf Timestamp/Duration fields
+  (model/proto/model.proto field numbering);
+* storage_v1 requests (GetTraceRequest, TraceQueryParameters) and the
+  streamed SpansResponseChunk / GetServicesResponse /
+  GetOperationsResponse (plugin/storage/grpc/proto/storage.proto).
+"""
+
+from __future__ import annotations
+
+from . import pbwire as w
+from .model import Resource, Span, Trace
+
+# KeyValue v_type enum
+_VT_STRING, _VT_BOOL, _VT_INT64, _VT_FLOAT64, _VT_BINARY = 0, 1, 2, 3, 4
+
+
+def _ts(buf: bytearray, field_no: int, unix_nano: int) -> None:
+    """google.protobuf.Timestamp {seconds=1, nanos=2}."""
+    m = bytearray()
+    w.write_varint_field(m, 1, unix_nano // 1_000_000_000)
+    w.write_varint_field(m, 2, unix_nano % 1_000_000_000)
+    w.write_message_field(buf, field_no, bytes(m))
+
+
+def _dur(buf: bytearray, field_no: int, nanos: int) -> None:
+    """google.protobuf.Duration {seconds=1, nanos=2}."""
+    m = bytearray()
+    w.write_varint_field(m, 1, nanos // 1_000_000_000)
+    w.write_varint_field(m, 2, nanos % 1_000_000_000)
+    w.write_message_field(buf, field_no, bytes(m))
+
+
+def _kv(key: str, value) -> bytes:
+    m = bytearray()
+    w.write_string_field(m, 1, key)
+    if isinstance(value, bool):
+        w.write_varint_field(m, 2, _VT_BOOL)
+        w.write_varint_field(m, 4, 1 if value else 0)
+    elif isinstance(value, int):
+        w.write_varint_field(m, 2, _VT_INT64)
+        w.write_varint_field(m, 5, value & 0xFFFFFFFFFFFFFFFF)
+    elif isinstance(value, float):
+        w.write_varint_field(m, 2, _VT_FLOAT64)
+        w.write_double_field(m, 6, value)
+    elif isinstance(value, bytes):
+        w.write_varint_field(m, 2, _VT_BINARY)
+        w.write_bytes_field(m, 7, value)
+    else:
+        w.write_varint_field(m, 2, _VT_STRING)
+        w.write_string_field(m, 3, str(value))
+    return bytes(m)
+
+
+def encode_span(sp: Span, res: Resource) -> bytes:
+    """One jaeger.api_v2.Span with an inlined Process (field 10)."""
+    m = bytearray()
+    w.write_bytes_field(m, 1, sp.trace_id.rjust(16, b"\x00")[:16])
+    w.write_bytes_field(m, 2, sp.span_id.rjust(8, b"\x00")[:8])
+    w.write_string_field(m, 3, sp.name)
+    p = sp.parent_span_id
+    if p and p.strip(b"\x00"):
+        ref = bytearray()  # SpanRef {trace_id=1, span_id=2, ref_type=3 CHILD_OF=0}
+        w.write_bytes_field(ref, 1, sp.trace_id.rjust(16, b"\x00")[:16])
+        w.write_bytes_field(ref, 2, p.rjust(8, b"\x00")[:8])
+        w.write_message_field(m, 4, bytes(ref))
+    _ts(m, 6, sp.start_unix_nano)
+    _dur(m, 7, max(0, sp.end_unix_nano - sp.start_unix_nano))
+    for k, v in sp.attrs.items():
+        w.write_message_field(m, 8, _kv(k, v))
+    if sp.kind:
+        kind_names = {1: "internal", 2: "server", 3: "client", 4: "producer", 5: "consumer"}
+        w.write_message_field(m, 8, _kv("span.kind", kind_names.get(int(sp.kind), "unspecified")))
+    if int(sp.status_code) == 2:
+        w.write_message_field(m, 8, _kv("error", True))
+    proc = bytearray()  # Process {service_name=1, tags=2}
+    w.write_string_field(proc, 1, res.service_name or "unknown")
+    for k, v in res.attrs.items():
+        if k != "service.name":
+            w.write_message_field(proc, 2, _kv(k, v))
+    w.write_message_field(m, 10, bytes(proc))
+    return bytes(m)
+
+
+def encode_spans_chunk(trace: Trace) -> bytes:
+    """SpansResponseChunk {repeated Span spans=1}."""
+    m = bytearray()
+    for rs in trace.resource_spans:
+        for ss in rs.scope_spans:
+            for sp in ss.spans:
+                w.write_message_field(m, 1, encode_span(sp, rs.resource))
+    return bytes(m)
+
+
+def encode_services_response(services: list[str]) -> bytes:
+    m = bytearray()
+    for s in services:
+        w.write_string_field(m, 1, s)
+    return bytes(m)
+
+
+def encode_operations_response(operations: list[str]) -> bytes:
+    """GetOperationsResponse: legacy operationNames=1 AND Operation
+    messages=2 (name=1) so both client generations work."""
+    m = bytearray()
+    for op in operations:
+        w.write_string_field(m, 1, op)
+    for op in operations:
+        sub = bytearray()
+        w.write_string_field(sub, 1, op)
+        w.write_message_field(m, 2, bytes(sub))
+    return bytes(m)
+
+
+def encode_trace_ids_response(trace_ids: list[bytes]) -> bytes:
+    m = bytearray()
+    for tid in trace_ids:
+        w.write_bytes_field(m, 1, tid.rjust(16, b"\x00")[:16])
+    return bytes(m)
+
+
+# ------------------------------------------------------------- requests
+
+
+def decode_get_trace_request(data: bytes) -> bytes:
+    """GetTraceRequest {trace_id bytes=1} -> 16-byte id."""
+    for field_no, wt, val in w.iter_fields(data):
+        if field_no == 1 and wt == 2:
+            return bytes(val).rjust(16, b"\x00")[:16]
+    return b"\x00" * 16
+
+
+def _decode_ts(data: bytes) -> int:
+    sec = nanos = 0
+    for field_no, wt, val in w.iter_fields(data):
+        if field_no == 1:
+            sec = int(val)
+        elif field_no == 2:
+            nanos = int(val)
+    return sec * 1_000_000_000 + nanos
+
+
+def decode_find_traces_request(data: bytes) -> dict:
+    """FindTracesRequest {TraceQueryParameters query=1} -> dict with
+    service_name, operation_name, tags, start_min/max (unix s),
+    duration_min/max (ms), num_traces."""
+    out = {"service_name": "", "operation_name": "", "tags": {},
+           "start_min": 0, "start_max": 0, "dur_min_ms": 0, "dur_max_ms": 0,
+           "num_traces": 20}
+    for field_no, wt, val in w.iter_fields(data):
+        if field_no != 1 or wt != 2:
+            continue
+        for f, wt2, v in w.iter_fields(bytes(val)):
+            if f == 1:
+                out["service_name"] = bytes(v).decode()
+            elif f == 2:
+                out["operation_name"] = bytes(v).decode()
+            elif f == 3:  # map<string,string> entry {key=1, value=2}
+                k = vv = ""
+                for mf, _, mv in w.iter_fields(bytes(v)):
+                    if mf == 1:
+                        k = bytes(mv).decode()
+                    elif mf == 2:
+                        vv = bytes(mv).decode()
+                if k:
+                    out["tags"][k] = vv
+            elif f == 4:
+                out["start_min"] = _decode_ts(bytes(v)) // 1_000_000_000
+            elif f == 5:
+                out["start_max"] = -(-_decode_ts(bytes(v)) // 1_000_000_000)
+            elif f == 6:
+                out["dur_min_ms"] = _decode_ts(bytes(v)) // 1_000_000
+            elif f == 7:
+                out["dur_max_ms"] = _decode_ts(bytes(v)) // 1_000_000
+            elif f == 8:
+                out["num_traces"] = int(v)
+    return out
+
+
+def decode_get_operations_request(data: bytes) -> str:
+    for field_no, wt, val in w.iter_fields(data):
+        if field_no == 1 and wt == 2:
+            return bytes(val).decode()
+    return ""

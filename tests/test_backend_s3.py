@@ -55,6 +55,17 @@ class _FakeS3(BaseHTTPRequestHandler):
             return
         ln = int(self.headers.get("Content-Length", 0))
         body = self.rfile.read(ln)
+        # the signed payload hash must also MATCH the actual body, or a
+        # signer hashing the wrong bytes would still pass (real S3:
+        # XAmzContentSHA256Mismatch)
+        import hashlib as _hashlib
+
+        want = self.headers.get("x-amz-content-sha256", "")
+        if want != _hashlib.sha256(body).hexdigest():
+            self.send_response(403)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+            return
         with self.lock:
             self.store[self._key()] = body
         self.send_response(200)
