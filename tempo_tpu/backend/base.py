@@ -121,6 +121,18 @@ class RawBackend(abc.ABC):
             # grace-window double-selection) already marked this block
             if self.has_object(tenant, block_id, COMPACTED_META_NAME):
                 return
+            # parts of a compound block carry no meta.json of their own
+            # (their meta lives in the compound's parts list): marking
+            # one writes a minimal stamped marker the poller's expansion
+            # understands (db/blocklist.py). ONLY parts: fabricating a
+            # marker for an ordinary missing block would resurrect a
+            # fully-deleted block as a phantom grace-searchable entry.
+            if "/" in block_id:
+                data = json.dumps({"block_id": block_id, "tenant_id": tenant,
+                                   "compacted_at_unix": _time.time()},
+                                  separators=(",", ":")).encode()
+                self.write(tenant, block_id, COMPACTED_META_NAME, data)
+                return
             raise
         try:
             d = json.loads(data)

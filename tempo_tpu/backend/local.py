@@ -140,12 +140,22 @@ class LocalBackend(RawBackend):
 
     # ---- delete
     def delete_block(self, tenant: str, block_id: str) -> None:
+        import shutil
+
         bdir = os.path.join(self.path, tenant, block_id)
         if not os.path.isdir(bdir):
             return
-        for name in os.listdir(bdir):
-            os.unlink(os.path.join(bdir, name))
-        os.rmdir(bdir)
+
+        def _onexc(fn, path, exc):
+            # concurrent deletion is fine; anything else (permissions,
+            # read-only fs) must surface -- retention reports this block
+            # as reclaimed based on the outcome
+            if not isinstance(exc, FileNotFoundError):
+                raise exc
+
+        # recursive: compound blocks (db/concat_compact.py) nest their
+        # parts as subdirectories of the block dir
+        shutil.rmtree(bdir, onexc=_onexc)
 
     def delete_tenant_object(self, tenant: str, name: str) -> None:
         try:

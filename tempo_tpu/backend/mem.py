@@ -75,8 +75,12 @@ class MemBackend(RawBackend):
             return sorted(out)
 
     def delete_block(self, tenant, block_id):
+        # prefix-recursive like the cloud backends: a compound block's
+        # parts live under "<block_id>/pN" ids
         with self._lock:
-            for key in [k for k in self._objects if k[0] == tenant and k[1] == block_id]:
+            for key in [k for k in self._objects
+                        if k[0] == tenant and (
+                            k[1] == block_id or k[1].startswith(block_id + "/"))]:
                 del self._objects[key]
 
     def delete_tenant_object(self, tenant, name):

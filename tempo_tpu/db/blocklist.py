@@ -141,25 +141,43 @@ class Poller:
         metas: list[BlockMeta] = []
         compacted: list[BlockMeta] = []
 
-        def read_one(block_id: str):
+        def read_one(block_id: str) -> list[tuple[BlockMeta, bool]]:
             try:
-                return BlockMeta.from_json(self.backend.read(tenant, block_id, "meta.json")), False
+                raw = self.backend.read(tenant, block_id, "meta.json")
             except DoesNotExist:
-                pass
-            try:
-                return (
-                    BlockMeta.from_json(self.backend.read(tenant, block_id, "meta.compacted.json")),
-                    True,
-                )
-            except DoesNotExist:
-                return None, False
+                try:
+                    return [(BlockMeta.from_json(
+                        self.backend.read(tenant, block_id, "meta.compacted.json")), True)]
+                except DoesNotExist:
+                    return []
+            doc = json.loads(raw)
+            if doc.get("version") == "vtpu1c":
+                # compound block (db/concat_compact.py): expand into its
+                # per-part metas so every downstream path sees ordinary
+                # blocks; fully-consumed compounds age out as a whole
+                from .concat_compact import expand_compound
+
+                pairs = expand_compound(self.backend, tenant, doc)
+                newest = max((m.compacted_at_unix for m, c in pairs if c),
+                             default=0.0)
+                if (pairs and all(c for _, c in pairs)
+                        and time.time() - newest > COMPACTED_GRACE_S):
+                    # collapse to the whole only after every part's
+                    # searchable-grace window has lapsed: a part consumed
+                    # seconds ago may still be covering for a rewrite
+                    # output the lister's snapshot predates
+                    whole = BlockMeta.from_json(json.dumps(
+                        {k: v for k, v in doc.items() if k != "parts"}).encode())
+                    whole.compacted_at_unix = newest
+                    return [(whole, True)]
+                return pairs
+            return [(BlockMeta.from_json(raw), False)]
 
         block_ids = self.backend.blocks(tenant)
         with ThreadPoolExecutor(max_workers=self.concurrency) as pool:
-            for meta, is_compacted in pool.map(read_one, block_ids):
-                if meta is None:
-                    continue
-                (compacted if is_compacted else metas).append(meta)
+            for pairs in pool.map(read_one, block_ids):
+                for meta, is_compacted in pairs:
+                    (compacted if is_compacted else metas).append(meta)
         # swap-window grace: a scan can race a compaction/rewrite swap --
         # the directory listing snapshot predates the REPLACEMENT block
         # while the old one is already marked compacted, so the torn view
@@ -172,7 +190,10 @@ class Poller:
         metas += [m for m in compacted
                   if m.compacted_at_unix
                   and now - m.compacted_at_unix < COMPACTED_GRACE_S
-                  and m.block_id not in ids]
+                  and m.block_id not in ids
+                  # compound WHOLES (vtpu1c) are containers, not openable
+                  # blocks; their parts got their own grace individually
+                  and m.version != "vtpu1c"]
         metas.sort(key=lambda m: m.block_id)
         compacted.sort(key=lambda m: m.block_id)
         return metas, compacted

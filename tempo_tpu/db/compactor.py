@@ -66,6 +66,11 @@ class CompactorConfig:
     # the same way: snappy on the write-heavy v2 path); ingest-time
     # block builds keep level 3
     zstd_level: int = 1
+    # level-0 jobs whose inputs are ALL at most this size take the
+    # no-decode concat path into a compound block (concat_compact.py);
+    # 0 disables. Parts surface one level up, where the ordinary
+    # columnar rewrite merges them for real.
+    concat_small_input_bytes: int = 8 << 20
 
 
 def select_jobs(tenant: str, metas: list[BlockMeta], cfg: CompactorConfig, now: float | None = None) -> list[CompactionJob]:
@@ -152,9 +157,20 @@ def _union_input_blooms(blocks: list[BackendBlock]):
 
 
 def compact(backend: RawBackend, job: CompactionJob, cfg: CompactorConfig) -> CompactionResult:
-    """Run one compaction job: the columnar numpy-level merge
-    (columnar_compact.py) by default, falling back to the wire-level
+    """Run one compaction job: no-decode CONCAT for all-small level-0
+    inputs (concat_compact.py: verbatim copies into one compound block
+    at backend IO speed), the columnar numpy-level merge
+    (columnar_compact.py) otherwise, falling back to the wire-level
     merge only when the inputs aren't columnar-mergeable."""
+    if (cfg.concat_small_input_bytes
+            and len(job.blocks) >= 2
+            and all(m.compaction_level == 0
+                    and m.version == "vtpu1"
+                    and 0 < m.size_bytes <= cfg.concat_small_input_bytes
+                    for m in job.blocks)):
+        from .concat_compact import compact_concat
+
+        return compact_concat(backend, job, cfg)
     if cfg.columnar:
         from .columnar_compact import UnsupportedColumnar, compact_columnar
 
@@ -259,6 +275,12 @@ def apply_retention(
             expired = m.end_time_unix_nano < (
                 now - cfg.retention_s - cfg.compacted_retention_s
             ) * 1e9
+        if "/" in m.block_id:
+            # a PART of a compound block: its bytes are reclaimed when
+            # the whole compound ages out (deleting a part's directory
+            # would also delete its compacted marker, resurrecting the
+            # part as a live-but-dataless block at the next poll)
+            continue
         if expired and owns(m.block_id):
             backend.delete_block(tenant, m.block_id)
             out.deleted.append(m.block_id)

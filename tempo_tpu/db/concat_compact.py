@@ -1,0 +1,126 @@
+"""No-decode compaction: concatenate small input blocks into one
+COMPOUND block by verbatim object copy.
+
+The many-tiny-blocks compaction shape (level 0 after an ingest burst)
+is dominated by per-block fixed costs and pays a full decode -> K-way
+merge -> re-encode even though the data is hours old at most. The
+reference's answer is a row-level no-decode parquet copy
+(vparquet/compactor.go:23-80); this design takes the same idea to its
+limit for the first level: a compound block is K verbatim part copies
+under one block id --
+
+    tenant/<cid>/meta.json              version "vtpu1c", parts list
+    tenant/<cid>/p0/{data.vtpu,dict.vtpu,bloom-*}
+    tenant/<cid>/p1/...
+
+so "compacting" 100 small blocks is 100 object copies at backend IO
+speed (no decompress, no merge, no re-encode) and the block COUNT drops
+100x for the poller/bloom/job machinery. The poller EXPANDS a compound
+into its per-part BlockMetas (block_id "cid/p3"), so every downstream
+path -- search, find, sharding, further compaction -- sees ordinary
+vtpu1 blocks and needs zero changes. Parts come out one level up, where
+the ordinary columnar rewrite merges them into genuinely sorted big
+blocks; a part consumed by that rewrite gets its own compacted marker
+(backend.mark_compacted handles meta-less parts), and a compound whose
+parts are all consumed ages out as a whole.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import uuid
+
+from ..backend.base import COMPACTED_META_NAME, DoesNotExist, RawBackend
+from ..block.builder import BLOOM_PREFIX, DATA_NAME, DICT_NAME
+from ..block.meta import BlockMeta
+
+COMPOUND_VERSION = "vtpu1c"
+
+
+def part_metas(compound_doc: dict) -> list[BlockMeta]:
+    return [BlockMeta.from_json(json.dumps(p).encode())
+            for p in compound_doc.get("parts", [])]
+
+
+def compact_concat(backend: RawBackend, job, cfg) -> "CompactionResult":
+    """Concatenate the job's input blocks into one compound block."""
+    from .compactor import CompactionResult
+
+    tenant = job.tenant
+    cid = str(uuid.uuid4())
+    out_level = max(m.compaction_level for m in job.blocks) + 1
+    parts: list[dict] = []
+    result = CompactionResult()
+    for i, m in enumerate(job.blocks):
+        part_id = f"{cid}/p{i}"
+        names = [DATA_NAME, DICT_NAME] + [
+            f"{BLOOM_PREFIX}{s}" for s in range(m.bloom_shards)
+        ]
+        for name in names:
+            try:
+                backend.write(tenant, part_id, name,
+                              backend.read(tenant, m.block_id, name))
+            except DoesNotExist:
+                if name == DATA_NAME:
+                    raise  # a block without data is corrupt; fail the job
+        pm = json.loads(m.to_json())
+        pm["block_id"] = part_id
+        pm["compaction_level"] = out_level
+        parts.append(pm)
+        result.traces_out += m.total_traces
+        result.spans_out += m.total_spans
+    doc = {
+        "version": COMPOUND_VERSION,
+        "block_id": cid,
+        "tenant_id": tenant,
+        "compaction_level": out_level,
+        "total_traces": result.traces_out,
+        "total_spans": result.spans_out,
+        "size_bytes": sum(m.size_bytes for m in job.blocks),
+        "created_at": time.time(),
+        "parts": parts,
+    }
+    # meta last: pollers never see a partial compound
+    backend.write(tenant, cid, "meta.json",
+                  json.dumps(doc, separators=(",", ":")).encode())
+    for m in job.blocks:
+        backend.mark_compacted(tenant, m.block_id)
+    result.new_blocks = part_metas(doc)
+    result.compacted_ids = [m.block_id for m in job.blocks]
+    return result
+
+
+# markers are monotonic (a part never un-compacts), so positive results
+# cache process-wide: a K-part compound costs K marker probes per poll
+# only while its parts are still being consumed
+_marker_cache: dict[tuple[str, str], float] = {}
+
+
+def expand_compound(backend: RawBackend, tenant: str, doc: dict):
+    """Compound meta doc -> [(part BlockMeta, is_compacted)]. A part is
+    compacted when the ordinary rewrite that consumed it left a marker
+    in its directory; transient marker-read errors conservatively keep
+    the part LIVE (searchable) for this cycle."""
+    out = []
+    for pm in doc.get("parts", []):
+        meta = BlockMeta.from_json(json.dumps(pm).encode())
+        key = (tenant, meta.block_id)
+        stamp = _marker_cache.get(key)
+        if stamp is None:
+            try:
+                marker = backend.read(tenant, meta.block_id, COMPACTED_META_NAME)
+            except DoesNotExist:
+                out.append((meta, False))
+                continue
+            except Exception:
+                out.append((meta, False))  # transient read error: stay live
+                continue
+            try:
+                stamp = float(json.loads(marker).get("compacted_at_unix", 0.0))
+            except (ValueError, TypeError):
+                stamp = time.time()  # corrupt marker: hold, don't age out
+            _marker_cache[key] = stamp = stamp or time.time()
+        meta.compacted_at_unix = stamp
+        out.append((meta, True))
+    return out

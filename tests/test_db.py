@@ -355,6 +355,7 @@ def test_compaction_unions_blooms_on_device(tmp_path, monkeypatch):
     from tempo_tpu.block.bloom import ShardedBloom
 
     db = _db(tmp_path)
+    db.cfg.compaction.concat_small_input_bytes = 0  # force the real merge
     a = make_traces(8, seed=31)
     b = make_traces(8, seed=32)
     db.write_block(TENANT, a)
@@ -623,3 +624,71 @@ def test_grace_listed_blocks_not_reprocessed(tmp_path):
 
     # idempotent mark: double-marking is a no-op, not DoesNotExist
     db.backend.mark_compacted(TENANT, graced[0].block_id)
+
+
+def test_concat_compound_compaction(tmp_path):
+    """Level-0 small blocks concat into a compound block (no-decode
+    verbatim copies); the poller expands it into part blocks that serve
+    find + search unchanged; the next level's columnar rewrite merges
+    the parts for real; a fully-consumed compound ages out whole."""
+    backend = MemBackend()
+    db = TempoDB(TempoDBConfig(wal_path=str(tmp_path / "w1")), backend=backend)
+    db.cfg.compaction.min_input_blocks = 2
+    db.cfg.compaction.max_input_blocks = 16
+    all_traces = make_traces(40, seed=61, n_spans=5)
+    for i in range(8):
+        db.write_block(TENANT, all_traces[i * 5:(i + 1) * 5])
+    db.poll_now()
+
+    res = db.compact_once(TENANT)
+    assert res and all("/" in m.block_id for r in res for m in r.new_blocks), \
+        "small level-0 inputs must take the concat path (parts have cid/pN ids)"
+    assert sum(r.traces_out for r in res) == 40
+
+    # a fresh process's poll expands the compound into parts
+    db2 = TempoDB(TempoDBConfig(wal_path=str(tmp_path / "w2")), backend=backend)
+    db2.poll_now()
+    parts = [m for m in db2.blocklist.metas(TENANT) if "/" in m.block_id]
+    assert len(parts) == 8 and all(m.compaction_level == 1 for m in parts)
+
+    for tid, original in all_traces[::7]:
+        got = db2.find_trace_by_id(TENANT, tid)
+        assert got is not None and got.span_count() == original.span_count()
+    resp = db2.search(TENANT, SearchRequest(limit=100))
+    assert len(resp.traces) == 40
+
+    # the next level merges parts with the real columnar rewrite
+    res2 = db2.compact_once(TENANT)
+    merged = [m for r in res2 for m in r.new_blocks]
+    assert merged and all("/" not in m.block_id for m in merged)
+    # freshly-consumed parts keep their searchable grace: the compound
+    # does NOT collapse to a whole yet
+    db3 = TempoDB(TempoDBConfig(wal_path=str(tmp_path / "w3")), backend=backend)
+    db3.poll_now()
+    assert not [m for m in db3.blocklist.compacted_metas(TENANT)
+                if m.version == "vtpu1c"]
+    import tempo_tpu.db.blocklist as BL
+
+    _g = BL.COMPACTED_GRACE_S
+    BL.COMPACTED_GRACE_S = 0.0  # grace lapsed: whole-collapse kicks in
+    db3.poll_now()
+    assert len(db3.search(TENANT, SearchRequest(limit=100)).traces) == 40
+    for tid, original in all_traces[::11]:
+        assert db3.find_trace_by_id(TENANT, tid) is not None
+
+    # every part consumed -> the compound lists as ONE compacted whole
+    wholes = [m for m in db3.blocklist.compacted_metas(TENANT)
+              if m.version == "vtpu1c"]
+    assert wholes, "fully-consumed compound should age out as a whole"
+
+    # retention deletes whole compounds (never individual parts)
+    try:
+        db3.cfg.compaction.compacted_retention_s = 0
+        res3 = db3.retention_once(TENANT)
+        assert wholes[0].block_id in res3.deleted
+        assert not any("/" in b for b in res3.deleted)
+        # the bytes are truly gone (recursive delete incl. parts)
+        assert not any(bid.startswith(wholes[0].block_id)
+                       for bid in backend.blocks(TENANT))
+    finally:
+        BL.COMPACTED_GRACE_S = _g

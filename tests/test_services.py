@@ -254,7 +254,9 @@ def test_compactor_ring_ownership(tmp_path):
     comp.run_once()
     assert comp.stats.blocks_compacted >= 2
     metas = db.blocklist.metas(TENANT)
-    assert len(metas) == 1 and metas[0].compaction_level == 1
+    # small level-0 inputs take the concat path: parts of ONE compound
+    assert len(metas) == 2 and all(m.compaction_level == 1 for m in metas)
+    assert len({m.block_id.split("/")[0] for m in metas}) == 1
     # a non-member instance owns nothing
     db2 = TempoDB(TempoDBConfig(wal_path=str(tmp_path / "dw2")), backend=MemBackend())
     db2.write_block(TENANT, make_traces(6, seed=3, n_spans=2, base_time_ns=now_ns))
