@@ -443,14 +443,31 @@ def search_block(
     else:
         n_rows = span_ax.n_rows if span_ax else 0
 
+    n_span_cols = max(1, sum(1 for n in needed if n.startswith(("span.", "sattr."))))
+
+    def _host_cheaper() -> bool:
+        """Auto mode weighs one device round trip against the host scan,
+        with the SAME cost model as search_blocks_fused: the tres plan
+        and cached host arrays scan at memory speed (serverless +
+        row-group shard jobs land here). Called only after the cheap
+        pinned/staged gate passed -- the RTT probe's first use inits the
+        device backend."""
+        host_cols_n, tres = _host_plan(blk, planned, groups_range)
+        if tres or all(blk.pack.has_cached_array(n) for n in host_cols_n
+                       if blk.pack.has(n)):
+            est_bytes = blk.meta.total_traces * 4 * 12 if tres else 0
+        else:
+            est_bytes = n_rows * 4 * n_span_cols
+        return est_bytes / _HOST_RATE_BPS * 1e3 < _link_rtt_ms()
+
     use_device = mode == "device" or (
         mode == "auto"
         and (getattr(blk, "device_pinned", False)
              or getattr(blk, "_staged_cache", None) is not None)
+        and not _host_cheaper()
     )
 
     if use_device:
-        n_span_cols = max(1, sum(1 for n in needed if n.startswith(("span.", "sattr."))))
         if n_rows * 4 * n_span_cols > _STREAM_MIN_STAGE_BYTES:
             # large scan: stream row-group chunks, prefetching the next
             # chunk's IO while the device filters the current one

@@ -631,6 +631,14 @@ def _metrics_text(app: App) -> str:
             f"{sum(len(i.live) for i in app.ingester.instances.values())}",
         ]
         lines += FLUSH_DURATION.text() + FLUSH_FAILURES.text() + WAL_REPLAYS.text()
+    if app.querier is not None:
+        q = app.querier.stats
+        lines += [
+            f"tempo_querier_searches_total {q.searches}",
+            f"tempo_querier_traces_found_total {q.traces_found}",
+            f"tempo_querier_external_searches_total {q.external_searches}",
+            f"tempo_querier_external_failures_total {q.external_failures}",
+        ]
     if app.compactor:
         lines += [
             f"tempo_compactor_runs_total {app.compactor.stats.runs}",
