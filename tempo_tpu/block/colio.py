@@ -29,7 +29,15 @@ _TAIL = struct.Struct("<I4s")
 
 CODEC_RAW = "raw"
 CODEC_ZSTD = "zstd"
+# constant chunk: stored bytes are ONE row, tiled to raw_len at read.
+# The structural win parquet gets from RLE/dictionary pages: absent
+# optional columns (http_*, sentinel ids, unused sattr typed lanes) are
+# roughly half a realistic block's raw bytes, and with this codec they
+# cost one row of storage, zero compression, zero decompression, and --
+# via stride-0 broadcast views on the compaction path -- zero copies.
+CODEC_CONST = "const"
 _MIN_COMPRESS = 128
+_CONST_MIN = 64  # don't bother const-marking chunks smaller than this
 
 # codec matrix (reference: tempodb/backend/encoding.go's nine codecs).
 # zstd is the default and the only one with a native threaded batch
@@ -37,6 +45,13 @@ _MIN_COMPRESS = 128
 # interop, lz4-class speed isn't in the stdlib so snappy/lz4 map to
 # "none" guidance in docs). Decode always dispatches on the chunk's
 # recorded codec, so blocks written with any codec stay readable.
+
+
+def is_broadcast(arr: np.ndarray) -> bool:
+    """True for stride-0 first-dim views (np.broadcast_to of one row) --
+    the in-memory marker for "this column is constant". The single
+    definition of the convention; the compaction merge imports it."""
+    return arr.ndim >= 1 and arr.size > 0 and arr.strides[0] == 0
 
 
 def _gzip_c(data: bytes, level: int) -> bytes:
@@ -116,7 +131,15 @@ def pack_columns_stream(
     from ..native import zstd_compress_from
 
     for name, arr in cols.items():
-        arr = np.ascontiguousarray(arr)
+        # stride-0 first dim = a broadcast view (read_all broadcast_const
+        # / the compaction merge's const fast path): constant by
+        # construction, and materializing it here would defeat the point.
+        # codec == raw means "store bytes verbatim", so raw packs
+        # materialize broadcast inputs instead of emitting const chunks
+        # (matching the sampled detector's raw-codec skip below).
+        bcast = codec != CODEC_RAW and is_broadcast(arr)
+        if not bcast:
+            arr = np.ascontiguousarray(arr)
         axis = col_axis.get(name)
         row_bytes = arr.dtype.itemsize * int(np.prod(arr.shape[1:], dtype=np.int64))
         if axis is not None:
@@ -129,14 +152,54 @@ def pack_columns_stream(
                       for g in range(ax.n_groups)]
         else:
             bounds = [(0, arr.shape[0] * row_bytes)]
+
+        if bcast:
+            row = np.ascontiguousarray(arr[:1]).tobytes()
+            recs = []
+            for lo, hi in bounds:
+                raw_len = hi - lo
+                if raw_len == 0:
+                    recs.append([offset, 0, 0, CODEC_RAW])
+                    continue
+                recs.append([offset, len(row), raw_len, CODEC_CONST])
+                offset += len(row)
+                yield row
+            footer["cols"][name] = {
+                "dtype": str(arr.dtype),
+                "shape": list(arr.shape),
+                "axis": axis,
+                "chunks": recs,
+            }
+            continue
+
         buf = arr.reshape(-1).view(np.uint8) if arr.size else np.empty(0, np.uint8)
+
+        # constant-chunk detection: a cheap sampled bail (rows 1 and mid
+        # vs row 0 -- random data fails in nanoseconds) gates the full
+        # equality check, so only genuinely constant chunks pay a read
+        # pass. Skipped for raw packs (codec == raw means "store bytes
+        # verbatim").
+        const_rows: dict[int, bytes] = {}
+        if codec != CODEC_RAW and row_bytes > 0:
+            for i, (lo, hi) in enumerate(bounds):
+                ln = hi - lo
+                if ln < max(_CONST_MIN, 2 * row_bytes):
+                    continue
+                r0 = buf[lo : lo + row_bytes]
+                mid = lo + ((ln // row_bytes) // 2) * row_bytes
+                if not ((buf[lo + row_bytes : lo + 2 * row_bytes] == r0).all()
+                        and (buf[mid : mid + row_bytes] == r0).all()):
+                    continue
+                if (buf[lo:hi].reshape(-1, row_bytes) == r0).all():
+                    const_rows[i] = r0.tobytes()
 
         # compress this column's compressible chunks: zstd runs as one
         # threaded native batch STRAIGHT FROM the array's memory (no
         # per-chunk source copies, python zstd as fallback); the stdlib
         # codec matrix handles the rest per chunk
         to_compress = [i for i, (lo, hi) in enumerate(bounds)
-                       if hi - lo >= _MIN_COMPRESS and codec != CODEC_RAW]
+                       if hi - lo >= _MIN_COMPRESS and codec != CODEC_RAW
+                       and i not in const_rows]
         compressed: dict[int, bytes] = {}
         if to_compress and codec == CODEC_ZSTD:
             outs = zstd_compress_from(
@@ -160,8 +223,11 @@ def pack_columns_stream(
         recs: list[list] = []
         for i, (lo, hi) in enumerate(bounds):
             raw_len = hi - lo
+            row = const_rows.get(i)
             z = compressed.get(i)
-            if z is not None and len(z) < raw_len:
+            if row is not None:
+                data, chunk_codec = row, CODEC_CONST
+            elif z is not None and len(z) < raw_len:
                 data, chunk_codec = z, codec
             else:
                 data, chunk_codec = buf[lo:hi].tobytes(), CODEC_RAW
@@ -297,6 +363,8 @@ class ColumnPack:
         self._count_read(stored_len)
         if codec == CODEC_ZSTD:
             data = self._dctx().decompress(data, max_output_size=raw_len)
+        elif codec == CODEC_CONST:
+            data = data * (raw_len // stored_len)  # tile the stored row
         elif codec != CODEC_RAW:
             data = _EXTRA_CODECS[codec][1](data, raw_len)  # codec matrix
         self._cache_put(off, data)
@@ -432,6 +500,11 @@ class ColumnPack:
         for (off, stored, raw_len, codec), dpos in other:
             data = self._read_range(off, stored)
             counted += stored
+            if codec == CODEC_CONST:
+                # tile the one stored row across the chunk, in place
+                dst[dpos : dpos + raw_len].reshape(-1, stored)[:] = (
+                    np.frombuffer(data, dtype=np.uint8))
+                continue
             if codec != CODEC_RAW:
                 data = _EXTRA_CODECS[codec][1](data, raw_len)
             dst[dpos : dpos + raw_len] = np.frombuffer(data, dtype=np.uint8)
@@ -535,26 +608,55 @@ class ColumnPack:
             })
         return out
 
-    def read_all(self) -> dict[str, np.ndarray]:
+    def _broadcast_const_cols(self) -> dict[str, np.ndarray]:
+        """Columns whose every chunk is const with one identical row,
+        as stride-0 broadcast views (zero decode, zero memory)."""
+        out: dict[str, np.ndarray] = {}
+        for name, meta in self._cols.items():
+            chs = [c for c in meta["chunks"] if c[2] > 0]
+            if not chs or any(c[3] != CODEC_CONST for c in chs):
+                continue
+            rows = {self._read_range(c[0], c[1]) for c in chs}  # tiny reads
+            self._count_read(sum(c[1] for c in chs))
+            if len(rows) != 1:
+                continue
+            dt = np.dtype(meta["dtype"])
+            shape = tuple(meta["shape"])
+            rv = np.frombuffer(next(iter(rows)), dtype=dt).reshape(shape[1:])
+            out[name] = np.broadcast_to(rv, shape)
+        return out
+
+    def read_all(self, broadcast_const: bool = False) -> dict[str, np.ndarray]:
         """Every column, zero-copy: ONE destination buffer laid out
         column-after-column, every zstd chunk decompressed straight into
         its final position (native batch), raw chunks memcpy'd, then each
         column is a frombuffer VIEW of the buffer. The bulk-read path
-        compaction uses -- no chunk cache round trips, no joins."""
+        compaction uses -- no chunk cache round trips, no joins.
+
+        broadcast_const=True returns fully-constant columns as stride-0
+        np.broadcast_to views instead of materialized tiles (the
+        compaction merge's const fast path); such views are read-only
+        and NOT contiguous -- callers that hand pointers to native code
+        must np.ascontiguousarray first."""
         from ..native import available, zstd_decompress_into
 
+        bc = self._broadcast_const_cols() if broadcast_const else {}
+
         if not available():
-            self.warm([(n, None) for n in self._cols])
-            return {n: self.read(n) for n in self._cols}
+            self.warm([(n, None) for n in self._cols if n not in bc])
+            return {n: bc[n] if n in bc else self.read(n) for n in self._cols}
 
         col_base: dict[str, int] = {}
         z_chunks: list[bytes] = []
         z_offs: list[int] = []
         z_lens: list[int] = []
         raw_parts: list[tuple[int, bytes]] = []
+        const_parts: list[tuple[int, bytes, int]] = []  # (pos, row, raw_len)
         counted = 0  # this attempt's IO accounting, for relative rollback
         pos = 0
         for name, meta in self._cols.items():
+            if name in bc:
+                continue
             pos = (pos + 15) & ~15  # keep every column view 16B-aligned
             col_base[name] = pos
             for off, stored, raw_len, codec in meta["chunks"]:
@@ -567,6 +669,8 @@ class ColumnPack:
                     z_chunks.append(data)
                     z_offs.append(pos)
                     z_lens.append(raw_len)
+                elif codec == CODEC_CONST:
+                    const_parts.append((pos, data, raw_len))
                 else:
                     if codec != CODEC_RAW:
                         data = _EXTRA_CODECS[codec][1](data, raw_len)
@@ -581,12 +685,18 @@ class ColumnPack:
             # Relative subtraction under the lock: a plain reset would
             # clobber concurrent readers' increments.
             self._count_read(-counted)
-            self.warm([(n, None) for n in self._cols])
-            return {n: self.read(n) for n in self._cols}
+            self.warm([(n, None) for n in self._cols if n not in bc])
+            return {n: bc[n] if n in bc else self.read(n) for n in self._cols}
         for p, data in raw_parts:
             dst[p : p + len(data)] = np.frombuffer(data, dtype=np.uint8)
+        for p, row, raw_len in const_parts:
+            dst[p : p + raw_len].reshape(-1, len(row))[:] = np.frombuffer(
+                row, dtype=np.uint8)
         out: dict[str, np.ndarray] = {}
         for name, meta in self._cols.items():
+            if name in bc:
+                out[name] = bc[name]
+                continue
             dt = np.dtype(meta["dtype"])
             n_bytes = int(np.prod(meta["shape"], dtype=np.int64)) * dt.itemsize
             base = col_base[name]

@@ -30,6 +30,7 @@ from ..backend.base import RawBackend
 from ..block import schema as S
 from ..block.bloom import ShardedBloom
 from ..block.builder import BlockBuilder, FinalizedBlock, compute_row_groups, write_block
+from ..block.colio import is_broadcast
 from ..block.dictionary import Dictionary, apply_remap
 from ..block.meta import BlockMeta
 from ..block.reader import BackendBlock
@@ -84,7 +85,10 @@ class _Source:
     def from_block(cls, blk: BackendBlock) -> "_Source":
         if blk.meta.size_bytes and blk.meta.size_bytes <= cls.PRELOAD_MAX_BYTES:
             blk.pack.preload()
-        return cls(blk.pack.read_all(), blk.dictionary)
+        # const columns arrive as stride-0 broadcast views: zero decode,
+        # zero memory, and _assemble forwards them const when every
+        # source agrees (the dominant case -- absent optional columns)
+        return cls(blk.pack.read_all(broadcast_const=True), blk.dictionary)
 
     def remap_codes(self, remap: np.ndarray, fused: bool = False) -> None:
         """Re-encode dict-code columns into the merged dictionary. With
@@ -145,6 +149,40 @@ def _combine_collision(sources: list[_Source], blocks: list[BackendBlock],
             f"collision rebuild lacks columns {sorted(base_names - set(cols))}"
         )
     return _Source(cols, fin.dictionary)
+
+
+def _const_source_row(s: _Source, n: str) -> np.ndarray | None:
+    """The column's constant row if the source is constant on n: a
+    stride-0 broadcast view (const-chunk read_all) or a small
+    materialized array (collision rebuilds) that checks out constant.
+    Code columns whose dictionary remap was deferred into the copy
+    kernel (fused_remap) get the remap applied to the row here, so the
+    returned row is always in the MERGED dictionary's code space."""
+    a = s.cols[n]
+    if a.ndim == 0 or a.size == 0:
+        return None
+    if is_broadcast(a):
+        row = np.ascontiguousarray(a[0])
+    elif a.nbytes <= 65536:
+        row = np.ascontiguousarray(a[0])
+        if not (a == row).all():
+            return None
+    else:
+        return None
+    if n in _DICT_COLS and s.fused_remap and s.remap is not None:
+        if row.size != 1:
+            return None
+        v = int(row.reshape(-1)[0])
+        row = np.asarray(
+            s.remap[v] if 0 <= v < s.remap.shape[0] else v, dtype=a.dtype)
+    return row
+
+
+def _unique_vals(a: np.ndarray) -> np.ndarray:
+    """np.unique that costs O(1) on stride-0 broadcast views."""
+    if is_broadcast(a):
+        return np.unique(np.ascontiguousarray(a[:1]))
+    return np.unique(a)
 
 
 def _ranges_to_idx(los: np.ndarray, his: np.ndarray) -> np.ndarray:
@@ -297,9 +335,14 @@ def _assemble(tenant: str, sources: list[_Source], chunks: list[tuple[int, int, 
     def packed_gather(si: int, axis: str, src: np.ndarray) -> np.ndarray:
         """Gather source rows of one axis into PACKED dst order (the
         concatenation of this source's dst runs): the staging buffer for
-        columns needing element-level math before placement."""
+        columns needing element-level math before placement. Broadcast
+        (const) sources stay broadcast: any gather of a constant is the
+        same constant."""
         s_offs, _, lens = runs_of[(si, axis)]
-        out = np.empty((int(lens.sum()),) + src.shape[1:], dtype=src.dtype)
+        n_packed = int(lens.sum())
+        if is_broadcast(src):
+            return np.broadcast_to(src[0], (n_packed,) + src.shape[1:])
+        out = np.empty((n_packed,) + src.shape[1:], dtype=src.dtype)
         _run_copy(src, out, s_offs, _packed_offs(lens), lens)
         return out
 
@@ -329,8 +372,8 @@ def _assemble(tenant: str, sources: list[_Source], chunks: list[tuple[int, int, 
         rv = packed_gather(si, "span", sources[si].cols["span.res_idx"])
         sv = packed_gather(si, "span", sources[si].cols["span.scope_idx"])
         span_resvals[si], span_scopevals[si] = rv, sv
-        ur = np.unique(rv)
-        us = np.unique(sv)
+        ur = _unique_vals(rv)
+        us = _unique_vals(sv)
         used_res[si] = ur[ur >= 0]
         used_scope[si] = us[us >= 0]
         res_base[si], scope_base[si] = rb, sb
@@ -357,6 +400,23 @@ def _assemble(tenant: str, sources: list[_Source], chunks: list[tuple[int, int, 
                  "trace.start_ms", "trace.end_ms", "trace.tres_off"):
             continue  # recomputed below
         if pref in axis_rows:
+            # const fast path: when every source is constant on this
+            # column with the SAME row (in merged-dictionary code space),
+            # the output is that constant -- a stride-0 broadcast view
+            # that costs nothing here and writes as const chunks. Index
+            # columns whose values are rebased/translated per source
+            # can't take it.
+            if n not in ("span.res_idx", "tres.res", "span.parent_idx",
+                         "span.scope_idx") and n not in _OWNER_COLS:
+                rows = [_const_source_row(sources[si], n) for si in src_order]
+                if all(r is not None for r in rows) and all(
+                    r.dtype == rows[0].dtype and r.tobytes() == rows[0].tobytes()
+                    for r in rows[1:]
+                ):
+                    cols[n] = np.broadcast_to(
+                        rows[0].astype(like.dtype, copy=False),
+                        (axis_rows[pref],) + like.shape[1:])
+                    continue
             out = np.empty((axis_rows[pref],) + like.shape[1:], dtype=like.dtype)
             for si in src_order:
                 if n == "span.res_idx":

@@ -194,6 +194,62 @@ def test_codec_matrix_roundtrip(codec):
         assert (out[name] == arr).all(), (codec, name)
 
 
+def test_const_chunks():
+    """Constant chunks store ONE row (codec "const") and tile back on
+    every read path; fully-constant columns come back as stride-0
+    broadcast views under read_all(broadcast_const=True), and
+    broadcast inputs write as const without materializing."""
+    import numpy as np
+
+    from tempo_tpu.block.colio import AxisChunks, ColumnPack, pack_columns
+
+    rng = np.random.default_rng(9)
+    n = 60_000
+    mixed = rng.integers(0, 2**31, size=n, dtype=np.int32)
+    mixed[20_000:40_000] = 7  # exactly one const chunk in a mixed column
+    cols = {
+        "a.const": np.full(n, -1, dtype=np.int32),
+        "a.mixed": mixed,
+        "a.rand": rng.integers(0, 2**31, size=n, dtype=np.int32),
+        "a.wide": np.broadcast_to(
+            np.arange(8, dtype=np.uint8), (n, 8)),  # stride-0 input
+        "solo.const": np.zeros(30_000, dtype=np.float64),
+    }
+    axes = {"rows": AxisChunks([0, 20_000, 40_000, n])}
+    ca = {k: "rows" for k in cols if k.startswith("a.")}
+    data = pack_columns(cols, axes, ca)
+    pack = ColumnPack.from_bytes(data)
+
+    # footer marks the right chunks const; const columns cost ~one row
+    stats = {s["name"]: s for s in pack.column_stats()}
+    assert stats["a.const"]["codecs"] == ["const"]
+    assert stats["a.const"]["stored"] == 3 * 4
+    assert stats["a.wide"]["codecs"] == ["const"]
+    assert "const" in stats["a.mixed"]["codecs"] and len(stats["a.mixed"]["codecs"]) > 1
+    assert stats["solo.const"]["codecs"] == ["const"]
+
+    for name, arr in cols.items():
+        assert (pack.read(name) == arr).all(), name
+    assert (pack.read_groups("a.mixed", [1, 2]) == mixed[20_000:]).all()
+
+    # read_all: materialized by default, broadcast views on request
+    out = ColumnPack.from_bytes(data).read_all()
+    for name, arr in cols.items():
+        assert (out[name] == arr).all(), name
+    bc = ColumnPack.from_bytes(data).read_all(broadcast_const=True)
+    for name, arr in cols.items():
+        assert (bc[name] == arr).all(), name
+    assert bc["a.const"].strides[0] == 0
+    assert bc["a.wide"].strides[0] == 0
+    assert bc["a.mixed"].strides[0] != 0  # only fully-const columns
+
+    # chunk-join fallback path (no native) tiles const chunks too
+    p2 = ColumnPack.from_bytes(data)
+    chunks_meta = p2._cols["a.const"]["chunks"]
+    raw = p2._chunks(chunks_meta)
+    assert (np.frombuffer(raw, np.int32) == cols["a.const"]).all()
+
+
 def test_concurrent_chunk_reads_thread_safety():
     """Concurrent cold reads of many zstd chunks from many threads:
     zstd contexts are per-thread (a shared context intermittently
