@@ -77,6 +77,9 @@ class AppConfig:
     # OTLP gRPC receiver port (reference receiver default 4317);
     # 0 = disabled, -1 = ephemeral (tests)
     otlp_grpc_port: int = 0
+    # OpenCensus gRPC receiver port (reference shim.go:98; OC agent
+    # convention 55678); 0 = disabled, -1 = ephemeral (tests)
+    opencensus_grpc_port: int = 0
     # Kafka receiver (reference shim.go:100): host:port of a broker, ""
     # = disabled; messages are OTLP-proto ExportTraceServiceRequest
     kafka_brokers: str = ""
@@ -255,6 +258,7 @@ class App:
         self.usage = UsageReporter(self.db.backend, cfg.target)
         self._started = False
         self.otlp_grpc = None
+        self.opencensus = None
         self.kafka = None
         self.remote_writer = None
         self.http_server: ThreadingHTTPServer | None = None
@@ -287,12 +291,15 @@ class App:
 
             self.otlp_grpc = OTLPGrpcReceiver(self)
             port = max(0, self.cfg.otlp_grpc_port)  # -1 -> ephemeral
-            # same bind policy as serve_http: loopback unless peers
-            # reach this process from other hosts
-            adv = self.cfg.advertise_addr
-            local = ("127.0.0.1" in adv) or ("localhost" in adv) or not adv
-            host = self.cfg.http_host or ("127.0.0.1" if local else "0.0.0.0")
-            self.cfg.otlp_grpc_port = self.otlp_grpc.start(port, host=host)
+            self.cfg.otlp_grpc_port = self.otlp_grpc.start(
+                port, host=self._bind_host())
+        if self.distributor is not None and self.cfg.opencensus_grpc_port != 0:
+            from .opencensus_grpc import OpenCensusReceiver
+
+            self.opencensus = OpenCensusReceiver(self)
+            port = max(0, self.cfg.opencensus_grpc_port)  # -1 -> ephemeral
+            self.cfg.opencensus_grpc_port = self.opencensus.start(
+                port, host=self._bind_host())
         if self.distributor is not None and self.cfg.kafka_brokers:
             from .kafka_receiver import DEFAULT_TOPIC, KafkaReceiver
 
@@ -318,6 +325,8 @@ class App:
         self.overrides.stop()
         if self.otlp_grpc is not None:
             self.otlp_grpc.stop()
+        if self.opencensus is not None:
+            self.opencensus.stop()
         if self.kafka is not None:
             self.kafka.stop()
         if self.querier_worker:
@@ -377,15 +386,20 @@ class App:
         return t
 
     # ------------------------------------------------------------ http
+    def _bind_host(self) -> str:
+        """Bind policy shared by the HTTP server and every gRPC
+        receiver: explicit http_host wins; else a non-loopback advertise
+        addr implies peers connect from other hosts (bind all
+        interfaces), else stay loopback-only."""
+        if self.cfg.http_host:
+            return self.cfg.http_host
+        adv = self.cfg.advertise_addr
+        local = ("127.0.0.1" in adv) or ("localhost" in adv) or not adv
+        return "127.0.0.1" if local else "0.0.0.0"
+
     def serve_http(self, port: int | None = None, background: bool = False):
         handler = _make_handler(self)
-        host = self.cfg.http_host
-        if not host:
-            # a non-loopback advertise addr implies peers connect from other
-            # hosts: bind all interfaces, else stay loopback-only
-            adv = self.cfg.advertise_addr
-            local = ("127.0.0.1" in adv) or ("localhost" in adv) or not adv
-            host = "127.0.0.1" if local else "0.0.0.0"
+        host = self._bind_host()
         self.http_server = ThreadingHTTPServer((host, port or self.cfg.http_port), handler)
         if background:
             t = threading.Thread(target=self.http_server.serve_forever, daemon=True)
@@ -620,6 +634,12 @@ def _metrics_text(app: App) -> str:
             f"tempo_kafka_receiver_messages_total {app.kafka.messages}",
             f"tempo_kafka_receiver_spans_total {app.kafka.spans}",
             f"tempo_kafka_receiver_failures_total {app.kafka.failures}",
+        ]
+    if app.opencensus is not None:
+        lines += [
+            f"tempo_opencensus_receiver_requests_total {app.opencensus.requests}",
+            f"tempo_opencensus_receiver_spans_total {app.opencensus.spans}",
+            f"tempo_opencensus_receiver_failures_total {app.opencensus.failures}",
         ]
     if app.ingester:
         from .ingester import FLUSH_DURATION, FLUSH_FAILURES, WAL_REPLAYS

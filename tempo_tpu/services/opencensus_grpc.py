@@ -1,0 +1,114 @@
+"""OpenCensus gRPC trace receiver (the pre-OTel agent protocol).
+
+Reference: the receiver shim registers an OpenCensus receiver factory
+(modules/distributor/receiver/shim.go:98). The OC agent protocol is a
+BIDI STREAM: `opencensus.proto.agent.trace.v1.TraceService/Export`
+carries a stream of ExportTraceServiceRequest messages where the first
+message must carry the Node and Resource, and later messages that omit
+them inherit the stream's last-seen values (sticky per-stream state) --
+that statefulness is the protocol's defining quirk and the reason it
+needs its own handler rather than the OTLP unary path
+(services/otlp_grpc.py).
+
+Same deployment shape as the OTLP receiver: a generic grpc handler (no
+generated stubs; wire decode in wire/oc_pb.py), tenancy from the
+x-scope-orgid stream metadata, push-limit errors mapped to canonical
+gRPC codes. One empty ExportTraceServiceResponse is yielded per request
+message as an ack.
+"""
+
+from __future__ import annotations
+
+from concurrent import futures
+
+from ..wire import oc_pb
+
+_SERVICE = "opencensus.proto.agent.trace.v1.TraceService"
+_METHOD = "Export"
+
+
+class OpenCensusReceiver:
+    def __init__(self, app, max_workers: int = 8):
+        self.app = app
+        self._max_workers = max_workers
+        self._server = None
+        self.port = 0
+        self.requests = 0
+        self.spans = 0
+        self.failures = 0
+
+    def start(self, port: int = 55678, host: str = "127.0.0.1") -> int:
+        """55678 is the OC agent's conventional port."""
+        import grpc
+
+        app = self.app
+        recv = self
+
+        def export(request_iter, context):
+            md = {k.lower(): v for k, v in (context.invocation_metadata() or [])}
+            try:
+                tenant = app.tenant_of(
+                    {"X-Scope-OrgID": md.get("x-scope-orgid", "")})
+            except Exception as e:
+                recv.failures += 1
+                context.abort(grpc.StatusCode.UNAUTHENTICATED,
+                              f"{type(e).__name__}: {e}")
+                return
+            node: dict | None = None  # sticky per-stream identity
+            resource: dict | None = None
+            for request in request_iter:
+                recv.requests += 1
+                try:
+                    n, r, spans = oc_pb.decode_export_request(request)
+                    if n is not None:
+                        node = n
+                    if r is not None:
+                        resource = r
+                    if spans:
+                        tr = oc_pb.to_trace(node, resource, spans)
+                        app.distributor.push(tenant, tr.resource_spans)
+                        # counted only after a successful push (the
+                        # kafka receiver's convention): rejected batches
+                        # show up in failures, not spans_total
+                        recv.spans += sum(
+                            len(ss.spans) for rs in tr.resource_spans
+                            for ss in rs.scope_spans)
+                    yield b""
+                except Exception as e:
+                    recv.failures += 1
+                    from .distributor import PushError
+
+                    if isinstance(e, PushError):
+                        code = (grpc.StatusCode.RESOURCE_EXHAUSTED
+                                if e.status == 429
+                                else grpc.StatusCode.UNAUTHENTICATED
+                                if e.status == 401
+                                else grpc.StatusCode.INVALID_ARGUMENT)
+                    else:
+                        code = grpc.StatusCode.INTERNAL
+                    context.abort(code, f"{type(e).__name__}: {e}")
+                    return
+
+        handler = grpc.method_handlers_generic_handler(
+            _SERVICE,
+            {
+                _METHOD: grpc.stream_stream_rpc_method_handler(
+                    export,
+                    request_deserializer=None,  # raw bytes in
+                    response_serializer=None,  # raw bytes out
+                )
+            },
+        )
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=self._max_workers,
+                                       thread_name_prefix="oc-grpc"),
+        )
+        self._server.add_generic_rpc_handlers((handler,))
+        self.port = self._server.add_insecure_port(f"{host}:{port}")
+        self._server.start()
+        return self.port
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.stop(grace=0.5)
+            self._server = None

@@ -1,0 +1,316 @@
+"""OpenCensus trace protobuf codec (decode-only).
+
+The reference's receiver shim registers an OpenCensus receiver alongside
+OTLP/Jaeger/Zipkin/Kafka (modules/distributor/receiver/shim.go:98-101).
+OC is the pre-OTel agent protocol: a bidi-streamed
+`opencensus.proto.agent.trace.v1.TraceService/Export` whose requests
+carry `node = 1`, `spans = 2` (opencensus.proto.trace.v1.Span) and
+`resource = 3` -- node/resource are STICKY per stream (a message that
+omits them inherits the last seen ones).
+
+This module decodes those messages with the generic pbwire reader and
+converts straight into the internal OTLP-shaped model (wire/model.py),
+mirroring the otel-collector's opencensus translator: node identity
+becomes resource attributes (service.name from Node.service_info.name,
+host.hostname / process.pid from Node.identifier), OC resource labels
+pass through, and OC's {string,int,bool,double} attribute values map
+onto AnyValue.
+"""
+
+from __future__ import annotations
+
+from . import pbwire as w
+from .model import Event, Link, Resource, ResourceSpans, Scope, ScopeSpans, Span, SpanKind, StatusCode, Trace
+
+# OC SpanKind: 0 unspecified, 1 SERVER, 2 CLIENT
+_KIND = {0: SpanKind.UNSPECIFIED, 1: SpanKind.SERVER, 2: SpanKind.CLIENT}
+
+
+def _truncatable(data: bytes) -> str:
+    """TruncatableString { value = 1 }."""
+    for f, wt, val in w.iter_fields(data):
+        if f == 1:
+            return val.decode("utf-8", "replace")
+    return ""
+
+
+def _timestamp_ns(data: bytes) -> int:
+    """google.protobuf.Timestamp { seconds = 1, nanos = 2 }."""
+    sec = nanos = 0
+    for f, wt, val in w.iter_fields(data):
+        if f == 1:
+            sec = w.to_signed64(val)
+        elif f == 2:
+            nanos = w.to_signed64(val)
+    return sec * 1_000_000_000 + nanos
+
+
+def _attr_value(data: bytes):
+    """AttributeValue oneof { string_value = 1 (TruncatableString),
+    int_value = 2, bool_value = 3, double_value = 4 }."""
+    for f, wt, val in w.iter_fields(data):
+        if f == 1:
+            return _truncatable(val)
+        if f == 2:
+            return w.to_signed64(val)
+        if f == 3:
+            return bool(val)
+        if f == 4:
+            return w.fixed64_to_double(val)
+    return ""
+
+
+def _attributes(data: bytes) -> tuple[dict, int]:
+    """Attributes { attribute_map = 1 (map<string, AttributeValue>),
+    dropped_attributes_count = 2 } -> (attrs, dropped)."""
+    attrs: dict = {}
+    dropped = 0
+    for f, wt, val in w.iter_fields(data):
+        if f == 1:  # one map entry: { key = 1, value = 2 }
+            k, v = "", ""
+            for mf, mwt, mval in w.iter_fields(val):
+                if mf == 1:
+                    k = mval.decode("utf-8", "replace")
+                elif mf == 2:
+                    v = _attr_value(mval)
+            if k:
+                attrs[k] = v
+        elif f == 2:
+            dropped = w.to_signed64(val)
+    return attrs, dropped
+
+
+def _tracestate(data: bytes) -> str:
+    """Span.Tracestate { entries = 1 { key = 1, value = 2 } } rendered
+    in the W3C comma-joined form the model stores."""
+    parts = []
+    for f, wt, val in w.iter_fields(data):
+        if f == 1:
+            k = v = ""
+            for ef, ewt, eval_ in w.iter_fields(val):
+                if ef == 1:
+                    k = eval_.decode("utf-8", "replace")
+                elif ef == 2:
+                    v = eval_.decode("utf-8", "replace")
+            if k:
+                parts.append(f"{k}={v}")
+    return ",".join(parts)
+
+
+def _time_events(data: bytes) -> list[Event]:
+    """TimeEvents { time_event = 1 }; each TimeEvent { time = 1,
+    annotation = 2 { description = 1, attributes = 2 },
+    message_event = 3 { type = 1, id = 2, uncompressed_size = 3,
+    compressed_size = 4 } }."""
+    out: list[Event] = []
+    for f, wt, val in w.iter_fields(data):
+        if f != 1:
+            continue
+        t_ns = 0
+        ev: Event | None = None
+        for tf, twt, tval in w.iter_fields(val):
+            if tf == 1:
+                t_ns = _timestamp_ns(tval)
+            elif tf == 2:  # annotation
+                name = ""
+                attrs: dict = {}
+                dropped = 0
+                for af, awt, aval in w.iter_fields(tval):
+                    if af == 1:
+                        name = _truncatable(aval)
+                    elif af == 2:
+                        attrs, dropped = _attributes(aval)
+                ev = Event(name=name, attrs=attrs,
+                           dropped_attributes_count=dropped)
+            elif tf == 3:  # message event (the collector maps these to
+                # "message" events with message.* attributes)
+                attrs = {}
+                for mf, mwt, mval in w.iter_fields(tval):
+                    if mf == 1:
+                        attrs["message.type"] = (
+                            "SENT" if w.to_signed64(mval) == 1 else "RECEIVED")
+                    elif mf == 2:
+                        attrs["message.id"] = w.to_signed64(mval)
+                    elif mf == 3:
+                        attrs["message.uncompressed_size"] = w.to_signed64(mval)
+                    elif mf == 4:
+                        attrs["message.compressed_size"] = w.to_signed64(mval)
+                ev = Event(name="message", attrs=attrs)
+        if ev is not None:
+            ev.time_unix_nano = t_ns
+            out.append(ev)
+    return out
+
+
+def _links(data: bytes) -> list[Link]:
+    """Links { link = 1 { trace_id = 1, span_id = 2, type = 3,
+    attributes = 4 } }."""
+    out: list[Link] = []
+    for f, wt, val in w.iter_fields(data):
+        if f != 1:
+            continue
+        ln = Link()
+        for lf, lwt, lval in w.iter_fields(val):
+            if lf == 1:
+                ln.trace_id = bytes(lval)
+            elif lf == 2:
+                ln.span_id = bytes(lval)
+            elif lf == 4:
+                ln.attrs, _ = _attributes(lval)
+        out.append(ln)
+    return out
+
+
+def decode_span(data: bytes) -> tuple[Span, dict | None]:
+    """One opencensus.proto.trace.v1.Span -> (model Span, per-span
+    resource attrs or None).
+
+    CAUTION on field numbers: OC's Span numbering is NOT OTLP's --
+    OTLP renumbered when it forked. Ground truth is the reference's
+    vendored codegen (census-instrumentation/opencensus-proto gen-go
+    trace/v1/trace.pb.go): 3=parent_span_id, 4=name, 5=start_time,
+    6=end_time, 7=attributes, 8=stack_trace, 9=time_events, 10=links,
+    11=status, 12=same_process_as_parent_span, 13=child_span_count,
+    14=kind, 15=tracestate, 16=resource."""
+    s = Span()
+    res_attrs: dict | None = None
+    for f, wt, val in w.iter_fields(data):
+        if f == 1:
+            s.trace_id = bytes(val)
+        elif f == 2:
+            s.span_id = bytes(val)
+        elif f == 3:
+            s.parent_span_id = bytes(val)
+        elif f == 4:
+            s.name = _truncatable(val)
+        elif f == 5:
+            s.start_unix_nano = _timestamp_ns(val)
+        elif f == 6:
+            s.end_unix_nano = _timestamp_ns(val)
+        elif f == 7:
+            s.attrs, s.dropped_attributes_count = _attributes(val)
+        elif f == 9:
+            s.events = _time_events(val)
+        elif f == 10:
+            s.links = _links(val)
+        elif f == 11:  # Status { code = 1, message = 2 }; OC uses gRPC
+            # codes, so 0 = OK maps to UNSET (the collector's mapping)
+            # and anything else is an error with the message carried
+            code = 0
+            msg = ""
+            for sf, swt, sval in w.iter_fields(val):
+                if sf == 1:
+                    code = w.to_signed64(sval)
+                elif sf == 2:
+                    msg = sval.decode("utf-8", "replace")
+            if code != 0:
+                s.status_code = StatusCode.ERROR
+                s.status_message = msg
+        elif f == 14:
+            s.kind = _KIND.get(w.to_signed64(val), SpanKind.UNSPECIFIED)
+        elif f == 15:
+            s.trace_state = _tracestate(val)
+        elif f == 16:  # per-span Resource override
+            res_attrs = _resource_attrs(val)
+    return s, res_attrs
+
+
+def _resource_attrs(data: bytes) -> dict:
+    """opencensus.proto.resource.v1.Resource { type = 1,
+    labels = 2 (map<string,string>) } -> resource attrs."""
+    attrs: dict = {}
+    for f, wt, val in w.iter_fields(data):
+        if f == 1:
+            t = val.decode("utf-8", "replace")
+            if t:
+                attrs["opencensus.resourcetype"] = t
+        elif f == 2:
+            k = v = ""
+            for mf, mwt, mval in w.iter_fields(val):
+                if mf == 1:
+                    k = mval.decode("utf-8", "replace")
+                elif mf == 2:
+                    v = mval.decode("utf-8", "replace")
+            if k:
+                attrs[k] = v
+    return attrs
+
+
+def node_attrs(data: bytes) -> dict:
+    """opencensus.proto.agent.common.v1.Node -> resource attrs the way
+    the otel-collector's OC translator maps node identity:
+    service_info.name -> service.name, identifier.host_name ->
+    host.hostname, identifier.pid -> process.pid, plus the node's
+    free-form attributes map (Node { identifier = 1, library_info = 2,
+    service_info = 3, attributes = 4 } per the vendored codegen)."""
+    attrs: dict = {}
+    for f, wt, val in w.iter_fields(data):
+        if f == 1:  # ProcessIdentifier { host_name = 1, pid = 2 }
+            for pf, pwt, pval in w.iter_fields(val):
+                if pf == 1:
+                    hn = pval.decode("utf-8", "replace")
+                    if hn:
+                        attrs["host.hostname"] = hn
+                elif pf == 2:
+                    attrs["process.pid"] = w.to_signed64(pval)
+        elif f == 3:  # ServiceInfo { name = 1 }
+            for sf, swt, sval in w.iter_fields(val):
+                if sf == 1:
+                    sn = sval.decode("utf-8", "replace")
+                    if sn:
+                        attrs["service.name"] = sn
+        elif f == 4:  # attributes map<string,string>
+            k = v = ""
+            for mf, mwt, mval in w.iter_fields(val):
+                if mf == 1:
+                    k = mval.decode("utf-8", "replace")
+                elif mf == 2:
+                    v = mval.decode("utf-8", "replace")
+            if k:
+                attrs[k] = v
+    return attrs
+
+
+def decode_export_request(data: bytes) -> tuple[dict | None, dict | None, list[tuple[Span, dict | None]]]:
+    """ExportTraceServiceRequest { node = 1, spans = 2, resource = 3 }
+    -> (node attrs | None, resource attrs | None, [(span, span-level
+    resource attrs | None)]). None means "absent in this message":
+    the receiver substitutes its per-stream sticky state."""
+    node: dict | None = None
+    resource: dict | None = None
+    spans: list[tuple[Span, dict | None]] = []
+    for f, wt, val in w.iter_fields(data):
+        if f == 1:
+            node = node_attrs(val)
+        elif f == 2:
+            spans.append(decode_span(val))
+        elif f == 3:
+            resource = _resource_attrs(val)
+    return node, resource, spans
+
+
+def to_trace(node: dict | None, resource: dict | None,
+             spans: list[tuple[Span, dict | None]]) -> Trace:
+    """Group decoded spans into a model Trace: spans sharing the request
+    (node+resource) identity land in one ResourceSpans; spans with a
+    per-span resource override get their own."""
+    base: dict = {}
+    if node:
+        base.update(node)
+    if resource:
+        base.update(resource)
+    groups: dict[tuple, ResourceSpans] = {}
+    out = Trace()
+    for sp, res_over in spans:
+        attrs = dict(base)
+        if res_over:
+            attrs.update(res_over)
+        key = tuple(sorted((k, repr(v)) for k, v in attrs.items()))
+        rs = groups.get(key)
+        if rs is None:
+            rs = ResourceSpans(resource=Resource(attrs=attrs),
+                               scope_spans=[ScopeSpans(scope=Scope())])
+            groups[key] = rs
+            out.resource_spans.append(rs)
+        rs.scope_spans[0].spans.append(sp)
+    return out

@@ -227,6 +227,163 @@ def test_otlp_grpc_ingest(tmp_path):
         app.stop()
 
 
+def test_opencensus_grpc_ingest(tmp_path):
+    """Push via the OpenCensus agent protocol (bidi stream, sticky
+    per-stream node/resource) and read the trace back over HTTP
+    (reference: shim.go:98 registers the opencensus receiver). The
+    second request message omits node+resource to prove the stream
+    state sticks."""
+    grpc = pytest.importorskip("grpc")
+    from tempo_tpu.wire import pbwire as w
+
+    def trunc(s):
+        b = bytearray()
+        w.write_string_field(b, 1, s)
+        return bytes(b)
+
+    def ts(ns):
+        b = bytearray()
+        w.write_varint_field(b, 1, ns // 10**9)
+        w.write_varint_field(b, 2, ns % 10**9)
+        return bytes(b)
+
+    def attr_val(v):
+        b = bytearray()
+        if isinstance(v, bool):
+            w.write_varint_field(b, 3, 1 if v else 0)
+        elif isinstance(v, str):
+            w.write_message_field(b, 1, trunc(v))
+        elif isinstance(v, int):
+            w.write_varint_field(b, 2, v)
+        elif isinstance(v, float):
+            w.write_double_field(b, 4, v)
+        return bytes(b)
+
+    def attributes(d):
+        b = bytearray()
+        for k, v in d.items():
+            e = bytearray()
+            w.write_string_field(e, 1, k)
+            w.write_message_field(e, 2, attr_val(v))
+            w.write_message_field(b, 1, bytes(e))
+        return bytes(b)
+
+    T0 = 1_700_000_000_000_000_000
+    tid = bytes(range(16))
+
+    def oc_span(span_id, name, kind=1, parent=b"", attrs=None, status=None,
+                annotation=None):
+        # field numbers per the OC proto (census-instrumentation
+        # opencensus-proto trace.pb.go), NOT OTLP's renumbered fork:
+        # 3=parent, 4=name, 5=start, 6=end, 7=attributes,
+        # 9=time_events, 11=status, 14=kind
+        b = bytearray()
+        w.write_bytes_field(b, 1, tid)
+        w.write_bytes_field(b, 2, span_id)
+        if parent:
+            w.write_bytes_field(b, 3, parent)
+        w.write_message_field(b, 4, trunc(name))
+        w.write_message_field(b, 5, ts(T0))
+        w.write_message_field(b, 6, ts(T0 + 5_000_000))
+        if attrs:
+            w.write_message_field(b, 7, attributes(attrs))
+        if annotation:
+            tev = bytearray()
+            w.write_message_field(tev, 1, ts(T0 + 1_000_000))
+            ann = bytearray()
+            w.write_message_field(ann, 1, trunc(annotation))
+            w.write_message_field(tev, 2, bytes(ann))
+            evs = bytearray()
+            w.write_message_field(evs, 1, bytes(tev))
+            w.write_message_field(b, 9, bytes(evs))
+        if status:
+            st = bytearray()
+            w.write_varint_field(st, 1, status[0])
+            w.write_string_field(st, 2, status[1])
+            w.write_message_field(b, 11, bytes(st))
+        w.write_varint_field(b, 14, kind)
+        return bytes(b)
+
+    # node { identifier { host_name } , service_info { name } }
+    node = bytearray()
+    ident = bytearray()
+    w.write_string_field(ident, 1, "host-7")
+    w.write_message_field(node, 1, bytes(ident))
+    svc = bytearray()
+    w.write_string_field(svc, 1, "oc-svc")
+    w.write_message_field(node, 3, bytes(svc))
+    # resource { type, labels }
+    res = bytearray()
+    w.write_string_field(res, 1, "container")
+    lbl = bytearray()
+    w.write_string_field(lbl, 1, "region")
+    w.write_string_field(lbl, 2, "eu-1")
+    w.write_message_field(res, 2, bytes(lbl))
+
+    req1 = bytearray()
+    w.write_message_field(req1, 1, bytes(node))
+    w.write_message_field(req1, 2, oc_span(
+        b"\x01" * 8, "root", kind=1,
+        attrs={"s": "x", "i": 42, "b": True, "d": 2.5},
+        annotation="checkpoint"))
+    w.write_message_field(req1, 3, bytes(res))
+    req2 = bytearray()  # NO node/resource: inherits the stream's
+    w.write_message_field(req2, 2, oc_span(
+        b"\x02" * 8, "child", kind=2, parent=b"\x01" * 8,
+        status=(13, "boom")))
+
+    cfg = AppConfig(
+        storage_path=str(tmp_path / "store"),
+        http_port=_free_port(),
+        opencensus_grpc_port=-1,  # ephemeral
+        compaction_cycle_s=9999,
+        ingester=IngesterConfig(max_trace_idle_s=0.0, max_block_age_s=0.0,
+                                flush_check_period_s=9999),
+    )
+    app = App(cfg)
+    app.start()
+    app.serve_http(background=True)
+    try:
+        assert cfg.opencensus_grpc_port > 0
+        ch = grpc.insecure_channel(f"127.0.0.1:{cfg.opencensus_grpc_port}")
+        export = ch.stream_stream(
+            "/opencensus.proto.agent.trace.v1.TraceService/Export",
+            request_serializer=None, response_deserializer=None,
+        )
+        acks = list(export(iter([bytes(req1), bytes(req2)])))
+        assert acks == [b"", b""]
+        ch.close()
+
+        base = f"http://127.0.0.1:{cfg.http_port}"
+        with urllib.request.urlopen(f"{base}/api/traces/{tid.hex()}",
+                                    timeout=10) as r:
+            got = otlp_json.loads(r.read())
+        spans = {sp.name: (resr, sp) for resr, _, sp in got.all_spans()}
+        assert set(spans) == {"root", "child"}
+        res_root, root = spans["root"]
+        res_child, child = spans["child"]
+        # node + resource identity applied to BOTH messages (sticky)
+        for resr in (res_root, res_child):
+            assert resr.attrs["service.name"] == "oc-svc"
+            assert resr.attrs["host.hostname"] == "host-7"
+            assert resr.attrs["region"] == "eu-1"
+            assert resr.attrs["opencensus.resourcetype"] == "container"
+        assert root.kind == 2  # OC SERVER -> model SERVER
+        assert child.kind == 3  # OC CLIENT -> model CLIENT
+        assert root.attrs == {"s": "x", "i": 42, "b": True, "d": 2.5}
+        assert root.events[0].name == "checkpoint"
+        assert root.events[0].time_unix_nano == T0 + 1_000_000
+        assert child.parent_span_id == b"\x01" * 8
+        assert child.status_code == 2 and child.status_message == "boom"
+        assert root.start_unix_nano == T0
+
+        with urllib.request.urlopen(f"{base}/metrics", timeout=10) as r:
+            metrics = r.read().decode()
+        assert "tempo_opencensus_receiver_spans_total 2" in metrics
+    finally:
+        app.stop()
+
+
 def test_metrics_depth(server):
     """/metrics exposes latency histograms plus a broad counter set
     (>=25 series) across roles (reference: promauto instrumentation on
