@@ -755,6 +755,9 @@ def main(argv=None):
                     help="frontend addr(s) a standalone querier pulls jobs from")
     ap.add_argument("--distributor.otlp-grpc-port", dest="otlp_grpc_port", type=int,
                     default=None, help="OTLP gRPC receiver port (0=off, -1=ephemeral)")
+    ap.add_argument("--distributor.opencensus-grpc-port", dest="opencensus_grpc_port",
+                    type=int, default=None,
+                    help="OpenCensus gRPC receiver port (0=off, -1=ephemeral)")
     ap.add_argument("--querier.search-external-endpoints", dest="search_external",
                     default=None,
                     help="comma-separated serverless search handler URLs")
@@ -781,6 +784,7 @@ def main(argv=None):
         "internal_token": args.internal_token,
         "frontend_addr": args.frontend_addr,
         "otlp_grpc_port": args.otlp_grpc_port,
+        "opencensus_grpc_port": args.opencensus_grpc_port,
         "search_external_endpoints": args.search_external,
         "kafka_brokers": args.kafka_brokers,
         "kafka_topic": args.kafka_topic,

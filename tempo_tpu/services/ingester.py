@@ -121,6 +121,13 @@ class Instance:
         self.head_created = time.time()
         # traces cut from the live map, waiting to go into the next block
         self.cut: dict[bytes, LiveTrace] = {}
+        # traces inside an in-flight block write: cut is cleared when the
+        # flush snapshot is taken, and the backend write takes real time,
+        # so without this set a trace would be invisible to find/search
+        # between snapshot and blocklist update (the reference keeps
+        # completing/complete blocks queryable at every stage,
+        # modules/ingester/instance.go:428-476)
+        self.flushing: dict[bytes, LiveTrace] = {}
         self.blocks_flushed = 0
 
     # ---------------------------------------------------------------- push
@@ -202,6 +209,7 @@ class Instance:
             for tid, lt in self.cut.items():
                 parts = [segment_to_trace(s) for s in lt.segments]
                 traces.append((tid, sort_trace(combine_traces(parts)) if len(parts) > 1 else parts[0]))
+            self.flushing.update(cut_snapshot)  # stay visible during the write
             self.cut.clear()
             # live traces staying behind move to the NEW head's WAL file so
             # the old file can be deleted after the block lands
@@ -223,6 +231,8 @@ class Instance:
             # would silently drop the snapshot's segments).
             with self.lock:
                 for tid, lt in cut_snapshot.items():
+                    if self.flushing.get(tid) is lt:
+                        del self.flushing[tid]
                     cur = self.cut.get(tid)
                     if cur is None:
                         self.cut[tid] = lt
@@ -233,6 +243,12 @@ class Instance:
                         cur.end_s = max(cur.end_s, lt.end_s)
             raise
         self.blocks_flushed += 1
+        with self.lock:
+            # the blocklist now carries the block (db.write_block updates
+            # it before returning): retire the in-flight snapshot
+            for tid, lt in cut_snapshot.items():
+                if self.flushing.get(tid) is lt:
+                    del self.flushing[tid]
         old_head.clear()  # checkpoint advanced: block is durable in backend
         return meta
 
@@ -240,7 +256,8 @@ class Instance:
     def find_trace_by_id(self, trace_id: bytes) -> Trace | None:
         with self.lock:
             segs = []
-            for src in (self.live.get(trace_id), self.cut.get(trace_id)):
+            for src in (self.live.get(trace_id), self.cut.get(trace_id),
+                        self.flushing.get(trace_id)):
                 if src is not None:
                     segs.extend(src.segments)
         if not segs:
@@ -278,7 +295,8 @@ class Instance:
         q = parse(req.query) if req.query else None
         resp = SearchResponse()
         with self.lock:
-            items = list(self.live.values()) + list(self.cut.values())
+            items = (list(self.live.values()) + list(self.cut.values())
+                     + list(self.flushing.values()))
         for lt in items:
             if req.start and lt.end_s < req.start:
                 continue

@@ -266,6 +266,16 @@ class KafkaReceiver:
         self.client = KafkaClient(*self.brokers[self._broker_i])
 
     def start(self) -> None:
+        # capture the start position SYNCHRONOUSLY: once start() returns,
+        # every message produced afterwards is guaranteed consumed. Lazy
+        # init in the poll loop raced producers -- with start_latest, a
+        # message produced between start() and the first poll fell
+        # before the captured baseline and was silently skipped.
+        try:
+            self._init_offsets()
+        except Exception as e:
+            log.warning("kafka receiver: offset init deferred (%s); "
+                        "retrying in the poll loop", e)
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="kafka-receiver")
         self._thread.start()
@@ -275,6 +285,18 @@ class KafkaReceiver:
         if self._thread is not None:
             self._thread.join(timeout=5)
         self.client.close()
+
+    def _init_offsets(self) -> None:
+        # build locally, assign atomically: a mid-iteration failure must
+        # not leave a partial map that poll_once's `if not self.offsets`
+        # guard would treat as complete (silently skipping partitions)
+        offs = {}
+        for p in self.client.partitions(self.topic):
+            offs[p] = self.client.list_offset(
+                self.topic, p, latest=self.start_latest)
+        self.offsets = offs
+        log.info("kafka receiver: topic %s partitions %s",
+                 self.topic, sorted(self.offsets))
 
     def poll_once(self) -> int:
         """One fetch round over all partitions; returns messages
@@ -287,11 +309,7 @@ class KafkaReceiver:
 
         got = 0
         if not self.offsets:
-            for p in self.client.partitions(self.topic):
-                self.offsets[p] = self.client.list_offset(
-                    self.topic, p, latest=self.start_latest)
-            log.info("kafka receiver: topic %s partitions %s",
-                     self.topic, sorted(self.offsets))
+            self._init_offsets()
         for p, off in list(self.offsets.items()):
             try:
                 records = self.client.fetch(self.topic, p, off)

@@ -76,17 +76,10 @@ class OpenCensusReceiver:
                     yield b""
                 except Exception as e:
                     recv.failures += 1
-                    from .distributor import PushError
+                    from .otlp_grpc import push_grpc_code
 
-                    if isinstance(e, PushError):
-                        code = (grpc.StatusCode.RESOURCE_EXHAUSTED
-                                if e.status == 429
-                                else grpc.StatusCode.UNAUTHENTICATED
-                                if e.status == 401
-                                else grpc.StatusCode.INVALID_ARGUMENT)
-                    else:
-                        code = grpc.StatusCode.INTERNAL
-                    context.abort(code, f"{type(e).__name__}: {e}")
+                    context.abort(push_grpc_code(e, grpc),
+                                  f"{type(e).__name__}: {e}")
                     return
 
         handler = grpc.method_handlers_generic_handler(

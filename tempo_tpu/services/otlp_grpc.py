@@ -23,6 +23,20 @@ _EXPORT_METHOD = "Export"
 _SERVICE = "opentelemetry.proto.collector.trace.v1.TraceService"
 
 
+def push_grpc_code(e: Exception, grpc):
+    """Push-exception -> canonical gRPC status, shared by every gRPC
+    receiver: 429 -> RESOURCE_EXHAUSTED (retryable to OTel SDKs),
+    401 -> UNAUTHENTICATED, other rejects -> INVALID_ARGUMENT (fatal),
+    anything unexpected -> INTERNAL."""
+    from .distributor import PushError
+
+    if isinstance(e, PushError):
+        return (grpc.StatusCode.RESOURCE_EXHAUSTED if e.status == 429
+                else grpc.StatusCode.UNAUTHENTICATED if e.status == 401
+                else grpc.StatusCode.INVALID_ARGUMENT)
+    return grpc.StatusCode.INTERNAL
+
+
 class OTLPGrpcReceiver:
     def __init__(self, app, max_workers: int = 8):
         self.app = app
@@ -49,15 +63,7 @@ class OTLPGrpcReceiver:
                 return b""
             except Exception as e:
                 recv.failures += 1
-                from .distributor import PushError
-
-                if isinstance(e, PushError):
-                    code = (grpc.StatusCode.RESOURCE_EXHAUSTED if e.status == 429
-                            else grpc.StatusCode.UNAUTHENTICATED if e.status == 401
-                            else grpc.StatusCode.INVALID_ARGUMENT)
-                else:
-                    code = grpc.StatusCode.INTERNAL
-                context.abort(code, f"{type(e).__name__}: {e}")
+                context.abort(push_grpc_code(e, grpc), f"{type(e).__name__}: {e}")
 
         handler = grpc.method_handlers_generic_handler(
             _SERVICE,
