@@ -369,6 +369,22 @@ void vtpu_seg_count_mask(const uint8_t* mask, const int32_t* span_off,
   }
 }
 
+// Weighted variant: rows carry fold weights (the tres membership axis,
+// where each entry stands for weight[j] spans -- db/search._host_eval).
+// Replaces numpy's pad+reduceat, which costs ~5x this linear scan.
+void vtpu_seg_weighted_count(const uint8_t* mask, const int32_t* weights,
+                             const int32_t* span_off, int64_t n_traces,
+                             int64_t n_spans, int64_t* out) {
+  for (int64_t t = 0; t < n_traces; t++) {
+    int64_t lo = span_off[t], hi = span_off[t + 1];
+    if (lo > n_spans) lo = n_spans;
+    if (hi > n_spans) hi = n_spans;
+    int64_t c = 0;
+    for (int64_t j = lo; j < hi; j++) c += mask[j] ? weights[j] : 0;
+    out[t] = c;
+  }
+}
+
 // --------------------------------------------------------- span metrics
 
 // Fused span-metrics fold (the metrics-generator's per-collection

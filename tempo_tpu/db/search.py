@@ -250,14 +250,16 @@ def _verify_candidates(blk: BackendBlock, req: SearchRequest, sids, needs_verify
     )
 
 
-def _build_results(
+def _candidates(
     blk: BackendBlock, req: SearchRequest, sids: list[int], counts: dict[int, int]
-) -> list[SearchResult]:
-    """Exact host re-check of time/duration + result materialization from
-    the cached trace-level index. O(len(sids)) -- callers cap it at the
-    escalation k, never the full match count."""
+) -> list[tuple]:
+    """Exact host re-check of time/duration + LIGHTWEIGHT candidate
+    records (start_ns, trace_id hex, dur_ms, matched, blk, sid):
+    everything the global merge sorts/dedupes on, with the dictionary
+    lookups + SearchResult construction deferred to the winners
+    (_materialize). O(len(sids)) -- callers cap it at the escalation k,
+    never the full match count."""
     ti = blk.trace_index
-    d = blk.dictionary
     out = []
     for sid in sids:
         start_ns = int(ti["trace.start_ns"][sid])
@@ -271,29 +273,42 @@ def _build_results(
             continue
         if req.end and start_ns > req.end * 1_000_000_000:
             continue
-        out.append(
-            SearchResult(
-                trace_id=ti["trace.id"][sid].tobytes().hex(),
-                root_service_name=d.string(int(ti["trace.root_service_id"][sid])),
-                root_trace_name=d.string(int(ti["trace.root_name_id"][sid])),
-                start_time_unix_nano=start_ns,
-                duration_ms=dur_ms,
-                matched_spans=int(counts.get(sid, 0)),
-            )
-        )
+        out.append((start_ns, ti["trace.id"][sid].tobytes().hex(), dur_ms,
+                    int(counts.get(sid, 0)), blk, int(sid)))
     return out
 
 
+def _materialize(cand: tuple) -> SearchResult:
+    """One candidate record -> wire SearchResult (the deferred
+    dictionary/materialization half of _candidates)."""
+    start_ns, tid_hex, dur_ms, cnt, blk, sid = cand
+    ti = blk.trace_index
+    d = blk.dictionary
+    return SearchResult(
+        trace_id=tid_hex,
+        root_service_name=d.string(int(ti["trace.root_service_id"][sid])),
+        root_trace_name=d.string(int(ti["trace.root_name_id"][sid])),
+        start_time_unix_nano=start_ns,
+        duration_ms=dur_ms,
+        matched_spans=cnt,
+    )
+
+
+
+
 def _collect_topk(blk: BackendBlock, req: SearchRequest, needs_verify: bool,
-                  selector, limit: int) -> list[SearchResult]:
+                  selector, limit: int, materialize: bool = True):
     """Escalating top-k collect: select k candidates (newest first),
     verify exactly, and only widen k when verification rejected enough
-    to fall short of the limit. selector(k) -> (sids, counts, n_match)."""
+    to fall short of the limit. selector(k) -> (sids, counts, n_match).
+    materialize=False returns candidate records (_candidates) for a
+    caller doing its own global merge -- the fused engine materializes
+    only the cross-block winners."""
     nt = blk.meta.total_traces
     if nt == 0:
         return []
     k = min(k_bucket(max(2 * limit, 32)), nt)
-    out: list[SearchResult] = []
+    out: list = []
     seen: set[int] = set()
     while True:
         sids, cnts, n_match = selector(k)
@@ -305,10 +320,10 @@ def _collect_topk(blk: BackendBlock, req: SearchRequest, needs_verify: bool,
             )
             okset = {int(s) for s in ok}
             out.extend(
-                _build_results(blk, req, [s for s, _ in fresh if s in okset], dict(fresh))
+                _candidates(blk, req, [s for s, _ in fresh if s in okset], dict(fresh))
             )
         if len(out) >= limit or len(seen) >= n_match or k >= nt:
-            return out
+            return [_materialize(c) for c in out] if materialize else out
         k = min(k_bucket(k * 4), nt)
 
 
@@ -612,7 +627,7 @@ def search_blocks_fused(
         return None
 
     io0 = {id(blk): blk.pack.bytes_read for blk, _ in live}
-    results: list[SearchResult] = []
+    results: list[tuple] = []  # _candidates records until the final merge
 
     def stage_and_eval(item):
         blk, p = item
@@ -650,7 +665,8 @@ def search_blocks_fused(
         def selector(k):
             return select_topk_host(tm, key, counts, k)
 
-        return _collect_topk(blk, req, p.needs_verify, selector, limit), n_spans
+        return _collect_topk(blk, req, p.needs_verify, selector, limit,
+                             materialize=False), n_spans
 
     # device staging IO + host scans overlap across one pool pass;
     # device kernel dispatches are async, so nothing blocks until the
@@ -692,17 +708,21 @@ def search_blocks_fused(
 
         results.extend(_collect_topk_multi(
             [blk for blk, _ in dev_items], [p for _, p in dev_items],
-            offsets, req, selector, limit,
+            offsets, req, selector, limit, materialize=False,
         ))
 
-    results.sort(key=lambda r: -r.start_time_unix_nano)
+    # global merge over lightweight candidates; only the winning `limit`
+    # pay dictionary lookups + SearchResult construction
+    results.sort(key=lambda c: -c[0])
     seen: set[str] = set()
-    deduped = []
-    for r in results:
-        if r.trace_id not in seen:
-            seen.add(r.trace_id)
-            deduped.append(r)
-    resp.traces = deduped[:limit]
+    resp.traces = []
+    for c in results:
+        if c[1] in seen:
+            continue
+        seen.add(c[1])
+        resp.traces.append(_materialize(c))
+        if len(resp.traces) >= limit:
+            break
     resp.inspected_bytes = sum(
         blk.pack.bytes_read - io0[id(blk)] for blk, _ in live
     )
@@ -710,16 +730,16 @@ def search_blocks_fused(
 
 
 def _collect_topk_multi(blocks, plans, offsets, req: SearchRequest, selector,
-                        limit: int) -> list[SearchResult]:
+                        limit: int, materialize: bool = True):
     """Escalating cross-block top-k collect: global winners map back to
     (block, sid) via the padded part offsets, then per-block exact
     verification + result building -- the multi-block twin of
-    _collect_topk."""
+    _collect_topk (same materialize contract)."""
     total = int(offsets[-1])
     if total == 0:
         return []
     k = min(k_bucket(max(2 * limit, 32)), total)
-    out: list[SearchResult] = []
+    out: list = []
     seen: set[int] = set()
     while True:
         gids, gcnts, n_match = selector(k)
@@ -739,10 +759,10 @@ def _collect_topk_multi(blocks, plans, offsets, req: SearchRequest, selector,
             ok = _verify_candidates(blk, req, sids, p.needs_verify)
             okset = {int(s) for s in ok}
             out.extend(
-                _build_results(blk, req, [s for s, c in pairs if s in okset], dict(pairs))
+                _candidates(blk, req, [s for s, c in pairs if s in okset], dict(pairs))
             )
         if len(out) >= limit or len(seen) >= n_match or k >= total or fresh == 0:
-            return out
+            return [_materialize(c) for c in out] if materialize else out
         k = min(k_bucket(k * 4), total)
 
 

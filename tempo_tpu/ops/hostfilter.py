@@ -223,17 +223,25 @@ def eval_block_host(
             out = None
             if n_spans == 0 or span_off.shape[0] <= 1:
                 out = np.zeros(n_traces, dtype=np.int64)
-            elif weights is None and span_off.shape[0] - 1 == n_traces:
+            elif span_off.shape[0] - 1 == n_traces:
                 # one-pass native fold (no astype/concatenate temps);
                 # int64 keeps the documented counts dtype uniform across
                 # the three branches
-                from ..native import seg_count_mask
+                if weights is None:
+                    from ..native import seg_count_mask
 
-                out = seg_count_mask(np.ascontiguousarray(span_mask),
-                                     np.ascontiguousarray(span_off, np.int32),
-                                     n_spans)
-                if out is not None:
-                    out = out.astype(np.int64)
+                    out = seg_count_mask(np.ascontiguousarray(span_mask),
+                                         np.ascontiguousarray(span_off, np.int32),
+                                         n_spans)
+                    if out is not None:
+                        out = out.astype(np.int64)
+                else:
+                    from ..native import seg_weighted_count
+
+                    out = seg_weighted_count(
+                        np.ascontiguousarray(span_mask),
+                        np.ascontiguousarray(weights, np.int32),
+                        np.ascontiguousarray(span_off, np.int32), n_spans)
             if out is None:
                 # sentinel-padded reduceat: starts may legally equal
                 # n_spans (sliced row-group shards clip trailing

@@ -517,15 +517,42 @@ def _make_handler(app: App):
                     parse_traceql(query)
                 except ParseError as e:
                     return self._err(400, f"invalid TraceQL: {e}")
-            req = SearchRequest(
-                tags=tags,
-                query=query,
-                min_duration_ms=int(float(q["minDuration"]) * 1000) if "minDuration" in q else 0,
-                max_duration_ms=int(float(q["maxDuration"]) * 1000) if "maxDuration" in q else 0,
-                start=int(q.get("start", 0)),
-                end=int(q.get("end", 0)),
-                limit=int(q.get("limit", 20)),
-            )
+            def dur_ms(name: str) -> int:
+                """Go-style duration params ('300ms', '1m30s', '2h') per
+                the reference's time.ParseDuration-based API
+                (pkg/api ParseSearchRequest); bare numbers keep this
+                API's original plain-seconds reading."""
+                v = q.get(name, "")
+                if not v:
+                    return 0
+                try:
+                    ms = int(float(v) * 1000)
+                except ValueError:
+                    from ..traceql.parser import _parse_duration_ns
+
+                    ns = _parse_duration_ns(v)
+                    if ns <= 0:
+                        raise ValueError(f"invalid duration {name}={v!r}")
+                    ms = ns // 1_000_000
+                if ms <= 0:
+                    # this filter API is ms-granularity; silently mapping
+                    # '500us' to 0 would DROP the filter (0 = unset)
+                    raise ValueError(
+                        f"{name}={v!r} is below this API's 1ms granularity")
+                return ms
+
+            try:
+                req = SearchRequest(
+                    tags=tags,
+                    query=query,
+                    min_duration_ms=dur_ms("minDuration"),
+                    max_duration_ms=dur_ms("maxDuration"),
+                    start=int(q.get("start", 0)),
+                    end=int(q.get("end", 0)),
+                    limit=int(q.get("limit", 20)),
+                )
+            except (ValueError, OverflowError) as e:
+                return self._err(400, f"bad search parameter: {e}")
             resp = app.frontend.search(tenant, req)
             return self._send(
                 200,

@@ -556,12 +556,18 @@ def plan_search_request(
             return PlannedQuery(None, (), [], {}, prune=True)
         if t != TRUE:
             children.append(("tracify", t))
-    if min_duration_ms or max_duration_ms:
-        lo = max(0, min_duration_ms * 1000 - 1) if min_duration_ms else 0
-        hi = min(2**31 - 1, max_duration_ms * 1000 + 1) if max_duration_ms else 2**31 - 1
-        children.append(
-            p.cond(Cond(target="trace", col="trace.dur_us", op="range", needs_verify=True), v0=lo, v1=hi)
-        )
+    # duration bounds compare EXACTLY via the (us, ns%1000) column pair,
+    # so they don't force verification (which tag searches never run --
+    # the old conservative +-1us range silently over-matched there, and
+    # needlessly host-verified every TraceQL duration query)
+    if min_duration_ms:
+        children.append(_dur_pair_tree(
+            p, "trace", "trace.dur_us", "trace.dur_lo", ">=",
+            min_duration_ms * 1_000_000))
+    if max_duration_ms:
+        children.append(_dur_pair_tree(
+            p, "trace", "trace.dur_us", "trace.dur_lo", "<=",
+            max_duration_ms * 1_000_000))
     if start_rel_ms is not None:
         lo, hi = start_rel_ms
         children.append(
