@@ -259,7 +259,7 @@ def _candidates(
     lookups + SearchResult construction deferred to the winners
     (_materialize). O(len(sids)) -- callers cap it at the escalation k,
     never the full match count."""
-    ti = blk.trace_index
+    ti = blk.search_index
     out = []
     for sid in sids:
         start_ns = int(ti["trace.start_ns"][sid])
@@ -282,7 +282,7 @@ def _materialize(cand: tuple) -> SearchResult:
     """One candidate record -> wire SearchResult (the deferred
     dictionary/materialization half of _candidates)."""
     start_ns, tid_hex, dur_ms, cnt, blk, sid = cand
-    ti = blk.trace_index
+    ti = blk.search_index
     d = blk.dictionary
     return SearchResult(
         trace_id=tid_hex,
