@@ -692,3 +692,106 @@ def test_concat_compound_compaction(tmp_path):
                        for bid in backend.blocks(TENANT))
     finally:
         BL.COMPACTED_GRACE_S = _g
+
+
+def _old_layout_block(backend, traces):
+    """Write a round-3-layout block: today's builder output minus the
+    columns that joined in round 4 (tres axis, span.parent_idx). The
+    single definition both compat tests share."""
+    from tempo_tpu.block.builder import BlockBuilder, write_block
+
+    b = BlockBuilder(TENANT)
+    for tid, t in sorted(traces, key=lambda p: p[0]):
+        b.add_trace(tid, t)
+    fin = b.finalize()
+    for name in list(fin.cols):
+        if name.startswith("tres.") or name in ("trace.tres_off", "span.parent_idx"):
+            del fin.cols[name]
+    return write_block(backend, fin)
+
+
+def test_pre_upgrade_block_compat(tmp_path):
+    """A physically OLD-format block (no tres axis, no span.parent_idx --
+    the round-3 layout) must keep working end to end: find by id, tag
+    search, structural-TraceQL planning without the parent column, and
+    compaction MIXED with a current-format block (differing column sets
+    force the columnar merge's UnsupportedColumnar fallback to the
+    wire-level merge)."""
+    from tempo_tpu.backend import MemBackend
+    from tempo_tpu.db.compactor import CompactionJob, CompactorConfig, compact
+    from tempo_tpu.db.search import search_block
+
+    backend = MemBackend()
+    old_traces = make_traces(20, seed=21, n_spans=4)
+    old_meta = _old_layout_block(backend, old_traces)
+
+    new_traces = make_traces(20, seed=22, n_spans=4)
+    new_meta = build_block_from_traces(backend, TENANT, new_traces)
+
+    db = _db(tmp_path, backend)
+    db.poll_now()
+
+    # the old block reads fine: find every id, search without tres/struct
+    blk = db.open_block(old_meta)
+    assert not blk.pack.has("tres.res") and not blk.pack.has("span.parent_idx")
+    for tid, t in old_traces:
+        got = db.find_trace_by_id(TENANT, tid)
+        assert got is not None and got.span_count() == t.span_count()
+    svc = next(iter(old_traces[0][1].resource_spans[0].resource.attrs.values()))
+    r = search_block(blk, SearchRequest(tags={"service.name": str(svc)}, limit=100),
+                     mode="host")
+    assert r.inspected_spans == blk.meta.total_spans
+    assert any(hit.trace_id == old_traces[0][0].hex() for hit in r.traces)
+    # structural TraceQL must plan WITHOUT the parent column (host path);
+    # testdata traces have server->client edges, so hits are guaranteed
+    r2 = search_block(
+        blk, SearchRequest(query='{ kind = server } > { kind = client }', limit=10),
+        mode="host")
+    assert r2.inspected_spans == blk.meta.total_spans
+
+    # mixed-format compaction: columnar merge refuses (differing column
+    # sets) and the wire fallback produces one complete modern block.
+    # concat is disabled so the small level-0 inputs don't take the
+    # compound-block shortcut (which legitimately keeps old layouts).
+    res = compact(backend, CompactionJob(TENANT, [old_meta, new_meta]),
+                  CompactorConfig(concat_small_input_bytes=0))
+    assert res.traces_out == 40
+    db.poll_now()
+    merged = [m for m in db.blocklist.metas(TENANT) if m.compaction_level >= 1]
+    assert len(merged) >= 1
+    mblk = db.open_block(merged[0])
+    assert mblk.pack.has("tres.res") and mblk.pack.has("span.parent_idx")
+    for tid, t in old_traces + new_traces:
+        got = db.find_trace_by_id(TENANT, tid)
+        assert got is not None and got.span_count() == t.span_count()
+
+
+def test_compound_block_mixed_layout_compat(tmp_path):
+    """The no-decode CONCAT compaction path applied to a rolling-upgrade
+    mix (one old-layout sub-block without tres/parent_idx, one current)
+    must yield a compound block that still answers find and search."""
+    from tempo_tpu.backend import MemBackend
+    from tempo_tpu.db.compactor import CompactionJob, CompactorConfig, compact
+
+    backend = MemBackend()
+    old_traces = make_traces(15, seed=31, n_spans=4)
+    old_meta = _old_layout_block(backend, old_traces)
+    new_traces = make_traces(15, seed=32, n_spans=4)
+    new_meta = build_block_from_traces(backend, TENANT, new_traces)
+
+    res = compact(backend, CompactionJob(TENANT, [old_meta, new_meta]),
+                  CompactorConfig())  # small level-0 inputs -> concat path
+    assert res.traces_out == 30
+
+    db = _db(tmp_path, backend)
+    db.poll_now()
+    merged = [m for m in db.blocklist.metas(TENANT) if m.compaction_level >= 1]
+    assert merged
+    for tid, t in old_traces + new_traces:
+        got = db.find_trace_by_id(TENANT, tid)
+        assert got is not None and got.span_count() == t.span_count()
+    svc = old_traces[0][1].resource_spans[0].resource.attrs["service.name"]
+    r = db.search(TENANT, SearchRequest(tags={"service.name": str(svc)}, limit=100))
+    assert r.inspected_spans >= 30 * 4
+    # the OLD-layout sub-block's matching trace must be among the hits
+    assert any(hit.trace_id == old_traces[0][0].hex() for hit in r.traces)
