@@ -12,11 +12,13 @@ Lines, in order:
      batched bisection kernel + row materialization).
   3. compaction_mb_per_sec -- BASELINE config #4 shape: level-0->1
      columnar compaction of many small blocks, MB/s of input consumed.
-  4. spanmetrics_reduce_spans_per_sec -- BASELINE config #5: span-metrics
+  4. ingest_otlp_mb_per_sec -- raw-bytes OTLP write path (native scan +
+     splice), vs the reference's 15 MB/s per-tenant rate-limit default.
+  5. spanmetrics_reduce_spans_per_sec -- BASELINE config #5: span-metrics
      segmented reduce (calls + latency sum + histogram) on device.
-  5. search_block_e2e_cold_spans_per_sec -- BASELINE config #2, fresh
+  6. search_block_e2e_cold_spans_per_sec -- BASELINE config #2, fresh
      reader each query: every byte from disk + staged to device.
-  6. search_block_e2e_spans_per_sec -- BASELINE config #2 (headline):
+  7. search_block_e2e_spans_per_sec -- BASELINE config #2 (headline):
      hot immutable block, staged device arrays cached (the production
      querier pattern; the reference's hot path re-decodes parquet from
      the OS page cache each query).
@@ -413,6 +415,45 @@ def bench_compaction(tmp: str) -> None:
     _emit("compaction_small_blocks_mb_per_sec", total2 / dt2 / 1e6, "MB/s", 0.0)
 
 
+def bench_ingest(tmp: str) -> None:
+    """OTLP raw-bytes ingest through the production write path
+    (push_raw: native structural scan + byte splice -> rate limit ->
+    WAL append + live map), distributor-role shape (no generator tap --
+    the tap is async and in production runs on other cores/hosts).
+    vs_baseline is the ratio to the reference's 15 MB/s per-tenant
+    ingest rate-limit default (modules/overrides/limits.go:92-99): >= 1
+    means one tenant at the default limit can't saturate this path."""
+    from tempo_tpu.services.app import App, AppConfig, IngesterConfig
+    from tempo_tpu.util.testdata import make_traces
+    from tempo_tpu.wire import otlp_pb
+
+    cfg = AppConfig(
+        target="all", http_port=0, storage_path=tmp + "/ingest-store",
+        ingester=IngesterConfig(max_trace_idle_s=9999, max_block_age_s=9999,
+                                flush_check_period_s=9999),
+    )
+    app = App(cfg)
+    app.start()
+    try:
+        app.distributor.generator_forward = None
+        app.distributor.generator_ring = None
+        tenant = app.tenant_of({})
+        traces = make_traces(200, seed=3, n_spans=20)
+        payloads = [otlp_pb.encode_trace(t) for _, t in traces]
+        raw_bytes = sum(len(p) for p in payloads)
+        app.distributor.push_raw(tenant, payloads[0])  # warm
+        iters = 5
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            for p in payloads:
+                app.distributor.push_raw(tenant, p)
+        dt = time.perf_counter() - t0
+        mbs = raw_bytes * iters / dt / 1e6
+        _emit("ingest_otlp_mb_per_sec", mbs, "MB/s", mbs / 15.0)
+    finally:
+        app.stop()
+
+
 def bench_spanmetrics() -> None:
     import jax
 
@@ -438,6 +479,7 @@ def main() -> None:
     try:
         cold, warm = bench_find_and_search(tmp)
         bench_compaction(tmp)
+        bench_ingest(tmp)
         bench_spanmetrics()
         _emit("search_block_e2e_cold_spans_per_sec", cold, "spans/s",
               cold / BASELINE_SPANS_PER_SEC)

@@ -127,6 +127,202 @@ int vtpu_varint_frames(const uint8_t* data, int64_t n,
   return count;
 }
 
+// --------------------------------------------------------- otlp span scan
+
+// Structural scan of an OTLP ExportTraceServiceRequest / TracesData:
+// locate every span submessage (byte range + owning resource/scope
+// envelope) and pull exactly three fields out of each span body --
+// trace_id (1), start (7) and end (8) -- WITHOUT decoding anything
+// else. The distributor's fast ingest path re-batches spans by trace
+// id by SPLICING these ranges back together under re-used envelope
+// bytes (wire/otlp_splice.py), replacing the Python
+// decode-model-re-encode round trip.
+//
+// Envelopes: for each ResourceSpans, every field EXCEPT scope_spans(2)
+// verbatim (tag+len+body); for each ScopeSpans, every field except
+// spans(2). Copied into env_buf so the Python side splices with two
+// slices per group.
+//
+// Returns 0 ok; 1 malformed (caller falls back to the Python decode
+// path); 2 capacity exceeded (caller re-calls with larger buffers).
+
+static inline bool oscan_varint(const uint8_t* d, int64_t n, int64_t* pos,
+                                uint64_t* out) {
+  uint64_t v = 0;
+  int shift = 0;
+  while (*pos < n && shift < 64) {
+    uint8_t b = d[(*pos)++];
+    v |= (uint64_t)(b & 0x7F) << shift;
+    if (!(b & 0x80)) {
+      *out = v;
+      return true;
+    }
+    shift += 7;
+  }
+  return false;
+}
+
+int vtpu_otlp_scan(const uint8_t* buf, int64_t n,
+                   int64_t* span_off, int64_t* span_len, int32_t* span_rs,
+                   int32_t* span_ss, uint8_t* trace_ids, uint64_t* start_ns,
+                   uint64_t* end_ns, int64_t cap_spans,
+                   uint8_t* env_buf, int64_t cap_env,        // rs envelopes
+                   uint8_t* senv_buf, int64_t cap_senv,      // ss envelopes
+                   int64_t* rs_env_off, int64_t* rs_env_len, int64_t cap_rs,
+                   int64_t* ss_env_off, int64_t* ss_env_len, int32_t* ss_rs,
+                   int64_t cap_ss,
+                   int64_t* counts /* [n_spans, n_rs, n_ss, env, senv] */) {
+  int64_t ns_count = 0, nrs = 0, nss = 0, env_pos = 0, senv_pos = 0;
+  int64_t pos = 0;
+  while (pos < n) {  // TracesData: repeated resource_spans = 1
+    uint64_t tag;
+    int64_t tag_start = pos;
+    (void)tag_start;
+    if (!oscan_varint(buf, n, &pos, &tag)) return 1;
+    uint64_t fno = tag >> 3, wt = tag & 7;
+    if (wt != 2) return 1;  // top level is only length-delimited RS
+    uint64_t len;
+    if (!oscan_varint(buf, n, &pos, &len) || pos + (int64_t)len > n) return 1;
+    if (fno != 1) {  // unknown top-level field: keep nothing, skip
+      pos += (int64_t)len;
+      continue;
+    }
+    // ---- one ResourceSpans
+    if (nrs >= cap_rs) return 2;
+    int64_t rs_idx = nrs++;
+    rs_env_off[rs_idx] = env_pos;
+    int64_t rs_end = pos + (int64_t)len;
+    while (pos < rs_end) {
+      int64_t f_start = pos;
+      uint64_t ftag;
+      if (!oscan_varint(buf, rs_end, &pos, &ftag)) return 1;
+      uint64_t ffno = ftag >> 3, fwt = ftag & 7;
+      int64_t body_off = pos, body_len = 0;
+      if (fwt == 2) {
+        uint64_t blen;
+        if (!oscan_varint(buf, rs_end, &pos, &blen) || pos + (int64_t)blen > rs_end)
+          return 1;
+        body_off = pos;
+        body_len = (int64_t)blen;
+        pos += body_len;
+      } else if (fwt == 0) {
+        uint64_t v;
+        if (!oscan_varint(buf, rs_end, &pos, &v)) return 1;
+      } else if (fwt == 1) {
+        if (pos + 8 > rs_end) return 1;
+        pos += 8;
+      } else if (fwt == 5) {
+        if (pos + 4 > rs_end) return 1;
+        pos += 4;
+      } else {
+        return 1;
+      }
+      if (!(ffno == 2 && fwt == 2)) {  // non-scope_spans: envelope verbatim
+        int64_t flen = pos - f_start;
+        if (env_pos + flen > cap_env) return 2;
+        memcpy(env_buf + env_pos, buf + f_start, (size_t)flen);
+        env_pos += flen;
+        continue;
+      }
+      // ---- one ScopeSpans
+      if (nss >= cap_ss) return 2;
+      int64_t ss_idx = nss++;
+      ss_rs[ss_idx] = (int32_t)rs_idx;
+      ss_env_off[ss_idx] = senv_pos;
+      int64_t ss_end = body_off + body_len;
+      int64_t spos = body_off;
+      while (spos < ss_end) {
+        int64_t sf_start = spos;
+        uint64_t stag;
+        if (!oscan_varint(buf, ss_end, &spos, &stag)) return 1;
+        uint64_t sfno = stag >> 3, swt = stag & 7;
+        int64_t sb_off = spos, sb_len = 0;
+        if (swt == 2) {
+          uint64_t blen;
+          if (!oscan_varint(buf, ss_end, &spos, &blen) ||
+              spos + (int64_t)blen > ss_end)
+            return 1;
+          sb_off = spos;
+          sb_len = (int64_t)blen;
+          spos += sb_len;
+        } else if (swt == 0) {
+          uint64_t v;
+          if (!oscan_varint(buf, ss_end, &spos, &v)) return 1;
+        } else if (swt == 1) {
+          if (spos + 8 > ss_end) return 1;
+          spos += 8;
+        } else if (swt == 5) {
+          if (spos + 4 > ss_end) return 1;
+          spos += 4;
+        } else {
+          return 1;
+        }
+        if (!(sfno == 2 && swt == 2)) {  // non-span field: ss envelope
+          int64_t flen = spos - sf_start;
+          if (senv_pos + flen > cap_senv) return 2;
+          memcpy(senv_buf + senv_pos, buf + sf_start, (size_t)flen);
+          senv_pos += flen;
+          continue;
+        }
+        // ---- one Span: record range + pull trace_id/start/end
+        if (ns_count >= cap_spans) return 2;
+        int64_t sp = ns_count++;
+        span_off[sp] = sb_off;
+        span_len[sp] = sb_len;
+        span_rs[sp] = (int32_t)rs_idx;
+        span_ss[sp] = (int32_t)ss_idx;
+        start_ns[sp] = 0;
+        end_ns[sp] = 0;
+        bool got_tid = false;
+        int64_t p2 = sb_off, sp_end = sb_off + sb_len;
+        while (p2 < sp_end) {
+          uint64_t t2;
+          if (!oscan_varint(buf, sp_end, &p2, &t2)) return 1;
+          uint64_t f2 = t2 >> 3, w2 = t2 & 7;
+          if (w2 == 2) {
+            uint64_t blen;
+            if (!oscan_varint(buf, sp_end, &p2, &blen) ||
+                p2 + (int64_t)blen > sp_end)
+              return 1;
+            if (f2 == 1 && blen == 16) {
+              memcpy(trace_ids + sp * 16, buf + p2, 16);
+              got_tid = true;
+            }
+            p2 += (int64_t)blen;
+          } else if (w2 == 1) {
+            if (p2 + 8 > sp_end) return 1;
+            uint64_t v;
+            memcpy(&v, buf + p2, 8);  // little-endian hosts only (x86/arm)
+            if (f2 == 7) start_ns[sp] = v;
+            else if (f2 == 8) end_ns[sp] = v;
+            p2 += 8;
+          } else if (w2 == 0) {
+            uint64_t v;
+            if (!oscan_varint(buf, sp_end, &p2, &v)) return 1;
+            // tolerate nonconformant varint timestamps
+            if (f2 == 7) start_ns[sp] = v;
+            else if (f2 == 8) end_ns[sp] = v;
+          } else if (w2 == 5) {
+            if (p2 + 4 > sp_end) return 1;
+            p2 += 4;
+          } else {
+            return 1;
+          }
+        }
+        if (!got_tid) return 1;  // spans without a 16B trace id: fall back
+      }
+      ss_env_len[ss_idx] = senv_pos - ss_env_off[ss_idx];
+    }
+    rs_env_len[rs_idx] = env_pos - rs_env_off[rs_idx];
+  }
+  counts[0] = ns_count;
+  counts[1] = nrs;
+  counts[2] = nss;
+  counts[3] = env_pos;
+  counts[4] = senv_pos;
+  return 0;
+}
+
 // ------------------------------------------------------------------- zstd
 
 // Compress n chunks in parallel. in_offsets[i]..+in_lens[i] index into

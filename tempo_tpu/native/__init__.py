@@ -114,6 +114,17 @@ def _bind(lib):
         ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
         ctypes.c_int64, ctypes.c_int64, ctypes.c_void_p,
     ]
+    lib.vtpu_otlp_scan.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64,
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+        ctypes.c_void_p, ctypes.c_int64,
+        ctypes.c_void_p, ctypes.c_int64,
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+        ctypes.c_void_p,
+    ]
+    lib.vtpu_otlp_scan.restype = ctypes.c_int
     lib.vtpu_span_metrics.argtypes = [
         ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
         ctypes.c_int, ctypes.c_int64, ctypes.c_void_p, ctypes.c_void_p,
@@ -492,6 +503,67 @@ def seg_weighted_count(mask: np.ndarray, weights: np.ndarray,
                                 span_off.ctypes.data, n_traces, n_spans,
                                 out.ctypes.data)
     return out
+
+
+def otlp_scan(payload: bytes):
+    """Structural scan of OTLP trace bytes (vtpu_otlp_scan): returns
+    (span_off, span_len, span_rs, span_ss, trace_ids (n,16) u8,
+    start_ns, end_ns, env_buf bytes, senv_buf bytes, rs_env (off,len),
+    ss_env (off,len,rs)) or None (native unavailable / malformed
+    payload -- caller decodes via the Python model path)."""
+    lib = _load()
+    if lib is None or getattr(lib, "vtpu_otlp_scan", None) is None:
+        return None
+    n = len(payload)
+    if n == 0:
+        return None
+    buf = np.frombuffer(payload, dtype=np.uint8)
+    # a span submessage can't be smaller than ~20 bytes (16B trace id +
+    # framing); start generous, regrow on rc=2
+    cap_spans = max(16, n // 24 + 8)
+    cap_rs = cap_ss = max(8, n // 64 + 8)
+    for _ in range(4):
+        span_off = np.empty(cap_spans, np.int64)
+        span_len = np.empty(cap_spans, np.int64)
+        span_rs = np.empty(cap_spans, np.int32)
+        span_ss = np.empty(cap_spans, np.int32)
+        tids = np.empty((cap_spans, 16), np.uint8)
+        start_ns = np.empty(cap_spans, np.uint64)
+        end_ns = np.empty(cap_spans, np.uint64)
+        env = np.empty(n + 16, np.uint8)
+        senv = np.empty(n + 16, np.uint8)
+        rs_off = np.empty(cap_rs, np.int64)
+        rs_len = np.empty(cap_rs, np.int64)
+        ss_off = np.empty(cap_ss, np.int64)
+        ss_len = np.empty(cap_ss, np.int64)
+        ss_rs = np.empty(cap_ss, np.int32)
+        counts = np.zeros(5, np.int64)
+        rc = lib.vtpu_otlp_scan(
+            buf.ctypes.data, n,
+            span_off.ctypes.data, span_len.ctypes.data, span_rs.ctypes.data,
+            span_ss.ctypes.data, tids.ctypes.data, start_ns.ctypes.data,
+            end_ns.ctypes.data, cap_spans,
+            env.ctypes.data, env.shape[0],
+            senv.ctypes.data, senv.shape[0],
+            rs_off.ctypes.data, rs_len.ctypes.data, cap_rs,
+            ss_off.ctypes.data, ss_len.ctypes.data, ss_rs.ctypes.data, cap_ss,
+            counts.ctypes.data,
+        )
+        if rc == 2:
+            cap_spans *= 4
+            cap_rs *= 4
+            cap_ss *= 4
+            continue
+        if rc != 0:
+            return None
+        k, nrs, nss = int(counts[0]), int(counts[1]), int(counts[2])
+        return (span_off[:k], span_len[:k], span_rs[:k], span_ss[:k],
+                tids[:k], start_ns[:k], end_ns[:k],
+                env[: int(counts[3])].tobytes(),
+                senv[: int(counts[4])].tobytes(),
+                rs_off[:nrs], rs_len[:nrs],
+                ss_off[:nss], ss_len[:nss], ss_rs[:nss])
+    return None
 
 
 def span_metrics_fold(sid: np.ndarray, dur: np.ndarray, edges: np.ndarray,

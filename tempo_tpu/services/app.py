@@ -320,6 +320,8 @@ class App:
         self._started = True
 
     def stop(self) -> None:
+        if self.distributor is not None:
+            self.distributor.stop()  # drain the async generator tap
         if self.remote_writer is not None:
             self.remote_writer.stop()
         self.overrides.stop()
@@ -596,11 +598,11 @@ def _make_handler(app: App):
                     ctype = self.headers.get("Content-Type", "")
                     if "json" in ctype:
                         tr = otlp_json.loads(body)
+                        app.distributor.push(tenant, tr.resource_spans)
                     else:
-                        from ..wire import otlp_pb
-
-                        tr = otlp_pb.decode_trace(body)
-                    app.distributor.push(tenant, tr.resource_spans)
+                        # proto bodies take the raw fast path (native
+                        # scan + splice; 400 if undecodable)
+                        app.distributor.push_raw(tenant, body)
                     return self._send(200, "{}")
                 if u.path == "/api/traces":  # Jaeger collector thrift ingest
                     if app.distributor is None:

@@ -35,6 +35,7 @@ class DistributorStats:
     push_failures: int = 0
     spans_refused_rate: int = 0
     traces_refused_size: int = 0
+    gen_tap_dropped: int = 0  # generator-tap queue overflows (lossy tap)
 
 
 class Distributor:
@@ -56,8 +57,73 @@ class Distributor:
         from ..util.metrics import Histogram
 
         self.push_latency = Histogram("tempo_distributor_push_duration_seconds")
+        # async generator tap: the metrics leg (decode for the raw fast
+        # path + shuffle-shard routing + network sends) runs OFF the
+        # ingest critical path on one worker; a bounded queue keeps it
+        # lossy-on-overflow, matching the tap's never-fail-ingest
+        # contract (errors are already swallowed)
+        import queue as _queue
+        import threading as _threading
 
-    def _forward_to_generators(self, tenant: str, per_trace: dict) -> None:
+        self._gen_q: _queue.Queue = _queue.Queue(maxsize=256)
+        self._gen_thread = None
+        self._gen_lock = _threading.Lock()  # guards thread start + pending
+        self._gen_pending = 0  # queued + in-flight tap items
+        self._gen_stop = False
+
+    def _forward_to_generators(self, tenant: str, traces_fn) -> None:
+        """traces_fn() -> {tid: Trace}, resolved ONLY when a generator
+        target exists -- and then on the TAP WORKER, not the push path:
+        the raw-bytes fast path never decodes models during ingest."""
+        if self.generator_ring is None and self.generator_forward is None:
+            return
+        import queue as _queue
+
+        with self._gen_lock:
+            if self._gen_thread is None:
+                import threading
+
+                self._gen_thread = threading.Thread(
+                    target=self._gen_tap_loop, daemon=True, name="generator-tap")
+                self._gen_thread.start()
+            try:
+                self._gen_q.put_nowait((tenant, traces_fn))
+                self._gen_pending += 1
+            except _queue.Full:
+                self.stats.gen_tap_dropped += 1
+
+    def _gen_tap_loop(self) -> None:
+        while not self._gen_stop:
+            try:
+                item = self._gen_q.get(timeout=0.5)
+            except Exception:
+                continue
+            try:
+                tenant, traces_fn = item
+                self._forward_now(tenant, traces_fn())
+            except Exception:
+                pass  # metrics tap must never crash its worker
+            finally:
+                # pending counts queued + in-flight, decremented only
+                # AFTER processing: flush can't slip through the window
+                # between queue pop and the work happening
+                with self._gen_lock:
+                    self._gen_pending -= 1
+
+    def flush_generator_tap(self, timeout_s: float = 5.0) -> None:
+        """Drain the tap queue (tests / graceful shutdown)."""
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            with self._gen_lock:
+                if self._gen_pending == 0:
+                    return
+            time.sleep(0.005)
+
+    def stop(self) -> None:
+        self.flush_generator_tap(timeout_s=2.0)
+        self._gen_stop = True
+
+    def _forward_now(self, tenant: str, per_trace: dict) -> None:
         if self.generator_ring is not None:
             from ..util.hashing import fnv1a_32
 
@@ -88,7 +154,54 @@ class Distributor:
         with timed(self.push_latency):
             self._push(tenant, batches)
 
-    def _push(self, tenant: str, batches: list[ResourceSpans]) -> None:
+    def push_raw(self, tenant: str, payload: bytes) -> int:
+        """One OTLP export request as RAW proto bytes: the fast write
+        path. The native structural scanner + byte splicer regroup spans
+        by trace id without building model objects or re-encoding
+        (wire/otlp_splice.py); the reference's analog keeps pre-marshaled
+        per-trace bytes end to end (PushBytes, sendToIngestersViaBytes).
+        Falls back to decode + the model path when the native layer is
+        unavailable or the payload doesn't scan cleanly; a payload
+        neither path can read raises PushError(400) so receivers can
+        classify it as poison rather than transient. Returns the span
+        count."""
+        from ..util.metrics import timed
+
+        with timed(self.push_latency):
+            out = None
+            try:
+                from ..wire.otlp_splice import split_by_trace
+
+                out = split_by_trace(payload)
+            except Exception:
+                out = None  # scanner edge case: the model path decides
+            if out is None:
+                from ..wire.otlp_pb import decode_trace
+
+                try:
+                    tr = decode_trace(payload)
+                except Exception as e:
+                    raise PushError(400, f"undecodable OTLP payload: {e}")
+                return self._push(tenant, tr.resource_spans)
+            segs, n_spans = out
+            now = time.time()
+            self.stats.spans_received += n_spans
+            if not self.limiter.peek(tenant, n_spans * 16, now):
+                self.stats.spans_refused_rate += n_spans
+                raise PushError(429, f"tenant {tenant} over ingestion rate limit")
+            if not segs:
+                return 0
+
+            def lazy_traces() -> dict:
+                from ..wire.segment import segment_to_trace
+
+                return {tid: segment_to_trace(seg)
+                        for tid, (_, _, seg) in segs.items()}
+
+            self._send_segments(tenant, segs, n_spans, lazy_traces, now)
+            return n_spans
+
+    def _push(self, tenant: str, batches: list[ResourceSpans]) -> int:
         now = time.time()
         n_spans = sum(len(ss.spans) for rs in batches for ss in rs.scope_spans)
         self.stats.spans_received += n_spans
@@ -104,23 +217,29 @@ class Distributor:
 
         per_trace = self._requests_by_trace_id(batches)
         if not per_trace:
-            return
+            return 0
 
         # serialize first so the limiter and bytes_received see REAL wire
         # bytes, not a guess (reference limits on actual request size,
         # distributor.go:312-319)
-        max_trace = self.overrides.for_tenant(tenant).max_bytes_per_trace
         segs = {}
-        nbytes = 0
         for tid, tr in per_trace.items():
             lo, hi = tr.time_range_nanos()
             seg = segment_for_write(tr, (lo or 0) // 10**9, ((hi or 0) + 10**9 - 1) // 10**9)
-            nbytes += len(seg)
             segs[tid] = ((lo or 0) // 10**9, ((hi or 0) + 10**9 - 1) // 10**9, seg)
+        self._send_segments(tenant, segs, n_spans, lambda: per_trace, now)
+        return n_spans
+
+    def _send_segments(self, tenant: str, segs: dict, n_spans: int,
+                       traces_fn, now: float) -> None:
+        """Limit, replicate and quorum-write prepared per-trace segments
+        (the shared tail of the model and raw push paths)."""
+        nbytes = sum(len(seg) for _, _, seg in segs.values())
         self.stats.bytes_received += nbytes
         if not self.limiter.allow(tenant, nbytes, now):
             self.stats.spans_refused_rate += n_spans
             raise PushError(429, f"tenant {tenant} over ingestion rate limit")
+        max_trace = self.overrides.for_tenant(tenant).max_bytes_per_trace
 
         lim_filtered = {}
         for tid, (s, e, seg) in segs.items():
@@ -164,7 +283,7 @@ class Distributor:
             raise PushError(500, f"{len(failed)} traces failed quorum write: {errors[:1]}")
         self.stats.traces_pushed += len(lim_filtered)
 
-        self._forward_to_generators(tenant, per_trace)
+        self._forward_to_generators(tenant, traces_fn)
 
     # ------------------------------------------------------------ rebatch
     @staticmethod

@@ -219,7 +219,10 @@ class Instance:
             for lt in self.live.values():
                 for seg in lt.segments:
                     self.head.append(lt.trace_id, lt.start_s, lt.end_s, seg)
-            self.head.flush()
+            # the new head is about to become the ONLY wal copy of the
+            # carried-over live traces (the old file is deleted once the
+            # block lands): force the fsync
+            self.head.flush(sync=True)
         try:
             with timed(FLUSH_DURATION):
                 meta = self.db.write_block(self.tenant, traces)

@@ -17,7 +17,6 @@ from __future__ import annotations
 
 from concurrent import futures
 
-from ..wire import otlp_pb
 
 _EXPORT_METHOD = "Export"
 _SERVICE = "opentelemetry.proto.collector.trace.v1.TraceService"
@@ -58,8 +57,9 @@ class OTLPGrpcReceiver:
                 md = {k.lower(): v for k, v in (context.invocation_metadata() or [])}
                 # gRPC metadata keys are lowercase; re-shape for tenant_of
                 tenant = app.tenant_of({"X-Scope-OrgID": md.get("x-scope-orgid", "")})
-                tr = otlp_pb.decode_trace(request)
-                app.distributor.push(tenant, tr.resource_spans)
+                # raw fast path: native scan + byte splice, no model
+                # decode on the write path (distributor.push_raw)
+                app.distributor.push_raw(tenant, request)
                 return b""
             except Exception as e:
                 recv.failures += 1

@@ -1,0 +1,96 @@
+"""Zero-decode OTLP rebatching: split an ExportTraceServiceRequest's
+raw bytes into per-trace segments by BYTE SPLICING.
+
+The distributor's hot write loop regroups spans by trace id
+(reference: requestsByTraceID, modules/distributor/distributor.go:451).
+The model path decodes the payload into wire objects and re-encodes one
+proto per trace -- all Python, and the single biggest ingest cost. Here
+the native structural scanner (native/vtpu_native.cc vtpu_otlp_scan)
+finds every span submessage's byte range plus its trace id and
+timestamps, and this module reassembles per-trace TracesData bytes from
+slices of the ORIGINAL payload: resource/scope envelope bytes are
+reused verbatim, span bodies are never touched. Proto semantics make
+the splice exact: repeated fields may appear in any order and split
+across messages, so concatenating envelope fields with a subset of
+span fields re-encodes the same logical message.
+
+Falls back to None (caller uses the model path) when the native layer
+is absent or the payload doesn't parse cleanly.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import pbwire as w
+from .segment import _HDR, _V1
+
+_SPAN_TAG = bytes([0x12])  # ScopeSpans.spans = 2, wire type 2
+_SS_TAG = bytes([0x12])  # ResourceSpans.scope_spans = 2, wire type 2
+_RS_TAG = bytes([0x0A])  # TracesData.resource_spans = 1, wire type 2
+
+
+def _frame(tag: bytes, body: bytes | bytearray) -> bytes:
+    hdr = bytearray(tag)
+    w.write_varint(hdr, len(body))
+    return bytes(hdr) + bytes(body)
+
+
+def split_by_trace(payload: bytes):
+    """-> (segments, n_spans) or None.
+
+    segments: {trace_id bytes: (start_s, end_s, segment_bytes)} where
+    segment_bytes is the wire segment (s1 header + per-trace TracesData)
+    exactly as segment_for_write would have produced for the same spans
+    (same span bytes, same envelope fields)."""
+    from ..native import otlp_scan
+
+    scan = otlp_scan(payload)
+    if scan is None:
+        return None
+    (span_off, span_len, span_rs, span_ss, tids, start_ns, end_ns,
+     env, senv, rs_off, rs_len, ss_off, ss_len, ss_rs) = scan
+    k = span_off.shape[0]
+    if k == 0:
+        return {}, 0
+
+    # group span indices by 16-byte trace id (one vectorized pass)
+    tid_void = np.ascontiguousarray(tids).view([("v", "V16")]).reshape(-1)
+    uniq, inverse = np.unique(tid_void, return_inverse=True)
+    order = np.argsort(inverse, kind="stable")
+    bounds = np.searchsorted(inverse[order], np.arange(uniq.shape[0] + 1))
+
+    # per-trace time range (min over starts, max over ends -- the
+    # model path's Trace.time_range_nanos over the same spans)
+    lo_ns = np.minimum.reduceat(start_ns[order], bounds[:-1])
+    hi_ns = np.maximum.reduceat(end_ns[order], bounds[:-1])
+
+    segments: dict[bytes, tuple[int, int, bytes]] = {}
+    mv = memoryview(payload)
+    for u in range(uniq.shape[0]):
+        idxs = order[bounds[u] : bounds[u + 1]]
+        body = bytearray()
+        i = 0
+        while i < len(idxs):
+            rs = int(span_rs[idxs[i]])
+            rs_body = bytearray(
+                env[int(rs_off[rs]) : int(rs_off[rs] + rs_len[rs])])
+            while i < len(idxs) and int(span_rs[idxs[i]]) == rs:
+                ss = int(span_ss[idxs[i]])
+                ss_body = bytearray(
+                    senv[int(ss_off[ss]) : int(ss_off[ss] + ss_len[ss])])
+                while i < len(idxs) and int(span_ss[idxs[i]]) == ss:
+                    j = int(idxs[i])
+                    ss_body += _frame(
+                        _SPAN_TAG, mv[span_off[j] : span_off[j] + span_len[j]])
+                    i += 1
+                rs_body += _frame(_SS_TAG, ss_body)
+            body += _frame(_RS_TAG, rs_body)
+        tid = uniq[u].tobytes()
+        lo = int(lo_ns[u])
+        hi = int(hi_ns[u])
+        start_s = lo // 10**9
+        end_s = (hi + 10**9 - 1) // 10**9
+        seg = _HDR.pack(_V1, start_s & 0xFFFFFFFF, end_s & 0xFFFFFFFF) + bytes(body)
+        segments[tid] = (start_s, end_s, seg)
+    return segments, k

@@ -7,6 +7,7 @@ force flush, query again from the backend.
 
 import json
 import socket
+import time
 import urllib.parse
 import urllib.request
 
@@ -118,8 +119,13 @@ def test_http_e2e(server):
     vals = json.loads(body)["tagValues"]
     assert "db" in vals
 
-    # span-metrics from the generator tap
-    st, body = _get(base, "/metrics")
+    # span-metrics from the generator tap (async: drains within ms)
+    deadline = time.time() + 5
+    while True:
+        st, body = _get(base, "/metrics")
+        if "traces_spanmetrics_calls_total" in body.decode() or time.time() > deadline:
+            break
+        time.sleep(0.05)
     assert "traces_spanmetrics_calls_total" in body.decode()
 
 

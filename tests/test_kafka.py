@@ -241,16 +241,16 @@ def test_kafka_receiver_transient_vs_poison(tmp_path):
         assert rx.failures == 1 and rx.messages == 1 and rx.offsets == {0: 2}
 
         # transient: monkeypatch distributor to rate-limit once
-        orig = app.distributor.push
+        orig = app.distributor.push_raw
         calls = {"n": 0}
 
-        def flaky(tenant, batches):
+        def flaky(tenant, payload):
             calls["n"] += 1
             if calls["n"] == 1:
                 raise PushError(429, "rate limited")
-            return orig(tenant, batches)
+            return orig(tenant, payload)
 
-        app.distributor.push = flaky
+        app.distributor.push_raw = flaky
         broker.produce(_otlp_message(b"\x04" * 16, "y", "s"))
         rx.poll_once()
         assert rx.offsets == {0: 2}, "transient failure must not advance"

@@ -392,6 +392,7 @@ def test_generator_shuffle_shard_disjoint():
     for tenant in pair:
         for tid, tr in make_traces(6, seed=hash(tenant) % 1000, n_spans=2):
             dist.push(tenant, tr.resource_spans)
+    dist.flush_generator_tap()  # the tap runs async off the push path
 
     got = {t: set() for t in pair}
     for addr, recs in pushed.items():
