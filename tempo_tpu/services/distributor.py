@@ -71,10 +71,12 @@ class Distributor:
         self._gen_pending = 0  # queued + in-flight tap items
         self._gen_stop = False
 
-    def _forward_to_generators(self, tenant: str, traces_fn) -> None:
-        """traces_fn() -> {tid: Trace}, resolved ONLY when a generator
-        target exists -- and then on the TAP WORKER, not the push path:
-        the raw-bytes fast path never decodes models during ingest."""
+    def _forward_to_generators(self, tenant: str, segs: dict, traces_fn) -> None:
+        """segs: {tid: (s, e, segment)}; traces_fn() -> {tid: Trace},
+        resolved ONLY by the in-process leg -- and on the TAP WORKER,
+        not the push path. The remote-ring leg ships proto blobs sliced
+        straight from the segments (segment_payload), so the sharded
+        production topology never decodes on the distributor at all."""
         if self.generator_ring is None and self.generator_forward is None:
             return
         import queue as _queue
@@ -87,7 +89,7 @@ class Distributor:
                     target=self._gen_tap_loop, daemon=True, name="generator-tap")
                 self._gen_thread.start()
             try:
-                self._gen_q.put_nowait((tenant, traces_fn))
+                self._gen_q.put_nowait((tenant, segs, traces_fn))
                 self._gen_pending += 1
             except _queue.Full:
                 self.stats.gen_tap_dropped += 1
@@ -99,8 +101,8 @@ class Distributor:
             except Exception:
                 continue
             try:
-                tenant, traces_fn = item
-                self._forward_now(tenant, traces_fn())
+                tenant, segs, traces_fn = item
+                self._forward_now(tenant, segs, traces_fn)
             except Exception:
                 pass  # metrics tap must never crash its worker
             finally:
@@ -123,26 +125,31 @@ class Distributor:
         self.flush_generator_tap(timeout_s=2.0)
         self._gen_stop = True
 
-    def _forward_now(self, tenant: str, per_trace: dict) -> None:
+    def _forward_now(self, tenant: str, segs: dict, traces_fn) -> None:
         if self.generator_ring is not None:
             from ..util.hashing import fnv1a_32
+            from ..wire.segment import segment_payload
 
             size = self.overrides.for_tenant(tenant).metrics_generator_ring_size
             shard = self.generator_ring.shuffle_shard(tenant, size)
             if not shard:
                 return
             by_member: dict[str, list] = defaultdict(list)
-            for tid, tr in per_trace.items():
+            for tid, (_, _, seg) in segs.items():
                 member = shard[fnv1a_32(tid) % len(shard)]
-                by_member[member.addr].append(tr)
-            for addr, traces in by_member.items():
+                by_member[member.addr].append(segment_payload(seg))
+            for addr, blobs in by_member.items():
                 try:
-                    self.client_for(addr).push_generator(tenant, traces)
+                    self.client_for(addr).push_generator_blobs(tenant, blobs)
                 except Exception:
                     pass  # metrics tap must never fail ingest
-        elif self.generator_forward is not None:
+        elif self.generator_forward is not None and traces_fn is not None:
             try:
-                self.generator_forward(tenant, list(per_trace.values()))
+                # restrict to the post-filter set: segs is lim_filtered,
+                # traces_fn() may also hold size-refused traces
+                per = traces_fn()
+                self.generator_forward(
+                    tenant, [tr for tid, tr in per.items() if tid in segs])
             except Exception:
                 pass
 
@@ -283,7 +290,14 @@ class Distributor:
             raise PushError(500, f"{len(failed)} traces failed quorum write: {errors[:1]}")
         self.stats.traces_pushed += len(lim_filtered)
 
-        self._forward_to_generators(tenant, traces_fn)
+        # forward the POST-filter set (a trace refused from storage must
+        # not produce span metrics); the model closure ships only when
+        # the in-process leg exists -- the ring leg never resolves it,
+        # and holding decoded models in the tap queue for nothing would
+        # double its memory
+        self._forward_to_generators(
+            tenant, lim_filtered,
+            traces_fn if self.generator_forward is not None else None)
 
     # ------------------------------------------------------------ rebatch
     @staticmethod

@@ -96,17 +96,25 @@ class HTTPIngesterClient:
                            for tid, s, e, seg in batch]},
             )
 
-    def push_generator(self, tenant: str, traces) -> None:
-        """Forward traces to a remote metrics-generator (the shuffle-
-        sharded generator write path, distributor.go:410-442)."""
+    def push_generator_blobs(self, tenant: str, blobs: list[bytes]) -> None:
+        """Forward traces to a remote metrics-generator as otlp-proto
+        bytes sliced from segments (the shuffle-sharded generator write
+        path, distributor.go:410-442): zero decode/encode on the send
+        side. The legacy-JSON fallback is the only path that must
+        decode."""
         from . import frames
 
         try:
-            self._post_frames("/internal/genpush", frames.encode_traces(tenant, traces))
+            self._post_frames("/internal/genpush",
+                              frames.encode_trace_blobs(tenant, blobs))
         except TransportError:
+            from ..wire import otlp_pb
+
             self._post(
                 "/internal/genpush",
-                {"tenant": tenant, "traces": [otlp_json.dumps(t) for t in traces]},
+                {"tenant": tenant,
+                 "traces": [otlp_json.dumps(otlp_pb.decode_trace(b))
+                            for b in blobs]},
             )
 
     # ------------------------------------------------ Querier (read path)

@@ -109,21 +109,28 @@ def decode_push(data: bytes) -> tuple[str, list[tuple[bytes, int, int, bytes]]]:
     return tenant, batch
 
 
-def encode_traces(tenant: str, traces) -> bytes:
-    """traces: wire-model Trace objects, shipped as otlp-proto blobs
-    (the generator forward path)."""
-    from ..wire import otlp_pb
-
+def encode_trace_blobs(tenant: str, blobs: list[bytes]) -> bytes:
+    """blobs: otlp-proto trace bytes, shipped verbatim -- the
+    distributor's generator tap slices these straight out of segments
+    (segment_payload), so the remote-generator leg never decodes or
+    re-encodes. Wire-identical to encode_traces."""
     out = io.BytesIO()
     t = tenant.encode()
     _w_uvarint(out, len(t))
     out.write(t)
-    blobs = [otlp_pb.encode_trace(tr) for tr in traces]
     _w_uvarint(out, len(blobs))
     for blob in blobs:
         _w_uvarint(out, len(blob))
         out.write(blob)
     return _seal(out.getvalue())
+
+
+def encode_traces(tenant: str, traces) -> bytes:
+    """traces: wire-model Trace objects, shipped as otlp-proto blobs
+    (the generator forward path)."""
+    from ..wire import otlp_pb
+
+    return encode_trace_blobs(tenant, [otlp_pb.encode_trace(tr) for tr in traces])
 
 
 def decode_traces(data: bytes) -> tuple[str, list]:

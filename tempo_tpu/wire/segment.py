@@ -41,6 +41,14 @@ def segment_fast_range(segment: bytes) -> tuple[int, int]:
     return start, end
 
 
+def segment_payload(segment: bytes) -> bytes:
+    """The raw otlp-proto trace bytes inside a segment (no decode):
+    the generator forward plane ships these blobs verbatim."""
+    if len(segment) < _HDR.size or segment[0] != _V1:
+        raise DecodeError("bad segment header")
+    return segment[_HDR.size :]
+
+
 def segment_to_trace(segment: bytes) -> Trace:
     if len(segment) < _HDR.size or segment[0] != _V1:
         raise DecodeError("bad segment header")

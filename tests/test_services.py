@@ -360,8 +360,9 @@ def test_generator_shuffle_shard_disjoint():
         def __init__(self, addr):
             self.addr = addr
 
-        def push_generator(self, tenant, traces):
-            pushed.setdefault(self.addr, []).append((tenant, len(traces)))
+        def push_generator_blobs(self, tenant, blobs):
+            # the tap ships otlp-proto blobs sliced from segments
+            pushed.setdefault(self.addr, []).append((tenant, len(blobs)))
 
     for i in range(4):
         lc = Lifecycler(kv, "generator-ring", f"gen-{i}", addr=f"gen-{i}:9095")
