@@ -295,8 +295,13 @@ class Distributor:
         # the in-process leg exists -- the ring leg never resolves it,
         # and holding decoded models in the tap queue for nothing would
         # double its memory
+        # the ring leg ships the segment bytes; the in-process leg only
+        # needs the post-filter id SET (holding segments in the queue
+        # would pin multi-MB batches for nothing)
         self._forward_to_generators(
-            tenant, lim_filtered,
+            tenant,
+            lim_filtered if self.generator_ring is not None
+            else frozenset(lim_filtered),
             traces_fn if self.generator_forward is not None else None)
 
     # ------------------------------------------------------------ rebatch
