@@ -262,6 +262,7 @@ class App:
         self.kafka = None
         self.remote_writer = None
         self.http_server: ThreadingHTTPServer | None = None
+        self._profile_lock = threading.Lock()  # one /debug/profile at a time
 
     # ------------------------------------------------------------ lifecycle
     def start(self) -> None:
@@ -460,6 +461,45 @@ def _make_handler(app: App):
                     return self._send(200, json.dumps(_config_dict(app.cfg), indent=2))
                 if u.path == "/status/usage-stats":
                     return self._send(200, json.dumps(app.usage.report(app), indent=2))
+                if u.path == "/debug/threads":
+                    # every thread's current stack (the role the
+                    # reference's pprof goroutine dump plays): first stop
+                    # for "what is this process stuck on". Same trust
+                    # gate as /internal/*: loopback or shared token
+                    # (stacks leak code paths; see _authorized_internal)
+                    if not self._authorized_internal():
+                        return self._err(403, "forbidden")
+                    import sys
+                    import traceback as _tb
+
+                    names = {t.ident: t.name for t in threading.enumerate()}
+                    parts = []
+                    for tid, frame in sys._current_frames().items():
+                        parts.append(f"--- thread {names.get(tid, tid)}\n")
+                        parts.extend(_tb.format_stack(frame))
+                    return self._send(200, "".join(parts), "text/plain")
+                if u.path == "/debug/profile":
+                    # sampling CPU profile over ?seconds=N (default 2,
+                    # capped): the pprof profile endpoint analog. Samples
+                    # sys._current_frames() across ALL threads at ~200 Hz
+                    # (a tracing profiler would only see this handler's
+                    # thread) and reports the hottest stacks. One at a
+                    # time: overlapping scrapes get a 409. Gated like
+                    # /internal/*: a repeatable multi-second CPU burn
+                    # must not be open to unauthenticated remote peers.
+                    if not self._authorized_internal():
+                        return self._err(403, "forbidden")
+                    try:
+                        secs = min(max(float(q.get("seconds", 2.0)), 0.1), 30.0)
+                    except ValueError:
+                        return self._err(400, "seconds must be a number")
+                    if not app._profile_lock.acquire(blocking=False):
+                        return self._err(409, "a profile is already running")
+                    try:
+                        return self._send(200, _sample_profile(secs),
+                                          "text/plain")
+                    finally:
+                        app._profile_lock.release()
                 if app.querier is None:
                     return self._err(404, f"target {app.cfg.target} serves no query API")
                 tenant = app.tenant_of(self.headers)
@@ -646,6 +686,43 @@ def _make_handler(app: App):
     return Handler
 
 
+def _sample_profile(seconds: float, hz: float = 200.0) -> str:
+    """Statistical profile: sample every thread's stack via
+    sys._current_frames() and count (thread, stack) occurrences. The
+    own sampling thread is excluded. Output: hottest stacks first with
+    their sample share -- enough to answer "where is the CPU going"
+    without a tracing profiler's overhead or its single-thread limit."""
+    import sys
+    import threading
+    import traceback
+    from collections import Counter
+
+    me = threading.get_ident()
+    names = {t.ident: t.name for t in threading.enumerate()}
+    counts: Counter = Counter()
+    total = 0
+    deadline = time.monotonic() + seconds
+    period = 1.0 / hz
+    while time.monotonic() < deadline:
+        for tid, frame in sys._current_frames().items():
+            if tid == me:
+                continue
+            stack = tuple(
+                f"{fs.filename.rsplit('/', 1)[-1]}:{fs.lineno} {fs.name}"
+                for fs in traceback.extract_stack(frame)[-12:]
+            )
+            counts[(names.get(tid, str(tid)), stack)] += 1
+            total += 1
+        time.sleep(period)
+    lines = [f"# sampling profile: {seconds:.1f}s at ~{hz:.0f} Hz, "
+             f"{total} thread-samples\n"]
+    for (tname, stack), n in counts.most_common(25):
+        lines.append(f"\n--- {tname}: {n} samples "
+                     f"({100.0 * n / max(1, total):.1f}%)\n")
+        lines.extend(f"    {fr}\n" for fr in stack)
+    return "".join(lines)
+
+
 def _metrics_text(app: App) -> str:
     lines = []
     if app.distributor:
@@ -656,6 +733,7 @@ def _metrics_text(app: App) -> str:
             f"tempo_distributor_push_failures_total {d.push_failures}",
             f"tempo_distributor_spans_refused_rate_total {d.spans_refused_rate}",
             f"tempo_distributor_traces_refused_size_total {d.traces_refused_size}",
+            f"tempo_distributor_gen_tap_dropped_total {d.gen_tap_dropped}",
         ]
         lines += app.distributor.push_latency.text()
     if app.kafka is not None:

@@ -478,3 +478,13 @@ def test_self_tracing(tmp_path):
         assert app.frontend.self_tracer.spans_emitted < 50
     finally:
         app.stop()
+
+
+def test_debug_endpoints(server):
+    """/debug/threads (the pprof goroutine-dump analog) and
+    /debug/profile (sampling CPU profile across all threads)."""
+    app, base = server
+    st, body = _get(base, "/debug/threads")
+    assert st == 200 and body.decode().count("--- thread") >= 2
+    st, body = _get(base, "/debug/profile?seconds=0.3")
+    assert st == 200 and "sampling profile" in body.decode()
