@@ -274,3 +274,48 @@ def test_concurrent_chunk_reads_thread_safety():
         with ThreadPoolExecutor(max_workers=12) as ex:
             for name, arr in ex.map(read_one, list(cols)):
                 assert (arr == cols[name]).all(), name
+
+
+def test_corrupt_block_fails_loudly(tmp_path):
+    """Bit-flipped or truncated block bytes must surface as clean Python
+    exceptions (zstd/codec/magic errors), never wrong data or a native
+    crash -- the storage layer's poison-input contract."""
+    import pytest as _pytest
+
+    from tempo_tpu.backend import LocalBackend
+    from tempo_tpu.block import open_block
+    from tempo_tpu.block.colio import ColumnPack
+
+    backend = LocalBackend(str(tmp_path))
+    traces = make_traces(30, seed=17, n_spans=5)
+    meta = build_block_from_traces(backend, TENANT, traces)
+    path = tmp_path / TENANT / meta.block_id / "data.vtpu"
+    good = path.read_bytes()
+
+    def fresh(data: bytes):
+        path.write_bytes(data)
+        return open_block(backend, TENANT, meta.block_id)
+
+    # bad magic
+    with _pytest.raises(Exception) as ei:
+        fresh(good[:-4] + b"NOPE").pack.names()
+    assert "magic" in str(ei.value).lower()
+
+    # truncated mid-data: the footer vanishes entirely
+    with _pytest.raises(Exception):
+        ColumnPack.from_bytes(good[: len(good) // 2])
+
+    # flip bytes INSIDE a compressed chunk: decode must raise, not
+    # return garbage (zstd frames carry integrity checks)
+    corrupt = bytearray(good)
+    for off in range(64, 200):
+        corrupt[off] ^= 0xFF
+    blk = fresh(bytes(corrupt))
+    with _pytest.raises(Exception):
+        for name in blk.pack.names():
+            blk.pack.read(name)
+
+    # restore: the same reader path works again on good bytes
+    blk = fresh(good)
+    for name in blk.pack.names():
+        blk.pack.read(name)
