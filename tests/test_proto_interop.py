@@ -73,3 +73,78 @@ def test_segment_splice_bytes_parse():
     for tid, (_, _, seg) in segs.items():
         text = _decode_raw(segment_payload(seg))
         assert text.startswith("1 {"), tid.hex()
+
+
+def test_opencensus_decode_against_protoc_encode(tmp_path):
+    """protoc --encode produces authoritative OpenCensus Span bytes from
+    the spec's field numbers (mirrored from the census-instrumentation
+    codegen); our decoder must read them. This is the direction the
+    self-consistent receiver test can't check -- an OC numbering bug on
+    both encode and decode sides cancels out (exactly the bug class a
+    review caught in this receiver's first draft)."""
+    proto = tmp_path / "oc_span.proto"
+    proto.write_text("""
+syntax = "proto3";
+package opencensus.proto.trace.v1;
+message TruncatableString { string value = 1; }
+message AttributeValue {
+  oneof value { TruncatableString string_value = 1; int64 int_value = 2;
+                bool bool_value = 3; double double_value = 4; }
+}
+message Attributes {
+  map<string, AttributeValue> attribute_map = 1;
+  int32 dropped_attributes_count = 2;
+}
+message Timestamp { int64 seconds = 1; int32 nanos = 2; }
+message Status { int32 code = 1; string message = 2; }
+message Span {
+  bytes trace_id = 1;
+  bytes span_id = 2;
+  bytes parent_span_id = 3;
+  TruncatableString name = 4;
+  Timestamp start_time = 5;
+  Timestamp end_time = 6;
+  Attributes attributes = 7;
+  Status status = 11;
+  enum SpanKind { SPAN_KIND_UNSPECIFIED = 0; SERVER = 1; CLIENT = 2; }
+  SpanKind kind = 14;
+  Resource resource = 16;
+}
+message Resource { string type = 1; map<string, string> labels = 2; }
+""")
+    textpb = """
+trace_id: "0123456789abcdef"
+span_id: "01234567"
+parent_span_id: "76543210"
+name { value: "authoritative-span" }
+start_time { seconds: 1700000000 nanos: 5 }
+end_time { seconds: 1700000001 nanos: 7 }
+attributes {
+  attribute_map { key: "k1" value { string_value { value: "v1" } } }
+  attribute_map { key: "k2" value { int_value: -3 } }
+  attribute_map { key: "k3" value { double_value: 2.5 } }
+}
+status { code: 13 message: "boom" }
+kind: CLIENT
+resource { type: "container" labels { key: "region" value: "eu" } }
+"""
+    out = subprocess.run(
+        [protoc, f"--proto_path={tmp_path}", "oc_span.proto",
+         "--encode=opencensus.proto.trace.v1.Span"],
+        input=textpb.encode(), capture_output=True, timeout=30)
+    assert out.returncode == 0, out.stderr.decode()
+
+    from tempo_tpu.wire import oc_pb
+    from tempo_tpu.wire.model import SpanKind, StatusCode
+
+    sp, res = oc_pb.decode_span(out.stdout)
+    assert sp.trace_id == b"0123456789abcdef"
+    assert sp.span_id == b"01234567"
+    assert sp.parent_span_id == b"76543210"
+    assert sp.name == "authoritative-span"
+    assert sp.start_unix_nano == 1700000000 * 10**9 + 5
+    assert sp.end_unix_nano == 1700000001 * 10**9 + 7
+    assert sp.attrs == {"k1": "v1", "k2": -3, "k3": 2.5}
+    assert sp.kind == SpanKind.CLIENT
+    assert sp.status_code == StatusCode.ERROR and sp.status_message == "boom"
+    assert res == {"opencensus.resourcetype": "container", "region": "eu"}
