@@ -148,3 +148,69 @@ resource { type: "container" labels { key: "region" value: "eu" } }
     assert sp.kind == SpanKind.CLIENT
     assert sp.status_code == StatusCode.ERROR and sp.status_message == "boom"
     assert res == {"opencensus.resourcetype": "container", "region": "eu"}
+
+
+def test_otlp_decode_against_protoc_encode(tmp_path):
+    """Same authoritative-bytes check for the OTLP decoder: protoc
+    encodes a spec-mirrored opentelemetry Span; our decoder reads it."""
+    proto = tmp_path / "otlp_span.proto"
+    proto.write_text("""
+syntax = "proto3";
+package opentelemetry.proto.trace.v1;
+message AnyValue {
+  oneof value { string string_value = 1; bool bool_value = 2;
+                int64 int_value = 3; double double_value = 4; }
+}
+message KeyValue { string key = 1; AnyValue value = 2; }
+message Status {
+  string message = 2;
+  enum StatusCode { STATUS_CODE_UNSET = 0; STATUS_CODE_OK = 1;
+                    STATUS_CODE_ERROR = 2; }
+  StatusCode code = 3;
+}
+message Span {
+  bytes trace_id = 1;
+  bytes span_id = 2;
+  string trace_state = 3;
+  bytes parent_span_id = 4;
+  string name = 5;
+  enum SpanKind { UNSPECIFIED = 0; INTERNAL = 1; SERVER = 2; CLIENT = 3;
+                  PRODUCER = 4; CONSUMER = 5; }
+  SpanKind kind = 6;
+  fixed64 start_time_unix_nano = 7;
+  fixed64 end_time_unix_nano = 8;
+  repeated KeyValue attributes = 9;
+  Status status = 15;
+}
+""")
+    textpb = """
+trace_id: "fedcba9876543210"
+span_id: "abcd0123"
+trace_state: "a=b"
+name: "authoritative-otlp"
+kind: PRODUCER
+start_time_unix_nano: 1700000000000000005
+end_time_unix_nano: 1700000000000000777
+attributes { key: "s" value { string_value: "x" } }
+attributes { key: "i" value { int_value: 42 } }
+attributes { key: "b" value { bool_value: true } }
+status { code: STATUS_CODE_ERROR message: "deadline" }
+"""
+    out = subprocess.run(
+        [protoc, f"--proto_path={tmp_path}", "otlp_span.proto",
+         "--encode=opentelemetry.proto.trace.v1.Span"],
+        input=textpb.encode(), capture_output=True, timeout=30)
+    assert out.returncode == 0, out.stderr.decode()
+
+    from tempo_tpu.wire.model import SpanKind, StatusCode
+
+    sp = otlp_pb.decode_span(out.stdout)
+    assert sp.trace_id == b"fedcba9876543210"
+    assert sp.span_id == b"abcd0123"
+    assert sp.trace_state == "a=b"
+    assert sp.name == "authoritative-otlp"
+    assert sp.kind == SpanKind.PRODUCER
+    assert sp.start_unix_nano == 1700000000000000005
+    assert sp.end_unix_nano == 1700000000000000777
+    assert sp.attrs == {"s": "x", "i": 42, "b": True}
+    assert sp.status_code == StatusCode.ERROR and sp.status_message == "deadline"
