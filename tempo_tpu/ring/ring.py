@@ -69,7 +69,8 @@ class InMemoryKV:
 @dataclass
 class ReplicationSet:
     instances: list[InstanceDesc]
-    max_errors: int  # quorum slack: len//2 for odd RF
+    max_errors: int  # quorum slack: majority ((len-1)//2), EXCEPT rf=2
+    # where it's len-1 (eventually-consistent minSuccess=1, Ring.get)
 
 
 class Ring:
@@ -126,6 +127,14 @@ class Ring:
                 if len(out) >= self.rf:
                     break
             i = (i + 1) % len(tokens)
+        if self.rf == 2:
+            # the reference's whole reason for wrapping dskit's ring: at
+            # RF=2 a majority quorum is ALL replicas, so one dead
+            # ingester would fail every write until the heartbeat
+            # timeout marks it out. EventuallyConsistentStrategy
+            # (pkg/ring/ring.go:52-86) instead needs minSuccess=1 on
+            # read and write -- NOT strongly consistent, eventually so.
+            return ReplicationSet(out, max_errors=max(0, len(out) - 1))
         return ReplicationSet(out, max_errors=max(0, (len(out) - 1) // 2))
 
     def shuffle_shard(self, tenant: str, size: int) -> list[InstanceDesc]:

@@ -54,25 +54,42 @@ def _wait_ready(port, timeout=30):
     raise TimeoutError(f"port {port} never became ready")
 
 
-@pytest.mark.slow
-def test_microservices_topology(tmp_path):
+import contextlib
+
+
+@contextlib.contextmanager
+def _two_ingester_topology(tmp_path, rf=2):
+    """2 ingesters + distributor(rf) + querier as real processes over a
+    shared storage path + file ring-KV; yields (ports, procs-by-name)."""
     storage = str(tmp_path / "storage")
     kv = str(tmp_path / "kv")
     ports = {r: _free_port() for r in ("ing1", "ing2", "dist", "query")}
-    procs = []
+    procs = {}
     try:
         for name in ("ing1", "ing2"):
-            procs.append(
-                _spawn("ingester", ports[name], storage, kv,
-                       ("--instance.id", name))
-            )
+            procs[name] = _spawn("ingester", ports[name], storage, kv,
+                                 ("--instance.id", name))
         _wait_ready(ports["ing1"])
         _wait_ready(ports["ing2"])
-        procs.append(_spawn("distributor", ports["dist"], storage, kv,
-                            ("--replication.factor", "2")))
-        procs.append(_spawn("querier", ports["query"], storage, kv))
+        procs["dist"] = _spawn("distributor", ports["dist"], storage, kv,
+                               ("--replication.factor", str(rf)))
+        procs["query"] = _spawn("querier", ports["query"], storage, kv)
         _wait_ready(ports["dist"])
         _wait_ready(ports["query"])
+        yield ports, procs
+    finally:
+        for p in procs.values():
+            p.terminate()
+        for p in procs.values():
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+@pytest.mark.slow
+def test_microservices_topology(tmp_path):
+    with _two_ingester_topology(tmp_path, rf=2) as (ports, procs):
 
         traces = make_traces(10, seed=55, n_spans=4)
         for _, tr in traces:
@@ -117,14 +134,6 @@ def test_microservices_topology(tmp_path):
             except urllib.error.HTTPError:
                 time.sleep(1)
         assert got is not None and got.span_count() == tr.span_count()
-    finally:
-        for p in procs:
-            p.terminate()
-        for p in procs:
-            try:
-                p.wait(timeout=5)
-            except subprocess.TimeoutExpired:
-                p.kill()
 
 
 @pytest.mark.slow
@@ -400,3 +409,49 @@ def test_remote_generator_blob_plane(tmp_path):
                 p.wait(timeout=10)
             except subprocess.TimeoutExpired:
                 p.kill()
+
+
+@pytest.mark.slow
+def test_rf2_survives_ingester_kill(tmp_path):
+    """RF=2 eventual consistency (pkg/ring EventuallyConsistentStrategy,
+    minSuccess=1): with one of two ingesters SIGKILLed -- and still
+    listed healthy in the ring (no heartbeat timeout yet) -- writes
+    keep succeeding on the surviving replica and every trace stays
+    readable through the querier."""
+    import signal
+
+    with _two_ingester_topology(tmp_path, rf=2) as (ports, procs):
+
+        def push(tr):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{ports['dist']}/v1/traces",
+                data=otlp_json.dumps(tr).encode(),
+                headers={"Content-Type": "application/json"})
+            assert urllib.request.urlopen(req, timeout=15).status == 200
+
+        before = make_traces(5, seed=71, n_spans=3)
+        for _, tr in before:
+            push(tr)
+
+        # hard-kill one replica; its ring entry stays "healthy" until the
+        # heartbeat staleness window, so the distributor still tries it
+        procs["ing2"].send_signal(signal.SIGKILL)
+        procs["ing2"].wait(timeout=10)
+
+        after = make_traces(5, seed=72, n_spans=3)
+        for _, tr in after:
+            push(tr)  # minSuccess=1: the surviving replica is enough
+
+        for tid, tr in before + after:
+            got = None
+            deadline = time.time() + 20
+            while time.time() < deadline:
+                try:
+                    with urllib.request.urlopen(
+                            f"http://127.0.0.1:{ports['query']}/api/traces/{tid.hex()}",
+                            timeout=15) as r:
+                        got = otlp_json.loads(r.read())
+                    break
+                except urllib.error.HTTPError:
+                    time.sleep(0.5)
+            assert got is not None and got.span_count() == tr.span_count(), tid.hex()
