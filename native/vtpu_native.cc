@@ -127,6 +127,29 @@ int vtpu_varint_frames(const uint8_t* data, int64_t n,
   return count;
 }
 
+// ------------------------------------------------------------ id bisect
+
+// Batched binary search of q 16-byte trace ids over a sorted (n, 16)
+// id table (memcmp order == big-endian lexicographic == the block's
+// trace.id sort). out[i] = row of an exact match, else -1. The host
+// twin of the device lockstep-bisection kernel (ops/find.py): numpy's
+// void16 searchsorted pays per-probe object machinery; this is a tight
+// memcmp loop.
+void vtpu_lex_bisect16(const uint8_t* ids, int64_t n, const uint8_t* queries,
+                       int64_t q, int32_t* out) {
+  for (int64_t i = 0; i < q; i++) {
+    const uint8_t* key = queries + i * 16;
+    int64_t lo = 0, hi = n;
+    while (lo < hi) {
+      int64_t mid = (lo + hi) >> 1;
+      if (memcmp(ids + mid * 16, key, 16) < 0) lo = mid + 1;
+      else hi = mid;
+    }
+    out[i] = (lo < n && memcmp(ids + lo * 16, key, 16) == 0)
+                 ? (int32_t)lo : -1;
+  }
+}
+
 // --------------------------------------------------------- otlp span scan
 
 // Structural scan of an OTLP ExportTraceServiceRequest / TracesData:

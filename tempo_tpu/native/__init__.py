@@ -114,6 +114,10 @@ def _bind(lib):
         ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
         ctypes.c_int64, ctypes.c_int64, ctypes.c_void_p,
     ]
+    lib.vtpu_lex_bisect16.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
+        ctypes.c_int64, ctypes.c_void_p,
+    ]
     lib.vtpu_otlp_scan.argtypes = [
         ctypes.c_void_p, ctypes.c_int64,
         ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
@@ -502,6 +506,24 @@ def seg_weighted_count(mask: np.ndarray, weights: np.ndarray,
     lib.vtpu_seg_weighted_count(mask.ctypes.data, weights.ctypes.data,
                                 span_off.ctypes.data, n_traces, n_spans,
                                 out.ctypes.data)
+    return out
+
+
+def lex_bisect16(ids: np.ndarray, queries: np.ndarray) -> np.ndarray | None:
+    """Exact-match rows of 16-byte queries in a sorted (n, 16) id
+    table (-1 miss). ids/queries: uint8, C-contiguous."""
+    lib = _load()
+    if lib is None or getattr(lib, "vtpu_lex_bisect16", None) is None:
+        return None
+    if (ids.dtype != np.uint8 or queries.dtype != np.uint8
+            or ids.ndim != 2 or ids.shape[1] != 16
+            or queries.ndim != 2 or queries.shape[1] != 16
+            or not ids.flags.c_contiguous or not queries.flags.c_contiguous):
+        return None
+    q = queries.shape[0]
+    out = np.empty(q, dtype=np.int32)
+    lib.vtpu_lex_bisect16(ids.ctypes.data, ids.shape[0],
+                          queries.ctypes.data, q, out.ctypes.data)
     return out
 
 

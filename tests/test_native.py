@@ -102,3 +102,23 @@ def test_colio_pack_native_roundtrip():
     for k, v in cols.items():
         np.testing.assert_array_equal(pack.read(k), v)
     np.testing.assert_array_equal(pack.read_groups("a", [1, 2]), cols["a"][1000:3000])
+
+
+def test_lex_bisect16_matches_searchsorted():
+    from tempo_tpu.native import lex_bisect16
+
+    rng = np.random.default_rng(3)
+    ids = rng.integers(0, 256, size=(500, 16), dtype=np.uint8)
+    ids = np.ascontiguousarray(ids[np.argsort(ids.view("V16").ravel())])
+    hits = ids[rng.integers(0, 500, size=64)]
+    misses = rng.integers(0, 256, size=(64, 16), dtype=np.uint8)
+    q = np.ascontiguousarray(np.concatenate([hits, misses]))
+    got = lex_bisect16(ids, q)
+    if got is None:
+        pytest.skip("native unavailable")
+    iv = ids.view("V16").ravel()
+    qv = q.view("V16").ravel()
+    pos = np.searchsorted(iv, qv)
+    clip = np.minimum(pos, len(iv) - 1)
+    want = np.where((pos < len(iv)) & (iv[clip] == qv), pos, -1).astype(np.int32)
+    np.testing.assert_array_equal(got, want)
