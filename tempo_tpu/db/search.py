@@ -260,22 +260,32 @@ def _candidates(
     (_materialize). O(len(sids)) -- callers cap it at the escalation k,
     never the full match count."""
     ti = blk.search_index
-    out = []
-    for sid in sids:
-        start_ns = int(ti["trace.start_ns"][sid])
-        end_ns = int(ti["trace.end_ns"][sid])
-        dur_ms = max(0, (end_ns - start_ns) // 1_000_000)
-        if req.min_duration_ms and dur_ms < req.min_duration_ms:
-            continue
-        if req.max_duration_ms and dur_ms > req.max_duration_ms:
-            continue
-        if req.start and start_ns < req.start * 1_000_000_000:
-            continue
-        if req.end and start_ns > req.end * 1_000_000_000:
-            continue
-        out.append((start_ns, ti["trace.id"][sid].tobytes().hex(), dur_ms,
-                    int(counts.get(sid, 0)), blk, int(sid)))
-    return out
+    if not len(sids):
+        return []
+    # vectorized over the candidate set (up to the escalation k): the
+    # per-sid scalar loop cost more than the selection it followed
+    sa = np.asarray(sids, dtype=np.int64)
+    start_ns = ti["trace.start_ns"][sa].astype(np.int64)
+    end_ns = ti["trace.end_ns"][sa].astype(np.int64)
+    dur_ms = np.maximum(0, (end_ns - start_ns) // 1_000_000)
+    keep = np.ones(sa.shape[0], dtype=bool)
+    if req.min_duration_ms:
+        keep &= dur_ms >= req.min_duration_ms
+    if req.max_duration_ms:
+        keep &= dur_ms <= req.max_duration_ms
+    if req.start:
+        keep &= start_ns >= req.start * 1_000_000_000
+    if req.end:
+        keep &= start_ns <= req.end * 1_000_000_000
+    ka = sa[keep]
+    # one hex() over the packed id rows, sliced per 16-byte id
+    blob = np.ascontiguousarray(ti["trace.id"][ka]).tobytes().hex()
+    ids_hex = [blob[i * 32 : (i + 1) * 32] for i in range(ka.shape[0])]
+    return [
+        (s, h, d, int(counts.get(sid, 0)), blk, sid)
+        for s, h, d, sid in zip(start_ns[keep].tolist(), ids_hex,
+                                dur_ms[keep].tolist(), ka.tolist())
+    ]
 
 
 def _materialize(cand: tuple) -> SearchResult:
