@@ -420,7 +420,13 @@ def _host_cols(blk: BackendBlock, needed: list[str], groups_range):
             return name, pack.read_groups(name, groups_range)
         return name, pack.read(name)
 
-    cols = dict(_host_io_pool.map(read, [n for n in needed if not n.startswith("span@")]))
+    wanted = [n for n in needed if not n.startswith("span@")]
+    # warm blocks: every column is an array-cache hit, and pool dispatch
+    # would cost more than the dict lookups it parallelizes
+    if all(pack.has_cached_array(n) for n in wanted if pack.has(n)):
+        cols = dict(read(n) for n in wanted)
+    else:
+        cols = dict(_host_io_pool.map(read, wanted))
     if "sattr.span" in cols and span_base:
         cols["sattr.span"] = cols["sattr.span"] - span_base
     if "trace.span_off" in cols and sliced:
