@@ -420,10 +420,13 @@ def _host_cols(blk: BackendBlock, needed: list[str], groups_range):
             return name, pack.read_groups(name, groups_range)
         return name, pack.read(name)
 
-    wanted = [n for n in needed if not n.startswith("span@")]
+    wanted = [n for n in needed if not n.startswith("span@") and pack.has(n)]
     # warm blocks: every column is an array-cache hit, and pool dispatch
-    # would cost more than the dict lookups it parallelizes
-    if all(pack.has_cached_array(n) for n in wanted if pack.has(n)):
+    # would cost more than the dict lookups it parallelizes. The check
+    # races concurrent evictions (check-then-act): losing it only
+    # degrades to serial re-reads of columns that were cached a moment
+    # ago -- a cache already thrashing at that point.
+    if wanted and all(pack.has_cached_array(n) for n in wanted):
         cols = dict(read(n) for n in wanted)
     else:
         cols = dict(_host_io_pool.map(read, wanted))
