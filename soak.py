@@ -82,13 +82,36 @@ class Soak:
 
     def _writer(self, stop: threading.Event, wid: int):
         svc = f"soak-svc-{wid % 4}"
+        # alternate transports: even writers push OTLP-proto (the raw
+        # native-scan fast path, the production OTel transport), odd
+        # writers push OTLP-JSON (the model path) -- the soak hammers
+        # both write paths concurrently
+        use_proto = wid % 2 == 0
+        if use_proto:
+            try:
+                from tempo_tpu.wire import otlp_json, otlp_pb
+            except ImportError as e:
+                # --target mode may run where the package isn't importable;
+                # a writer dying silently would pass the soak vacuously
+                with self.lock:
+                    self.errors.append(f"write: proto transport unavailable: {e}")
+                return
         while not stop.is_set():
             ids = [os.urandom(16).hex() for _ in range(self.batch)]
             try:
-                t0 = time.perf_counter()
+                # bodies built BEFORE the timed window: write_lat measures
+                # the POSTs, not client-side encoding
+                bodies = []
                 for tid in ids:
-                    self._post("/v1/traces",
-                               json.dumps(self._trace_json(tid, svc)).encode())
+                    j = json.dumps(self._trace_json(tid, svc)).encode()
+                    if use_proto:
+                        bodies.append((otlp_pb.encode_trace(otlp_json.loads(j)),
+                                       "application/x-protobuf"))
+                    else:
+                        bodies.append((j, "application/json"))
+                t0 = time.perf_counter()
+                for body, ctype in bodies:
+                    self._post("/v1/traces", body, ctype=ctype)
                 dt = (time.perf_counter() - t0) / self.batch
                 with self.lock:
                     self.write_lat.append(dt)
