@@ -21,7 +21,7 @@ from dataclasses import dataclass, field, replace
 import numpy as np
 
 from ..block.dictionary import Dictionary
-from ..ops.filter import Cond
+from ..ops.filter import Cond, normalize_tree
 from .ast import (
     Comparison,
     Field,
@@ -352,7 +352,15 @@ def _plan_spanset_expr(p: Plan, d: Dictionary, q, allow_struct: bool = True) -> 
         t = _plan_expr(p, d, q.expr)
         if t in (TRUE, FALSE):
             return t, False
-        return ("tracify", t), False
+        # lift instead of blind-wrapping: a trace-target cond inside
+        # ('tracify', ...) would reach the engines' SPAN evaluators and
+        # crash (fuzz-found on `{...} ~ { traceDuration > 1ms }`).
+        # normalize_tree keeps this leaf's span conds in ONE tracify
+        # group (same-span semantics) with trace conds alongside. The
+        # mixed-or verify flag is computed on the RAW tree here and
+        # propagated by the combinator fold: _finish's _mixed_or can't
+        # see through the pre-inserted tracify nodes.
+        return normalize_tree(t, tuple(p.conds)), _mixed_or(t, tuple(p.conds))
     if isinstance(q, Pipeline):
         # wrapped-pipeline operand ((...|count()>1|{false}) && ...):
         # prefilter by its first spanset; the stages are exact-host-only
