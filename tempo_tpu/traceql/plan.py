@@ -447,22 +447,37 @@ class PlannedQuery:
 
 
 def _mixed_or(tree, conds) -> bool:
-    """True if the tree contains an OR mixing span- and trace-level
-    children: the device evaluates those per-trace (over-matching the
-    same-span semantics), so candidates need exact host re-verification."""
+    """True when the engines' shallow trace-level lift (ops/filter
+    normalize_tree) is INEXACT for this tree, so candidates need exact
+    host re-verification. Two shapes qualify:
+
+    - an OR mixing span- and trace-level children: the lift evaluates
+      the span side per-trace, over-matching same-span semantics;
+    - an AND with a MIXED child (e.g. nested `(traceDur > 1s && kind =
+      client) && name != "x"`): the lift groups only DIRECT span
+      siblings into one tracify, so span conds separated by the nesting
+      land in different same-span groups and over-match -- found by the
+      three-way equivalence fuzzer.
+
+    Flat mixes (every and/or child pure span or pure trace) lift
+    exactly and stay verification-free."""
 
     def purity(t):
-        if t[0] == "tracify":
+        if t[0] in ("tracify", "true", "false"):
             return "trace"
+        if t[0] == "struct":
+            return "span"
         if t[0] == "cond":
             return "trace" if conds[t[1]].target == "trace" else "span"
         ks = {purity(ch) for ch in t[1:]}
         return ks.pop() if len(ks) == 1 else "mixed"
 
     def walk(t):
-        if t[0] in ("cond", "tracify"):
+        if t[0] in ("cond", "tracify", "true", "false", "struct"):
             return False
         if t[0] == "or" and purity(t) == "mixed":
+            return True
+        if t[0] == "and" and any(purity(ch) == "mixed" for ch in t[1:]):
             return True
         return any(walk(ch) for ch in t[1:])
 
