@@ -115,7 +115,7 @@ int vtpu_varint_frames(const uint8_t* data, int64_t n,
       }
       shift += 7;
     }
-    if (!ok || pos + (int64_t)len > n) {
+    if (!ok || len > (uint64_t)(n - pos)) {  // unsigned: >=2^63 len must read as torn, not negative
       offsets[count] = start;  // torn tail marker
       return -count - 1;
     }
@@ -205,7 +205,9 @@ int vtpu_otlp_scan(const uint8_t* buf, int64_t n,
     uint64_t fno = tag >> 3, wt = tag & 7;
     if (wt != 2) return 1;  // top level is only length-delimited RS
     uint64_t len;
-    if (!oscan_varint(buf, n, &pos, &len) || pos + (int64_t)len > n) return 1;
+    // Compare unsigned against remaining bytes: casting len to int64_t
+    // would let a crafted >=2^63 varint go negative and bypass the check.
+    if (!oscan_varint(buf, n, &pos, &len) || len > (uint64_t)(n - pos)) return 1;
     if (fno != 1) {  // unknown top-level field: keep nothing, skip
       pos += (int64_t)len;
       continue;
@@ -223,7 +225,8 @@ int vtpu_otlp_scan(const uint8_t* buf, int64_t n,
       int64_t body_off = pos, body_len = 0;
       if (fwt == 2) {
         uint64_t blen;
-        if (!oscan_varint(buf, rs_end, &pos, &blen) || pos + (int64_t)blen > rs_end)
+        if (!oscan_varint(buf, rs_end, &pos, &blen) ||
+            blen > (uint64_t)(rs_end - pos))
           return 1;
         body_off = pos;
         body_len = (int64_t)blen;
@@ -263,7 +266,7 @@ int vtpu_otlp_scan(const uint8_t* buf, int64_t n,
         if (swt == 2) {
           uint64_t blen;
           if (!oscan_varint(buf, ss_end, &spos, &blen) ||
-              spos + (int64_t)blen > ss_end)
+              blen > (uint64_t)(ss_end - spos))
             return 1;
           sb_off = spos;
           sb_len = (int64_t)blen;
@@ -305,7 +308,7 @@ int vtpu_otlp_scan(const uint8_t* buf, int64_t n,
           if (w2 == 2) {
             uint64_t blen;
             if (!oscan_varint(buf, sp_end, &p2, &blen) ||
-                p2 + (int64_t)blen > sp_end)
+                blen > (uint64_t)(sp_end - p2))
               return 1;
             if (f2 == 1 && blen == 16) {
               memcpy(trace_ids + sp * 16, buf + p2, 16);

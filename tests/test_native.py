@@ -14,6 +14,14 @@ from tempo_tpu import native
 from tempo_tpu.block.bloom import ShardedBloom
 from tempo_tpu.util.hashing import ring_token
 
+# The native layer is a required part of the framework: skipping this
+# suite silently would drop its only coverage on an image change. Allow
+# a skip only when explicitly requested (e.g. a deliberately
+# Python-only environment).
+if not native.available() and not os.environ.get("TEMPO_TPU_ALLOW_NATIVE_SKIP"):
+    pytest.fail("native lib not built -- run `make -C native` "
+                "(set TEMPO_TPU_ALLOW_NATIVE_SKIP=1 to skip deliberately)",
+                pytrace=False)
 pytestmark = pytest.mark.skipif(not native.available(), reason="native lib not built")
 
 
@@ -122,3 +130,42 @@ def test_lex_bisect16_matches_searchsorted():
     clip = np.minimum(pos, len(iv) - 1)
     want = np.where((pos < len(iv)) & (iv[clip] == qv), pos, -1).astype(np.int32)
     np.testing.assert_array_equal(got, want)
+
+
+def test_otlp_scan_huge_varint_lengths_rejected():
+    """Regression: a length varint >= 2^63 must read as malformed at every
+    nesting level, never as a negative int64 that bypasses the bounds
+    check (previously a deterministic SIGSEGV from a ~15-byte payload,
+    reachable unauthenticated through push_raw)."""
+    hv = b"\x80" * 9 + b"\x01"  # varint encoding of 2^63
+
+    # top-level ResourceSpans length
+    assert native.otlp_scan(b"\x0a" + hv + b"\x00" * 4) is None
+
+    # huge length on a field inside ResourceSpans (the advisory's payload shape)
+    inner = b"\x0a" + hv + b"\x00"
+    assert native.otlp_scan(b"\x0a" + bytes([len(inner)]) + inner) is None
+
+    # huge length on a field inside ScopeSpans
+    ss_body = b"\x0a" + hv + b"\x00"
+    ss = b"\x12" + bytes([len(ss_body)]) + ss_body
+    assert native.otlp_scan(b"\x0a" + bytes([len(ss)]) + ss) is None
+
+    # huge length on a field inside a Span submessage
+    span_body = b"\x0a" + hv
+    span = b"\x12" + bytes([len(span_body)]) + span_body
+    ss2 = b"\x12" + bytes([len(span)]) + span
+    assert native.otlp_scan(b"\x0a" + bytes([len(ss2)]) + ss2) is None
+
+
+def test_varint_frames_huge_length_reads_as_torn():
+    """A WAL frame header claiming >= 2^63 bytes is a torn tail, not a
+    negative-length frame."""
+    good = b"\x03abc"
+    hv = b"\x80" * 9 + b"\x01"
+    res = native.varint_frames(good + hv + b"xyz")
+    assert res is not None
+    offs, lens, clean, torn_at = res
+    assert not clean
+    assert len(offs) == 1 and lens[0] == 3
+    assert torn_at == len(good)

@@ -9,6 +9,7 @@ the interop evidence that an off-the-shelf OTel SDK can talk to the
 receivers (conformance with our own decoder alone would not catch a
 field-numbering bug on both sides)."""
 
+import os
 import shutil
 import subprocess
 
@@ -18,6 +19,12 @@ from tempo_tpu.util.testdata import make_trace
 from tempo_tpu.wire import otlp_pb
 
 protoc = shutil.which("protoc")
+# Interop evidence must not vanish silently on an image change: fail
+# loudly when protoc is missing unless the skip is explicitly requested.
+if protoc is None and not os.environ.get("TEMPO_TPU_ALLOW_PROTOC_SKIP"):
+    pytest.fail("protoc not on PATH -- interop suite cannot run "
+                "(set TEMPO_TPU_ALLOW_PROTOC_SKIP=1 to skip deliberately)",
+                pytrace=False)
 pytestmark = pytest.mark.skipif(protoc is None, reason="protoc not available")
 
 
