@@ -798,12 +798,14 @@ def search_blocks_device(
     pool=None,
 ) -> SearchResponse | None:
     """Search many blocks as ONE stacked mesh program: blocks shard over
-    'dp', span rows over 'sp', per-block operands resolved through each
-    block's dictionary (parallel/search.py). The multi-chip analog of the
-    reference's per-block job fan-out (modules/frontend/searchsharding.go
-    + tempodb/pool). Returns None when the query needs the per-block
-    generic-attr path or the stacked columns exceed the device budget --
-    the caller falls back to per-block search_block."""
+    'dp', span rows AND generic-attr rows over 'sp', per-block operands
+    resolved through each block's dictionary (parallel/search.py). The
+    multi-chip analog of the reference's per-block job fan-out
+    (modules/frontend/searchsharding.go + tempodb/pool), including the
+    generic attribute iterators (vparquet/block_traceql.go:682-763).
+    Returns None when the query has structural ops or the stacked
+    columns exceed the device budget -- the caller falls back to
+    per-block search_block."""
     resp = SearchResponse()
     in_range = [b for b in blocks if b.meta.overlaps_time(req.start, req.end)]
     # plan fan-out pulls each block's dictionary + footer: overlap the IO
@@ -816,8 +818,6 @@ def search_blocks_device(
     for blk, p in zip(in_range, plans):
         if p.prune:
             continue
-        if any(c.target not in (T_SPAN, T_RES, T_TRACE) for c in p.conds):
-            return None  # generic-attr tables stay on the per-block path
         if p.has_struct:
             return None  # struct trees run on the per-block engines
         live.append((blk, p))
@@ -863,9 +863,26 @@ def _search_group_device(items, tree, conds, req: SearchRequest, mesh, resp: Sea
     Bp = ((B + dp - 1) // dp) * dp
     s_max = max(blk.pack.axes[S.AX_SPAN].n_rows for blk, _ in items)
     S_b = sp * bucket(max(1, -(-max(s_max, 1) // sp)))
-    if Bp * S_b * 4 * max(1, len(span_cols)) > _DEVICE_SEARCH_MAX_BYTES:
-        return None
     NT_b = bucket(max(max(blk.meta.total_traces for blk, _ in items), 1))
+    # generic-attr rows ride the sp axis like span rows; their buckets
+    # come from the widest block in the group (axis metadata -- no IO)
+    attr_b: dict[str, int] = {}
+    for pre, ax in (("sattr", S.AX_SATTR), ("rattr", S.AX_RATTR)):
+        if f"{pre}.key_id" in needed:
+            a_max = max(
+                blk.pack.axes[ax].n_rows if ax in blk.pack.axes else 0 for blk, _ in items
+            )
+            attr_b[pre] = sp * bucket(max(1, -(-max(a_max, 1) // sp)))
+    # stacked-bytes estimate BEFORE any column IO, per-axis products: an
+    # over-budget group must fall back without paying the cold reads
+    est = S_b * max(1, len(span_cols))
+    for pre, a_b in attr_b.items():
+        n_val_cols = sum(
+            1 for n in needed if n.startswith(f"{pre}.") and not n.endswith((".span", ".res"))
+        )
+        est += a_b * n_val_cols + (S_b + 1 if pre == "sattr" else 0)  # values + off
+    if Bp * est * 4 > _DEVICE_SEARCH_MAX_BYTES:
+        return None
 
     host: dict[str, np.ndarray] = {}
     io0 = [blk.pack.bytes_read for blk, _ in items]
@@ -883,6 +900,7 @@ def _search_group_device(items, tree, conds, req: SearchRequest, mesh, resp: Sea
     ]
     R_b = bucket(max(max(n_res_per), 1))
     for n in needed:
+        pre = n.split(".", 1)[0]
         if n == "trace.span_off":
             # (NT_b+1,) offsets per block; padded trace rows collapse to
             # empty segments by repeating the final offset
@@ -893,14 +911,38 @@ def _search_group_device(items, tree, conds, req: SearchRequest, mesh, resp: Sea
                 out[bi, a.shape[0]:] = a[-1] if a.size else 0
             host[n] = out
             continue
+        if n in ("sattr.span", "rattr.res"):
+            # owner rows (grouped by owner) -> per-owner offset column,
+            # replicated along sp; the kernel aggregates with cumsum +
+            # offset gathers (parallel/search.owner_counts). Mirrors
+            # ops/stage.py's single-device offsetting.
+            n_seg_b = S_b if n == "sattr.span" else R_b
+            out = np.zeros((Bp, n_seg_b + 1), dtype=np.int32)
+            for bi, cols in enumerate(per_block):
+                owners = cols[n]
+                n_seg = (
+                    items[bi][0].pack.axes[S.AX_SPAN].n_rows
+                    if n == "sattr.span"
+                    else n_res_per[bi]
+                )
+                cnt = np.bincount(
+                    np.clip(owners, 0, max(n_seg, 1) - 1), minlength=max(n_seg, 1)
+                ) if owners.size else np.zeros(max(n_seg, 1), dtype=np.int64)
+                off = np.concatenate([[0], np.cumsum(cnt)]).astype(np.int32)
+                out[bi, : off.shape[0]] = off
+                out[bi, off.shape[0]:] = off[-1]
+            host[f"{pre}.off"] = out
+            continue
         if n.startswith("span."):
             shape, fill = (Bp, S_b), PAD_I32
+        elif pre in attr_b:
+            shape, fill = (Bp, attr_b[pre]), PAD_I32
         elif n.startswith("res."):
             shape, fill = (Bp, R_b), PAD_I32
         elif n.startswith("trace."):
             shape, fill = (Bp, NT_b), PAD_I32
         else:
-            return None  # attr tables never reach here (guarded above)
+            return None
         first = per_block[0][n]
         if first.dtype not in (np.int32, np.float32):
             return None

@@ -17,8 +17,20 @@ share one compiled program.
 
 Mirrors ops/filter.py's trace-level tree semantics: span subtrees
 aggregate through ('tracify', t) nodes, trace-axis conds compare
-per-block (B, NT) columns. The generic-attr tables shard differently and
-stay on the per-block path (ops/filter.py).
+per-block (B, NT) columns.
+
+Generic-attr conds (sattr/rattr -- the reference's first-class generic
+attribute iterators, tempodb/encoding/vparquet/block_traceql.go:682-763)
+run on the mesh too: attr VALUE rows shard over 'sp' exactly like span
+rows, the per-owner aggregation is a local cumsum + gathers at the
+(replicated) owner-offset column, and the cross-shard stitch is a
+`psum_scatter` over 'sp' -- a reduce-scatter that lands each chip
+precisely its own span slice of the per-span hit counts, so an
+arbitrary `{ span.foo = "bar" }` costs one collective the size of the
+span axis. rattr rows aggregate to the small replicated resource axis
+with a plain `psum` and gather through span.res_idx. Padded attr rows
+carry key_id = PAD (< 0); planner key codes are always >= 0, so
+validity needs no extra operand.
 """
 
 from __future__ import annotations
@@ -31,7 +43,18 @@ import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from ..ops.device import bucket
-from ..ops.filter import Cond, Operands, T_RES, T_SPAN, T_TRACE, normalize_tree
+from ..ops.filter import (
+    _ATTR_VALUE_COL,
+    _VT_CODE,
+    Cond,
+    Operands,
+    T_RATTR,
+    T_RES,
+    T_SATTR,
+    T_SPAN,
+    T_TRACE,
+    normalize_tree,
+)
 from .mesh import smap
 
 
@@ -95,6 +118,46 @@ def make_sharded_search(mesh, tree, conds: tuple[Cond, ...], col_names: tuple[st
             return _cmp_b(c.op, x, ops_i[:, i, 1], ops_i[:, i, 2],
                           ops_f[:, i, 0], ops_f[:, i, 1], c.is_float, tables.get(i))
 
+        def owner_counts(row_hit, off):
+            """Per-owner True counts when rows are GROUPED by owner and
+            sharded over 'sp': local exclusive cumsum + gathers at the
+            global offsets, each shard contributing only the slice of
+            every segment it holds. off: (Bl, n_seg+1) global attr rows,
+            replicated along sp. Returns (Bl, n_seg) PARTIAL counts --
+            the caller sums over 'sp'."""
+            Al = row_hit.shape[1]
+            arow0 = jax.lax.axis_index("sp") * Al
+            ecs = jnp.concatenate(
+                [jnp.zeros((row_hit.shape[0], 1), jnp.int32),
+                 jnp.cumsum(row_hit.astype(jnp.int32), axis=1)], axis=1)
+            lo = jnp.clip(off[:, :-1] - arow0, 0, Al)
+            hi = jnp.clip(off[:, 1:] - arow0, 0, Al)
+            return jnp.take_along_axis(ecs, hi, 1) - jnp.take_along_axis(ecs, lo, 1)
+
+        def attr_mask(i):
+            """Span-level mask for a generic-attr cond: hit rows in the
+            sharded attr table, aggregated to their owner axis."""
+            c = conds[i]
+            pre = c.target  # 'sattr' | 'rattr'
+            key_match = cols[f"{pre}.key_id"] == ops_i[:, i, 0][:, None]
+            if c.col == "any":
+                row_hit = key_match
+            else:
+                vcol = cols[f"{pre}.{_ATTR_VALUE_COL[c.col]}"]
+                vt_ok = cols[f"{pre}.vtype"] == _VT_CODE[c.col]
+                row_hit = key_match & vt_ok & cond_cmp(i, vcol)
+            cnt = owner_counts(row_hit, cols[f"{pre}.off"])
+            if pre == T_SATTR:
+                # reduce-scatter: chip k receives the summed counts for
+                # exactly its span columns [k*Sl, (k+1)*Sl)
+                cnt = jax.lax.psum_scatter(cnt, "sp", scatter_dimension=1,
+                                           tiled=True)  # (Bl, Sl)
+                return (cnt > 0) & valid
+            rm = jax.lax.psum(cnt, "sp") > 0  # (Bl, R) -- small, replicated
+            idx = jnp.clip(cols["span.res_idx"], 0, rm.shape[1] - 1)
+            rm_g = jnp.take_along_axis(rm, idx, axis=1)
+            return rm_g & (cols["span.res_idx"] >= 0) & valid
+
         def cond_mask(i):
             c = conds[i]
             if c.target == T_SPAN:
@@ -104,6 +167,8 @@ def make_sharded_search(mesh, tree, conds: tuple[Cond, ...], col_names: tuple[st
                 idx = jnp.clip(cols["span.res_idx"], 0, rm.shape[1] - 1)
                 rm_g = jnp.take_along_axis(rm, idx, axis=1)
                 return rm_g & (cols["span.res_idx"] >= 0) & valid
+            if c.target in (T_SATTR, T_RATTR):
+                return attr_mask(i)
             raise ValueError(f"sharded search: unsupported target {c.target}")
 
         def ev_span(t):
@@ -165,7 +230,12 @@ def make_sharded_search(mesh, tree, conds: tuple[Cond, ...], col_names: tuple[st
 
     in_specs = [P("dp"), P("dp"), P("dp")] + [P("dp")] * len(table_idxs)
     for n in col_names:
-        in_specs.append(P("dp", "sp") if n.startswith("span.") else P("dp"))
+        if n.endswith(".off"):
+            in_specs.append(P("dp"))  # owner offsets: replicated along sp
+        elif n.startswith(("span.", "sattr.", "rattr.")):
+            in_specs.append(P("dp", "sp"))  # row axes shard over sp
+        else:
+            in_specs.append(P("dp"))
     fn = smap(local, mesh, in_specs=tuple(in_specs), out_specs=(P("dp"), P("dp")))
     return jax.jit(fn)
 

@@ -335,6 +335,38 @@ def test_device_paths_run_mesh_programs(tmp_path):
     assert got_host.span_count() == got.span_count()
 
 
+def test_device_search_generic_attr_on_mesh(tmp_path):
+    """Arbitrary {span.foo = "bar"} / mixed generic-attr queries run the
+    stacked MESH program (attr rows sharded over sp) and match the host
+    path -- previously the generic-attr tables forced the per-block
+    fallback."""
+    from tempo_tpu.parallel import search as ps
+
+    db = _db(tmp_path)
+    for seed in (31, 32, 33):
+        db.write_block(TENANT, make_traces(10, seed=seed))
+
+    for q in (
+        '{ span.component = "grpc" }',          # sattr str eq
+        '{ .component =~ "gr.*" }',             # EITHER scope + regex table
+        '{ span.latency.weight > 0.25 }',       # float attr (needs_verify)
+        '{ span.component != nil && duration > 1ms }',  # exists + span col
+    ):
+        si = ps.make_sharded_search.cache_info()
+        before = si.hits + si.misses
+        req = SearchRequest(query=q, limit=100)
+        resp = db.search(TENANT, req)
+        si = ps.make_sharded_search.cache_info()
+        assert si.hits + si.misses > before, f"{q} did not run the mesh program"
+        assert resp.traces, q
+        db.cfg.device_search = False
+        resp_host = db.search(TENANT, req)
+        db.cfg.device_search = True
+        assert sorted(r.trace_id for r in resp.traces) == sorted(
+            r.trace_id for r in resp_host.traces
+        ), q
+
+
 def test_device_find_combines_partials(tmp_path):
     """Device Find returns per-block hit rows so replicated partial
     traces still combine (not a single elected winner)."""

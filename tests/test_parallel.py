@@ -129,6 +129,99 @@ def test_sharded_search_trace_cond_and_table(mesh):
         np.testing.assert_array_equal(sc[b], np.where(expected_tm, counts, 0))
 
 
+def test_sharded_search_generic_attr_matches_oracle(mesh):
+    """Generic sattr/rattr conds ({span.foo = "bar"} over the attr
+    tables) run on the mesh: attr rows shard over sp, owner aggregation
+    stitches across shard cuts with psum_scatter/psum. Checked against
+    the numpy oracle on raggedy per-span attr counts that straddle the
+    4-way sp split."""
+    rng = np.random.default_rng(5)
+    from tempo_tpu.ops.device import PAD_I32
+    from tempo_tpu.ops.filter import T_RATTR, T_SATTR
+
+    B, S_rows, NT, R = 2, 32, 8, 4
+    A, RA = 64, 16  # sattr / rattr row buckets (multiples of sp=4)
+    n_spans = np.asarray([32, 21], dtype=np.int32)
+
+    cols = {
+        "span.trace_sid": rng.integers(0, NT, size=(B, S_rows)).astype(np.int32),
+        "span.res_idx": rng.integers(0, R, size=(B, S_rows)).astype(np.int32),
+        "sattr.key_id": np.full((B, A), PAD_I32, np.int32),
+        "sattr.vtype": np.full((B, A), PAD_I32, np.int32),
+        "sattr.str_id": np.full((B, A), PAD_I32, np.int32),
+        "sattr.off": np.zeros((B, S_rows + 1), np.int32),
+        "rattr.key_id": np.full((B, RA), PAD_I32, np.int32),
+        "rattr.vtype": np.full((B, RA), PAD_I32, np.int32),
+        "rattr.int32": np.full((B, RA), PAD_I32, np.int32),
+        "rattr.off": np.zeros((B, R + 1), np.int32),
+    }
+    sattr_real = []  # (key, vtype, str_id, owner) per block for the oracle
+    rattr_real = []
+    for b in range(B):
+        counts = rng.integers(0, 4, size=n_spans[b])
+        # truncate the tail so the rows fit in A while keeping raggedness
+        over = np.cumsum(counts) > A
+        counts[over] = 0
+        assert counts.sum() > 0
+        off = np.zeros(S_rows + 1, np.int32)
+        off[1 : n_spans[b] + 1] = np.cumsum(counts)
+        off[n_spans[b] + 1 :] = off[n_spans[b]]
+        cols["sattr.off"][b] = off
+        n_rows = int(off[-1])
+        keys = rng.integers(0, 5, size=n_rows).astype(np.int32)
+        vts = rng.integers(0, 2, size=n_rows).astype(np.int32)  # str/int mix
+        vals = rng.integers(0, 6, size=n_rows).astype(np.int32)
+        cols["sattr.key_id"][b, :n_rows] = keys
+        cols["sattr.vtype"][b, :n_rows] = vts
+        cols["sattr.str_id"][b, :n_rows] = vals
+        owners = np.repeat(np.arange(n_spans[b]), counts)
+        sattr_real.append((keys, vts, vals, owners))
+
+        rcounts = rng.integers(0, 4, size=R)
+        rcounts[np.cumsum(rcounts) > RA] = 0
+        roff = np.concatenate([[0], np.cumsum(rcounts)]).astype(np.int32)
+        cols["rattr.off"][b] = roff
+        rn = int(roff[-1])
+        rkeys = rng.integers(0, 3, size=rn).astype(np.int32)
+        rvts = np.ones(rn, np.int32)  # int-typed
+        rvals = rng.integers(0, 50, size=rn).astype(np.int32)
+        cols["rattr.key_id"][b, :rn] = rkeys
+        cols["rattr.vtype"][b, :rn] = rvts
+        cols["rattr.int32"][b, :rn] = rvals
+        rowners = np.repeat(np.arange(R), rcounts)
+        rattr_real.append((rkeys, rvts, rvals, rowners))
+
+    conds = (
+        Cond(target=T_SATTR, col="str", op="eq"),      # span.foo = code 3
+        Cond(target=T_RATTR, col="int", op="ge"),      # resource.bar >= 20
+        Cond(target=T_SATTR, col="any", op="exists"),  # span.baz != nil
+    )
+    tree = ("and", ("cond", 0), ("or", ("cond", 1), ("cond", 2)))
+    operands = Operands.build(
+        [(2, 3, 0, 0.0, 0.0), (1, 20, 0, 0.0, 0.0), (4, 0, 0, 0.0, 0.0)]
+    )
+    tm, sc = sharded_search(mesh, tree, conds, operands, cols, n_spans, nt=NT)
+
+    for b in range(B):
+        keys, vts, vals, owners = sattr_real[b]
+        rkeys, rvts, rvals, rowners = rattr_real[b]
+        ns = n_spans[b]
+        m0 = np.zeros(S_rows, bool)
+        hit0 = (keys == 2) & (vts == 0) & (vals == 3)
+        np.logical_or.at(m0, owners[hit0], True)
+        rmask = np.zeros(R, bool)
+        rhit = (rkeys == 1) & (rvts == 1) & (rvals >= 20)
+        np.logical_or.at(rmask, rowners[rhit], True)
+        m1 = rmask[cols["span.res_idx"][b]]
+        m2 = np.zeros(S_rows, bool)
+        np.logical_or.at(m2, owners[keys == 4], True)
+        valid = np.arange(S_rows) < ns
+        sm = m0 & (m1 | m2) & valid
+        counts = np.bincount(cols["span.trace_sid"][b][sm], minlength=NT)[:NT]
+        np.testing.assert_array_equal(sc[b], counts, err_msg=f"block {b}")
+        np.testing.assert_array_equal(tm[b], counts > 0, err_msg=f"block {b}")
+
+
 def test_sharded_bloom_union(mesh):
     blooms = []
     all_ids = []
@@ -199,6 +292,40 @@ def test_graft_dryrun_multichip_entry():
     try:
         import __graft_entry__ as graft
 
+        graft.dryrun_multichip(8)
+    finally:
+        sys.path.pop(0)
+
+
+def test_graft_dryrun_scale_shape():
+    """The --scale dryrun: >= 1M padded span rows per chip, ragged
+    per-block sizes, generic-attr conds, per-chip memory budget, host
+    oracle -- the dryrun stand-in for the 100M-span sharded Find/search
+    baseline config."""
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+    try:
+        import __graft_entry__ as graft
+
+        graft.dryrun_multichip(8, scale=True)
+    finally:
+        sys.path.pop(0)
+
+
+def test_graft_dryrun_subprocess_fallback(monkeypatch):
+    """When the in-process virtual-device switch is impossible (private
+    jax API moved), the dryrun still runs via a fresh subprocess
+    configured purely through public env vars."""
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+    try:
+        import __graft_entry__ as graft
+
+        monkeypatch.setattr(graft, "_force_virtual_devices", lambda n: False)
         graft.dryrun_multichip(8)
     finally:
         sys.path.pop(0)
