@@ -349,6 +349,206 @@ int vtpu_otlp_scan(const uint8_t* buf, int64_t n,
   return 0;
 }
 
+// ----------------------------------------------------------- otlp splice
+
+// Scan + group-by-trace-id + emit, one call: the distributor's whole
+// rebatch loop (wire/otlp_splice.py used to drive vtpu_otlp_scan from
+// Python and splice per-trace bytes in a Python loop -- the single
+// biggest ingest cost). Emits finished wire segments back to back into
+// `out`: 9-byte header (version 0x01, u32 start_s, u32 end_s, little
+// endian -- wire/segment._HDR) followed by the per-trace TracesData
+// built from envelope + span slices of the original payload.
+//
+// Returns 0 ok (counts = [n_traces, out_bytes, n_spans]);
+//         1 malformed (caller falls back to the Python model path);
+//         2 capacity: counts[0]/counts[1] carry the needed trace count
+//           and out bytes -- re-call with buffers at least that big.
+
+static inline int vsize(uint64_t v) {
+  int s = 1;
+  while (v >= 128) { v >>= 7; s++; }
+  return s;
+}
+
+static inline void vput(uint8_t** p, uint64_t v) {
+  while (v >= 128) { *(*p)++ = (uint8_t)(v | 0x80); v >>= 7; }
+  *(*p)++ = (uint8_t)v;
+}
+
+int vtpu_otlp_splice(const uint8_t* buf, int64_t n,
+                     uint8_t* out, int64_t cap_out,
+                     uint8_t* tids_out, int64_t cap_traces,
+                     int64_t* seg_off, int64_t* seg_len,
+                     int64_t* start_s_out, int64_t* end_s_out,
+                     int64_t* counts) {
+  // scan with internally managed buffers (grow-on-demand mirrors the
+  // Python binding's retry loop)
+  std::vector<int64_t> sp_off, sp_len, rs_eoff, rs_elen, ss_eoff, ss_elen;
+  std::vector<int32_t> sp_rs, sp_ss, ss_rsv;
+  std::vector<uint8_t> tids, env, senv;
+  std::vector<uint64_t> st_ns, en_ns;
+  int64_t cap_spans = n / 24 + 16, cap_g = n / 64 + 8;
+  int64_t c[5];
+  int rc = 1;
+  for (int t = 0; t < 4; t++) {
+    sp_off.resize(cap_spans); sp_len.resize(cap_spans);
+    sp_rs.resize(cap_spans); sp_ss.resize(cap_spans);
+    tids.resize((size_t)cap_spans * 16);
+    st_ns.resize(cap_spans); en_ns.resize(cap_spans);
+    env.resize(n + 16); senv.resize(n + 16);
+    rs_eoff.resize(cap_g); rs_elen.resize(cap_g);
+    ss_eoff.resize(cap_g); ss_elen.resize(cap_g); ss_rsv.resize(cap_g);
+    rc = vtpu_otlp_scan(buf, n, sp_off.data(), sp_len.data(), sp_rs.data(),
+                        sp_ss.data(), tids.data(), st_ns.data(), en_ns.data(),
+                        cap_spans, env.data(), (int64_t)env.size(),
+                        senv.data(), (int64_t)senv.size(),
+                        rs_eoff.data(), rs_elen.data(), cap_g,
+                        ss_eoff.data(), ss_elen.data(), ss_rsv.data(), cap_g, c);
+    if (rc == 2) { cap_spans *= 4; cap_g *= 4; continue; }
+    break;
+  }
+  if (rc != 0) return 1;
+  const int64_t k = c[0];
+  counts[2] = k;
+  if (k == 0) { counts[0] = 0; counts[1] = 0; return 0; }
+
+  // stable order by 16-byte id keeps spans of a trace in payload order
+  std::vector<int32_t> order(k);
+  for (int64_t i = 0; i < k; i++) order[i] = (int32_t)i;
+  const uint8_t* tp = tids.data();
+  std::stable_sort(order.begin(), order.end(), [tp](int32_t a, int32_t b) {
+    return memcmp(tp + (size_t)a * 16, tp + (size_t)b * 16, 16) < 0;
+  });
+
+  // one trace's TracesData body size: same rs/ss-run walk as the emit
+  // pass, arithmetic only. [g0, g1) index into `order`.
+  auto body_size = [&](int64_t g0, int64_t g1, uint64_t* lo, uint64_t* hi) {
+    int64_t body = 0;
+    int64_t a = g0;
+    while (a < g1) {
+      int32_t rs = sp_rs[order[a]];
+      int64_t rs_body = rs_elen[rs];
+      while (a < g1 && sp_rs[order[a]] == rs) {
+        int32_t ss = sp_ss[order[a]];
+        int64_t ss_body = ss_elen[ss];
+        while (a < g1 && sp_ss[order[a]] == ss) {
+          int32_t j = order[a];
+          ss_body += 1 + vsize((uint64_t)sp_len[j]) + sp_len[j];
+          if (st_ns[j] < *lo) *lo = st_ns[j];
+          if (en_ns[j] > *hi) *hi = en_ns[j];
+          a++;
+        }
+        rs_body += 1 + vsize((uint64_t)ss_body) + ss_body;
+      }
+      body += 1 + vsize((uint64_t)rs_body) + rs_body;
+    }
+    return body;
+  };
+
+  // pass A: total output size + trace count (capacity check up front so
+  // the emit pass never has to be abandoned half-written); per-trace
+  // results are cached so pass B never re-walks the sizes
+  int64_t total_out = 0, n_tr = 0;
+  std::vector<int64_t> tr_start, tr_body;
+  std::vector<uint64_t> tr_lo, tr_hi;
+  for (int64_t i = 0; i < k;) {
+    int64_t g0 = i;
+    while (i < k && memcmp(tp + (size_t)order[i] * 16,
+                           tp + (size_t)order[g0] * 16, 16) == 0)
+      i++;
+    uint64_t lo = UINT64_MAX, hi = 0;
+    int64_t body = body_size(g0, i, &lo, &hi);
+    tr_start.push_back(g0);
+    tr_body.push_back(body);
+    tr_lo.push_back(lo);
+    tr_hi.push_back(hi);
+    total_out += 9 + body;
+    n_tr++;
+  }
+  if (n_tr > cap_traces || total_out > cap_out) {
+    counts[0] = n_tr;
+    counts[1] = total_out;
+    return 2;
+  }
+
+  // pass B: emit
+  int64_t out_pos = 0;
+  tr_start.push_back(k);  // sentinel: trace u spans order[tr_start[u] : tr_start[u+1]]
+  for (int64_t u = 0; u < n_tr; u++) {
+    int64_t g0 = tr_start[u], i = tr_start[u + 1];
+    int64_t body = tr_body[u];
+    uint64_t lo = tr_lo[u], hi = tr_hi[u];
+    memcpy(tids_out + (size_t)u * 16, tp + (size_t)order[g0] * 16, 16);
+    seg_off[u] = out_pos;
+    seg_len[u] = 9 + body;
+    uint64_t lo_s = lo == UINT64_MAX ? 0 : lo / 1000000000ull;
+    // saturate before the ceiling add: end timestamps near 2^64 (the
+    // scanner tolerates nonconformant varints) must not wrap to ~0 --
+    // the Python oracle computes this with bignums
+    uint64_t hi_s = hi > UINT64_MAX - 999999999ull
+                        ? UINT64_MAX / 1000000000ull + 1
+                        : (hi + 999999999ull) / 1000000000ull;
+    start_s_out[u] = (int64_t)lo_s;
+    end_s_out[u] = (int64_t)hi_s;
+    uint8_t* p = out + out_pos;
+    *p++ = 0x01;
+    uint32_t w32 = (uint32_t)lo_s;
+    memcpy(p, &w32, 4); p += 4;
+    w32 = (uint32_t)hi_s;
+    memcpy(p, &w32, 4); p += 4;
+    int64_t a = g0;
+    while (a < i) {
+      int32_t rs = sp_rs[order[a]];
+      // recompute the run sizes inline (cheap arithmetic; avoids
+      // buffering per-run size vectors between passes)
+      int64_t rs_body = rs_elen[rs];
+      {
+        int64_t a2 = a;
+        while (a2 < i && sp_rs[order[a2]] == rs) {
+          int32_t ss = sp_ss[order[a2]];
+          int64_t ss_body = ss_elen[ss];
+          while (a2 < i && sp_ss[order[a2]] == ss) {
+            ss_body += 1 + vsize((uint64_t)sp_len[order[a2]]) + sp_len[order[a2]];
+            a2++;
+          }
+          rs_body += 1 + vsize((uint64_t)ss_body) + ss_body;
+        }
+      }
+      *p++ = 0x0A;  // TracesData.resource_spans
+      vput(&p, (uint64_t)rs_body);
+      memcpy(p, env.data() + rs_eoff[rs], (size_t)rs_elen[rs]);
+      p += rs_elen[rs];
+      while (a < i && sp_rs[order[a]] == rs) {
+        int32_t ss = sp_ss[order[a]];
+        int64_t ss_body = ss_elen[ss];
+        {
+          int64_t a2 = a;
+          while (a2 < i && sp_ss[order[a2]] == ss) {
+            ss_body += 1 + vsize((uint64_t)sp_len[order[a2]]) + sp_len[order[a2]];
+            a2++;
+          }
+        }
+        *p++ = 0x12;  // ResourceSpans.scope_spans
+        vput(&p, (uint64_t)ss_body);
+        memcpy(p, senv.data() + ss_eoff[ss], (size_t)ss_elen[ss]);
+        p += ss_elen[ss];
+        while (a < i && sp_ss[order[a]] == ss) {
+          int32_t j = order[a];
+          *p++ = 0x12;  // ScopeSpans.spans
+          vput(&p, (uint64_t)sp_len[j]);
+          memcpy(p, buf + sp_off[j], (size_t)sp_len[j]);
+          p += sp_len[j];
+          a++;
+        }
+      }
+    }
+    out_pos += 9 + body;
+  }
+  counts[0] = n_tr;
+  counts[1] = out_pos;
+  return 0;
+}
+
 // ------------------------------------------------------------------- zstd
 
 // Compress n chunks in parallel. in_offsets[i]..+in_lens[i] index into

@@ -129,6 +129,15 @@ def _bind(lib):
         ctypes.c_void_p,
     ]
     lib.vtpu_otlp_scan.restype = ctypes.c_int
+    lib.vtpu_otlp_splice.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64,
+        ctypes.c_void_p, ctypes.c_int64,
+        ctypes.c_void_p, ctypes.c_int64,
+        ctypes.c_void_p, ctypes.c_void_p,
+        ctypes.c_void_p, ctypes.c_void_p,
+        ctypes.c_void_p,
+    ]
+    lib.vtpu_otlp_splice.restype = ctypes.c_int
     lib.vtpu_span_metrics.argtypes = [
         ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
         ctypes.c_int, ctypes.c_int64, ctypes.c_void_p, ctypes.c_void_p,
@@ -585,6 +594,50 @@ def otlp_scan(payload: bytes):
                 senv[: int(counts[4])].tobytes(),
                 rs_off[:nrs], rs_len[:nrs],
                 ss_off[:nss], ss_len[:nss], ss_rs[:nss])
+    return None
+
+
+def otlp_splice(payload: bytes):
+    """Scan + group-by-trace + emit finished wire segments, ONE native
+    call (vtpu_otlp_splice): returns (tids (K,16) u8, seg_off (K,),
+    seg_len (K,), start_s (K,), end_s (K,), out u8 buffer, n_spans) or
+    None (native unavailable / malformed -- caller uses the Python
+    path). Each out[seg_off[u] : seg_off[u]+seg_len[u]] is a complete
+    segment (9B header + per-trace TracesData)."""
+    lib = _load()
+    if lib is None or getattr(lib, "vtpu_otlp_splice", None) is None:
+        return None
+    n = len(payload)
+    if n == 0:
+        return None
+    buf = np.frombuffer(payload, dtype=np.uint8)
+    # envelopes repeat per trace, so output can exceed the payload;
+    # 2n + slack covers typical shapes, rc=2 reports the exact need
+    cap_out = 2 * n + 4096
+    cap_tr = max(16, n // 64 + 8)
+    for _ in range(3):
+        out = np.empty(cap_out, np.uint8)
+        tids = np.empty((cap_tr, 16), np.uint8)
+        seg_off = np.empty(cap_tr, np.int64)
+        seg_len = np.empty(cap_tr, np.int64)
+        st = np.empty(cap_tr, np.int64)
+        en = np.empty(cap_tr, np.int64)
+        counts = np.zeros(3, np.int64)
+        rc = lib.vtpu_otlp_splice(
+            buf.ctypes.data, n, out.ctypes.data, cap_out,
+            tids.ctypes.data, cap_tr,
+            seg_off.ctypes.data, seg_len.ctypes.data,
+            st.ctypes.data, en.ctypes.data, counts.ctypes.data,
+        )
+        if rc == 2:
+            cap_tr = max(cap_tr * 2, int(counts[0]))
+            cap_out = max(cap_out * 2, int(counts[1]))
+            continue
+        if rc != 0:
+            return None
+        K = int(counts[0])
+        return (tids[:K], seg_off[:K], seg_len[:K], st[:K], en[:K], out,
+                int(counts[2]))
     return None
 
 

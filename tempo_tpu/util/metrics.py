@@ -10,6 +10,7 @@ exposition text by /metrics.
 from __future__ import annotations
 
 import threading
+import time
 
 DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
                    1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
@@ -80,17 +81,22 @@ class Counter:
             ]
 
 
+class _Timed:
+    __slots__ = ("hist", "labels", "t0")
+
+    def __init__(self, hist, labels):
+        self.hist = hist
+        self.labels = labels
+
+    def __enter__(self):
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.hist.observe(time.perf_counter() - self.t0, self.labels)
+        return False
+
+
 def timed(hist: Histogram, labels: str = ""):
     """Context manager: observe the block's wall time."""
-    import time
-
-    class _T:
-        def __enter__(self):
-            self.t0 = time.perf_counter()
-            return self
-
-        def __exit__(self, *exc):
-            hist.observe(time.perf_counter() - self.t0, labels)
-            return False
-
-    return _T()
+    return _Timed(hist, labels)

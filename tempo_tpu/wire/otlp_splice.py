@@ -42,7 +42,27 @@ def split_by_trace(payload: bytes):
     segments: {trace_id bytes: (start_s, end_s, segment_bytes)} where
     segment_bytes is the wire segment (s1 header + per-trace TracesData)
     exactly as segment_for_write would have produced for the same spans
-    (same span bytes, same envelope fields)."""
+    (same span bytes, same envelope fields).
+
+    Fast path: ONE native call (vtpu_otlp_splice) scans, groups and
+    emits finished segments; Python only slices the output buffer. The
+    scan-here-splice-in-Python path below remains as the fallback and
+    as the differential oracle for the native emitter."""
+    from ..native import otlp_splice
+
+    res = otlp_splice(payload)
+    if res is not None:
+        tids, seg_off, seg_len, st, en, out, n_spans = res
+        segments: dict[bytes, tuple[int, int, bytes]] = {}
+        for u in range(tids.shape[0]):
+            o = int(seg_off[u])
+            segments[tids[u].tobytes()] = (
+                int(st[u]), int(en[u]), out[o : o + int(seg_len[u])].tobytes())
+        return segments, n_spans
+    return _split_by_trace_py(payload)
+
+
+def _split_by_trace_py(payload: bytes):
     from ..native import otlp_scan
 
     scan = otlp_scan(payload)
