@@ -482,12 +482,10 @@ int vtpu_otlp_splice(const uint8_t* buf, int64_t n,
     seg_off[u] = out_pos;
     seg_len[u] = 9 + body;
     uint64_t lo_s = lo == UINT64_MAX ? 0 : lo / 1000000000ull;
-    // saturate before the ceiling add: end timestamps near 2^64 (the
+    // overflow-free exact ceil(hi / 1e9): end timestamps near 2^64 (the
     // scanner tolerates nonconformant varints) must not wrap to ~0 --
     // the Python oracle computes this with bignums
-    uint64_t hi_s = hi > UINT64_MAX - 999999999ull
-                        ? UINT64_MAX / 1000000000ull + 1
-                        : (hi + 999999999ull) / 1000000000ull;
+    uint64_t hi_s = hi ? (hi - 1) / 1000000000ull + 1 : 0;
     start_s_out[u] = (int64_t)lo_s;
     end_s_out[u] = (int64_t)hi_s;
     uint8_t* p = out + out_pos;
@@ -564,12 +562,15 @@ int vtpu_zstd_compress_batch(const uint8_t* src, const int64_t* in_offsets,
   std::atomic<int> next(0), failed(0);
   auto work = [&]() {
     ZSTD_CCtx* ctx = ZSTD_createCCtx();
+    // advanced API: the one-shot ZSTD_compressCCtx treats level <= 0 as
+    // "default", silently ignoring the fast negative levels
+    ZSTD_CCtx_setParameter(ctx, ZSTD_c_compressionLevel, level);
     for (;;) {
       int i = next.fetch_add(1);
       if (i >= n_chunks) break;
-      size_t r = ZSTD_compressCCtx(ctx, dst + out_offsets[i],
-                                   (size_t)(vtpu_zstd_bound(in_lens[i])),
-                                   src + in_offsets[i], (size_t)in_lens[i], level);
+      size_t r = ZSTD_compress2(ctx, dst + out_offsets[i],
+                                (size_t)(vtpu_zstd_bound(in_lens[i])),
+                                src + in_offsets[i], (size_t)in_lens[i]);
       if (ZSTD_isError(r)) {
         failed.store(1);
         break;

@@ -516,9 +516,59 @@ def write_block(backend: RawBackend, fin: FinalizedBlock, level: int = 3,
     m = fin.meta
     app = backend.open_append(m.tenant_id, m.block_id, DATA_NAME)
     try:
-        for part in pack_columns_stream(fin.cols, fin.axes, fin.col_axis,
-                                        level=level, codec=codec):
-            app.append(part)
+        # pipelined writer: append() blocks on disk writeback (the write
+        # syscall drops the GIL), so a single ordered writer thread
+        # overlaps IO stalls with the next chunk's compression -- on the
+        # one-core compactor box this hides most of the write wall time
+        import queue as _queue
+        import threading as _threading
+
+        # compression emits chunks in per-column batch bursts; the queue
+        # must absorb a burst (~one column's chunks) or the producer
+        # blocks on put() instead of compressing the next column. The
+        # bound is BYTES, not parts: a slow disk must not let hundreds
+        # of MB of compressed chunks pile up in memory.
+        q: _queue.Queue = _queue.Queue()
+        cond = _threading.Condition()
+        pending = [0]  # bytes queued but not yet written
+        budget_bytes = 32 << 20
+        werr: list[BaseException] = []
+
+        def _writer():
+            # keep draining after a failure so the producer never
+            # deadlocks waiting for budget; the error surfaces after join
+            while True:
+                part = q.get()
+                if part is None:
+                    return
+                if not werr:
+                    try:
+                        app.append(part)
+                    except BaseException as e:
+                        werr.append(e)
+                with cond:
+                    pending[0] -= len(part)
+                    cond.notify()
+
+        wt = _threading.Thread(target=_writer, name="block-writer", daemon=True)
+        wt.start()
+        try:
+            for part in pack_columns_stream(fin.cols, fin.axes, fin.col_axis,
+                                            level=level, codec=codec):
+                if werr:
+                    break
+                with cond:
+                    # an oversized single part passes when the queue is
+                    # empty rather than deadlocking on the budget
+                    while pending[0] > 0 and pending[0] + len(part) > budget_bytes:
+                        cond.wait()
+                    pending[0] += len(part)
+                q.put(part)
+        finally:
+            q.put(None)
+            wt.join()
+        if werr:
+            raise werr[0]
         app.close()
     except BaseException:
         app.abort()

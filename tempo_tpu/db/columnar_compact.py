@@ -217,35 +217,33 @@ def _packed_offs(lens: np.ndarray) -> np.ndarray:
     return cs - lens
 
 
-def _assemble(tenant: str, sources: list[_Source], chunks: list[tuple[int, int, int]],
+def _assemble(tenant: str, sources: list[_Source],
+              chunks: tuple[np.ndarray, np.ndarray, np.ndarray],
               merged: Dictionary, level: int, row_group_spans: int,
               bloom: ShardedBloom | None) -> FinalizedBlock:
-    """Assemble one output block from (src, sid_lo, sid_hi) chunks.
+    """Assemble one output block from (src, sid_lo, sid_hi) run arrays.
 
     Everything is per-SOURCE vectorized: each axis of each source
     contributes via exactly one gather + one scatter per column, so cost
     does not degrade when the merge interleaves finely (many tiny runs,
     the 1000-small-blocks compaction shape)."""
-    names = list(sources[chunks[0][0]].cols)
-    csrc = np.asarray([c[0] for c in chunks], dtype=np.int32)
-    clo = np.asarray([c[1] for c in chunks], dtype=np.int64)
-    chi = np.asarray([c[2] for c in chunks], dtype=np.int64)
-    src_order: list[int] = []
-    for s in csrc:
-        if int(s) not in src_order:
-            src_order.append(int(s))
+    csrc, clo, chi = chunks
+    csrc = csrc.astype(np.int32)
+    n_chunks = csrc.shape[0]
+    names = list(sources[int(csrc[0])].cols)
+    src_order = [int(s) for s in np.unique(csrc)]
     by_src = {si: np.nonzero(csrc == si)[0] for si in src_order}
 
     # per-chunk row ranges along every axis (one vectorized searchsorted
     # per source per child axis)
-    span_lo = np.zeros(len(chunks), np.int64)
-    span_hi = np.zeros(len(chunks), np.int64)
+    span_lo = np.zeros(n_chunks, np.int64)
+    span_hi = np.zeros(n_chunks, np.int64)
     child_axes = {  # axis -> (owner col, parent range arrays)
         "sattr": "sattr.span", "ev": "ev.span", "ln": "ln.span",
         "evattr": "evattr.ev", "lnattr": "lnattr.ln",
     }
-    ax_lo = {a: np.zeros(len(chunks), np.int64) for a in child_axes}
-    ax_hi = {a: np.zeros(len(chunks), np.int64) for a in child_axes}
+    ax_lo = {a: np.zeros(n_chunks, np.int64) for a in child_axes}
+    ax_hi = {a: np.zeros(n_chunks, np.int64) for a in child_axes}
     for si in src_order:
         s = sources[si]
         ii = by_src[si]
@@ -264,8 +262,8 @@ def _assemble(tenant: str, sources: list[_Source], chunks: list[tuple[int, int, 
     # trace-child axis whose per-chunk ranges come straight from the
     # source's offsets column -- no searchsorted needed
     has_tres = "tres.res" in names
-    tres_lo = np.zeros(len(chunks), np.int64)
-    tres_hi = np.zeros(len(chunks), np.int64)
+    tres_lo = np.zeros(n_chunks, np.int64)
+    tres_hi = np.zeros(n_chunks, np.int64)
     if has_tres:
         for si in src_order:
             toff = sources[si].cols["trace.tres_off"].astype(np.int64)
@@ -382,7 +380,15 @@ def _assemble(tenant: str, sources: list[_Source], chunks: list[tuple[int, int, 
 
     def _translate(si: int, old: np.ndarray, used: dict[int, np.ndarray],
                    base: dict[int, int]) -> np.ndarray:
-        new = np.searchsorted(used[si], old).astype(np.int32) + base[si]
+        u = used[si]
+        if u.size and int(u[-1]) < (1 << 22):
+            # dense lookup table: O(n) gather instead of the O(n log m)
+            # searchsorted -- res/scope index spaces are small ints
+            lut = np.zeros(int(u[-1]) + 1, np.int32)
+            lut[u] = np.arange(u.size, dtype=np.int32)
+            new = lut[np.clip(old, 0, int(u[-1]))] + base[si]
+        else:
+            new = np.searchsorted(u, old).astype(np.int32) + base[si]
         return np.where(old >= 0, new, old).astype(np.int32)
 
     axis_rows = {"trace": n_traces, "span": n_spans, **ax_n}
@@ -547,28 +553,45 @@ def compact_columnar(backend: RawBackend, job: CompactionJob, cfg: CompactorConf
     if n:
         dup[:-1] |= same[1:]
 
-    # collision groups become one-trace sources appended after the blocks
-    runs: list[tuple[int, int, int]] = []  # (src, sid_lo, sid_hi)
-    i = 0
-    while i < n:
-        if dup[i]:
-            j = i + 1
-            while j < n and same[j]:
-                j += 1
-            members = [(int(src_arr[k]), int(sid_arr[k])) for k in range(i, j)]
-            sources.append(_combine_collision(sources, blocks, members, tenant))
-            runs.append((len(sources) - 1, 0, 1))
-            i = j
-        else:
-            b, lo = int(src_arr[i]), int(sid_arr[i])
-            hi = lo + 1
-            j = i + 1
-            while j < n and not dup[j] and src_arr[j] == b and sid_arr[j] == hi:
-                hi += 1
-                j += 1
-            runs.append((b, lo, hi))
-            i = j
-    if not runs:
+    # vectorized run detection (the old per-trace Python loop cost more
+    # than the dictionary merge on realistic jobs): a run continues while
+    # the source stays, sids stay consecutive, and neither row belongs to
+    # a collision group
+    if n:
+        cont = np.zeros(n, dtype=bool)
+        cont[1:] = ((src_arr[1:] == src_arr[:-1])
+                    & (sid_arr[1:] == sid_arr[:-1] + 1)
+                    & ~dup[1:] & ~dup[:-1])
+        starts = np.nonzero(~cont)[0]
+        seg_len = np.append(starts[1:], n) - starts
+        run_src = src_arr[starts].astype(np.int64)
+        run_lo = sid_arr[starts].astype(np.int64)
+        run_hi = run_lo + seg_len
+        if dup.any():
+            # collision groups become one-trace sources appended after
+            # the blocks (rare; random 16-byte ids almost never collide)
+            seg_dup = dup[starts]
+            cs = starts[seg_dup]  # every collision member is its own segment
+            new_group = ~same[cs]
+            gid = np.cumsum(new_group) - 1
+            groups: list[list[tuple[int, int]]] = [[] for _ in range(int(gid[-1]) + 1)] if cs.size else []
+            for t, g in zip(cs, gid):
+                groups[int(g)].append((int(src_arr[t]), int(sid_arr[t])))
+            coll_src = []
+            for members in groups:
+                sources.append(_combine_collision(sources, blocks, members, tenant))
+                coll_src.append(len(sources) - 1)
+            # splice the one-trace collision runs back at their merged
+            # position (each group sits where its first member sorted)
+            all_pos = np.concatenate([starts[~seg_dup], cs[new_group]])
+            all_src = np.concatenate([run_src[~seg_dup], np.asarray(coll_src, np.int64)])
+            all_lo = np.concatenate([run_lo[~seg_dup], np.zeros(len(coll_src), np.int64)])
+            all_hi = np.concatenate([run_hi[~seg_dup], np.ones(len(coll_src), np.int64)])
+            o = np.argsort(all_pos, kind="stable")
+            run_src, run_lo, run_hi = all_src[o], all_lo[o], all_hi[o]
+    else:
+        run_src = run_lo = run_hi = np.empty(0, np.int64)
+    if run_src.size == 0:
         for m in job.blocks:
             backend.mark_compacted(tenant, m.block_id)
         return CompactionResult(compacted_ids=[m.block_id for m in job.blocks])
@@ -597,25 +620,39 @@ def compact_columnar(backend: RawBackend, job: CompactionJob, cfg: CompactorConf
     cap_traces = max(1, int(max(target - len(blob), target // 4) / bpt))
 
     result = CompactionResult()
-    chunk_lists: list[list[tuple[int, int, int]]] = [[]]
-    acc = 0
-    for src, lo, hi in runs:
-        while hi - lo > 0:
-            room = cap_traces - acc
-            take = min(hi - lo, max(1, room))
-            chunk_lists[-1].append((src, lo, lo + take))
-            lo += take
-            acc += take
-            if acc >= cap_traces:
-                chunk_lists.append([])
-                acc = 0
-    chunk_lists = [cl for cl in chunk_lists if cl]
+    # split the run table into per-output-block slices at cap_traces
+    # boundaries (vectorized; a run straddling a cut is split in two)
+    lens = run_hi - run_lo
+    cum = np.cumsum(lens)
+    total_tr = int(cum[-1])
+    n_out = max(1, -(-total_tr // cap_traces))
+    chunk_lists: list[tuple[np.ndarray, np.ndarray, np.ndarray]] = []
+    if n_out == 1:
+        chunk_lists.append((run_src, run_lo, run_hi))
+    else:
+        prev_run, prev_off = 0, 0  # resume point: run index + traces consumed
+        for b_idx in range(n_out):
+            if b_idx < n_out - 1:
+                boundary = (b_idx + 1) * cap_traces
+                r = int(np.searchsorted(cum, boundary, "left"))
+                off_in_r = boundary - (int(cum[r]) - int(lens[r]))
+            else:
+                r, off_in_r = len(lens) - 1, int(lens[-1])
+            s_src = run_src[prev_run : r + 1].copy()
+            s_lo = run_lo[prev_run : r + 1].copy()
+            s_hi = run_hi[prev_run : r + 1].copy()
+            s_lo[0] = run_lo[prev_run] + prev_off
+            s_hi[-1] = run_lo[r] + off_in_r
+            keep = s_hi > s_lo
+            if keep.any():
+                chunk_lists.append((s_src[keep], s_lo[keep], s_hi[keep]))
+            prev_run, prev_off = r, off_in_r
 
     single_out = len(chunk_lists) == 1
     for cl in chunk_lists:
         bloom = _union_input_blooms(blocks) if single_out else None
         fin = _assemble(tenant, sources, cl, merged, out_level, cfg.row_group_spans, bloom)
-        meta = write_block(backend, fin, level=cfg.zstd_level)
+        meta = write_block(backend, fin, level=cfg.level_for(out_level))
         result.new_blocks.append(meta)
         result.traces_out += fin.meta.total_traces
         result.spans_out += fin.meta.total_spans

@@ -222,12 +222,13 @@ def test_otlp_splice_timestamp_near_u64_max():
     from tempo_tpu.wire.model import ResourceSpans, ScopeSpans, Span, Trace
     from tempo_tpu.wire.otlp_splice import _split_by_trace_py, split_by_trace
 
-    sp = Span(trace_id=b"\x01" * 16, span_id=b"\x02" * 8, name="edge",
-              start_unix_nano=2**64 - 5, end_unix_nano=2**64 - 1)
-    payload = otlp_pb.encode_trace(
-        Trace(resource_spans=[ResourceSpans(scope_spans=[ScopeSpans(spans=[sp])])]))
-    got = split_by_trace(payload)
-    want = _split_by_trace_py(payload)
-    assert got == want
-    (_, end_s, _), = got[0].values()
-    assert end_s == (2**64 - 1 + 10**9 - 1) // 10**9
+    for end in (2**64 - 1, 18446744072800000000, 18446744073000000000, 10**9, 1):
+        sp = Span(trace_id=b"\x01" * 16, span_id=b"\x02" * 8, name="edge",
+                  start_unix_nano=min(end, 2**64 - 5), end_unix_nano=end)
+        payload = otlp_pb.encode_trace(
+            Trace(resource_spans=[ResourceSpans(scope_spans=[ScopeSpans(spans=[sp])])]))
+        got = split_by_trace(payload)
+        want = _split_by_trace_py(payload)
+        assert got == want, f"end={end}"
+        (_, end_s, _), = got[0].values()
+        assert end_s == (end + 10**9 - 1) // 10**9, f"end={end}"
