@@ -4,4 +4,4 @@ from .reader import BackendBlock, open_block
 from .bloom import ShardedBloom
 from .dictionary import Dictionary
 
-VERSION = "vtpu1"
+from .versioned import CURRENT_VERSION as VERSION

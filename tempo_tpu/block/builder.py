@@ -507,13 +507,35 @@ def compute_row_groups(cols, start_ms, dur_us, row_group_spans):
     return axes, col_axis, row_groups
 
 
+# metadata axes every COLD query must decompress before it can do
+# anything (tres plan columns, trace candidate/result columns, res and
+# scope tables): stored at zstd's fast negative level, which decodes
+# ~3-4x faster than level 3 for ~1-2% larger blocks (these axes are a
+# few % of pack bytes; the span/attr payload keeps the ratio level).
+FAST_DECODE_PREFIXES = ("trace.", "tres.", "res.", "scope.")
+FAST_DECODE_LEVEL = -5
+
+
+def _column_level(name: str) -> int | None:
+    return FAST_DECODE_LEVEL if name.startswith(FAST_DECODE_PREFIXES) else None
+
+
 def write_block(backend: RawBackend, fin: FinalizedBlock, level: int = 3,
-                codec: str = "zstd") -> BlockMeta:
+                codec: str = "zstd", version: str | None = None) -> BlockMeta:
     """Write all block objects; meta.json last so pollers never see a
     partial block (reference writes meta last for the same reason).
     codec selects the chunk compression (colio codec matrix); readers
-    dispatch per chunk, so mixed-codec backends are fine."""
+    dispatch per chunk, so mixed-codec backends are fine.
+
+    version: block encoding version to WRITE (default: the registry's
+    CURRENT_VERSION). "vtpu1" emits the JSON pack footer that pre-binary
+    readers parse; "vtpu2" the binary footer. The convert tool and
+    mixed-version tests are the down-level writers."""
+    from .versioned import CURRENT_VERSION
+
     m = fin.meta
+    m.version = version or CURRENT_VERSION
+    footer_kind = "json" if m.version == "vtpu1" else "binary"
     app = backend.open_append(m.tenant_id, m.block_id, DATA_NAME)
     try:
         # pipelined writer: append() blocks on disk writeback (the write
@@ -554,7 +576,9 @@ def write_block(backend: RawBackend, fin: FinalizedBlock, level: int = 3,
         wt.start()
         try:
             for part in pack_columns_stream(fin.cols, fin.axes, fin.col_axis,
-                                            level=level, codec=codec):
+                                            level=level, codec=codec,
+                                            level_for=_column_level,
+                                            footer=footer_kind):
                 if werr:
                     break
                 with cond:

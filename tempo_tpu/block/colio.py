@@ -111,6 +111,8 @@ def pack_columns_stream(
     col_axis: dict[str, str] | None = None,
     level: int = 3,
     codec: str = CODEC_ZSTD,
+    level_for=None,
+    footer: str = "binary",
 ):
     """Yield the serialized pack as byte parts, ONE COLUMN AT A TIME
     (chunks of a column compress as one threaded native batch, then the
@@ -125,12 +127,21 @@ def pack_columns_stream(
             f"unknown codec {codec!r} (matrix: "
             f"{[CODEC_RAW, CODEC_ZSTD, *sorted(_EXTRA_CODECS)]})"
         )
-    footer: dict = {"cols": {}, "axes": {k: v.offsets for k, v in axes.items()}}
+    footer_tbl: dict = {"cols": {}, "axes": {k: v.offsets for k, v in axes.items()}}
     offset = 0
 
     from ..native import zstd_compress_from
 
     for name, arr in cols.items():
+        # per-column level override (level_for(name) -> int | None): the
+        # write policy keeps fast-decode levels on the metadata axes a
+        # cold query must decompress (block/builder.FAST_DECODE_PREFIXES)
+        col_level = level
+        if level_for is not None and codec == CODEC_ZSTD:
+            # zstd only: the stdlib codec matrix rejects negative levels
+            ov = level_for(name)
+            if ov is not None:
+                col_level = ov
         # stride-0 first dim = a broadcast view (read_all broadcast_const
         # / the compaction merge's const fast path): constant by
         # construction, and materializing it here would defeat the point.
@@ -164,7 +175,7 @@ def pack_columns_stream(
                 recs.append([offset, len(row), raw_len, CODEC_CONST])
                 offset += len(row)
                 yield row
-            footer["cols"][name] = {
+            footer_tbl["cols"][name] = {
                 "dtype": str(arr.dtype),
                 "shape": list(arr.shape),
                 "axis": axis,
@@ -206,17 +217,17 @@ def pack_columns_stream(
                 buf,
                 np.asarray([bounds[i][0] for i in to_compress], np.int64),
                 np.asarray([bounds[i][1] - bounds[i][0] for i in to_compress], np.int64),
-                level,
+                col_level,
             )
             if outs is None:
-                comp = zstandard.ZstdCompressor(level=level)
+                comp = zstandard.ZstdCompressor(level=col_level)
                 outs = [comp.compress(buf[bounds[i][0] : bounds[i][1]].tobytes())
                         for i in to_compress]
             compressed = dict(zip(to_compress, outs))
         elif to_compress:
             cfun = _EXTRA_CODECS[codec][0]  # unknown codec fails loudly here
             compressed = {
-                i: cfun(buf[bounds[i][0] : bounds[i][1]].tobytes(), level)
+                i: cfun(buf[bounds[i][0] : bounds[i][1]].tobytes(), col_level)
                 for i in to_compress
             }
 
@@ -234,16 +245,174 @@ def pack_columns_stream(
             recs.append([offset, len(data), raw_len, chunk_codec])
             offset += len(data)
             yield data
-        footer["cols"][name] = {
+        footer_tbl["cols"][name] = {
             "dtype": str(arr.dtype),
             "shape": list(arr.shape),
             "axis": axis,
             "chunks": recs,
         }
 
-    fbytes = json.dumps(footer, separators=(",", ":")).encode("utf-8")
+    # footer="json" writes the vtpu1-era footer (block version
+    # compatibility: the convert tool and mixed-version tests produce
+    # genuinely old-format blocks); readers auto-detect either form
+    fbytes = (_encode_footer_binary(footer_tbl) if footer == "binary"
+              else json.dumps(footer_tbl, separators=(",", ":")).encode("utf-8"))
     yield fbytes
     yield _TAIL.pack(len(fbytes), MAGIC)
+
+
+# Binary footer ("\x00BF1" marker; JSON can never start with NUL): the
+# JSON footer cost ~0.8 ms to parse per cold block open -- a fixed tax
+# on every one-shot reader. Encoding: marker, then [axes] u32 count +
+# per axis (u16 name len, name utf8, u32 n_offsets, i64 offsets), then
+# [cols] u32 count + per column (u16 name len, name, u8 dtype len,
+# dtype str, u8 ndim, i64 dims, u8 axis len, axis, u32 n_chunks, chunks
+# as (n,3) i64 [off, stored, raw] + n bytes codec indexes into the u8
+# codec table emitted before [cols]). Readers accept both forms.
+_BF_MARKER = b"\x00BF1"
+
+
+def _encode_footer_binary(footer: dict) -> bytes:
+    out = bytearray(_BF_MARKER)
+
+    def put_str(s: str, wide: bool = False):
+        b = s.encode("utf-8")
+        out.extend(struct.pack("<H" if wide else "<B", len(b)))
+        out.extend(b)
+
+    axes = footer.get("axes", {})
+    out.extend(struct.pack("<I", len(axes)))
+    for name, offsets in axes.items():
+        put_str(name, wide=True)
+        arr = np.asarray(offsets, dtype=np.int64)
+        out.extend(struct.pack("<I", arr.shape[0]))
+        out.extend(arr.tobytes())
+    codecs = sorted({rec[3] for c in footer["cols"].values() for rec in c["chunks"]})
+    out.extend(struct.pack("<B", len(codecs)))
+    for c in codecs:
+        put_str(c)
+    cidx = {c: i for i, c in enumerate(codecs)}
+    cols = footer["cols"]
+    out.extend(struct.pack("<I", len(cols)))
+    for name, meta in cols.items():
+        put_str(name, wide=True)
+        body = bytearray()
+
+        def bput_str(s: str):
+            b = s.encode("utf-8")
+            body.extend(struct.pack("<B", len(b)))
+            body.extend(b)
+
+        bput_str(meta["dtype"])
+        shape = meta["shape"]
+        body.extend(struct.pack("<B", len(shape)))
+        body.extend(np.asarray(shape, dtype=np.int64).tobytes())
+        bput_str(meta["axis"] or "")
+        recs = meta["chunks"]
+        body.extend(struct.pack("<I", len(recs)))
+        tbl = np.asarray([[r[0], r[1], r[2]] for r in recs], dtype=np.int64)
+        body.extend(tbl.tobytes())
+        body.extend(bytes(cidx[r[3]] for r in recs))
+        # body-length prefix: a reader indexes all columns by skipping
+        # bodies in one hop each, decoding only the columns it touches
+        out.extend(struct.pack("<I", len(body)))
+        out.extend(body)
+    return bytes(out)
+
+
+class _LazyFooterCols(dict):
+    """Footer column table decoding each column's chunk records on first
+    access: a cold query touches ~a dozen of the pack's ~90 columns, so
+    eagerly building every chunk list cost more than the whole footer
+    read. Maps name -> meta dict; undecoded entries hold their body's
+    byte range in the footer buffer."""
+
+    def __init__(self, data: bytes, codecs: list[str], index: dict[str, tuple[int, int]]):
+        super().__init__()
+        self._data = data
+        self._codecs = codecs
+        self._index = index
+        for name in index:
+            dict.__setitem__(self, name, None)
+
+    def _decode(self, name: str) -> dict:
+        data, pos = self._data, self._index[name][0]
+        (dlen,) = struct.unpack_from("<B", data, pos)
+        pos += 1
+        dtype = data[pos : pos + dlen].decode("utf-8")
+        pos += dlen
+        (ndim,) = struct.unpack_from("<B", data, pos)
+        pos += 1
+        shape = np.frombuffer(data, dtype=np.int64, count=ndim, offset=pos).tolist()
+        pos += 8 * ndim
+        (alen,) = struct.unpack_from("<B", data, pos)
+        pos += 1
+        axis = data[pos : pos + alen].decode("utf-8") or None
+        pos += alen
+        (n_chunks,) = struct.unpack_from("<I", data, pos)
+        pos += 4
+        tbl = np.frombuffer(data, dtype=np.int64, count=3 * n_chunks, offset=pos)
+        pos += 24 * n_chunks
+        ci = data[pos : pos + n_chunks]
+        codecs = self._codecs
+        meta = {
+            "dtype": dtype,
+            "shape": shape,
+            "axis": axis,
+            "chunks": [[o, s, r, codecs[c]]
+                       for (o, s, r), c in zip(tbl.reshape(-1, 3).tolist(), ci)],
+        }
+        dict.__setitem__(self, name, meta)
+        return meta
+
+    def __getitem__(self, name: str) -> dict:
+        v = dict.__getitem__(self, name)
+        return self._decode(name) if v is None else v
+
+    def get(self, name, default=None):
+        return self[name] if name in self else default
+
+    def items(self):
+        return [(k, self[k]) for k in self.keys()]
+
+    def values(self):
+        return [self[k] for k in self.keys()]
+
+
+def _decode_footer_binary(data: bytes) -> dict:
+    pos = len(_BF_MARKER)
+
+    def get(fmt):
+        nonlocal pos
+        vals = struct.unpack_from(fmt, data, pos)
+        pos += struct.calcsize(fmt)
+        return vals
+
+    def get_str(wide: bool = False) -> str:
+        nonlocal pos
+        (ln,) = get("<H" if wide else "<B")
+        s = data[pos : pos + ln].decode("utf-8")
+        pos += ln
+        return s
+
+    axes = {}
+    (n_axes,) = get("<I")
+    for _ in range(n_axes):
+        name = get_str(wide=True)
+        (n_off,) = get("<I")
+        offs = np.frombuffer(data, dtype=np.int64, count=n_off, offset=pos)
+        pos += 8 * n_off
+        axes[name] = offs.tolist()
+    (n_codecs,) = get("<B")
+    codecs = [get_str() for _ in range(n_codecs)]
+    index: dict[str, tuple[int, int]] = {}
+    (n_cols,) = get("<I")
+    for _ in range(n_cols):
+        name = get_str(wide=True)
+        (blen,) = get("<I")
+        index[name] = (pos, blen)
+        pos += blen
+    return {"cols": _LazyFooterCols(data, codecs, index), "axes": axes}
 
 
 def pack_columns(
@@ -278,7 +447,8 @@ class ColumnPack:
         if magic != MAGIC:
             raise ValueError("not a vtpu column pack (bad magic)")
         fbytes = self._read_range(total_size - _TAIL.size - flen, flen)
-        footer = json.loads(fbytes)
+        footer = (_decode_footer_binary(fbytes)
+                  if fbytes[:4] == _BF_MARKER else json.loads(fbytes))
         self._cols: dict[str, dict] = footer["cols"]
         self.axes: dict[str, AxisChunks] = {
             k: AxisChunks(v) for k, v in footer.get("axes", {}).items()
@@ -590,6 +760,90 @@ class ColumnPack:
             self._count_read(sum(r[1] for r in miss))
             for r, raw in zip(miss, outs):
                 self._cache_put(r[0], raw)
+
+    def warm_columns(self, names: list[str], gap_bytes: int = 256 << 10) -> None:
+        """Cold-read accelerator: fetch EVERY missing chunk of the named
+        columns with a few coalesced ranged reads (runs split only at
+        gaps > gap_bytes, so interleaved unwanted columns aren't pulled
+        wholesale), decompress ALL of them with ONE threaded native
+        ranges call straight into one destination buffer, and cache the
+        assembled per-column arrays. A cold query touching 12 small
+        columns pays ~2 fixed IO costs instead of 12, with zero
+        intermediate bytes objects."""
+        from ..native import available, zstd_decompress_ranges
+
+        if not available():
+            return  # read()'s own per-column paths handle the fallback
+        wanted: list[tuple[str, dict, int]] = []  # (name, meta, dst start)
+        recs: list[tuple[list, int]] = []  # (chunk rec, dst_pos)
+        pos = 0
+        for name in dict.fromkeys(names):  # dedupe; call sites overlap
+            meta = self._cols.get(name)
+            if meta is None or self.has_cached_array(name):
+                continue
+            pos = (pos + 15) & ~15  # dtype-aligned column starts
+            wanted.append((name, meta, pos))
+            for r in meta["chunks"]:
+                if r[2] > 0:
+                    recs.append((r, pos))
+                    pos += r[2]
+        if len(recs) <= 1:
+            return
+        total_raw = pos
+        by_off = sorted(recs, key=lambda t: t[0][0])
+        # coalesce into gap-bounded file runs
+        runs: list[tuple[int, int, list]] = []  # (off, end, members)
+        for r, dpos in by_off:
+            if runs and r[0] - runs[-1][1] <= gap_bytes:
+                off, end, members = runs[-1]
+                runs[-1] = (off, max(end, r[0] + r[1]), members + [(r, dpos)])
+            else:
+                runs.append((r[0], r[0] + r[1], [(r, dpos)]))
+        src_parts: list[bytes] = []
+        src_pos: dict[int, int] = {}  # chunk file off -> offset in joined src
+        base = 0
+        counted = 0
+        for off, end, members in runs:
+            data = self._read_range(off, end - off)
+            src_parts.append(data)
+            counted += sum(m[0][1] for m in members)
+            for r, _ in members:
+                src_pos[r[0]] = base + (r[0] - off)
+            base += len(data)
+        self._count_read(counted)
+        src = (np.frombuffer(src_parts[0], np.uint8) if len(src_parts) == 1
+               else np.frombuffer(b"".join(src_parts), np.uint8))
+        dst = np.empty(total_raw, np.uint8)
+        zst = [(r, dpos) for r, dpos in recs if r[3] == CODEC_ZSTD]
+        if zst:
+            ok = zstd_decompress_ranges(
+                src,
+                np.asarray([src_pos[r[0]] for r, _ in zst], np.int64),
+                np.asarray([r[1] for r, _ in zst], np.int64),
+                dst,
+                np.asarray([d for _, d in zst], np.int64),
+                np.asarray([r[2] for r, _ in zst], np.int64),
+            )
+            if not ok:
+                return  # corrupt chunk: read()'s path reports it properly
+        for r, dpos in recs:
+            if r[3] == CODEC_ZSTD:
+                continue
+            chunk = src[src_pos[r[0]] : src_pos[r[0]] + r[1]]
+            if r[3] == CODEC_CONST:
+                dst[dpos : dpos + r[2]].reshape(-1, r[1])[:] = chunk
+            elif r[3] == CODEC_RAW:
+                dst[dpos : dpos + r[2]] = chunk
+            else:
+                dec = _EXTRA_CODECS[r[3]][1](chunk.tobytes(), r[2])
+                dst[dpos : dpos + r[2]] = np.frombuffer(dec, np.uint8)
+        # slice per-column views out of the shared buffer and cache them
+        for name, meta, start in wanted:
+            n_bytes = sum(r[2] for r in meta["chunks"] if r[2] > 0)
+            out = dst[start : start + n_bytes].view(np.dtype(meta["dtype"]))
+            out = out.reshape(meta["shape"])
+            out.flags.writeable = False
+            self._arrays_put(name, out)
 
     def column_stats(self) -> list[dict]:
         """Per-column layout summary (name, dtype, rows, chunks, stored/

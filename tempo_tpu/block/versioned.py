@@ -6,11 +6,15 @@ registry (FromVersion/OpenBlock) so new formats can ship while old
 blocks stay readable, and an unknown version fails loudly instead of
 misparsing bytes.
 
-Here `vtpu1` (block/{builder,reader,colio}) is the current format.
-Introducing `vtpu2` means registering a second opener -- nothing above
-this seam (TempoDB, search, compaction inputs) names a concrete reader
-class. Compaction OUTPUT always writes the latest version, which is how
-old formats age out of a backend, same as the reference's compactors.
+Two real versions coexist: `vtpu1` (JSON pack footer) and the current
+`vtpu2` (binary lazy-decode footer; colio._BF_MARKER). The column/chunk
+layout is shared, so one reader class serves both -- but the VERSION
+field is the compatibility contract: a vtpu1-only reader must reject a
+vtpu2 block through UnknownVersion, never hit the NUL-prefixed footer
+and die in a JSON parser. Compaction OUTPUT always writes the latest
+version, which is how old formats age out of a backend, same as the
+reference's compactors; `tempo-cli convert-block` rewrites one block
+across versions (reference: cmd/tempo-cli/cmd-convert-block.go).
 """
 
 from __future__ import annotations
@@ -18,7 +22,7 @@ from __future__ import annotations
 from ..backend.base import RawBackend
 from .meta import BlockMeta
 
-CURRENT_VERSION = "vtpu1"
+CURRENT_VERSION = "vtpu2"
 
 
 class UnknownVersion(Exception):
@@ -56,4 +60,14 @@ def _open_vtpu1(backend: RawBackend, meta: BlockMeta):
     return BackendBlock(backend, meta)
 
 
+def _open_vtpu2(backend: RawBackend, meta: BlockMeta):
+    # same reader: ColumnPack dispatches on the footer marker; the
+    # version field exists so DOWN-LEVEL readers reject these blocks
+    # loudly instead of misparsing the binary footer
+    from .reader import BackendBlock
+
+    return BackendBlock(backend, meta)
+
+
 register_encoding("vtpu1", _open_vtpu1)
+register_encoding("vtpu2", _open_vtpu2)

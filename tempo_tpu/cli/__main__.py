@@ -162,6 +162,34 @@ def cmd_rewrite_block(args):
           f"old block marked compacted")
 
 
+def cmd_convert_block(args):
+    """Rewrite one block at a TARGET encoding version (reference:
+    cmd/tempo-cli/cmd-convert-block.go): open through the versioned
+    seam, re-encode, write at --to. Used for forward-migrating vtpu1
+    blocks (or producing vtpu1 blocks for a down-level fleet)."""
+    from ..block.builder import BlockBuilder, write_block
+    from ..block.versioned import supported_versions
+
+    if args.to not in supported_versions():
+        raise SystemExit(
+            f"unknown target version {args.to!r} (supported: {supported_versions()})")
+    db = _open_db(args.backend)
+    meta = _require_block(db, args.tenant, args.block_id)
+    blk = db.open_block(meta)
+    n = meta.total_traces
+    ids = blk.trace_index["trace.id"]
+    b = BlockBuilder(args.tenant, compaction_level=meta.compaction_level)
+    for lo in range(0, n, 1024):
+        sids = list(range(lo, min(lo + 1024, n)))
+        for s, t in zip(sids, blk.materialize_traces(sids)):
+            b.add_trace(ids[s].tobytes(), t)
+    new = write_block(db.backend, b.finalize(), version=args.to)
+    db.backend.mark_compacted(args.tenant, args.block_id)
+    db.close()
+    print(f"converted {args.block_id} ({meta.version}) -> {new.block_id} "
+          f"({new.version}, {new.total_traces} traces); old block marked compacted")
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(prog="tempo-tpu-cli")
     ap.add_argument("--backend.path", dest="backend", default="./tempo-data")
@@ -211,6 +239,13 @@ def main(argv=None):
     p.add_argument("block_id")
     p.add_argument("--codec", default="zstd")
     p.set_defaults(fn=cmd_rewrite_block)
+
+    p = sub.add_parser("convert-block",
+                       help="rewrite a block at a target encoding version")
+    p.add_argument("tenant")
+    p.add_argument("block_id")
+    p.add_argument("--to", default="vtpu2")
+    p.set_defaults(fn=cmd_convert_block)
 
     args = ap.parse_args(argv)
     try:

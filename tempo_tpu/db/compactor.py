@@ -178,7 +178,7 @@ def compact(backend: RawBackend, job: CompactionJob, cfg: CompactorConfig) -> Co
     if (cfg.concat_small_input_bytes
             and len(job.blocks) >= 2
             and all(m.compaction_level == 0
-                    and m.version == "vtpu1"
+                    and m.version in ("vtpu1", "vtpu2")
                     and 0 < m.size_bytes <= cfg.concat_small_input_bytes
                     for m in job.blocks)):
         from .concat_compact import compact_concat

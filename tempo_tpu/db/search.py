@@ -373,12 +373,14 @@ def _host_plan(blk: BackendBlock, p, groups_range) -> tuple[list[str], bool]:
     return host_needed, False
 
 
-def _host_eval(blk: BackendBlock, p, operands, groups_range):
+def _host_eval(blk: BackendBlock, p, operands, groups_range, plan=None):
     """Run the host engine under the chosen axis: returns
     (trace_mask, counts, cols_read). Covered spans are the caller's to
     report: tres mode still inspects every span's data (via its
-    membership summary), so inspected_spans stays the span-axis count."""
-    host_needed, tres = _host_plan(blk, p, groups_range)
+    membership summary), so inspected_spans stays the span-axis count.
+    plan: a precomputed _host_plan result (callers that already built it
+    for warm_columns pass it through)."""
+    host_needed, tres = plan if plan is not None else _host_plan(blk, p, groups_range)
     cols = _host_cols(blk, host_needed, groups_range)
     if tres:
         # evaluate the same condition tree over the tres axis: entries
@@ -539,7 +541,12 @@ def search_block(
     else:
         # span_off carries the span->trace grouping: the full-length
         # trace_sid column never needs to leave disk on the host path
-        tm, counts, _ = _host_eval(blk, planned, operands, groups_range)
+        plan = None
+        if groups_range is None:
+            plan = _host_plan(blk, planned, None)
+            blk.pack.warm_columns(
+                plan[0] + list(blk.SEARCH_TRACE_COLS) + ["trace.start_ms"])
+        tm, counts, _ = _host_eval(blk, planned, operands, groups_range, plan=plan)
         n_spans_seen = n_rows
         key = _start_key_host(blk)
 
@@ -670,11 +677,19 @@ def search_blocks_fused(
         # inflate the rate EMA and mislead the engine choice for
         # genuinely cold blocks (and the shared bytes_read counter can't
         # distinguish this thread's IO from concurrent readers')
-        host_needed, _ = _host_plan(blk, p, None)
+        plan = _host_plan(blk, p, None)
+        host_needed = plan[0]
         cold = not all(blk.pack.has_cached_array(n)
                        for n in host_needed if blk.pack.has(n))
         t0 = _time.perf_counter()
-        tm, counts, cols = _host_eval(blk, p, operands, None)
+        if cold:
+            # one coalesced ranged read + one threaded decompress batch
+            # for EVERYTHING this query touches (eval columns + the
+            # candidate/result trace columns): a cold scan's cost is
+            # per-column fixed overheads, not bytes
+            blk.pack.warm_columns(
+                host_needed + list(blk.SEARCH_TRACE_COLS) + ["trace.start_ms"])
+        tm, counts, cols = _host_eval(blk, p, operands, None, plan=plan)
         if cold:
             _note_host_rate(sum(a.nbytes for a in cols.values()),
                             _time.perf_counter() - t0)
