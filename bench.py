@@ -41,6 +41,16 @@ import numpy as np
 
 BASELINE_SPANS_PER_SEC = 10.4e6 / 0.18  # reference vParquet search, IO incl.
 
+# peak HBM bandwidth per chip, for the kernel roofline line
+# (vs_baseline = fraction of peak). v5e: 819 GB/s; axon is the tunneled
+# TPU platform this box exposes. Unknown platforms (cpu) report 0.
+# NOTE: the fraction CAN exceed 1.0 -- the bytes model counts every
+# input column per iteration, but across a batch of back-to-back
+# queries XLA keeps hot columns resident on-chip (VMEM), so the kernel
+# reads HBM less than once per query. >1.0 therefore means "serving
+# from on-chip memory", not a measurement error.
+_HBM_PEAK_BPS = {"tpu": 819e9, "axon": 819e9}
+
 
 def best_window(fn, windows: int = 3):
     """Best (minimum) wall time of `windows` runs of fn() -- timeit's
@@ -266,6 +276,15 @@ def bench_kernel() -> None:
     sps = N_SPANS * iters / dt
     _emit("traceql_filter_kernel_spans_per_sec_per_chip", sps, "spans/s",
           sps / BASELINE_SPANS_PER_SEC)
+    # roofline accounting: unique input column bytes the query touches
+    # per iteration / kernel time, as a fraction of the chip's peak HBM
+    # bandwidth -- says whether the kernel is near the memory roofline
+    # or leaving headroom (the spans/s line alone has no denominator)
+    bytes_touched = sum(v.nbytes for v in cols.values())
+    bps = bytes_touched * iters / dt
+    peak = _HBM_PEAK_BPS.get(jax.devices()[0].platform, 0.0)
+    _emit("traceql_filter_kernel_bytes_per_sec", bps, "B/s",
+          bps / peak if peak else 0.0)
 
 
 def bench_find_and_search(tmp: str) -> tuple[float, float]:

@@ -178,12 +178,85 @@ class RedisCache:
             pass
 
 
+class BackgroundWriteCache:
+    """Write-behind wrapper (reference: pkg/cache/background.go:22-80):
+    set() enqueues onto a byte-bounded queue drained by background
+    writer threads, so a slow or stalled cache tier can never block the
+    read path that populates it. When the queue is full the write is
+    DROPPED (counted), exactly like the reference -- cache writes are
+    best-effort by definition."""
+
+    def __init__(self, inner, max_queued_bytes: int = 16 << 20, writers: int = 2):
+        import queue
+
+        self.inner = inner
+        self.max_queued_bytes = max_queued_bytes
+        self._q: queue.Queue = queue.Queue()
+        self._queued_bytes = 0
+        self._lock = threading.Lock()
+        self.dropped = 0
+        self._threads = [
+            threading.Thread(target=self._drain, name=f"cache-writeback-{i}",
+                             daemon=True)
+            for i in range(writers)
+        ]
+        for t in self._threads:
+            t.start()
+
+    def _drain(self) -> None:
+        while True:
+            item = self._q.get()
+            try:
+                if item is None:
+                    return
+                key, value = item
+                with self._lock:
+                    self._queued_bytes -= len(value)
+                try:
+                    self.inner.set(key, value)
+                except Exception:
+                    pass  # cache writes are best-effort
+            finally:
+                self._q.task_done()
+
+    def flush(self) -> None:
+        """Block until every queued write has been attempted (tests /
+        orderly shutdown)."""
+        self._q.join()
+
+    def get(self, key: str) -> bytes | None:
+        return self.inner.get(key)
+
+    def set(self, key: str, value: bytes) -> None:
+        with self._lock:
+            if self._queued_bytes + len(value) > self.max_queued_bytes:
+                self.dropped += 1
+                return
+            self._queued_bytes += len(value)
+        self._q.put((key, value))
+
+    def stop(self) -> None:
+        for _ in self._threads:
+            self._q.put(None)
+        for t in self._threads:
+            t.join(timeout=2.0)
+
+
 def open_external_cache(cfg: dict):
     """Config -> client: {"kind": "memcached", "addrs": [...]} or
-    {"kind": "redis", "addr": "host:port"}."""
+    {"kind": "redis", "addr": "host:port"}. Writes go through the
+    write-behind queue unless "background": false."""
     kind = cfg.get("kind", "")
     if kind == "memcached":
-        return MemcachedCache(cfg["addrs"], ttl_s=int(cfg.get("ttl_s", 3600)))
-    if kind == "redis":
-        return RedisCache(cfg["addr"], ttl_s=int(cfg.get("ttl_s", 3600)))
-    raise ValueError(f"unknown external cache kind {kind!r}")
+        client = MemcachedCache(cfg["addrs"], ttl_s=int(cfg.get("ttl_s", 3600)))
+    elif kind == "redis":
+        client = RedisCache(cfg["addr"], ttl_s=int(cfg.get("ttl_s", 3600)))
+    else:
+        raise ValueError(f"unknown external cache kind {kind!r}")
+    if cfg.get("background", True):
+        return BackgroundWriteCache(
+            client,
+            max_queued_bytes=int(cfg.get("background_queue_bytes", 16 << 20)),
+            writers=int(cfg.get("background_writers", 2)),
+        )
+    return client

@@ -790,6 +790,18 @@ class ColumnPack:
         if len(recs) <= 1:
             return
         total_raw = pos
+        # chunks already decoded in the chunk cache (e.g. a prior
+        # find-by-id's read_groups) copy straight into dst: no refetch,
+        # no re-decompress
+        cached: list[tuple[bytes, int, int]] = []  # (raw, dst_pos, raw_len)
+        fetch: list[tuple[list, int]] = []
+        for r, dpos in recs:
+            hit = self._cache_get(r[0])
+            if hit is not None:
+                cached.append((hit, dpos, r[2]))
+            else:
+                fetch.append((r, dpos))
+        recs = fetch
         by_off = sorted(recs, key=lambda t: t[0][0])
         # coalesce into gap-bounded file runs
         runs: list[tuple[int, int, list]] = []  # (off, end, members)
@@ -812,8 +824,11 @@ class ColumnPack:
             base += len(data)
         self._count_read(counted)
         src = (np.frombuffer(src_parts[0], np.uint8) if len(src_parts) == 1
-               else np.frombuffer(b"".join(src_parts), np.uint8))
+               else np.frombuffer(b"".join(src_parts), np.uint8)
+               ) if src_parts else np.empty(0, np.uint8)
         dst = np.empty(total_raw, np.uint8)
+        for raw, dpos, raw_len in cached:
+            dst[dpos : dpos + raw_len] = np.frombuffer(raw, np.uint8)
         zst = [(r, dpos) for r, dpos in recs if r[3] == CODEC_ZSTD]
         if zst:
             ok = zstd_decompress_ranges(
@@ -837,10 +852,13 @@ class ColumnPack:
             else:
                 dec = _EXTRA_CODECS[r[3]][1](chunk.tobytes(), r[2])
                 dst[dpos : dpos + r[2]] = np.frombuffer(dec, np.uint8)
-        # slice per-column views out of the shared buffer and cache them
+        # COPY each column out of the shared buffer: cached views over
+        # one big base would pin the whole buffer for as long as any one
+        # entry lives, making LRU eviction free nothing (the copy is a
+        # fraction of the decompress cost just paid)
         for name, meta, start in wanted:
             n_bytes = sum(r[2] for r in meta["chunks"] if r[2] > 0)
-            out = dst[start : start + n_bytes].view(np.dtype(meta["dtype"]))
+            out = dst[start : start + n_bytes].copy().view(np.dtype(meta["dtype"]))
             out = out.reshape(meta["shape"])
             out.flags.writeable = False
             self._arrays_put(name, out)

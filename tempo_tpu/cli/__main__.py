@@ -136,12 +136,12 @@ def cmd_dump_columns(args):
     db.close()
 
 
-def cmd_rewrite_block(args):
-    """Rewrite a block at the CURRENT encoding version/codec (reference:
-    tempo-cli's convert/migrate role). Writes the new block fully, then
-    marks the old one compacted; between the two writes pollers may
-    briefly see both (the same transient-duplicate window normal
-    compaction has -- result dedupe covers it)."""
+def _rewrite_block(args, **write_kwargs):
+    """Shared rewrite loop: materialize in bounded batches, re-encode,
+    write with the given write_block kwargs, mark the old block
+    compacted. Writes the new block fully first; between the two writes
+    pollers may briefly see both (the same transient-duplicate window
+    normal compaction has -- result dedupe covers it)."""
     from ..block.builder import BlockBuilder, write_block
 
     db = _open_db(args.backend)
@@ -154,9 +154,16 @@ def cmd_rewrite_block(args):
         sids = list(range(lo, min(lo + 1024, n)))
         for s, t in zip(sids, blk.materialize_traces(sids)):
             b.add_trace(ids[s].tobytes(), t)
-    new = write_block(db.backend, b.finalize(), codec=args.codec)
+    new = write_block(db.backend, b.finalize(), **write_kwargs)
     db.backend.mark_compacted(args.tenant, args.block_id)
     db.close()
+    return meta, new
+
+
+def cmd_rewrite_block(args):
+    """Rewrite a block at the CURRENT encoding version/codec (reference:
+    tempo-cli's convert/migrate role)."""
+    _, new = _rewrite_block(args, codec=args.codec)
     print(f"rewrote {args.block_id} -> {new.block_id} "
           f"(codec={args.codec}, {new.total_traces} traces); "
           f"old block marked compacted")
@@ -167,25 +174,12 @@ def cmd_convert_block(args):
     cmd/tempo-cli/cmd-convert-block.go): open through the versioned
     seam, re-encode, write at --to. Used for forward-migrating vtpu1
     blocks (or producing vtpu1 blocks for a down-level fleet)."""
-    from ..block.builder import BlockBuilder, write_block
     from ..block.versioned import supported_versions
 
     if args.to not in supported_versions():
         raise SystemExit(
             f"unknown target version {args.to!r} (supported: {supported_versions()})")
-    db = _open_db(args.backend)
-    meta = _require_block(db, args.tenant, args.block_id)
-    blk = db.open_block(meta)
-    n = meta.total_traces
-    ids = blk.trace_index["trace.id"]
-    b = BlockBuilder(args.tenant, compaction_level=meta.compaction_level)
-    for lo in range(0, n, 1024):
-        sids = list(range(lo, min(lo + 1024, n)))
-        for s, t in zip(sids, blk.materialize_traces(sids)):
-            b.add_trace(ids[s].tobytes(), t)
-    new = write_block(db.backend, b.finalize(), version=args.to)
-    db.backend.mark_compacted(args.tenant, args.block_id)
-    db.close()
+    meta, new = _rewrite_block(args, version=args.to)
     print(f"converted {args.block_id} ({meta.version}) -> {new.block_id} "
           f"({new.version}, {new.total_traces} traces); old block marked compacted")
 

@@ -1,8 +1,14 @@
 """Write-ahead log: row-based append blocks + replay.
 
 The WAL is the framework's checkpoint (SURVEY.md 5.4): every accepted
-push is appended before it is acknowledged; on restart, RescanBlocks
-replays the files back into in-progress head blocks. Like the reference
+push is appended and flushed to the OS before it is acknowledged
+(survives a process crash); fsync to stable media runs on a BOUNDED
+interval (fsync_interval_s, default 0.25 s, 0 = every flush), so a
+HOST crash can lose pushes acked inside that window -- RF-way
+replication covers that gap, and RF=1 deployments can set the interval
+to 0 through IngesterConfig.wal_fsync_interval_s. On restart,
+RescanBlocks replays the files back into in-progress head blocks.
+Like the reference
 -- whose WAL stays row-based v2 even when complete blocks are parquet
 (tempodb/wal/wal.go:91-92) -- the WAL is row-oriented for append speed
 while complete blocks are columnar.
@@ -165,12 +171,13 @@ class WAL:
     """Directory manager + block factory + replay scan
     (reference: tempodb/wal/wal.go:39-142)."""
 
-    def __init__(self, dirpath: str):
+    def __init__(self, dirpath: str, fsync_interval_s: float = 0.25):
         self.dir = dirpath
+        self.fsync_interval_s = fsync_interval_s
         os.makedirs(dirpath, exist_ok=True)
 
     def new_block(self, tenant: str) -> WALBlock:
-        return WALBlock(self.dir, tenant)
+        return WALBlock(self.dir, tenant, fsync_interval_s=self.fsync_interval_s)
 
     def rescan_blocks(self) -> list[ReplayedBlock]:
         out: list[ReplayedBlock] = []

@@ -134,6 +134,14 @@ class Ring:
             # timeout marks it out. EventuallyConsistentStrategy
             # (pkg/ring/ring.go:52-86) instead needs minSuccess=1 on
             # read and write -- NOT strongly consistent, eventually so.
+            # READ-SIDE STALENESS: a minSuccess=1 write may have landed
+            # on only one replica; readers (querier.find_trace_by_id)
+            # best-effort fan out to EVERY ingester and merge partials,
+            # but if the one replica holding the write errors while the
+            # one that missed it answers, the trace is transiently
+            # not-found until the flush or the retry hits the holder --
+            # the same window the reference's eventually-consistent
+            # strategy accepts.
             return ReplicationSet(out, max_errors=max(0, len(out) - 1))
         return ReplicationSet(out, max_errors=max(0, (len(out) - 1) // 2))
 

@@ -80,6 +80,9 @@ class AppConfig:
     # OpenCensus gRPC receiver port (reference shim.go:98; OC agent
     # convention 55678); 0 = disabled, -1 = ephemeral (tests)
     opencensus_grpc_port: int = 0
+    # Jaeger gRPC collector port (reference shim.go:95-101; jaeger
+    # collector convention 14250); 0 = disabled, -1 = ephemeral (tests)
+    jaeger_grpc_port: int = 0
     # Kafka receiver (reference shim.go:100): host:port of a broker, ""
     # = disabled; messages are OTLP-proto ExportTraceServiceRequest
     kafka_brokers: str = ""
@@ -167,7 +170,9 @@ class App:
 
         self.ingester = self.lifecycler = None
         if has("ingester"):
-            self.ingester = Ingester(WAL(wal_path), self.db, self.overrides, cfg.ingester)
+            self.ingester = Ingester(
+                WAL(wal_path, fsync_interval_s=cfg.ingester.wal_fsync_interval_s),
+                self.db, self.overrides, cfg.ingester)
             self.ingester.replay_wal()
             if default_wal_layout:
                 # only the per-instance layout has meaningful siblings; an
@@ -259,6 +264,7 @@ class App:
         self._started = False
         self.otlp_grpc = None
         self.opencensus = None
+        self.jaeger_grpc = None
         self.kafka = None
         self.remote_writer = None
         self.http_server: ThreadingHTTPServer | None = None
@@ -301,6 +307,13 @@ class App:
             port = max(0, self.cfg.opencensus_grpc_port)  # -1 -> ephemeral
             self.cfg.opencensus_grpc_port = self.opencensus.start(
                 port, host=self._bind_host())
+        if self.distributor is not None and self.cfg.jaeger_grpc_port != 0:
+            from .jaeger_grpc import JaegerGrpcReceiver
+
+            self.jaeger_grpc = JaegerGrpcReceiver(self)
+            port = max(0, self.cfg.jaeger_grpc_port)  # -1 -> ephemeral
+            self.cfg.jaeger_grpc_port = self.jaeger_grpc.start(
+                port, host=self._bind_host())
         if self.distributor is not None and self.cfg.kafka_brokers:
             from .kafka_receiver import DEFAULT_TOPIC, KafkaReceiver
 
@@ -330,6 +343,8 @@ class App:
             self.otlp_grpc.stop()
         if self.opencensus is not None:
             self.opencensus.stop()
+        if self.jaeger_grpc is not None:
+            self.jaeger_grpc.stop()
         if self.kafka is not None:
             self.kafka.stop()
         if self.querier_worker:
@@ -865,6 +880,9 @@ def main(argv=None):
     ap.add_argument("--distributor.opencensus-grpc-port", dest="opencensus_grpc_port",
                     type=int, default=None,
                     help="OpenCensus gRPC receiver port (0=off, -1=ephemeral)")
+    ap.add_argument("--distributor.jaeger-grpc-port", dest="jaeger_grpc_port",
+                    type=int, default=None,
+                    help="Jaeger gRPC collector port (0=off, -1=ephemeral)")
     ap.add_argument("--querier.search-external-endpoints", dest="search_external",
                     default=None,
                     help="comma-separated serverless search handler URLs")
@@ -892,6 +910,7 @@ def main(argv=None):
         "frontend_addr": args.frontend_addr,
         "otlp_grpc_port": args.otlp_grpc_port,
         "opencensus_grpc_port": args.opencensus_grpc_port,
+        "jaeger_grpc_port": args.jaeger_grpc_port,
         "search_external_endpoints": args.search_external,
         "kafka_brokers": args.kafka_brokers,
         "kafka_topic": args.kafka_topic,

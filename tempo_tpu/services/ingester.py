@@ -104,6 +104,11 @@ class IngesterConfig:
     max_block_age_s: float = 120.0
     max_block_bytes: int = 64 * 1024 * 1024
     flush_check_period_s: float = 2.0
+    # WAL fsync cadence: acked pushes are flushed to the OS immediately
+    # and fsynced at most this often (bounded host-crash loss window,
+    # covered by RF-way replication). RF=1 deployments set 0 to fsync
+    # every flush.
+    wal_fsync_interval_s: float = 0.25
 
 
 class Instance:

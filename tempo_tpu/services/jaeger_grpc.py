@@ -1,0 +1,80 @@
+"""Jaeger gRPC collector ingest: jaeger.api_v2.CollectorService/PostSpans.
+
+Reference: the receiver shim registers the full Jaeger factory
+(modules/distributor/receiver/shim.go:95-101), whose primary transport
+is the gRPC collector endpoint (:14250) that jaeger agents and clients
+push model.proto Batches to. Same generic-handler pattern as the OTLP
+receiver (services/otlp_grpc.py): no generated stubs, the hand-rolled
+api_v2 codec (wire/jaeger_pb.decode_post_spans) feeds the distributor's
+model push path; PostSpansResponse serializes to b"".
+"""
+
+from __future__ import annotations
+
+from concurrent import futures
+
+_SERVICE = "jaeger.api_v2.CollectorService"
+_METHOD = "PostSpans"
+
+
+class JaegerGrpcReceiver:
+    def __init__(self, app, max_workers: int = 8):
+        self.app = app
+        self._max_workers = max_workers
+        self._server = None
+        self.port = 0
+        self.requests = 0
+        self.failures = 0
+
+    def start(self, port: int = 14250, host: str = "127.0.0.1") -> int:
+        import grpc
+
+        from ..wire.jaeger_pb import decode_post_spans
+        from .otlp_grpc import push_grpc_code
+
+        app = self.app
+        recv = self
+
+        def post_spans(request: bytes, context) -> bytes:
+            recv.requests += 1
+            # decode OUTSIDE the push try-block: context.abort raises to
+            # unwind, and a surrounding except would re-abort as INTERNAL
+            try:
+                batches = decode_post_spans(request)
+            except ValueError as e:  # malformed proto: fatal, not retryable
+                recv.failures += 1
+                context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                              f"bad PostSpansRequest: {e}")
+            try:
+                md = {k.lower(): v for k, v in (context.invocation_metadata() or [])}
+                tenant = app.tenant_of({"X-Scope-OrgID": md.get("x-scope-orgid", "")})
+                if batches:
+                    app.distributor.push(tenant, batches)
+                return b""
+            except Exception as e:
+                recv.failures += 1
+                context.abort(push_grpc_code(e, grpc), f"{type(e).__name__}: {e}")
+
+        handler = grpc.method_handlers_generic_handler(
+            _SERVICE,
+            {
+                _METHOD: grpc.unary_unary_rpc_method_handler(
+                    post_spans,
+                    request_deserializer=None,  # raw bytes in
+                    response_serializer=None,  # raw bytes out
+                )
+            },
+        )
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=self._max_workers,
+                                       thread_name_prefix="jaeger-grpc"),
+        )
+        self._server.add_generic_rpc_handlers((handler,))
+        self.port = self._server.add_insecure_port(f"{host}:{port}")
+        self._server.start()
+        return self.port
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.stop(grace=1.0)
+            self._server = None

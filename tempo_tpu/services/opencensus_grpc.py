@@ -78,6 +78,13 @@ class OpenCensusReceiver:
                     recv.failures += 1
                     from .otlp_grpc import push_grpc_code
 
+                    # AT-LEAST-ONCE on errors: aborting mid-stream (incl.
+                    # transient 429s) makes the agent reconnect and resend
+                    # the whole stream, re-ingesting batches acked before
+                    # the error; duplicates collapse at query-time span
+                    # merge. The alternative -- ack-and-drop -- would lose
+                    # spans silently with no backpressure signal, since
+                    # the OC export stream has no per-message status.
                     context.abort(push_grpc_code(e, grpc),
                                   f"{type(e).__name__}: {e}")
                     return

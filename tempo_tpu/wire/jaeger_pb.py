@@ -189,3 +189,177 @@ def decode_get_operations_request(data: bytes) -> str:
         if field_no == 1 and wt == 2:
             return bytes(val).decode()
     return ""
+
+
+# ------------------------------------------------- collector ingest side
+# jaeger.api_v2.CollectorService/PostSpans: the Jaeger agent/client's
+# primary gRPC transport (reference: the receiver shim's jaeger factory,
+# modules/distributor/receiver/shim.go). Field numbers from
+# jaeger model/proto/model.proto: Batch{1 spans, 2 process},
+# PostSpansRequest{1 batch}; KeyValue v_type 0 str / 1 bool / 2 int64 /
+# 3 float64 / 4 binary (NOTE: a different enum order than thrift).
+
+
+def _decode_kv(data: bytes) -> tuple[str, object]:
+    key, vtype = "", 0
+    v_str, v_bool, v_int, v_float, v_bin = "", False, 0, 0.0, b""
+    for f, wt, v in w.iter_fields(data):
+        if f == 1 and wt == 2:
+            key = v.decode("utf-8", "replace")
+        elif f == 2 and wt == 0:
+            vtype = int(v)
+        elif f == 3 and wt == 2:
+            v_str = v.decode("utf-8", "replace")
+        elif f == 4 and wt == 0:
+            v_bool = bool(v)
+        elif f == 5 and wt == 0:
+            v_int = w.to_signed64(int(v))
+        elif f == 6 and wt == 1:
+            v_float = w.fixed64_to_double(int(v))
+        elif f == 7 and wt == 2:
+            v_bin = bytes(v)
+    if vtype == _VT_BOOL:
+        return key, v_bool
+    if vtype == _VT_INT64:
+        return key, v_int
+    if vtype == _VT_FLOAT64:
+        return key, v_float
+    if vtype == _VT_BINARY:
+        return key, v_bin.hex()  # hex like the reference's translator
+    return key, v_str
+
+
+def _decode_kvs(items: list[bytes]) -> dict:
+    attrs = {}
+    for data in items:
+        k, v = _decode_kv(data)
+        if k:
+            attrs[k] = v
+    return attrs
+
+
+def _decode_process(data: bytes) -> dict:
+    service, tags = "", []
+    for f, wt, v in w.iter_fields(data):
+        if f == 1 and wt == 2:
+            service = v.decode("utf-8", "replace")
+        elif f == 2 and wt == 2:
+            tags.append(v)
+    attrs = _decode_kvs(tags)
+    attrs["service.name"] = service
+    return attrs
+
+
+def decode_post_spans(data: bytes) -> list:
+    """PostSpansRequest bytes -> list[ResourceSpans] (one per distinct
+    process: batch-level by default, span-level process overrides get
+    their own resource, mirroring the OTel jaeger translator)."""
+    from .model import Event, Link, Resource, ResourceSpans, Scope, ScopeSpans
+    from .model import Span as MSpan
+    from .model import SpanKind, StatusCode
+
+    batch = None
+    for f, wt, v in w.iter_fields(data):
+        if f == 1 and wt == 2:
+            batch = v
+    if batch is None:
+        return []
+    span_msgs: list[bytes] = []
+    batch_proc: dict = {"service.name": ""}
+    for f, wt, v in w.iter_fields(batch):
+        if f == 1 and wt == 2:
+            span_msgs.append(v)
+        elif f == 2 and wt == 2:
+            batch_proc = _decode_process(v)
+
+    _KIND_MAP = {
+        "client": SpanKind.CLIENT, "server": SpanKind.SERVER,
+        "producer": SpanKind.PRODUCER, "consumer": SpanKind.CONSUMER,
+        "internal": SpanKind.INTERNAL,
+    }
+
+    by_proc: dict[tuple, list] = {}
+    proc_attrs: dict[tuple, dict] = {}
+    for msg in span_msgs:
+        tid = b"\x00" * 16
+        sid = b"\x00" * 8
+        name = ""
+        refs: list[bytes] = []
+        start_ns = dur_ns = 0
+        tags: list[bytes] = []
+        logs: list[bytes] = []
+        own_proc = None
+        for f, wt, v in w.iter_fields(msg):
+            if f == 1 and wt == 2:
+                tid = bytes(v).rjust(16, b"\x00")[:16]
+            elif f == 2 and wt == 2:
+                sid = bytes(v).rjust(8, b"\x00")[:8]
+            elif f == 3 and wt == 2:
+                name = v.decode("utf-8", "replace")
+            elif f == 4 and wt == 2:
+                refs.append(v)
+            elif f == 6 and wt == 2:
+                start_ns = _decode_ts(v)
+            elif f == 7 and wt == 2:
+                dur_ns = _decode_ts(v)
+            elif f == 8 and wt == 2:
+                tags.append(v)
+            elif f == 9 and wt == 2:
+                logs.append(v)
+            elif f == 10 and wt == 2:
+                own_proc = _decode_process(v)
+        parent = b""
+        links: list = []
+        for rdata in refs:
+            r_tid, r_sid, r_type = b"", b"", 0
+            for f, wt, v in w.iter_fields(rdata):
+                if f == 1 and wt == 2:
+                    r_tid = bytes(v).rjust(16, b"\x00")[:16]
+                elif f == 2 and wt == 2:
+                    r_sid = bytes(v).rjust(8, b"\x00")[:8]
+                elif f == 3 and wt == 0:
+                    r_type = int(v)
+            if r_type == 0 and not parent:  # CHILD_OF -> parent
+                parent = r_sid
+            elif r_type != 0:  # FOLLOWS_FROM -> link (otel mapping)
+                links.append(Link(trace_id=r_tid, span_id=r_sid,
+                                  attrs={"jaeger.ref_type": "follows_from"}))
+        events = []
+        for ldata in logs:
+            l_ts, l_fields = 0, []
+            for f, wt, v in w.iter_fields(ldata):
+                if f == 1 and wt == 2:
+                    l_ts = _decode_ts(v)
+                elif f == 2 and wt == 2:
+                    l_fields.append(v)
+            events.append(Event(time_unix_nano=l_ts, name="log",
+                                attrs=_decode_kvs(l_fields)))
+        attrs = _decode_kvs(tags)
+        kind = _KIND_MAP.get(str(attrs.pop("span.kind", "")).lower(),
+                             SpanKind.INTERNAL)
+        status = StatusCode.UNSET
+        if str(attrs.get("error", "")).lower() in ("true", "1"):
+            status = StatusCode.ERROR
+        proc = own_proc if own_proc is not None else batch_proc
+        pkey = tuple(sorted((k, str(v)) for k, v in proc.items()))
+        proc_attrs[pkey] = proc
+        by_proc.setdefault(pkey, []).append(MSpan(
+            trace_id=tid,
+            span_id=sid,
+            parent_span_id=parent,
+            name=name,
+            kind=kind,
+            start_unix_nano=start_ns,
+            end_unix_nano=start_ns + dur_ns,
+            status_code=status,
+            attrs=attrs,
+            events=events,
+            links=links,
+        ))
+    return [
+        ResourceSpans(
+            resource=Resource(attrs=proc_attrs[k]),
+            scope_spans=[ScopeSpans(scope=Scope(name="jaeger"), spans=spans)],
+        )
+        for k, spans in by_proc.items()
+    ]

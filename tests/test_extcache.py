@@ -130,6 +130,7 @@ def test_tiered_cached_backend(memcached):
 
     c1 = CachedBackend(store, external=ext)
     assert c1.read("t", "b", "bloom-0") == b"BLOOM"  # miss -> store, fills both
+    ext.flush()  # external writes ride the write-behind queue
 
     reads = []
     orig = store.read
@@ -146,3 +147,64 @@ def test_tiered_cached_backend(memcached):
     # and now it's in c2's local LRU too
     assert c2.read("t", "b", "bloom-0") == b"BLOOM"
     assert c2.hits == 1
+
+
+def test_background_writeback_survives_stalled_cache():
+    """A STALLED cache tier (accepts connections, never answers) must not
+    block the read path: set() returns immediately through the
+    write-behind queue, over-budget writes drop, and get() fails fast on
+    its own socket timeout (reference: pkg/cache/background.go:22-80)."""
+    import socketserver
+    import threading
+    import time
+
+    from tempo_tpu.backend.extcache import BackgroundWriteCache, MemcachedCache
+
+    class _Stalled(socketserver.StreamRequestHandler):
+        def handle(self):
+            time.sleep(30)  # never respond
+
+    srv = socketserver.ThreadingTCPServer(("127.0.0.1", 0), _Stalled)
+    srv.daemon_threads = True
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        slow = MemcachedCache([f"127.0.0.1:{srv.server_address[1]}"], timeout=0.2)
+        cache = BackgroundWriteCache(slow, max_queued_bytes=1024, writers=1)
+        t0 = time.perf_counter()
+        cache.set("a", b"x" * 512)  # queued; writer blocks on the stall
+        cache.set("b", b"y" * 600)  # over budget while the writer stalls -> drop
+        assert time.perf_counter() - t0 < 0.05, "set() blocked on the cache tier"
+        assert cache.dropped >= 1
+        t0 = time.perf_counter()
+        assert cache.get("a") is None  # socket timeout, not a hang
+        assert time.perf_counter() - t0 < 2.0
+        cache.stop()
+    finally:
+        srv.shutdown()
+
+
+def test_background_writeback_delivers():
+    """With a healthy tier, queued writes land and later gets hit."""
+    import time
+
+    from tempo_tpu.backend.extcache import BackgroundWriteCache
+
+    class _Mem:
+        def __init__(self):
+            self.d = {}
+
+        def get(self, k):
+            return self.d.get(k)
+
+        def set(self, k, v):
+            self.d[k] = v
+
+    cache = BackgroundWriteCache(_Mem(), writers=1)
+    for i in range(50):
+        cache.set(f"k{i}", b"v%d" % i)
+    deadline = time.time() + 5
+    while time.time() < deadline and cache.get("k49") is None:
+        time.sleep(0.01)
+    assert cache.get("k0") == b"v0" and cache.get("k49") == b"v49"
+    assert cache.dropped == 0
+    cache.stop()

@@ -156,3 +156,122 @@ def test_jaeger_http_e2e(tmp_path):
         assert sp.kind == SpanKind.CLIENT
     finally:
         app.stop()
+
+
+# ------------------------------------------------ gRPC PostSpans ingest
+
+
+def _pb_kv(key, value):
+    """Independent api_v2 KeyValue encoder (hand-built against
+    model.proto, NOT the product encoder, so the decoder is checked
+    against the spec)."""
+    from tempo_tpu.wire import pbwire as w
+
+    m = bytearray()
+    w.write_string_field(m, 1, key)
+    if isinstance(value, bool):
+        w.write_varint_field(m, 2, 1)
+        w.write_varint_field(m, 4, 1 if value else 0)
+    elif isinstance(value, int):
+        w.write_varint_field(m, 2, 2)
+        w.write_varint_field(m, 5, value)
+    elif isinstance(value, float):
+        w.write_varint_field(m, 2, 3)
+        w.write_double_field(m, 6, value)
+    else:
+        w.write_string_field(m, 3, str(value))
+    return bytes(m)
+
+
+def _pb_ts(field, buf, unix_nano):
+    from tempo_tpu.wire import pbwire as w
+
+    t = bytearray()
+    w.write_varint_field(t, 1, unix_nano // 10**9)
+    w.write_varint_field(t, 2, unix_nano % 10**9)
+    w.write_message_field(buf, field, bytes(t))
+
+
+def _post_spans_request(trace_id: bytes, n_spans: int, service: str) -> bytes:
+    from tempo_tpu.wire import pbwire as w
+
+    base = 1_700_000_000 * 10**9
+    spans = []
+    for i in range(n_spans):
+        m = bytearray()
+        w.write_bytes_field(m, 1, trace_id)
+        w.write_bytes_field(m, 2, (i + 1).to_bytes(8, "big"))
+        w.write_string_field(m, 3, f"op-{i}")
+        if i > 0:  # CHILD_OF reference -> parent span
+            ref = bytearray()
+            w.write_bytes_field(ref, 1, trace_id)
+            w.write_bytes_field(ref, 2, (1).to_bytes(8, "big"))
+            w.write_message_field(m, 4, bytes(ref))
+        _pb_ts(6, m, base + i * 1000)
+        dur = bytearray()
+        w.write_varint_field(dur, 2, 5_000_000)  # 5 ms
+        w.write_message_field(m, 7, bytes(dur))
+        w.write_message_field(m, 8, _pb_kv("span.kind", "server"))
+        w.write_message_field(m, 8, _pb_kv("http.status_code", 200))
+        spans.append(bytes(m))
+    batch = bytearray()
+    for s in spans:
+        w.write_message_field(batch, 1, s)
+    proc = bytearray()
+    w.write_string_field(proc, 1, service)
+    w.write_message_field(proc, 2, _pb_kv("jaeger.version", "go-2.30"))
+    w.write_message_field(batch, 2, bytes(proc))
+    req = bytearray()
+    w.write_message_field(req, 1, bytes(batch))
+    return bytes(req)
+
+
+def test_jaeger_grpc_post_spans_e2e(tmp_path):
+    """Push a Batch through the real gRPC collector endpoint
+    (jaeger.api_v2.CollectorService/PostSpans) and read it back through
+    the querier as OTLP, with references mapped to parent ids and the
+    process to resource attrs."""
+    import json
+
+    import grpc
+
+    from tempo_tpu.services.app import App, AppConfig, IngesterConfig
+
+    cfg = AppConfig(
+        target="all", http_port=0, jaeger_grpc_port=-1,
+        storage_path=str(tmp_path / "store"),
+        ingester=IngesterConfig(max_trace_idle_s=9999, max_block_age_s=9999,
+                                flush_check_period_s=9999),
+    )
+    app = App(cfg)
+    app.start()
+    srv = app.serve_http(background=True)
+    try:
+        http_port = srv.server_address[1]
+        tid = bytes(range(16))
+        payload = _post_spans_request(tid, 3, "jaeger-svc")
+        ch = grpc.insecure_channel(f"127.0.0.1:{cfg.jaeger_grpc_port}")
+        resp = ch.unary_unary("/jaeger.api_v2.CollectorService/PostSpans")(payload)
+        assert resp == b""
+        got = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{http_port}/api/traces/{tid.hex()}", timeout=10).read())
+        spans = [sp for rs in got["resourceSpans"]
+                 for ss in rs["scopeSpans"] for sp in ss["spans"]]
+        assert len(spans) == 3
+        by_name = {sp["name"]: sp for sp in spans}
+        assert by_name["op-1"]["parentSpanId"] == (1).to_bytes(8, "big").hex()
+        res_attrs = {a["key"]: a["value"] for rs in got["resourceSpans"]
+                     for a in rs["resource"]["attributes"]}
+        assert res_attrs["service.name"]["stringValue"] == "jaeger-svc"
+        assert res_attrs["jaeger.version"]["stringValue"] == "go-2.30"
+        # malformed payload -> INVALID_ARGUMENT, server stays up
+        import pytest as _pytest
+
+        with _pytest.raises(grpc.RpcError) as ei:
+            ch.unary_unary("/jaeger.api_v2.CollectorService/PostSpans")(b"\xff\xff\xff")
+        assert ei.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+        r2 = ch.unary_unary("/jaeger.api_v2.CollectorService/PostSpans")(payload)
+        assert r2 == b""
+    finally:
+        srv.shutdown()
+        app.stop()
