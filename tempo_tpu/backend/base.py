@@ -70,6 +70,12 @@ class Appender:
 class RawBackend(abc.ABC):
     """Reader+writer+compactor over raw named objects."""
 
+    # True when reads cross a network (object stores): IO waits release
+    # the GIL, so thread-pool fan-out overlaps them even on one core.
+    # Local/mem backends override to False -- there a 1-core box gains
+    # nothing from pool handoffs (db/search gates its pools on this).
+    is_remote = True
+
     # ---- write
     @abc.abstractmethod
     def write(self, tenant: str, block_id: str, name: str, data: bytes) -> None: ...

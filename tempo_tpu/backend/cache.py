@@ -33,6 +33,7 @@ class CachedBackend(RawBackend):
         a querier fleet fetches each control object from object storage
         once per cluster, not once per process."""
         self.inner = inner
+        self.is_remote = getattr(inner, "is_remote", True)
         self.max_bytes = max_bytes
         self.external = external
         self._lock = threading.Lock()
@@ -155,6 +156,7 @@ class HedgedBackend(RawBackend):
 
     def __init__(self, inner: RawBackend, hedge_after_s: float = 0.5, workers: int = 16):
         self.inner = inner
+        self.is_remote = getattr(inner, "is_remote", True)
         self.hedge_after_s = hedge_after_s
         self.pool = ThreadPoolExecutor(max_workers=workers, thread_name_prefix="hedge")
         self.hedged_requests = 0

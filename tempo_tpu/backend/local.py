@@ -55,6 +55,7 @@ class _FileAppender(Appender):
 
 
 class LocalBackend(RawBackend):
+    is_remote = False
     def __init__(self, path: str):
         self.path = os.path.abspath(path)
         os.makedirs(self.path, exist_ok=True)

@@ -9,6 +9,7 @@ from .base import COMPACTED_META_NAME, META_NAME, DoesNotExist, RawBackend
 
 
 class MemBackend(RawBackend):
+    is_remote = False
     def __init__(self):
         self._lock = threading.Lock()
         self._objects: dict[tuple[str, str, str], bytes] = {}

@@ -507,17 +507,18 @@ def compute_row_groups(cols, start_ms, dur_us, row_group_spans):
     return axes, col_axis, row_groups
 
 
-# metadata axes every COLD query must decompress before it can do
-# anything (tres plan columns, trace candidate/result columns, res and
-# scope tables): stored at zstd's fast negative level, which decodes
-# ~3-4x faster than level 3 for ~1-2% larger blocks (these axes are a
-# few % of pack bytes; the span/attr payload keeps the ratio level).
+# metadata axes every COLD query must decode before it can do anything
+# (tres plan columns, trace candidate/result columns, res and scope
+# tables): stored UNCOMPRESSED so a cold open's critical path is pure
+# IO -- they are a few percent of pack bytes, so the block grows ~2-3%
+# while cold queries skip their entire decompress step. (The const-chunk
+# codec still applies, so absent optional columns stay one row.) The
+# span/attr payload keeps the ratio-optimal zstd level.
 FAST_DECODE_PREFIXES = ("trace.", "tres.", "res.", "scope.")
-FAST_DECODE_LEVEL = -5
 
 
-def _column_level(name: str) -> int | None:
-    return FAST_DECODE_LEVEL if name.startswith(FAST_DECODE_PREFIXES) else None
+def _column_level(name: str):
+    return "raw" if name.startswith(FAST_DECODE_PREFIXES) else None
 
 
 def write_block(backend: RawBackend, fin: FinalizedBlock, level: int = 3,

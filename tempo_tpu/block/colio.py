@@ -133,14 +133,19 @@ def pack_columns_stream(
     from ..native import zstd_compress_from
 
     for name, arr in cols.items():
-        # per-column level override (level_for(name) -> int | None): the
-        # write policy keeps fast-decode levels on the metadata axes a
-        # cold query must decompress (block/builder.FAST_DECODE_PREFIXES)
+        # per-column override (level_for(name) -> int | "raw" | None):
+        # ints pick a zstd level; "raw" stores the column uncompressed
+        # (the fast-decode policy for metadata axes a cold query must
+        # decode, block/builder.FAST_DECODE_PREFIXES); None keeps the
+        # pack-wide level
         col_level = level
+        col_raw = False
         if level_for is not None and codec == CODEC_ZSTD:
-            # zstd only: the stdlib codec matrix rejects negative levels
+            # zstd only: the stdlib codec matrix rejects the overrides
             ov = level_for(name)
-            if ov is not None:
+            if ov == "raw":  # store uncompressed (fast-decode policy)
+                col_raw = True
+            elif ov is not None:
                 col_level = ov
         # stride-0 first dim = a broadcast view (read_all broadcast_const
         # / the compaction merge's const fast path): constant by
@@ -210,7 +215,7 @@ def pack_columns_stream(
         # codec matrix handles the rest per chunk
         to_compress = [i for i, (lo, hi) in enumerate(bounds)
                        if hi - lo >= _MIN_COMPRESS and codec != CODEC_RAW
-                       and i not in const_rows]
+                       and not col_raw and i not in const_rows]
         compressed: dict[int, bytes] = {}
         if to_compress and codec == CODEC_ZSTD:
             outs = zstd_compress_from(

@@ -19,10 +19,13 @@ Two execution engines share the plan + verify contract:
 
 from __future__ import annotations
 
+import os
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 
 import numpy as np
+
+_N_CPU = os.cpu_count() or 2
 
 from ..block import schema as S
 from ..block.reader import BackendBlock
@@ -428,7 +431,10 @@ def _host_cols(blk: BackendBlock, needed: list[str], groups_range):
     # races concurrent evictions (check-then-act): losing it only
     # degrades to serial re-reads of columns that were cached a moment
     # ago -- a cache already thrashing at that point.
-    if wanted and all(pack.has_cached_array(n) for n in wanted):
+    serial = all(pack.has_cached_array(n) for n in wanted) or (
+        _N_CPU == 1 and not getattr(blk.backend, "is_remote", True)
+    )
+    if wanted and serial:
         cols = dict(read(n) for n in wanted)
     else:
         cols = dict(_host_io_pool.map(read, wanted))
@@ -600,6 +606,11 @@ def search_blocks_fused(
     resp = SearchResponse()
     limit = req.limit or default_limit
     in_range = [b for b in blocks if b.meta.overlaps_time(req.start, req.end)]
+    # TempoDB already gates its io_pool on core count + backend locality;
+    # this covers direct callers handing in an ungated pool
+    if (pool is not None and _N_CPU == 1 and in_range
+            and not getattr(in_range[0].backend, "is_remote", True)):
+        pool = None
     plans = (
         list(pool.map(lambda b: _plan_for_block(b, req), in_range))
         if pool is not None

@@ -60,6 +60,15 @@ class TempoDB:
         self.blocklist = Blocklist()
         self.poller = Poller(self.backend)
         self.pool = ThreadPoolExecutor(max_workers=cfg.pool_workers)
+        # fan-out pool for the query engines: on a 1-core box with a
+        # LOCAL backend the handoffs only add GIL ping-pong (~20% of a
+        # cold scan), so every engine gets None and runs serial; remote
+        # backends keep the pool (IO waits release the GIL and overlap)
+        self.io_pool = (
+            self.pool
+            if (os.cpu_count() or 2) > 1 or getattr(self.backend, "is_remote", True)
+            else None
+        )
         self._block_cache: dict[tuple[str, str], BackendBlock] = {}
         self._cache_lock = threading.Lock()
         self._poll_thread: threading.Thread | None = None
@@ -203,19 +212,22 @@ class TempoDB:
 
                 got = search_blocks_device(
                     [self.open_block(m) for m in metas], req, self.mesh,
-                    default_limit=self.cfg.search_default_limit, pool=self.pool,
+                    default_limit=self.cfg.search_default_limit, pool=self.io_pool,
                 )
             else:
                 from .search import search_blocks_fused
 
                 got = search_blocks_fused(
                     [self.open_block(m) for m in metas], req,
-                    pool=self.pool, default_limit=self.cfg.search_default_limit,
+                    pool=self.io_pool, default_limit=self.cfg.search_default_limit,
                     promote_touches=self.cfg.device_promote_touches,
                 )
             if got is not None:  # None -> oversize / plan-shape fallback
                 return got
-        for r in self.pool.map(lambda m: search_block(self.open_block(m), req), metas):
+        fallback = (self.io_pool.map(lambda m: search_block(self.open_block(m), req), metas)
+                    if self.io_pool is not None
+                    else (search_block(self.open_block(m), req) for m in metas))
+        for r in fallback:
             resp.merge(r, req.limit or self.cfg.search_default_limit)
             if len(resp.traces) >= (req.limit or self.cfg.search_default_limit):
                 break
