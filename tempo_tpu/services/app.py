@@ -83,6 +83,10 @@ class AppConfig:
     # Jaeger gRPC collector port (reference shim.go:95-101; jaeger
     # collector convention 14250); 0 = disabled, -1 = ephemeral (tests)
     jaeger_grpc_port: int = 0
+    # Jaeger agent UDP ports (client-SDK emitBatch; 6831 thrift-compact,
+    # 6832 thrift-binary); 0 = disabled, -1 = ephemeral (tests). One
+    # flag enables both sockets.
+    jaeger_agent_port: int = 0
     # Kafka receiver (reference shim.go:100): host:port of a broker, ""
     # = disabled; messages are OTLP-proto ExportTraceServiceRequest
     kafka_brokers: str = ""
@@ -265,6 +269,7 @@ class App:
         self.otlp_grpc = None
         self.opencensus = None
         self.jaeger_grpc = None
+        self.jaeger_agent = None
         self.kafka = None
         self.remote_writer = None
         self.http_server: ThreadingHTTPServer | None = None
@@ -314,6 +319,20 @@ class App:
             port = max(0, self.cfg.jaeger_grpc_port)  # -1 -> ephemeral
             self.cfg.jaeger_grpc_port = self.jaeger_grpc.start(
                 port, host=self._bind_host())
+        if self.distributor is not None and self.cfg.jaeger_agent_port != 0:
+            if self.cfg.multitenancy:
+                # UDP datagrams cannot carry X-Scope-OrgID: every push
+                # would 401 and silently vanish -- fail the config loudly
+                raise ValueError(
+                    "jaeger_agent_port requires multitenancy off "
+                    "(UDP carries no tenant header)")
+            from .jaeger_agent import JaegerAgentReceiver
+
+            self.jaeger_agent = JaegerAgentReceiver(self)
+            want = max(0, self.cfg.jaeger_agent_port)
+            cport, _bport = self.jaeger_agent.start(
+                want, want + 1 if want else 0, host=self._bind_host())
+            self.cfg.jaeger_agent_port = cport
         if self.distributor is not None and self.cfg.kafka_brokers:
             from .kafka_receiver import DEFAULT_TOPIC, KafkaReceiver
 
@@ -345,6 +364,8 @@ class App:
             self.opencensus.stop()
         if self.jaeger_grpc is not None:
             self.jaeger_grpc.stop()
+        if self.jaeger_agent is not None:
+            self.jaeger_agent.stop()
         if self.kafka is not None:
             self.kafka.stop()
         if self.querier_worker:
@@ -883,6 +904,10 @@ def main(argv=None):
     ap.add_argument("--distributor.jaeger-grpc-port", dest="jaeger_grpc_port",
                     type=int, default=None,
                     help="Jaeger gRPC collector port (0=off, -1=ephemeral)")
+    ap.add_argument("--distributor.jaeger-agent-port", dest="jaeger_agent_port",
+                    type=int, default=None,
+                    help="Jaeger agent UDP compact port; binary opens at +1 "
+                         "(0=off, -1=ephemeral)")
     ap.add_argument("--querier.search-external-endpoints", dest="search_external",
                     default=None,
                     help="comma-separated serverless search handler URLs")
@@ -911,6 +936,7 @@ def main(argv=None):
         "otlp_grpc_port": args.otlp_grpc_port,
         "opencensus_grpc_port": args.opencensus_grpc_port,
         "jaeger_grpc_port": args.jaeger_grpc_port,
+        "jaeger_agent_port": args.jaeger_agent_port,
         "search_external_endpoints": args.search_external,
         "kafka_brokers": args.kafka_brokers,
         "kafka_topic": args.kafka_topic,

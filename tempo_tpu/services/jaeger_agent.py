@@ -1,0 +1,81 @@
+"""Jaeger AGENT UDP ingest: the client-library emitBatch ports.
+
+Reference: the receiver shim's jaeger factory also opens the agent UDP
+sockets (modules/distributor/receiver/shim.go; jaeger convention 6831 =
+thrift compact, 6832 = thrift binary). Jaeger client SDKs fire
+agent.thrift `emitBatch` datagrams at these ports; one datagram is one
+complete message (no framing). Decode (wire/jaeger_thrift, compact and
+strict-binary auto-detect) feeds the same distributor push path as the
+collector endpoints. UDP is fire-and-forget: malformed or over-limit
+datagrams increment counters and drop -- there is nothing to answer.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+
+_MAX_DGRAM = 65536
+
+
+class JaegerAgentReceiver:
+    def __init__(self, app):
+        self.app = app
+        self._socks: list[socket.socket] = []
+        self._threads: list[threading.Thread] = []
+        self.compact_port = 0
+        self.binary_port = 0
+        self.packets = 0
+        self.spans = 0
+        self.failures = 0
+        self._stop = threading.Event()
+
+    def start(self, compact_port: int = 6831, binary_port: int = 6832,
+              host: str = "127.0.0.1") -> tuple[int, int]:
+        ports = []
+        for want in (compact_port, binary_port):
+            s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            s.bind((host, max(0, want)))  # -1/-0 -> ephemeral
+            s.settimeout(0.5)  # lets the serve loop observe _stop
+            self._socks.append(s)
+            ports.append(s.getsockname()[1])
+            t = threading.Thread(target=self._serve, args=(s,),
+                                 name=f"jaeger-agent-{ports[-1]}", daemon=True)
+            self._threads.append(t)
+            t.start()
+        self.compact_port, self.binary_port = ports
+        return self.compact_port, self.binary_port
+
+    def _serve(self, sock: socket.socket) -> None:
+        from ..wire.jaeger_thrift import decode_agent_message
+
+        app = self.app
+        while not self._stop.is_set():
+            try:
+                data, _ = sock.recvfrom(_MAX_DGRAM)
+            except socket.timeout:
+                continue
+            except OSError:
+                return  # socket closed
+            self.packets += 1
+            try:
+                rs = decode_agent_message(data)
+                if rs is None:
+                    continue  # other agent methods (emitZipkinBatch)
+                tenant = app.tenant_of({})  # UDP carries no tenant header
+                app.distributor.push(tenant, [rs])
+                self.spans += sum(len(ss.spans) for ss in rs.scope_spans)
+            except Exception:
+                self.failures += 1  # fire-and-forget: count and drop
+
+    def stop(self) -> None:
+        self._stop.set()
+        for s in self._socks:
+            try:
+                s.close()
+            except OSError:
+                pass
+        for t in self._threads:
+            t.join(timeout=2.0)
+        self._socks = []
+        self._threads = []

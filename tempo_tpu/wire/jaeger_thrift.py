@@ -94,6 +94,111 @@ class _Reader:
             out[fid] = self.read(ttype)
 
 
+class _CompactReader(_Reader):
+    """Thrift COMPACT protocol reader producing the same generic struct
+    dicts as _Reader (the jaeger AGENT's UDP wire form, port 6831:
+    zigzag-varint ints, delta-encoded field ids, little-endian doubles,
+    bool values folded into the field-header type). Shares the cursor
+    (_take) with the binary reader; read/read_struct are overridden
+    wholesale for the compact encodings."""
+
+    # compact type codes
+    _CT_BOOL_TRUE, _CT_BOOL_FALSE = 1, 2
+    _CT_BYTE, _CT_I16, _CT_I32, _CT_I64 = 3, 4, 5, 6
+    _CT_DOUBLE, _CT_BINARY = 7, 8
+    _CT_LIST, _CT_SET, _CT_MAP, _CT_STRUCT = 9, 10, 11, 12
+
+    def varint(self) -> int:
+        v = shift = 0
+        while True:
+            b = self._take(1)[0]
+            v |= (b & 0x7F) << shift
+            if not (b & 0x80):
+                return v
+            shift += 7
+            if shift > 70:
+                raise ThriftError("varint too long")
+
+    def zigzag(self) -> int:
+        v = self.varint()
+        return (v >> 1) ^ -(v & 1)
+
+    def read(self, ct: int):
+        if ct == self._CT_BOOL_TRUE:
+            return True
+        if ct == self._CT_BOOL_FALSE:
+            return False
+        if ct == self._CT_BYTE:
+            b = self._take(1)[0]
+            return b - 256 if b >= 128 else b
+        if ct in (self._CT_I16, self._CT_I32, self._CT_I64):
+            return self.zigzag()
+        if ct == self._CT_DOUBLE:
+            return struct.unpack("<d", self._take(8))[0]
+        if ct == self._CT_BINARY:
+            return self._take(self.varint())
+        if ct == self._CT_STRUCT:
+            return self.read_struct()
+        if ct in (self._CT_LIST, self._CT_SET):
+            hdr = self._take(1)[0]
+            n, et = hdr >> 4, hdr & 0xF
+            if n == 0xF:
+                n = self.varint()
+            return [self.read(et) for _ in range(n)]
+        if ct == self._CT_MAP:
+            n = self.varint()
+            if n == 0:
+                return {}
+            kv = self._take(1)[0]
+            kt, vt = kv >> 4, kv & 0xF
+            return {self.read(kt): self.read(vt) for _ in range(n)}
+        raise ThriftError(f"unsupported compact type {ct}")
+
+    def read_struct(self) -> dict[int, object]:
+        out: dict[int, object] = {}
+        fid = 0
+        while True:
+            hdr = self._take(1)[0]
+            if hdr == _STOP:
+                return out
+            delta, ct = hdr >> 4, hdr & 0xF
+            fid = fid + delta if delta else self.zigzag()
+            # bool-in-field: the header's type IS the value
+            out[fid] = self.read(ct)
+
+
+def decode_agent_message(data: bytes) -> "ResourceSpans | None":
+    """One jaeger AGENT UDP datagram (agent.thrift emitBatch, compact
+    0x82 or strict-binary framing, auto-detected) -> ResourceSpans, or
+    None for other methods (emitZipkinBatch is unsupported)."""
+    if not data:
+        raise ThriftError("empty datagram")
+    if data[0] == 0x82:  # compact protocol message header
+        r = _CompactReader(data)
+        r._take(1)  # protocol id
+        r._take(1)  # (type << 5) | version
+        r.varint()  # seqid
+        name = r._take(r.varint())
+        if name != b"emitBatch":
+            return None
+        args = r.read_struct()
+    else:  # strict binary: i32 (version|type), string name, i32 seqid
+        r = _Reader(data)
+        (ver,) = struct.unpack(">i", r._take(4))
+        if ver >= 0:  # old-style unframed: i32 name len first -- reject
+            raise ThriftError("not a strict-binary thrift message")
+        (nlen,) = struct.unpack(">i", r._take(4))
+        name = r._take(nlen)
+        r._take(4)  # seqid
+        if name != b"emitBatch":
+            return None
+        args = r.read_struct()
+    batch = args.get(1)
+    if not isinstance(batch, dict):
+        raise ThriftError("emitBatch args missing Batch")
+    return batch_to_resource_spans(batch)
+
+
 def _tags_to_attrs(tags) -> dict:
     attrs = {}
     for t in tags or []:
@@ -120,9 +225,14 @@ _KIND_MAP = {
 
 
 def decode_batch(data: bytes) -> ResourceSpans:
-    """One thrift Batch -> one ResourceSpans (Process == resource)."""
-    r = _Reader(data)
-    batch = r.read_struct()
+    """One thrift-binary Batch -> one ResourceSpans (Process ==
+    resource); the collector HTTP endpoint's payload form."""
+    return batch_to_resource_spans(_Reader(data).read_struct())
+
+
+def batch_to_resource_spans(batch: dict) -> ResourceSpans:
+    """Generic parsed Batch struct -> ResourceSpans: shared by the
+    binary collector payload and both agent UDP protocols."""
     process = batch.get(1) or {}
     service = (process.get(1) or b"").decode("utf-8", "replace")
     res_attrs = _tags_to_attrs(process.get(2))

@@ -275,3 +275,166 @@ def test_jaeger_grpc_post_spans_e2e(tmp_path):
     finally:
         srv.shutdown()
         app.stop()
+
+
+# ------------------------------------------------ agent UDP (emitBatch)
+
+
+def _cz(v: int) -> bytes:
+    """Independent compact-protocol zigzag varint encoder."""
+    u = (v << 1) ^ (v >> 63) if v < 0 else v << 1
+    out = bytearray()
+    while True:
+        b = u & 0x7F
+        u >>= 7
+        if u:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _cv(u: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = u & 0x7F
+        u >>= 7
+        if u:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _cfield(prev_fid: int, fid: int, ctype: int) -> bytes:
+    delta = fid - prev_fid
+    if 0 < delta <= 15:
+        return bytes([(delta << 4) | ctype])
+    return bytes([ctype]) + _cz(fid)
+
+
+def _cstr(s) -> bytes:
+    b = s if isinstance(s, bytes) else s.encode()
+    return _cv(len(b)) + b
+
+
+def _compact_emit_batch(trace_id: bytes, n_spans: int, service: str) -> bytes:
+    """Hand-built compact-protocol emitBatch datagram (agent.thrift),
+    independent of the product decoder."""
+    tid_hi = int.from_bytes(trace_id[:8], "big", signed=True)
+    tid_lo = int.from_bytes(trace_id[8:], "big", signed=True)
+
+    def tag(key, sval):  # string tag
+        t = _cfield(0, 1, 8) + _cstr(key)      # key
+        t += _cfield(1, 2, 5) + _cz(0)         # vType STRING
+        t += _cfield(2, 3, 8) + _cstr(sval)    # vStr
+        return t + b"\x00"
+
+    def span(i):
+        m = _cfield(0, 1, 6) + _cz(tid_lo)          # traceIdLow
+        m += _cfield(1, 2, 6) + _cz(tid_hi)         # traceIdHigh
+        m += _cfield(2, 3, 6) + _cz(i + 1)          # spanId
+        m += _cfield(3, 4, 6) + _cz(1 if i else 0)  # parentSpanId
+        m += _cfield(4, 5, 8) + _cstr(f"udp-op-{i}")
+        m += _cfield(5, 7, 5) + _cz(1)              # flags (skips fid 6)
+        m += _cfield(7, 8, 6) + _cz(1_700_000_000_000_000 + i)  # startTime us
+        m += _cfield(8, 9, 6) + _cz(5_000)          # duration us
+        m += _cfield(9, 10, 9) + bytes([(1 << 4) | 12]) + tag("k", "v")  # tags list
+        return m + b"\x00"
+
+    process = _cfield(0, 1, 8) + _cstr(service) + b"\x00"
+    batch = _cfield(0, 1, 12) + process
+    spans = b"".join(span(i) for i in range(n_spans))
+    hdr = bytes([(n_spans << 4) | 12]) if n_spans < 15 else bytes([0xFC]) + _cv(n_spans)
+    batch += _cfield(1, 2, 9) + hdr + spans + b"\x00"
+    args = _cfield(0, 1, 12) + batch + b"\x00"
+    # message: protocol id, (type ONEWAY=4)<<5 | version 1, seqid, name
+    return bytes([0x82, (4 << 5) | 1]) + _cv(0) + _cstr("emitBatch") + args
+
+
+def _binary_emit_batch(trace_id: bytes, n_spans: int, service: str) -> bytes:
+    """Strict-binary framed emitBatch using the binary struct helpers."""
+    tid_hi = trace_id[:8]
+    tid_lo = trace_id[8:]
+
+    def span(i):
+        out = _fld(1, _I64, tid_lo)
+        out += _fld(2, _I64, tid_hi)
+        out += _fld(3, _I64, struct.pack(">q", i + 1))
+        out += _fld(4, _I64, struct.pack(">q", 1 if i else 0))
+        out += _fld(5, _STRING, _s(f"bin-op-{i}"))
+        out += _fld(7, _I32, struct.pack(">i", 1))
+        out += _fld(8, _I64, struct.pack(">q", 1_700_000_100_000_000 + i))
+        out += _fld(9, _I64, struct.pack(">q", 7_000))
+        return out + b"\x00"
+
+    process = _fld(1, _STRING, _s(service)) + b"\x00"
+    batch = _fld(1, _STRUCT, process)
+    batch += _fld(2, _LIST, _lst(_STRUCT, [span(i) for i in range(n_spans)]))
+    batch += b"\x00"
+    args = _fld(1, _STRUCT, batch) + b"\x00"
+    name = b"emitBatch"
+    # strict binary: version 0x80010000 | type ONEWAY(4), name, seqid
+    return (struct.pack(">I", 0x80010000 | 4) + struct.pack(">i", len(name))
+            + name + struct.pack(">i", 0) + args)
+
+
+def test_jaeger_agent_udp_both_protocols(tmp_path):
+    """Client-SDK UDP datagrams (compact on 6831-role port, strict
+    binary on its +1) land through the distributor and read back."""
+    import json
+    import socket
+    import time
+
+    from tempo_tpu.services.app import App, AppConfig, IngesterConfig
+
+    cfg = AppConfig(
+        target="all", http_port=0, jaeger_agent_port=-1,
+        storage_path=str(tmp_path / "store"),
+        ingester=IngesterConfig(max_trace_idle_s=9999, max_block_age_s=9999,
+                                flush_check_period_s=9999),
+    )
+    app = App(cfg)
+    app.start()
+    srv = app.serve_http(background=True)
+    try:
+        http_port = srv.server_address[1]
+        recv = app.jaeger_agent
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        tid_c = bytes(range(16))
+        tid_b = bytes(range(16, 32))
+        s.sendto(_compact_emit_batch(tid_c, 3, "udp-compact-svc"),
+                 ("127.0.0.1", recv.compact_port))
+        s.sendto(_binary_emit_batch(tid_b, 2, "udp-binary-svc"),
+                 ("127.0.0.1", recv.binary_port))
+        s.sendto(b"\x82\x21\x00\x09emitBatch garbage", ("127.0.0.1", recv.compact_port))
+
+        deadline = time.time() + 10
+        got_c = got_b = None
+        while time.time() < deadline and (got_c is None or got_b is None):
+            for tid, slot in ((tid_c, "c"), (tid_b, "b")):
+                try:
+                    r = json.loads(urllib.request.urlopen(
+                        f"http://127.0.0.1:{http_port}/api/traces/{tid.hex()}",
+                        timeout=5).read())
+                except Exception:
+                    continue
+                if slot == "c":
+                    got_c = r
+                else:
+                    got_b = r
+            time.sleep(0.1)
+        assert got_c is not None and got_b is not None
+        n_c = sum(len(ss["spans"]) for rs in got_c["resourceSpans"]
+                  for ss in rs["scopeSpans"])
+        n_b = sum(len(ss["spans"]) for rs in got_b["resourceSpans"]
+                  for ss in rs["scopeSpans"])
+        assert n_c == 3 and n_b == 2
+        svc_c = {a["key"]: a["value"].get("stringValue")
+                 for rs in got_c["resourceSpans"]
+                 for a in rs["resource"]["attributes"]}
+        assert svc_c["service.name"] == "udp-compact-svc"
+        assert recv.failures >= 1  # the garbage datagram counted, nothing died
+    finally:
+        srv.shutdown()
+        app.stop()

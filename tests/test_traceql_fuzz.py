@@ -109,3 +109,36 @@ def test_fuzz_host_device_oracle_agree(tmp_path, seed):
         assert got_d == want, (q, sorted(got_d ^ want)[:4])
         checked += 1
     assert checked == 40
+
+
+@pytest.mark.parametrize("seed", [404, 505])
+def test_fuzz_mesh_path_agrees(tmp_path, seed):
+    """Fourth leg: the stacked MESH program (blocks over dp, span AND
+    generic-attr rows over sp, parallel/search.py) against the wire
+    oracle on the 8-virtual-device mesh. Struct-tree queries fall back
+    (search_blocks_device returns None) and are already covered by the
+    per-block legs above."""
+    from tempo_tpu.db.search import search_blocks_device
+
+    rng = random.Random(seed)
+    db = TempoDB(TempoDBConfig(wal_path=str(tmp_path / "w")), backend=MemBackend())
+    traces1 = make_traces(30, seed=seed, n_spans=6)
+    traces2 = make_traces(30, seed=seed + 1, n_spans=6)
+    db.write_block(TENANT, traces1)
+    db.write_block(TENANT, traces2)
+    blocks = [db.open_block(m) for m in db.blocklist.metas(TENANT)]
+    assert db.mesh.devices.size == 8
+    all_traces = traces1 + traces2
+
+    mesh_ran = 0
+    for _ in range(40):
+        q = _query(rng)
+        ast = parse(q)
+        want = {tid.hex() for tid, t in all_traces if trace_matches(ast, t)}
+        resp = search_blocks_device(blocks, SearchRequest(query=q, limit=1000), db.mesh)
+        if resp is None:
+            continue
+        got = {t.trace_id for t in resp.traces}
+        assert got == want, (q, sorted(got ^ want)[:4])
+        mesh_ran += 1
+    assert mesh_ran >= 20, f"only {mesh_ran} queries ran the mesh path"
