@@ -828,10 +828,13 @@ def search_blocks_device(
     resolved through each block's dictionary (parallel/search.py). The
     multi-chip analog of the reference's per-block job fan-out
     (modules/frontend/searchsharding.go + tempodb/pool), including the
-    generic attribute iterators (vparquet/block_traceql.go:682-763).
-    Returns None when the query has structural ops or the stacked
-    columns exceed the device budget -- the caller falls back to
-    per-block search_block."""
+    generic attribute iterators (vparquet/block_traceql.go:682-763) and
+    structural ops (>, >>, ~: parent tables all_gather along sp).
+    Pre-upgrade blocks without span.parent_idx never reach a struct
+    tree -- their planner falls back to the conservative force-verify
+    plan, which runs on the mesh like any other. Returns None only when
+    the stacked columns (plus struct all_gather replication) exceed the
+    device budget -- the caller falls back to per-block search_block."""
     resp = SearchResponse()
     in_range = [b for b in blocks if b.meta.overlaps_time(req.start, req.end)]
     # plan fan-out pulls each block's dictionary + footer: overlap the IO
@@ -844,8 +847,6 @@ def search_blocks_device(
     for blk, p in zip(in_range, plans):
         if p.prune:
             continue
-        if p.has_struct:
-            return None  # struct trees run on the per-block engines
         live.append((blk, p))
     if not live:
         return resp
@@ -882,8 +883,10 @@ def _search_group_device(items, tree, conds, req: SearchRequest, mesh, resp: Sea
 
     dp, sp = mesh.shape["dp"], mesh.shape["sp"]
     # span@ materialization is a staged-cache concept; the stacked path
-    # reads and stacks raw columns only
-    needed = [n for n in required_columns(conds) if not n.startswith("span@")]
+    # reads and stacks raw columns only. extra_cols carries tree-level
+    # needs (span.parent_idx for struct nodes).
+    needed = [n for n in required_columns(conds) + list(items[0][1].extra_cols)
+              if not n.startswith("span@")]
     span_cols = [n for n in needed if n.startswith("span.")]
     B = len(items)
     Bp = ((B + dp - 1) // dp) * dp
@@ -907,6 +910,12 @@ def _search_group_device(items, tree, conds, req: SearchRequest, mesh, resp: Sea
             1 for n in needed if n.startswith(f"{pre}.") and not n.endswith((".span", ".res"))
         )
         est += a_b * n_val_cols + (S_b + 1 if pre == "sattr" else 0)  # values + off
+    if items[0][1].has_struct:
+        # each struct node all_gathers full span-axis tables onto EVERY
+        # chip (lm/pid/valid + pointer-doubling temps): account the
+        # replication so near-budget struct queries fall back instead of
+        # exhausting device memory mid-program
+        est += 6 * S_b * sp
     if Bp * est * 4 > _DEVICE_SEARCH_MAX_BYTES:
         return None
 

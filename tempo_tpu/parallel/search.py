@@ -171,9 +171,56 @@ def make_sharded_search(mesh, tree, conds: tuple[Cond, ...], col_names: tuple[st
                 return attr_mask(i)
             raise ValueError(f"sharded search: unsupported target {c.target}")
 
+        def ev_struct(op, lm, rm):
+            """Structural relation on the mesh: lhs mask / parent table /
+            validity all_gather along 'sp' (span-axis bytes per block --
+            one collective per struct node), the relation runs on the
+            replicated (Bl, S) tables exactly as the single-chip kernel
+            (ops/filter.ev_struct), and each chip slices its own span
+            range back out to AND with the local rhs."""
+            Sl = lm.shape[1]
+            lm_g = jax.lax.all_gather(lm, "sp", axis=1, tiled=True)  # (Bl, S)
+            pid_g = jax.lax.all_gather(cols["span.parent_idx"], "sp",
+                                       axis=1, tiled=True)
+            val_g = jax.lax.all_gather(valid, "sp", axis=1, tiled=True)
+            Sg = lm_g.shape[1]
+            has_p = (pid_g >= 0) & val_g
+            safe = jnp.clip(pid_g, 0, Sg - 1)
+            if op == ">":
+                out = has_p & jnp.take_along_axis(lm_g, safe, 1)
+            elif op == ">>":
+                acc = has_p & jnp.take_along_axis(lm_g, safe, 1)
+                ptr = jnp.where(has_p, safe, -1)
+                for _ in range(max(1, (Sg - 1).bit_length())):
+                    psafe = jnp.clip(ptr, 0, Sg - 1)
+                    alive = ptr >= 0
+                    acc = acc | (alive & jnp.take_along_axis(acc, psafe, 1))
+                    nxt = jnp.take_along_axis(ptr, psafe, 1)
+                    ptr = jnp.where(alive, jnp.where(nxt >= 0, nxt, -1), -1)
+                out = acc
+            else:  # '~': sibling with a DIFFERENT lhs span under one parent
+                lhs_child = (lm_g & has_p).astype(jnp.int32)
+                owner = jnp.where(has_p & lm_g, safe, Sg)
+                cnt = jax.vmap(
+                    lambda o, w: jax.ops.segment_sum(w, o, num_segments=Sg + 1)[:Sg]
+                )(owner, lhs_child)
+                sibs = jnp.take_along_axis(cnt, safe, 1) - lhs_child
+                orphan = (pid_g == -2) & val_g
+                any_lhs_orphan = jnp.any(lm_g & orphan, axis=1, keepdims=True)
+                out = (has_p & (sibs > 0)) | (orphan & any_lhs_orphan)
+            row0_ = jax.lax.axis_index("sp") * Sl
+            out_local = jax.lax.dynamic_slice_in_dim(out, row0_, Sl, axis=1)
+            return rm & out_local & valid
+
         def ev_span(t):
+            if t == ("true",):
+                return valid
+            if t == ("false",):
+                return jnp.zeros_like(valid)
             if t[0] == "cond":
                 return cond_mask(t[1])
+            if t[0] == "struct":
+                return ev_struct(t[1], ev_span(t[2]), ev_span(t[3]))
             ms = [ev_span(ch) for ch in t[1:]]
             out = ms[0]
             for m in ms[1:]:
@@ -205,6 +252,10 @@ def make_sharded_search(mesh, tree, conds: tuple[Cond, ...], col_names: tuple[st
                 sm = ev_span(t[1])
                 span_masks.append(sm)
                 return seg_reduce(sm) > 0
+            if t == ("true",):
+                return jnp.ones((n_spans_l.shape[0], NT), dtype=bool)
+            if t == ("false",):
+                return jnp.zeros((n_spans_l.shape[0], NT), dtype=bool)
             if t[0] == "cond":
                 return cond_cmp(t[1], cols[conds[t[1]].col])
             ms = [ev_trace(ch) for ch in t[1:]]

@@ -114,10 +114,9 @@ def test_fuzz_host_device_oracle_agree(tmp_path, seed):
 @pytest.mark.parametrize("seed", [404, 505])
 def test_fuzz_mesh_path_agrees(tmp_path, seed):
     """Fourth leg: the stacked MESH program (blocks over dp, span AND
-    generic-attr rows over sp, parallel/search.py) against the wire
-    oracle on the 8-virtual-device mesh. Struct-tree queries fall back
-    (search_blocks_device returns None) and are already covered by the
-    per-block legs above."""
+    generic-attr rows over sp, structural ops via all_gathered parent
+    tables, parallel/search.py) against the wire oracle on the
+    8-virtual-device mesh."""
     from tempo_tpu.db.search import search_blocks_device
 
     rng = random.Random(seed)
