@@ -52,17 +52,24 @@ BASELINE_SPANS_PER_SEC = 10.4e6 / 0.18  # reference vParquet search, IO incl.
 _HBM_PEAK_BPS = {"tpu": 819e9, "axon": 819e9}
 
 
-def best_window(fn, windows: int = 3):
-    """Best (minimum) wall time of `windows` runs of fn() -- timeit's
-    rationale: this box is a shared core whose neighbors can eat an
-    entire timing window; contention only ever adds time, so the best
-    window measures the engine and the others measure the neighbors."""
+def best_window(fn, windows: int = 3, max_windows: int | None = None):
+    """Best (minimum) wall time of fn() runs -- timeit's rationale: this
+    box is a shared core whose neighbors can eat an entire timing
+    window; contention only ever adds time, so the best window measures
+    the engine and the others measure the neighbors. After the minimum
+    `windows` runs, keeps sampling while the best keeps improving >2%
+    (a noisy patch squeezes real windows out), up to 2x the minimum."""
+    if max_windows is None:
+        max_windows = 2 * windows
     best = None
-    for _ in range(windows):
+    for i in range(max_windows):
         t0 = time.perf_counter()
         fn()
         dt = time.perf_counter() - t0
+        improved = best is None or dt < best * 0.98
         best = dt if best is None else min(best, dt)
+        if i + 1 >= windows and not improved:
+            break
     return best
 
 
@@ -272,7 +279,9 @@ def bench_kernel() -> None:
             out = run(i % 64, 400_000 + i, i % 100, i % 5_000)
         jax.block_until_ready(out)
 
-    dt = best_window(window, windows=3)
+    # windows are ~0.1 s here, so sample generously: the kernel line is
+    # the ceiling metric and must not record a neighbor's timeslice
+    dt = best_window(window, windows=6, max_windows=15)
     sps = N_SPANS * iters / dt
     _emit("traceql_filter_kernel_spans_per_sec_per_chip", sps, "spans/s",
           sps / BASELINE_SPANS_PER_SEC)
@@ -434,13 +443,13 @@ def bench_compaction(tmp: str) -> None:
     metas = [synth_block(backend, "bench", rng, 1 << 14, 24, n_res=256)[0]
              for _ in range(8)]
     total = sum(m.size_bytes for m in metas)
-    # best of 2 (same min-under-noise rationale as the search timings;
-    # one run of this job is ~6 s, long enough to catch a neighbor)
+    # best of 3 (same min-under-noise rationale as the search timings;
+    # one run of this job is ~2 s, and any window can catch a neighbor)
     def job():
         res = compact(backend, CompactionJob("bench", metas), cfg)
         assert res.traces_out == 8 * (1 << 14)
 
-    best = best_window(job, windows=2)
+    best = best_window(job, windows=3)
     _emit("compaction_mb_per_sec", total / best / 1e6, "MB/s", 0.0)
 
     backend2 = LocalBackend(tmp + "/cstore-small")

@@ -241,6 +241,10 @@ def normalize_tree(tree: CondTree, conds: tuple[Cond, ...]) -> CondTree:
     def purity(t):  # 'trace' | 'span' | 'mixed'
         if t[0] == "tracify":
             return "trace"
+        if t[0] == "struct":
+            # ('struct', op, lhs, rhs): spanset-relation node, span-level
+            # by construction (t[1] is the op STRING -- never recurse it)
+            return "span"
         if t[0] == "cond":
             return "trace" if t[1] in trace_idx else "span"
         kinds = {purity(ch) for ch in t[1:]}
