@@ -124,8 +124,17 @@ def test_fuzz_mesh_path_agrees(tmp_path, seed):
     traces1 = make_traces(30, seed=seed, n_spans=6)
     traces2 = make_traces(30, seed=seed + 1, n_spans=6)
     db.write_block(TENANT, traces1)
-    db.write_block(TENANT, traces2)
+    # second block written DOWN-LEVEL (vtpu1, JSON footer): the mesh
+    # program must stack mixed-version blocks transparently
+    from tempo_tpu.block.builder import BlockBuilder, write_block
+
+    b = BlockBuilder(TENANT)
+    for tid, t in sorted(traces2):
+        b.add_trace(tid, t)
+    m1 = write_block(db.backend, b.finalize(), version="vtpu1")
+    db.blocklist.update(TENANT, add=[m1])
     blocks = [db.open_block(m) for m in db.blocklist.metas(TENANT)]
+    assert {b.meta.version for b in blocks} == {"vtpu1", "vtpu2"}
     assert db.mesh.devices.size == 8
     all_traces = traces1 + traces2
 
