@@ -851,16 +851,40 @@ def _config_dict(cfg: AppConfig) -> dict:
     return asdict(cfg)
 
 
-def load_config_file(path: str) -> dict:
+def load_config_file(path: str, expand_env: bool = False) -> dict:
     """YAML config root. Precedence: YAML supplies the base, explicitly
-    set command-line flags override it (no env-var layer). Keys mirror
-    AppConfig fields; unknown keys are rejected so typos fail loudly
-    like the reference's strict YAML."""
+    set command-line flags override it. Keys mirror AppConfig fields;
+    unknown keys are rejected so typos fail loudly like the reference's
+    strict YAML. expand_env substitutes ${VAR} / ${VAR:-default}
+    references BEFORE parsing (the reference's --config.expand-env,
+    cmd/tempo/main.go envsubst) -- the secrets-from-environment pattern
+    for credentials in checked-in config files."""
     import yaml
     from dataclasses import fields as dc_fields
 
     with open(path) as f:
-        data = yaml.safe_load(f) or {}
+        text = f.read()
+    if expand_env:
+        import os as _os
+        import re as _re
+
+        def sub(m):
+            ref = m.group(1)
+            name, has_def, default = ref.partition(":-")
+            val = _os.environ.get(name)
+            if has_def:
+                # shell ':-' semantics: default applies when unset OR empty
+                return val if val else default
+            if val is None:
+                # no default and unset: fail at config load with the real
+                # cause, not later as a None field deep in startup
+                raise ValueError(
+                    f"config references ${{{name}}} but it is not set "
+                    f"(use ${{{name}:-default}} for an optional value)")
+            return val
+
+        text = _re.sub(r"\$\{([A-Za-z_][A-Za-z0-9_]*(?::-[^}]*)?)\}", sub, text)
+    data = yaml.safe_load(text) or {}
     valid = {f.name for f in dc_fields(AppConfig)}
     unknown = set(data) - valid - {"ingester"}
     if unknown:
@@ -875,6 +899,9 @@ def main(argv=None):
     # None defaults = "flag not given"; a flag the user set ALWAYS overrides
     # the config file, even when set to the built-in default value
     ap.add_argument("--config.file", dest="config_file", default="")
+    ap.add_argument("--config.expand-env", dest="config_expand_env",
+                    action="store_true",
+                    help="substitute ${VAR} / ${VAR:-default} in the config file")
     ap.add_argument("--target", default=None)
     ap.add_argument("--http.port", dest="port", type=int, default=None)
     ap.add_argument("--storage.path", dest="storage", default=None)
@@ -917,7 +944,8 @@ def main(argv=None):
     ap.add_argument("--distributor.kafka-tenant", dest="kafka_tenant", default=None,
                     help="tenant kafka messages ingest into (required with multitenancy)")
     args = ap.parse_args(argv)
-    base = load_config_file(args.config_file) if args.config_file else {}
+    base = (load_config_file(args.config_file, args.config_expand_env)
+            if args.config_file else {})
     flag_vals = {
         "target": args.target,
         "http_port": args.port,

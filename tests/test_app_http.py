@@ -488,3 +488,34 @@ def test_debug_endpoints(server):
     assert st == 200 and body.decode().count("--- thread") >= 2
     st, body = _get(base, "/debug/profile?seconds=0.3")
     assert st == 200 and "sampling profile" in body.decode()
+
+
+def test_config_expand_env(tmp_path, monkeypatch):
+    """--config.expand-env substitutes ${VAR} / ${VAR:-default} before
+    YAML parse (the reference's envsubst option); without the flag the
+    file is taken literally."""
+    from tempo_tpu.services.app import load_config_file
+
+    cfg = tmp_path / "tempo.yaml"
+    cfg.write_text(
+        "target: ${TEMPO_TARGET:-all}\n"
+        "storage_path: ${TEMPO_STORE}\n"
+        "http_port: 0\n"
+    )
+    monkeypatch.setenv("TEMPO_STORE", "/data/blocks")
+    monkeypatch.delenv("TEMPO_TARGET", raising=False)
+    data = load_config_file(str(cfg), expand_env=True)
+    assert data["target"] == "all"
+    assert data["storage_path"] == "/data/blocks"
+    monkeypatch.setenv("TEMPO_TARGET", "querier")
+    assert load_config_file(str(cfg), expand_env=True)["target"] == "querier"
+    # shell ':-' semantics: set-but-EMPTY also takes the default
+    monkeypatch.setenv("TEMPO_TARGET", "")
+    assert load_config_file(str(cfg), expand_env=True)["target"] == "all"
+    # unset without a default fails at config load, not deep in startup
+    monkeypatch.delenv("TEMPO_STORE")
+    with pytest.raises(ValueError, match="TEMPO_STORE"):
+        load_config_file(str(cfg), expand_env=True)
+    monkeypatch.setenv("TEMPO_STORE", "/data/blocks")
+    # literal without the flag
+    assert load_config_file(str(cfg))["storage_path"] == "${TEMPO_STORE}"
