@@ -903,7 +903,8 @@ class ColumnPack:
             out[name] = np.broadcast_to(rv, shape)
         return out
 
-    def read_all(self, broadcast_const: bool = False) -> dict[str, np.ndarray]:
+    def read_all(self, broadcast_const: bool = False,
+                 independent: bool = False) -> dict[str, np.ndarray]:
         """Every column, zero-copy: ONE destination buffer laid out
         column-after-column, every zstd chunk decompressed straight into
         its final position (native batch), raw chunks memcpy'd, then each
@@ -914,14 +915,30 @@ class ColumnPack:
         np.broadcast_to views instead of materialized tiles (the
         compaction merge's const fast path); such views are read-only
         and NOT contiguous -- callers that hand pointers to native code
-        must np.ascontiguousarray first."""
+        must np.ascontiguousarray first.
+
+        independent=True copies each column out of the shared buffer
+        (one extra memcpy pass) so a caller can FREE columns one by one
+        -- views over one base would pin the whole buffer for as long
+        as any single column lives (the compaction merge's
+        consume-as-you-go path)."""
         from ..native import available, zstd_decompress_into
 
         bc = self._broadcast_const_cols() if broadcast_const else {}
 
-        if not available():
+        def _fallback():
+            # honor independent on the fallback paths too: read() hands
+            # back arrays pinned in the pack's LRU cache, which would
+            # silently void the caller's free-one-by-one contract
             self.warm([(n, None) for n in self._cols if n not in bc])
-            return {n: bc[n] if n in bc else self.read(n) for n in self._cols}
+            return {
+                n: bc[n] if n in bc
+                else (self.read(n).copy() if independent else self.read(n))
+                for n in self._cols
+            }
+
+        if not available():
+            return _fallback()
 
         col_base: dict[str, int] = {}
         z_chunks: list[bytes] = []
@@ -962,8 +979,7 @@ class ColumnPack:
             # Relative subtraction under the lock: a plain reset would
             # clobber concurrent readers' increments.
             self._count_read(-counted)
-            self.warm([(n, None) for n in self._cols if n not in bc])
-            return {n: bc[n] if n in bc else self.read(n) for n in self._cols}
+            return _fallback()
         for p, data in raw_parts:
             dst[p : p + len(data)] = np.frombuffer(data, dtype=np.uint8)
         for p, row, raw_len in const_parts:
@@ -977,5 +993,8 @@ class ColumnPack:
             dt = np.dtype(meta["dtype"])
             n_bytes = int(np.prod(meta["shape"], dtype=np.int64)) * dt.itemsize
             base = col_base[name]
-            out[name] = dst[base : base + n_bytes].view(dt).reshape(meta["shape"])
+            col = dst[base : base + n_bytes]
+            if independent:
+                col = col.copy()
+            out[name] = col.view(dt).reshape(meta["shape"])
         return out

@@ -82,13 +82,18 @@ class _Source:
     PRELOAD_MAX_BYTES = 32 << 20
 
     @classmethod
-    def from_block(cls, blk: BackendBlock) -> "_Source":
+    def from_block(cls, blk: BackendBlock, independent: bool = True) -> "_Source":
         if blk.meta.size_bytes and blk.meta.size_bytes <= cls.PRELOAD_MAX_BYTES:
             blk.pack.preload()
         # const columns arrive as stride-0 broadcast views: zero decode,
         # zero memory, and _assemble forwards them const when every
-        # source agrees (the dominant case -- absent optional columns)
-        return cls(blk.pack.read_all(broadcast_const=True), blk.dictionary)
+        # source agrees (the dominant case -- absent optional columns).
+        # independent=True: _assemble's consume-as-you-go frees each
+        # column after its output pass; views over one shared buffer
+        # would pin the whole thing for as long as any column lived.
+        # Multi-output jobs never consume, so the caller skips the copy.
+        return cls(blk.pack.read_all(broadcast_const=True, independent=independent),
+                   blk.dictionary)
 
     def remap_codes(self, remap: np.ndarray, fused: bool = False) -> None:
         """Re-encode dict-code columns into the merged dictionary. With
@@ -220,7 +225,8 @@ def _packed_offs(lens: np.ndarray) -> np.ndarray:
 def _assemble(tenant: str, sources: list[_Source],
               chunks: tuple[np.ndarray, np.ndarray, np.ndarray],
               merged: Dictionary, level: int, row_group_spans: int,
-              bloom: ShardedBloom | None) -> FinalizedBlock:
+              bloom: ShardedBloom | None,
+              consume: bool = False) -> FinalizedBlock:
     """Assemble one output block from (src, sid_lo, sid_hi) run arrays.
 
     Everything is per-SOURCE vectorized: each axis of each source
@@ -399,11 +405,25 @@ def _assemble(tenant: str, sources: list[_Source],
     )
 
     cols: dict[str, np.ndarray] = {}
+
+    def _consume(n: str) -> None:
+        # single-output jobs: each source column is read by exactly ONE
+        # output column's pass, so free it the moment that pass is done.
+        # Halves peak memory (sources + output no longer coexist whole)
+        # and keeps the working set cache-resident. Exceptions that later
+        # passes re-read: trace.tres_off and trace.span_off (the
+        # recompute section) and rattr.res (every rattr VALUE column
+        # filters by the owner).
+        if consume and n not in ("trace.tres_off", "trace.span_off", "rattr.res"):
+            for si in src_order:
+                sources[si].cols.pop(n, None)
+
     for n in names:
         pref = n.split(".", 1)[0]
         like = sources[src_order[0]].cols[n]
         if n in ("span.trace_sid", "span.start_ms", "trace.span_off",
                  "trace.start_ms", "trace.end_ms", "trace.tres_off"):
+            _consume(n)
             continue  # recomputed below
         if pref in axis_rows:
             # const fast path: when every source is constant on this
@@ -422,6 +442,7 @@ def _assemble(tenant: str, sources: list[_Source],
                     cols[n] = np.broadcast_to(
                         rows[0].astype(like.dtype, copy=False),
                         (axis_rows[pref],) + like.shape[1:])
+                    _consume(n)
                     continue
             out = np.empty((axis_rows[pref],) + like.shape[1:], dtype=like.dtype)
             for si in src_order:
@@ -479,6 +500,7 @@ def _assemble(tenant: str, sources: list[_Source],
             cols[n] = np.concatenate(parts) if parts else like[:0]
         else:
             raise UnsupportedColumnar(f"unknown column family: {n}")
+        _consume(n)
 
     # recomputed columns
     span_counts = np.empty(n_traces, dtype=np.int64)
@@ -541,7 +563,12 @@ def compact_columnar(backend: RawBackend, job: CompactionJob, cfg: CompactorConf
     # version dispatch: an unknown-format input must fail the job
     # loudly, never be misparsed as vtpu1 bytes
     blocks = [open_block_versioned(backend, m) for m in job.blocks]
-    sources = [_Source.from_block(b) for b in blocks]
+    # one output block => consume-as-you-go pays; multi-output jobs never
+    # consume, so skip the per-column copies (estimate from input bytes:
+    # single iff everything fits one target block, the common L0->L1 case)
+    target_est = cfg.target_block_bytes or cfg.max_block_bytes
+    single_est = sum(m.size_bytes for m in job.blocks) <= target_est * 9 // 10
+    sources = [_Source.from_block(b, independent=single_est) for b in blocks]
     names = set(sources[0].cols)
     if any(set(s.cols) != names for s in sources[1:]):
         raise UnsupportedColumnar("input blocks have differing column sets")
@@ -651,7 +678,8 @@ def compact_columnar(backend: RawBackend, job: CompactionJob, cfg: CompactorConf
     single_out = len(chunk_lists) == 1
     for cl in chunk_lists:
         bloom = _union_input_blooms(blocks) if single_out else None
-        fin = _assemble(tenant, sources, cl, merged, out_level, cfg.row_group_spans, bloom)
+        fin = _assemble(tenant, sources, cl, merged, out_level,
+                        cfg.row_group_spans, bloom, consume=single_out)
         meta = write_block(backend, fin, level=cfg.level_for(out_level))
         result.new_blocks.append(meta)
         result.traces_out += fin.meta.total_traces
