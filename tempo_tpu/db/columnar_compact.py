@@ -679,7 +679,8 @@ def compact_columnar(backend: RawBackend, job: CompactionJob, cfg: CompactorConf
     for cl in chunk_lists:
         bloom = _union_input_blooms(blocks) if single_out else None
         fin = _assemble(tenant, sources, cl, merged, out_level,
-                        cfg.row_group_spans, bloom, consume=single_out)
+                        cfg.row_group_spans, bloom,
+                        consume=single_out and single_est)
         meta = write_block(backend, fin, level=cfg.level_for(out_level))
         result.new_blocks.append(meta)
         result.traces_out += fin.meta.total_traces

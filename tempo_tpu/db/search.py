@@ -45,6 +45,7 @@ from ..ops.select import (
     select_topk_device,
     select_topk_device_multi,
     select_topk_host,
+    select_topk_host_multi,
 )
 from ..ops.stage import stage_block
 from ..traceql.plan import plan_search_request
@@ -707,11 +708,22 @@ def search_blocks_fused(
         key = _start_key_host(blk)
         n_spans = blk.pack.axes[S.AX_SPAN].n_rows
 
+        if not p.needs_verify:
+            # exact plans skip the per-block escalating collect: ONE
+            # global host selection covers every such block (the host
+            # twin of the fused device select). Key = the cross-block
+            # seconds-granularity gkey (shared definition with the
+            # staged device column); the final merge sorts winners by
+            # exact start_ns anyway.
+            from ..ops.stage import gkey_from_start_ms
+
+            return ("raw", tm, counts, gkey_from_start_ms(blk.meta, key), n_spans)
+
         def selector(k):
             return select_topk_host(tm, key, counts, k)
 
-        return _collect_topk(blk, req, p.needs_verify, selector, limit,
-                             materialize=False), n_spans
+        return ("cand", _collect_topk(blk, req, p.needs_verify, selector, limit,
+                                      materialize=False), n_spans)
 
     # device staging IO + host scans overlap across one pool pass;
     # device kernel dispatches are async, so nothing blocks until the
@@ -735,11 +747,32 @@ def search_blocks_fused(
         run_item(t) for t in tagged
     ]
     evald = [o for tag, o in outs if tag == "dev"]
-    host_out = [o for tag, o in outs if tag == "host"]
+    host_out = [(o, it) for (tag, o), (htag, it) in zip(outs, tagged) if tag == "host"]
 
-    for out, n_spans in host_out:
-        results.extend(out)
+    host_raw: list[tuple] = []
+    for (o, item) in host_out:
+        if o[0] == "cand":
+            _, out, n_spans = o
+            results.extend(out)
+        else:
+            _, tm, counts, gkey, n_spans = o
+            host_raw.append((item[0], item[1], tm, counts, gkey))
         resp.inspected_spans += int(n_spans)
+    if host_raw:
+        h_blocks = [b for b, _, _, _, _ in host_raw]
+        h_plans = [p for _, p, _, _, _ in host_raw]
+        h_tms = [t for _, _, t, _, _ in host_raw]
+        h_cnts = [c for _, _, _, c, _ in host_raw]
+        h_keys = [k for _, _, _, _, k in host_raw]
+        h_offsets = np.cumsum([0] + [int(t.shape[0]) for t in h_tms])
+
+        def h_selector(k):
+            return select_topk_host_multi(h_tms, h_keys, h_cnts, k)
+
+        results.extend(_collect_topk_multi(
+            h_blocks, h_plans, h_offsets, req, h_selector, limit,
+            materialize=False,
+        ))
 
     if evald:
         tms = [e[0] for e in evald]

@@ -103,6 +103,15 @@ def select_topk_device_multi(masks, keys, counts, k: int):
     return gids[valid], cnts[valid], int(out[3 * k])
 
 
+def select_topk_host_multi(masks, keys, counts, k: int):
+    """Host twin of select_topk_device_multi: one global top-k over many
+    blocks' (mask, key, count) vectors. Keys must already be globally
+    comparable (the cross-block gkey convention); returned ids index the
+    concatenation of the parts."""
+    return select_topk_host(
+        np.concatenate(masks), np.concatenate(keys), np.concatenate(counts), k)
+
+
 def select_topk_host(mask: np.ndarray, key: np.ndarray, counts: np.ndarray, k: int):
     """Numpy twin: argpartition + sort, same descending-key order."""
     n = mask.shape[0]

@@ -89,6 +89,17 @@ def _evict_over_budget_locked() -> None:
 # that orders traces ACROSS blocks -- per-block relative ms don't
 GKEY_ORIGIN_S = 1_577_836_800
 
+
+def gkey_from_start_ms(meta, start_ms):
+    """The cross-block top-k ordering key (trace@gkey_s convention):
+    absolute seconds since GKEY_ORIGIN_S, derived from a block's
+    relative start_ms column. ONE definition -- the staged device
+    column and the host raw-select path must order identically."""
+    import numpy as np
+
+    base_s = meta.start_time_unix_nano // 1_000_000_000 - GKEY_ORIGIN_S
+    return np.asarray(start_ms).astype(np.int64) // 1000 + base_s
+
 @jax.jit
 def _res_to_span(res_vals, res_idx):
     """Broadcast a res-axis column to span rows; PAD where no resource."""
@@ -201,10 +212,8 @@ def stage_block(
 
     if want_gkey:
         # derived column: the cross-block top-k ordering key
-        base_s = blk.meta.start_time_unix_nano // 1_000_000_000 - GKEY_ORIGIN_S
-        host["trace@gkey_s"] = (
-            host["trace.start_ms"].astype(np.int64) // 1000 + base_s
-        ).astype(np.int32)
+        host["trace@gkey_s"] = gkey_from_start_ms(
+            blk.meta, host["trace.start_ms"]).astype(np.int32)
         if start_ms_for_gkey_only:
             host.pop("trace.start_ms", None)  # read only to derive the key
 
