@@ -52,25 +52,33 @@ BASELINE_SPANS_PER_SEC = 10.4e6 / 0.18  # reference vParquet search, IO incl.
 _HBM_PEAK_BPS = {"tpu": 819e9, "axon": 819e9}
 
 
+def adaptive_min(sample, base: int, cap: int) -> float:
+    """ONE definition of the stop policy every metric shares: take at
+    least `base` samples, keep sampling while the minimum improves >2%
+    (a noisy patch squeezes real windows out), stop at `cap`.
+    sample() -> seconds for one run."""
+    times: list[float] = []
+    for i in range(cap):
+        dt = sample()
+        improved = not times or dt < min(times) * 0.98
+        times.append(dt)
+        if i + 1 >= base and not improved:
+            break
+    return min(times)
+
+
 def best_window(fn, windows: int = 3, max_windows: int | None = None):
     """Best (minimum) wall time of fn() runs -- timeit's rationale: this
     box is a shared core whose neighbors can eat an entire timing
     window; contention only ever adds time, so the best window measures
-    the engine and the others measure the neighbors. After the minimum
-    `windows` runs, keeps sampling while the best keeps improving >2%
-    (a noisy patch squeezes real windows out), up to 2x the minimum."""
-    if max_windows is None:
-        max_windows = 2 * windows
-    best = None
-    for i in range(max_windows):
+    the engine and the others measure the neighbors."""
+
+    def sample() -> float:
         t0 = time.perf_counter()
         fn()
-        dt = time.perf_counter() - t0
-        improved = best is None or dt < best * 0.98
-        best = dt if best is None else min(best, dt)
-        if i + 1 >= windows and not improved:
-            break
-    return best
+        return time.perf_counter() - t0
+
+    return adaptive_min(sample, windows, max_windows or 2 * windows)
 
 
 def _emit(metric: str, value: float, unit: str, vs_baseline: float) -> None:
@@ -399,28 +407,31 @@ def bench_find_and_search(tmp: str) -> tuple[float, float]:
     # ever ADDS time, so the minimum is the measurement of the engine
     # and the median is a measurement of the neighbors.
     iters = 6
-    cold_times = []
-    for _ in range(iters):
+
+    def cold_sample() -> float:
         dbc = TempoDB(TempoDBConfig(wal_path=tmp + "/wal"), backend=backend)
         dbc.poll_now()
         t0 = time.perf_counter()
         resp = dbc.search("bench", req)
-        cold_times.append(time.perf_counter() - t0)
+        dt = time.perf_counter() - t0
         assert resp.inspected_spans == total_spans
         dbc.close()
-    cold = total_spans / float(np.min(cold_times))
+        return dt
+
+    cold = total_spans / adaptive_min(cold_sample, iters, 2 * iters)
 
     # hot: long-lived readers (the production querier pattern over
     # immutable blocks) => staged device arrays cached; ~one device sync
     # per query. The reference's analog hot path still re-decodes
     # parquet pages from the OS page cache each query.
-    warm_times = []
-    for _ in range(2 * iters):
+    def warm_sample() -> float:
         t0 = time.perf_counter()
         resp = db.search("bench", req)
-        warm_times.append(time.perf_counter() - t0)
+        dt = time.perf_counter() - t0
         assert resp.inspected_spans == total_spans
-    warm = total_spans / float(np.min(warm_times))
+        return dt
+
+    warm = total_spans / adaptive_min(warm_sample, 2 * iters, 4 * iters)
     db.close()
     return cold, warm
 
