@@ -432,6 +432,33 @@ def bench_find_and_search(tmp: str) -> tuple[float, float]:
         return dt
 
     warm = total_spans / adaptive_min(warm_sample, 2 * iters, 4 * iters)
+
+    # --- TraceQL metrics range query over the same 10-block backend
+    # (db/metrics_exec): fused filter->bucketize->fold per block, device
+    # for blocks whose staged columns are already hot. No reference
+    # figure exists (the reference's traceql-metrics shipped unbenched),
+    # so vs_baseline stays 0.0.
+    from tempo_tpu.db.metrics_exec import align_params
+
+    base_s = 1_700_000_000
+    mreq = align_params(
+        '{ span.http.status_code >= 200 } | rate() by(resource.service.name)',
+        base_s, base_s + 3600, 60)
+    mresp = db.metrics_query_range("bench", mreq)
+    assert mresp.series, "metrics bench query matched nothing"
+    total_counted = sum(int(s["count"].sum()) for s in mresp.series.values())
+    assert total_counted > 0
+
+    def metrics_sample() -> float:
+        t0 = time.perf_counter()
+        r = db.metrics_query_range("bench", mreq)
+        dt = time.perf_counter() - t0
+        assert r.inspected_spans == total_spans
+        return dt
+
+    msec = adaptive_min(metrics_sample, 4, 10)
+    _emit("metrics_query_range_spans_per_sec", total_spans / msec, "spans/s", 0.0)
+
     db.close()
     return cold, warm
 

@@ -505,6 +505,12 @@ class ColumnPack:
     def has(self, name: str) -> bool:
         return name in self._cols
 
+    def n_rows_of(self, name: str) -> int:
+        """Row count of a column from footer metadata alone -- no chunk
+        IO (pre-read budget estimates)."""
+        meta = self._cols.get(name)
+        return int(meta["shape"][0]) if meta else 0
+
     def _cache_get(self, off: int) -> bytes | None:
         with self._cache_lock:
             hit = self._cache.get(off)

@@ -71,6 +71,25 @@ def cmd_search(args):
     print(json.dumps({"traces": [t.to_dict() for t in resp.traces]}, indent=2))
 
 
+def cmd_query_range(args):
+    """Offline TraceQL metrics over a backend path: the CLI face of
+    /api/metrics/query_range (db/metrics_exec), Prometheus matrix JSON
+    on stdout."""
+    import time
+
+    from ..db.metrics_exec import align_params, to_prometheus
+
+    db = _open_db(args.backend)
+    try:
+        end = args.end if args.end is not None else time.time()
+        start = args.start if args.start is not None else end - 3600.0
+        req = align_params(args.q, start, end, args.step)
+        resp = db.metrics_query_range(args.tenant, req)
+    finally:
+        db.close()
+    print(json.dumps(to_prometheus(resp), indent=2))
+
+
 def cmd_gen(args):
     """Generate a synthetic block (bench/test fixture)."""
     from ..util.testdata import make_traces
@@ -209,6 +228,16 @@ def main(argv=None):
     p.add_argument("-q", help="TraceQL query")
     p.add_argument("--limit", type=int, default=20)
     p.set_defaults(fn=cmd_search)
+
+    p = sub.add_parser("query-range",
+                       help="TraceQL metrics range query against the backend")
+    p.add_argument("tenant")
+    p.add_argument("-q", required=True,
+                   help='metrics query, e.g. \'{ span.foo = "bar" } | rate() by(resource.service.name)\'')
+    p.add_argument("--start", type=float, default=None, help="unix seconds (default: end-3600)")
+    p.add_argument("--end", type=float, default=None, help="unix seconds (default: now)")
+    p.add_argument("--step", type=float, default=60.0, help="step seconds")
+    p.set_defaults(fn=cmd_query_range)
 
     p = sub.add_parser("gen", help="generate a synthetic block")
     p.add_argument("tenant")

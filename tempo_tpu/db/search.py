@@ -938,6 +938,17 @@ def _search_group_device(items, tree, conds, req: SearchRequest, mesh, resp: Sea
     # stacked-bytes estimate BEFORE any column IO, per-axis products: an
     # over-budget group must fall back without paying the cold reads
     est = S_b * max(1, len(span_cols))
+    # trace-axis tables (span_off at NT_b+1 plus any trace.* conds) and
+    # res-axis columns ride every block too; their row counts come from
+    # footer metadata (pack.n_rows_of), so trace-heavy groups near the
+    # budget are no longer understated (ADVICE round 5)
+    n_trace_cols = sum(1 for n in needed if n.startswith("trace."))
+    est += NT_b * n_trace_cols
+    res_cols = [n for n in needed if n.startswith("res.")]
+    if res_cols:
+        r_rows = max((blk.pack.n_rows_of(n) for blk, _ in items for n in res_cols),
+                     default=1)
+        est += bucket(max(r_rows, 1)) * len(res_cols)
     for pre, a_b in attr_b.items():
         n_val_cols = sum(
             1 for n in needed if n.startswith(f"{pre}.") and not n.endswith((".span", ".res"))

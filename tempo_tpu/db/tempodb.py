@@ -238,6 +238,24 @@ class TempoDB:
         """One sharded search job (frontend's StartPage/TotalPages analog)."""
         return search_block(self.open_block(meta), req, groups_range=groups_range)
 
+    # ------------------------------------------------------------ metrics
+    def metrics_query_range(self, tenant: str, req) -> "object":
+        """TraceQL metrics range query over the backend blocklist
+        (db/metrics_exec): per-block fused filter->bucketize->fold on
+        device or host by temperature, partial series merged by label;
+        the stacked mesh fold takes over on multi-chip."""
+        from .metrics_exec import MetricsRequest, metrics_query_range_blocks
+
+        assert isinstance(req, MetricsRequest)
+        start_s, end_s = req.start_ms // 1000, -(-req.end_ms // 1000)
+        metas = [m for m in self.blocklist.metas(tenant)
+                 if m.overlaps_time(start_s, end_s)]
+        blocks = [self.open_block(m) for m in metas]
+        mesh = (self.mesh if self.cfg.device_search
+                and self.mesh.devices.size > 1 else None)
+        return metrics_query_range_blocks(
+            blocks, req, pool=self.io_pool, mesh=mesh)
+
     def search_tags(self, tenant: str, max_bytes: int = 0) -> list[str]:
         c = DistinctStringCollector(max_bytes)
         for m in self.blocklist.metas(tenant):

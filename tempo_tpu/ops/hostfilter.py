@@ -155,6 +155,53 @@ def _struct_mask_np(op: str, lm: np.ndarray, rm: np.ndarray,
     return (rm & has_p & (sibs > 0)) | (rm & orphan & bool((lm & orphan).any()))
 
 
+def eval_span_mask_host(
+    query,
+    cols: dict[str, np.ndarray],
+    operands: Operands,
+    n_spans: int,
+    n_traces: int,
+) -> np.ndarray:
+    """SPAN-level mask of a raw (un-lifted) condition tree -- the host
+    engine of the metrics path (db/metrics_exec): no tracify nodes, no
+    trace-level output. Trace-target conds evaluate on the trace axis
+    and gather to spans through span.trace_sid (a span inherits its
+    trace's truth value). Returns a bool (n_spans,) mask with the same
+    conservative-encoding semantics as the search engines."""
+    tree, conds = query
+    if tree is None:
+        return np.ones(n_spans, dtype=bool)
+    tables = operands.tables or {}
+    ops_i, ops_f = operands.ints, operands.floats
+    n_res = 0
+    for n, a in cols.items():
+        if n.startswith("res."):
+            n_res = max(n_res, a.shape[0])
+    tsid = cols.get("span.trace_sid")
+
+    def ev(t):
+        if t == ("true",):
+            return np.ones(n_spans, dtype=bool)
+        if t == ("false",):
+            return np.zeros(n_spans, dtype=bool)
+        if t[0] == "cond":
+            i = t[1]
+            c = conds[i]
+            if c.target == T_TRACE:
+                tm = _cmp_np(c.op, cols[c.col], int(ops_i[i, 1]), int(ops_i[i, 2]),
+                             float(ops_f[i, 0]), float(ops_f[i, 1]), c.is_float,
+                             tables.get(i))
+                return _lut_gather(np.asarray(tm, dtype=bool), tsid)
+            return _cond_mask_np(c, i, cols, ops_i, ops_f, tables, n_spans, n_res)
+        ms = [ev(ch) for ch in t[1:]]
+        out = ms[0]
+        for m in ms[1:]:
+            out = (out & m) if t[0] == "and" else (out | m)
+        return out
+
+    return ev(tree) & np.ones(n_spans, dtype=bool)
+
+
 def eval_block_host(
     query,
     cols: dict[str, np.ndarray],

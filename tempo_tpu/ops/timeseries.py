@@ -1,0 +1,180 @@
+"""Time-bucketed segmented reductions: the TraceQL-metrics kernel.
+
+One fused device pass per (block, query): evaluate the span-level
+predicate tree (the same data-driven condition machinery as ops/filter,
+so `{span.foo = "bar"} | rate()` and `{span.foo = "baz"} | rate()`
+share a compiled program), bucketize each surviving span's start time
+onto the request's step-aligned axis, and fold into
+`[num_groups, num_buckets]` accumulators with one segment reduce over a
+combined (group, bucket) index -- the same combined-index trick the
+span-metrics generator reduce uses (ops/reduce.py histogram scatter).
+
+Only the tree/condition STRUCTURE and the padded (groups, buckets)
+shapes key the jit compile; operand values, group ids, value columns
+and the time origin are traced, so the program is shared across blocks
+and across steps/ranges of the same query shape.
+
+Group ids arrive as a per-span int32 column computed host-side from the
+by() field's dictionary codes (db/metrics_exec) -- group-key resolution
+is per-block (each block has its own dictionary), the kernel only ever
+sees dense ids in [0, num_groups). -1 drops the span (missing label).
+
+Value folds (`min/avg/sum/max_over_time(field)`) take a per-span f32
+value + presence mask derived host-side from the EXACT host columns
+(sattr.int64/f64, span.start_ns/end_ns), so the only device-side loss
+is the f32 cast -- integer counts are exact on both engines.
+
+The host twin (eval_timeseries_host) mirrors the semantics in numpy
+over raw columns (f64 accumulation) for cold blocks; exact-verify
+queries bypass both engines entirely (db/metrics_exec exact path).
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .device import bucket, pad_rows
+from .filter import Cond, Operands, T_TRACE, _cmp, _cond_mask
+from .hostfilter import eval_span_mask_host
+
+
+@lru_cache(maxsize=256)
+def _compiled_ts(tree, conds: tuple[Cond, ...], table_idxs: tuple[int, ...],
+                 has_val: bool, n_spans_b: int, n_res_b: int, n_traces_b: int,
+                 G_b: int, B_b: int):
+    """tree: raw SPAN-level expression (no tracify); None matches all.
+    Trace-target conds gather through span.trace_sid."""
+
+    @jax.jit
+    def run(cols, ops_i, ops_f, table_list, gid, val, vpres,
+            t0_ms, step_ms, n_spans, n_buckets):
+        tables = dict(zip(table_idxs, table_list))
+        valid = jnp.arange(n_spans_b, dtype=jnp.int32) < n_spans
+
+        def ev(t):
+            if t == ("true",):
+                return valid
+            if t == ("false",):
+                return jnp.zeros_like(valid)
+            if t[0] == "cond":
+                i = t[1]
+                c = conds[i]
+                if c.target == T_TRACE:
+                    tm = _cmp(c.op, cols[c.col], ops_i[i, 1], ops_i[i, 2],
+                              ops_f[i, 0], ops_f[i, 1], c.is_float,
+                              tables.get(i))
+                    sid = jnp.clip(cols["span.trace_sid"], 0, n_traces_b - 1)
+                    return tm[sid] & valid
+                return _cond_mask(c, i, cols, ops_i, ops_f, tables,
+                                  n_spans_b, n_res_b, valid)
+            ms = [ev(ch) for ch in t[1:]]
+            out = ms[0]
+            for m in ms[1:]:
+                out = (out & m) if t[0] == "and" else (out | m)
+            return out
+
+        sm = valid if tree is None else (ev(tree) & valid)
+        # int32 bucket math (x64 stays off): the caller clips t0 into
+        # int32, and blocks span hours, not the ~24-day int32-ms range
+        b = (cols["span.start_ms"] - t0_ms) // step_ms
+        ok = sm & (b >= 0) & (b < n_buckets) & (gid >= 0)
+        b32 = jnp.clip(b, 0, B_b - 1).astype(jnp.int32)
+        seg = jnp.where(ok, gid * B_b + b32, G_b * B_b)
+        nseg = G_b * B_b + 1
+        counts = jax.ops.segment_sum(ok.astype(jnp.int32), seg,
+                                     num_segments=nseg)[:-1].reshape(G_b, B_b)
+        if not has_val:
+            return (counts,)
+        pres = ok & vpres
+        segv = jnp.where(pres, seg, G_b * B_b)
+        vcnt = jax.ops.segment_sum(pres.astype(jnp.int32), segv,
+                                   num_segments=nseg)[:-1].reshape(G_b, B_b)
+        v = jnp.where(pres, val, jnp.float32(0))
+        vsum = jax.ops.segment_sum(v, segv, num_segments=nseg)[:-1].reshape(G_b, B_b)
+        vmin = jax.ops.segment_min(
+            jnp.where(pres, val, jnp.float32(jnp.inf)), segv,
+            num_segments=nseg)[:-1].reshape(G_b, B_b)
+        vmax = jax.ops.segment_max(
+            jnp.where(pres, val, jnp.float32(-jnp.inf)), segv,
+            num_segments=nseg)[:-1].reshape(G_b, B_b)
+        return counts, vcnt, vsum, vmin, vmax
+
+    return run
+
+
+def _table_list(operands: Operands):
+    tables = operands.tables or {}
+    table_idxs = tuple(sorted(tables))
+    return table_idxs, [
+        pad_rows(np.asarray(tables[i], dtype=np.uint8),
+                 bucket(max(1, len(tables[i]))), 0)
+        for i in table_idxs
+    ]
+
+
+def eval_timeseries_device(query, staged, operands: Operands,
+                           gid: np.ndarray, val: np.ndarray | None,
+                           vpres: np.ndarray | None,
+                           t0_rel_ms: int, step_ms: int,
+                           n_buckets: int, n_groups: int):
+    """One fused device dispatch over a StagedBlock (ops/stage).
+    gid/val/vpres are raw span-length host arrays for the staged span
+    slice; the padded uploads ride the jit call's batched transfer.
+    Returns numpy accumulators clipped to (n_groups, n_buckets):
+    (counts,) or (counts, vcnt, vsum, vmin, vmax)."""
+    tree, conds = query
+    G_b, B_b = bucket(max(n_groups, 1)), bucket(max(n_buckets, 1))
+    table_idxs, tabs = _table_list(operands)
+    has_val = val is not None
+    fn = _compiled_ts(tree, conds, table_idxs, has_val,
+                      staged.n_spans_b, staged.n_res_b, staged.n_traces_b,
+                      G_b, B_b)
+    gid_p = pad_rows(np.asarray(gid, np.int32), staged.n_spans_b, np.int32(-1))
+    if has_val:
+        val_p = pad_rows(np.asarray(val, np.float32), staged.n_spans_b,
+                         np.float32(0))
+        pres_p = pad_rows(np.asarray(vpres, bool), staged.n_spans_b, False)
+    else:
+        val_p = pres_p = np.zeros(0, np.float32)
+    t0 = int(np.clip(t0_rel_ms, -(2**31) + 1, 2**31 - 1))
+    outs = fn(staged.cols, operands.ints, operands.floats, tabs,
+              gid_p, val_p, pres_p,
+              np.int32(t0), np.int32(max(1, step_ms)),
+              np.int32(staged.n_spans), np.int32(n_buckets))
+    return tuple(np.asarray(o)[:n_groups, :n_buckets] for o in outs)
+
+
+def eval_timeseries_host(query, cols: dict[str, np.ndarray],
+                         operands: Operands, n_spans: int, n_traces: int,
+                         gid: np.ndarray, val: np.ndarray | None,
+                         vpres: np.ndarray | None,
+                         t0_rel_ms: int, step_ms: int,
+                         n_buckets: int, n_groups: int):
+    """Numpy twin of the device kernel over RAW host columns (the cold-
+    block engine): same masks, same bucketing, f64 value accumulation.
+    Returns the same accumulator tuple shapes as the device path."""
+    sm = eval_span_mask_host(query, cols, operands, n_spans, n_traces)
+    b = (cols["span.start_ms"].astype(np.int64) - int(t0_rel_ms)) // int(step_ms)
+    ok = sm & (b >= 0) & (b < n_buckets) & (gid >= 0)
+    nb = int(n_buckets)
+    key = gid.astype(np.int64) * nb + np.clip(b, 0, nb - 1)
+    nk = max(n_groups, 1) * nb
+    counts = np.bincount(key[ok], minlength=nk)[:nk].reshape(-1, nb)
+    counts = counts[:n_groups]
+    if val is None:
+        return (counts,)
+    pres = ok & vpres
+    kp = key[pres]
+    vcnt = np.bincount(kp, minlength=nk)[:nk].reshape(-1, nb)[:n_groups]
+    vv = val.astype(np.float64)[pres]
+    vsum = np.bincount(kp, weights=vv, minlength=nk)[:nk].reshape(-1, nb)[:n_groups]
+    vmin = np.full(nk, np.inf)
+    vmax = np.full(nk, -np.inf)
+    np.minimum.at(vmin, kp, vv)
+    np.maximum.at(vmax, kp, vv)
+    return (counts, vcnt, vsum,
+            vmin.reshape(-1, nb)[:n_groups], vmax.reshape(-1, nb)[:n_groups])

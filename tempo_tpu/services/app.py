@@ -553,6 +553,8 @@ def _make_handler(app: App):
                     return self._send(200, json.dumps(trace_to_jaeger(tr)))
                 if u.path == "/api/search":
                     return self._search(tenant, q)
+                if u.path == "/api/metrics/query_range":
+                    return self._metrics_query_range(tenant, q)
                 if u.path == "/api/search/tags":
                     tags = app.querier.search_tags(tenant)
                     return self._send(200, json.dumps({"tagNames": tags}))
@@ -577,6 +579,55 @@ def _make_handler(app: App):
                 return self._err(404, "trace not found")
             return self._send(200, otlp_json.dumps(tr))
 
+        def _metrics_query_range(self, tenant: str, q: dict):
+            """GET /api/metrics/query_range?q=...&start=...&end=...&step=...
+            -- TraceQL metrics over the backend (Prometheus-style matrix
+            JSON; start/end unix seconds, step a Go duration or
+            seconds). The step grid is aligned (metrics_exec
+            align_params), so any client polling cadence yields stable
+            buckets."""
+            from ..db.metrics_exec import (
+                align_params,
+                parse_metrics_query,
+                to_prometheus,
+            )
+            from ..traceql.ast import ParseError
+            from ..traceql.parser import _parse_duration_ns
+
+            query = q.get("q") or q.get("query", "")
+            if not query:
+                return self._err(400, "missing q parameter")
+            try:
+                parse_metrics_query(query)
+            except ParseError as e:
+                return self._err(400, f"invalid TraceQL metrics query: {e}")
+            try:
+                end = float(q["end"]) if "end" in q else time.time()
+                start = float(q["start"]) if "start" in q else end - 3600.0
+                if end <= start:
+                    raise ValueError("end must be after start")
+                sv = q.get("step", "")
+                if sv:
+                    try:
+                        step = float(sv)
+                    except ValueError:
+                        step = _parse_duration_ns(sv) / 1e9
+                    if step <= 0:
+                        raise ValueError(f"invalid step {sv!r}")
+                else:
+                    # default: ~60 points over the range, 1s floor
+                    step = max(1.0, round((end - start) / 60.0))
+                req = align_params(query, start, end, step)
+            except (ValueError, OverflowError) as e:
+                return self._err(400, f"bad query_range parameter: {e}")
+            try:
+                resp = app.frontend.metrics_query_range(tenant, req)
+            except ValueError as e:
+                # execution-time request errors (e.g. by() cardinality
+                # over the accumulator budget) are the caller's to fix
+                return self._err(400, f"query_range failed: {e}")
+            return self._send(200, json.dumps(to_prometheus(resp)))
+
         def _search(self, tenant: str, q: dict):
             tags = {}
             if "tags" in q:  # logfmt-ish k=v space separated
@@ -588,13 +639,17 @@ def _make_handler(app: App):
             if query:
                 # parse + type-check once at the API boundary so a bad
                 # query is a 400, not a per-block failure downstream
-                from ..traceql.ast import ParseError
+                from ..traceql.ast import MetricsQuery, ParseError
                 from ..traceql.parser import parse as parse_traceql
 
                 try:
-                    parse_traceql(query)
+                    parsed = parse_traceql(query)
                 except ParseError as e:
                     return self._err(400, f"invalid TraceQL: {e}")
+                if isinstance(parsed, MetricsQuery):
+                    return self._err(
+                        400, "metrics queries (rate(), *_over_time()) belong "
+                             "on /api/metrics/query_range, not /api/search")
             def dur_ms(name: str) -> int:
                 """Go-style duration params ('300ms', '1m30s', '2h') per
                 the reference's time.ParseDuration-based API
@@ -799,6 +854,7 @@ def _metrics_text(app: App) -> str:
         lines += [
             f"tempo_querier_searches_total {q.searches}",
             f"tempo_querier_traces_found_total {q.traces_found}",
+            f"tempo_querier_metrics_queries_total {q.metrics_queries}",
             f"tempo_querier_external_searches_total {q.external_searches}",
             f"tempo_querier_external_failures_total {q.external_failures}",
         ]
@@ -858,7 +914,10 @@ def load_config_file(path: str, expand_env: bool = False) -> dict:
     strict YAML. expand_env substitutes ${VAR} / ${VAR:-default}
     references BEFORE parsing (the reference's --config.expand-env,
     cmd/tempo/main.go envsubst) -- the secrets-from-environment pattern
-    for credentials in checked-in config files."""
+    for credentials in checked-in config files. Names follow the shell
+    grammar [A-Za-z_]\\w* (anything else passes through verbatim), and
+    `$$` escapes a literal dollar, so a value that legitimately
+    contains ${...} is written `$${...}` -- envsubst behavior."""
     import yaml
     from dataclasses import fields as dc_fields
 
@@ -869,6 +928,10 @@ def load_config_file(path: str, expand_env: bool = False) -> dict:
         import re as _re
 
         def sub(m):
+            if m.group(0) == "$$":
+                # envsubst escape: $$ -> literal $, so $${FOO} survives
+                # expansion as the literal text ${FOO}
+                return "$"
             ref = m.group(1)
             name, has_def, default = ref.partition(":-")
             val = _os.environ.get(name)
@@ -883,7 +946,11 @@ def load_config_file(path: str, expand_env: bool = False) -> dict:
                     f"(use ${{{name}:-default}} for an optional value)")
             return val
 
-        text = _re.sub(r"\$\{([A-Za-z_][A-Za-z0-9_]*(?::-[^}]*)?)\}", sub, text)
+        # one alternation pass: the $$ alternative consumes its dollars
+        # BEFORE the ${...} branch can see them, which is exactly the
+        # escape semantics (names outside [A-Za-z_]\w* never match and
+        # pass through verbatim)
+        text = _re.sub(r"\$\$|\$\{([A-Za-z_]\w*(?::-[^}]*)?)\}", sub, text)
     data = yaml.safe_load(text) or {}
     valid = {f.name for f in dc_fields(AppConfig)}
     unknown = set(data) - valid - {"ingester"}

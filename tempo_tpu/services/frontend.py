@@ -133,6 +133,10 @@ class _Job:
 
 def decode_job_result(kind: str, out: dict):
     """Wire result -> the object the local closure would have returned."""
+    if kind == "metrics_query_range":
+        from ..db.metrics_exec import response_from_dict as metrics_response_from_dict
+
+        return metrics_response_from_dict(out)
     if kind.startswith("search"):
         return response_from_dict(out)
     tr = out.get("trace")
@@ -507,6 +511,72 @@ class Frontend:
             self._emit_self_trace(jobs, trace)
         resp.traces.sort(key=lambda r: -r.start_time_unix_nano)
         resp.traces = resp.traces[:limit]
+        return resp
+
+    # ------------------------------------------------------------ metrics
+    METRICS_BUCKETS_PER_JOB = 64  # time-shard unit of /api/metrics/query_range
+
+    def metrics_query_range(self, tenant: str, req):
+        """Time-sharded metrics range query: the step-aligned bucket
+        axis splits into sub-range jobs (the metrics analog of the
+        reference's searchsharding time splits), each executed by a
+        local worker or a remote querier pull, partial series merged by
+        label -- alignment to one global grid makes the shard merge
+        exact (metrics_exec.align_params)."""
+        from ..util.metrics import timed
+
+        with timed(self.query_latency, 'op="metrics"'):
+            if self.self_tracer is None or tenant == self.self_tracer.tenant:
+                return self._metrics_query_range(tenant, req)
+            with self.self_tracer.trace(
+                "frontend.metrics_query_range", {"tenant": tenant, "q": req.query}
+            ) as t:
+                return self._metrics_query_range(tenant, req, trace=t)
+
+    def _metrics_query_range(self, tenant: str, req, trace=None):
+        from ..db.metrics_exec import (
+            MetricsRequest,
+            MetricsResponse,
+            expr_label,
+            parse_metrics_query,
+            request_to_dict as metrics_request_to_dict,
+        )
+
+        q = parse_metrics_query(req.query)  # ParseError -> 400 at the API
+        nb = req.n_buckets
+        n_jobs = max(1, -(-nb // self.METRICS_BUCKETS_PER_JOB))
+        if nb >= 2 and n_jobs < 2:
+            n_jobs = 2  # the shard/merge path is the production path: keep it hot
+        per_job = -(-nb // n_jobs)
+        jobs: list[_Job] = []
+        for lo in range(0, nb, per_job):
+            hi = min(lo + per_job, nb)
+            sub = MetricsRequest(
+                query=req.query,
+                start_ms=req.start_ms + lo * req.step_ms,
+                end_ms=req.start_ms + hi * req.step_ms,
+                step_ms=req.step_ms,
+            )
+            jobs.append(_Job(
+                kind="metrics_query_range",
+                payload={"req": metrics_request_to_dict(sub)},
+                fn=self.querier.metrics_query_range, args=(tenant, sub),
+            ))
+        self._run_jobs(tenant, jobs)
+        if trace is not None:
+            self._emit_self_trace(jobs, trace)
+        resp = MetricsResponse(
+            fn=q.agg.fn, start_ms=req.start_ms, step_ms=req.step_ms,
+            n_buckets=nb,
+            label_names=tuple(expr_label(e, i) for i, e in enumerate(q.agg.by)),
+        )
+        for j in jobs:
+            if j.error is not None:
+                # a lost time shard would silently zero part of every
+                # series: fail the request (same rule as find shards)
+                raise j.error
+            if j.result is not None:
+                resp.merge(j.result)
         return resp
 
     def _group_chunks(self, meta) -> list[list[int]]:

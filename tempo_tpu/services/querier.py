@@ -22,6 +22,7 @@ from ..wire.model import Trace
 class QuerierStats:
     traces_found: int = 0
     searches: int = 0
+    metrics_queries: int = 0  # metrics_query_range jobs executed
     external_searches: int = 0  # shard jobs served by serverless endpoints
     external_failures: int = 0  # external legs that fell back to local
 
@@ -213,6 +214,15 @@ class Querier:
         round trip."""
         self.stats.searches += 1
         return self.db.search_blocks(tenant, metas, req)
+
+    def metrics_query_range(self, tenant: str, req):
+        """One metrics time-shard job: a step-aligned sub-range of the
+        query_range axis, executed over the backend blocklist
+        (db/metrics_exec). Recent unflushed data lives in the ingester
+        WAL and is not yet visible to metrics (same contract as the
+        reference's initial traceql-metrics: blocks only)."""
+        self.stats.metrics_queries += 1
+        return self.db.metrics_query_range(tenant, req)
 
     def find_in_blocks(self, tenant: str, trace_id: bytes, metas: list):
         """One frontend ID-shard job: lookup restricted to a partition

@@ -46,6 +46,14 @@ def execute_job(querier: Querier, tenant: str, kind: str, payload: dict) -> dict
         return response_to_dict(
             querier.search_block_shard(tenant, metas[0], req, payload["groups"])
         )
+    if kind == "metrics_query_range":
+        from ..db.metrics_exec import (
+            request_from_dict as metrics_request_from_dict,
+            response_to_dict as metrics_response_to_dict,
+        )
+
+        mreq = metrics_request_from_dict(payload["req"])
+        return metrics_response_to_dict(querier.metrics_query_range(tenant, mreq))
     if kind == "find_recent":
         tr = querier.find_trace_by_id(
             tenant, bytes.fromhex(payload["trace_id"]), query_backend=False

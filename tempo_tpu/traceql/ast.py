@@ -170,6 +170,38 @@ class ScalarPipeline:
     scalar: "Scalar"
 
 
+# metrics pipeline stages (the reference's TraceQL-metrics surface,
+# traceql/ast.go metricsAggregate): terminal stages turning a spanset
+# pipeline into step-aligned time series
+METRICS_FNS = ("rate", "count_over_time", "min_over_time", "max_over_time",
+               "avg_over_time", "sum_over_time")
+# which metrics fns take a fieldExpression argument
+METRICS_FIELD_FNS = ("min_over_time", "max_over_time", "avg_over_time",
+                     "sum_over_time")
+
+
+@dataclass(frozen=True)
+class MetricsAggregate:
+    """A terminal metrics stage: `rate()`, `count_over_time()`,
+    `min/max/avg/sum_over_time(fieldExpr)`, each with an optional
+    `by(fieldExpr, ...)` grouping clause."""
+
+    fn: str  # one of METRICS_FNS
+    field: "Expr | None"  # argument (None for rate/count_over_time)
+    by: tuple = ()  # grouping field expressions
+
+
+@dataclass(frozen=True)
+class MetricsQuery:
+    """`{ ... } | ... | rate() by(...)`: a spanset pipeline terminated
+    by a metrics aggregate. Only valid on the metrics endpoints
+    (/api/metrics/query_range); the search planner rejects it."""
+
+    filter: "PipelineExpr"  # the spanset pipeline ahead of the stage
+    stages: tuple  # intermediate pipeline stages (usually empty)
+    agg: MetricsAggregate
+
+
 Scalar = Union[Aggregate, Static, ScalarOp, ScalarPipeline]
 
 

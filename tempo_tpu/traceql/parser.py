@@ -51,6 +51,10 @@ from .ast import (
     INTRINSICS,
     KIND_NAMES,
     LogicalExpr,
+    METRICS_FIELD_FNS,
+    METRICS_FNS,
+    MetricsAggregate,
+    MetricsQuery,
     ParseError,
     Pipeline,
     Scalar,
@@ -70,7 +74,7 @@ _TOKEN_RE = re.compile(
   | (?P<string>"(?:[^"\\]|\\.)*"|`[^`]*`)
   | (?P<duration>\d+(?:\.\d+)?(?:ns|us|µs|ms|s|m|h)(?:\d+(?:\.\d+)?(?:ns|us|µs|ms|s|m|h))*)
   | (?P<number>\d+(?:\.\d+)?)
-  | (?P<op>=~|!~|!=|<=|>=|>>|&&|\|\||[{}()=<>.|~+\-*/%^!])
+  | (?P<op>=~|!~|!=|<=|>=|>>|&&|\|\||[{}()=<>.|~+\-*/%^!,])
   | (?P<ident>[a-zA-Z_][a-zA-Z0-9_./-]*)
 """,
     re.VERBOSE,
@@ -165,15 +169,25 @@ class _Parser:
     def parse_pipeline_chain(self):
         lhs = self.parse_pipeline_structural()
         while self.peek()[1] in ("&&", "||"):
+            if isinstance(lhs, MetricsQuery):
+                raise ParseError("metrics pipelines cannot be combined")
             _, op = self.next()
-            lhs = SpansetOp(op, lhs, self.parse_pipeline_structural())
+            rhs = self.parse_pipeline_structural()
+            if isinstance(rhs, MetricsQuery):
+                raise ParseError("metrics pipelines cannot be combined")
+            lhs = SpansetOp(op, lhs, rhs)
         return lhs
 
     def parse_pipeline_structural(self):
         lhs = self.parse_pipeline_term()
         while self.peek()[1] in (">", ">>", "~"):
+            if isinstance(lhs, MetricsQuery):
+                raise ParseError("metrics pipelines cannot be combined")
             _, op = self.next()
-            lhs = SpansetOp(op, lhs, self.parse_pipeline_term())
+            rhs = self.parse_pipeline_term()
+            if isinstance(rhs, MetricsQuery):
+                raise ParseError("metrics pipelines cannot be combined")
+            lhs = SpansetOp(op, lhs, rhs)
         return lhs
 
     def parse_pipeline_term(self):
@@ -195,6 +209,7 @@ class _Parser:
                                  allow_scalar_tail=False)
         stages.append(first)
         scalar_tail: Scalar | None = None
+        metrics_agg: MetricsAggregate | None = None
         while self.peek()[1] == "|":
             self.next()
             last_ok = allow_scalar_tail
@@ -203,7 +218,19 @@ class _Parser:
             if isinstance(st, tuple) and st[0] == "scalar_tail":
                 scalar_tail = st[1]
                 break
+            if isinstance(st, MetricsAggregate):
+                # terminal by construction: nothing may follow the stage
+                if self.peek()[1] == "|":
+                    raise ParseError(
+                        f"{st.fn}() must be the final pipeline stage")
+                metrics_agg = st
+                break
             stages.append(st)
+        if metrics_agg is not None:
+            q = self._stages_to_query(stages)
+            if isinstance(q, Pipeline):
+                return MetricsQuery(q.filter, q.stages, metrics_agg)
+            return MetricsQuery(q, (), metrics_agg)
         if scalar_tail is not None:
             filt = self._stages_to_query(stages)
             return ScalarPipeline(filt, scalar_tail)
@@ -228,6 +255,11 @@ class _Parser:
             e = self.parse_or()
             self.expect(")")
             return GroupBy(e)
+        if kind == "ident" and val in METRICS_FNS and self.peek(1)[1] == "(":
+            if first:
+                raise ParseError(
+                    f"{val}() needs a spanset pipeline ahead of it")
+            return self.parse_metrics_stage(val)
         if kind == "ident" and val == "coalesce" and self.peek(1)[1] == "(":
             if first:
                 raise ParseError("pipelines can't start with coalesce()")
@@ -249,6 +281,32 @@ class _Parser:
         raise ParseError(
             "naked scalar pipelines not allowed (scalar stages must compare)"
         )
+
+    def parse_metrics_stage(self, fn: str) -> MetricsAggregate:
+        """`rate() | count_over_time() | <fn>_over_time(fieldExpr)`, each
+        with an optional trailing `by(fieldExpr, ...)` clause."""
+        self.next()  # fn ident
+        self.expect("(")
+        arg = None
+        if self.peek()[1] != ")":
+            if fn not in METRICS_FIELD_FNS:
+                raise ParseError(f"{fn}() takes no argument")
+            arg = self.parse_or()
+        elif fn in METRICS_FIELD_FNS:
+            raise ParseError(f"{fn}() needs a field expression argument")
+        self.expect(")")
+        by: list = []
+        if self.peek()[1] == "by" and self.peek(1)[1] == "(":
+            self.next()
+            self.expect("(")
+            if self.peek()[1] == ")":
+                raise ParseError("by() needs at least one field expression")
+            by.append(self.parse_or())
+            while self.peek()[1] == ",":
+                self.next()
+                by.append(self.parse_or())
+            self.expect(")")
+        return MetricsAggregate(fn=fn, field=arg, by=tuple(by))
 
     # spansetExpression: combinators over braced spansets; parens here
     # wrap spanset expressions only (stage-level grammar)
@@ -537,9 +595,10 @@ class _Parser:
 
 
 def parse(src: str):
-    """-> SpansetFilter | SpansetOp | Pipeline. Parses the full expr.y
-    surface and runs the reference's validate() analog; both failure
-    modes raise ParseError subclasses."""
+    """-> SpansetFilter | SpansetOp | Pipeline | MetricsQuery. Parses
+    the full expr.y surface plus the TraceQL-metrics stages (rate(),
+    *_over_time() with by(...)) and runs the reference's validate()
+    analog; both failure modes raise ParseError subclasses."""
     q = _Parser(tokenize(src)).parse_query()
     from .validate import validate
 

@@ -27,6 +27,9 @@ from .ast import (
     Field,
     GroupBy,
     LogicalExpr,
+    METRICS_FIELD_FNS,
+    MetricsAggregate,
+    MetricsQuery,
     ParseError,
     Pipeline,
     ScalarFilter,
@@ -198,8 +201,39 @@ def _validate_scalar_filter(sf: ScalarFilter) -> None:
     _check_cmp(sf.op, lt, rt)
 
 
+def _validate_metrics(agg: MetricsAggregate) -> None:
+    """Metrics-stage typing: *_over_time(field) arguments follow the
+    scalar-aggregate rules (numeric, span-referencing); by() expressions
+    must reference span data (same rule as pipeline by())."""
+    if agg.field is not None:
+        t = _expr_type(agg.field)
+        if t not in _NUMERIC:
+            raise ValidationError(f"{agg.fn}() needs a numeric argument, got {t}")
+        if not _references_span(agg.field):
+            raise ValidationError(f"{agg.fn}() must reference span data")
+    elif agg.fn in METRICS_FIELD_FNS:
+        raise ValidationError(f"{agg.fn}() needs a field expression argument")
+    for e in agg.by:
+        _expr_type(e)
+        if not _references_span(e):
+            raise ValidationError("by() must reference span data")
+
+
 def validate(q) -> None:
     """Raises ValidationError when the parsed query is ill-typed."""
+    if isinstance(q, MetricsQuery):
+        validate(q.filter)
+        for st in q.stages:
+            if isinstance(st, (SpansetFilter, SpansetOp)):
+                validate(st)
+            elif isinstance(st, ScalarFilter):
+                _validate_scalar_filter(st)
+            elif isinstance(st, GroupBy):
+                _expr_type(st.expr)
+            elif not isinstance(st, Coalesce):
+                raise ValidationError(f"unknown pipeline stage {st!r}")
+        _validate_metrics(q.agg)
+        return
     if isinstance(q, SpansetFilter):
         if q.expr is not None:
             t = _expr_type(q.expr)

@@ -144,15 +144,29 @@ class _CompactReader(_Reader):
             n, et = hdr >> 4, hdr & 0xF
             if n == 0xF:
                 n = self.varint()
-            return [self.read(et) for _ in range(n)]
+            return [self._read_elem(et) for _ in range(n)]
         if ct == self._CT_MAP:
             n = self.varint()
             if n == 0:
                 return {}
             kv = self._take(1)[0]
             kt, vt = kv >> 4, kv & 0xF
-            return {self.read(kt): self.read(vt) for _ in range(n)}
+            return {self._read_elem(kt): self._read_elem(vt) for _ in range(n)}
         raise ThriftError(f"unsupported compact type {ct}")
+
+    def _read_elem(self, et: int):
+        """Container-element read: unlike field values (where the bool
+        IS the field-header type code and carries no bytes), bool
+        elements inside list/set/map occupy one byte each -- 1 = true,
+        2 = false per the spec, and thrift-py writers emit 0 for false.
+        Dispatching them to read() would consume nothing and desync the
+        cursor on untrusted UDP payloads."""
+        if et in (self._CT_BOOL_TRUE, self._CT_BOOL_FALSE):
+            b = self._take(1)[0]
+            if b not in (0, self._CT_BOOL_TRUE, self._CT_BOOL_FALSE):
+                raise ThriftError(f"bad bool element value {b}")
+            return b == self._CT_BOOL_TRUE
+        return self.read(et)
 
     def read_struct(self) -> dict[int, object]:
         out: dict[int, object] = {}

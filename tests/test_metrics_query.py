@@ -1,0 +1,393 @@
+"""TraceQL metrics engine: parser/validate vectors, step alignment and
+by() grouping against a hand-computed fixture, device-vs-host engine
+equality, frontend time-sharding, and an HTTP round trip through
+/api/metrics/query_range on the single-binary app."""
+
+import json
+import socket
+import urllib.error
+import urllib.parse
+import urllib.request
+
+import numpy as np
+import pytest
+
+from tempo_tpu.db.metrics_exec import (
+    MetricsRequest,
+    MetricsResponse,
+    align_params,
+    metrics_block,
+    metrics_query_range_blocks,
+    parse_metrics_query,
+    response_from_dict,
+    response_to_dict,
+    series_values,
+    to_prometheus,
+)
+from tempo_tpu.db.tempodb import TempoDB, TempoDBConfig
+from tempo_tpu.traceql.ast import MetricsQuery, ParseError
+from tempo_tpu.traceql.parser import parse
+from tempo_tpu.wire.model import Resource, ResourceSpans, Scope, ScopeSpans, Span, Trace
+
+BASE_NS = 1_700_000_000_000_000_000
+BASE_S = BASE_NS // 1_000_000_000
+
+
+# ------------------------------------------------------- parser vectors
+
+PARSE_OK = [
+    '{ span.foo = "bar" } | rate()',
+    '{ span.foo = "bar" } | rate() by(resource.service.name)',
+    '{ true } | count_over_time() by(name, status)',
+    '{ duration > 10ms } | min_over_time(duration)',
+    '{ true } | max_over_time(span.http.status_code) by(kind)',
+    '{ true } | avg_over_time(duration) by(.foo, resource.service.name)',
+    '{ true } | sum_over_time(.weight)',
+    '{ .a = 1 } | count() = 1 | rate()',  # scalar stage ahead of metrics
+]
+
+PARSE_FAIL = [
+    'rate()',  # no spanset ahead
+    '{ true } | rate() | { true }',  # not terminal
+    '{ true } | rate(duration)',  # rate takes no argument
+    '{ true } | count_over_time(name)',
+    '{ true } | avg_over_time()',  # needs an argument
+    '{ true } | sum_over_time(name)',  # non-numeric argument
+    '{ true } | avg_over_time(3)',  # must reference span data
+    '{ true } | rate() by()',  # empty by
+    '{ true } | rate() by(3)',  # by must reference span data
+    '{ true } && ({ true } | rate())',  # metrics pipelines do not combine
+]
+
+
+def test_parse_metrics_vectors():
+    for src in PARSE_OK:
+        q = parse(src)
+        assert isinstance(q, MetricsQuery), src
+        assert q.agg.fn in ("rate", "count_over_time", "min_over_time",
+                            "max_over_time", "avg_over_time", "sum_over_time")
+    for src in PARSE_FAIL:
+        with pytest.raises(ParseError):
+            parse(src)
+
+
+def test_metrics_stage_shapes():
+    q = parse('{ true } | avg_over_time(duration) by(name, resource.service.name)')
+    assert q.agg.fn == "avg_over_time"
+    assert q.agg.field is not None
+    assert len(q.agg.by) == 2
+    q2 = parse('{ true } | rate()')
+    assert q2.agg.field is None and q2.agg.by == ()
+
+
+def test_metrics_rejected_on_search_paths():
+    """Metrics stages are only valid on the metrics endpoints: the
+    search planner refuses them, and parse_metrics_query refuses the
+    inverse (a plain spanset on the metrics endpoint)."""
+    from tempo_tpu.block.dictionary import Dictionary
+    from tempo_tpu.traceql.plan import plan_search_request
+
+    d = Dictionary(["bar", "foo"])
+    with pytest.raises(ParseError):
+        plan_search_request(d, {}, query='{ .foo = "bar" } | rate()')
+    with pytest.raises(ParseError):
+        parse_metrics_query('{ .foo = "bar" }')
+    # a plain search on the same dictionary still plans fine
+    plan_search_request(d, {}, query='{ .foo = "bar" }')
+
+
+def test_align_params():
+    req = align_params("{ true } | rate()", 103, 158, 10)
+    assert req.start_ms == 100_000 and req.end_ms == 160_000
+    assert req.step_ms == 10_000 and req.n_buckets == 6
+    with pytest.raises(ValueError):
+        align_params("{ true } | rate()", 0, 10_000_000, 1)  # too many buckets
+
+
+# ------------------------------------------------------ fixture blocks
+
+
+def _trace(tid_byte: int, svc: str, spans):
+    """spans: list of (name, start_off_s, dur_s, attrs)."""
+    tid = bytes([0] * 15 + [tid_byte])
+    t = Trace()
+    rs = ResourceSpans(resource=Resource(attrs={"service.name": svc}))
+    ss = ScopeSpans(scope=Scope(name="test", version="1"))
+    for name, off_s, dur_s, attrs in spans:
+        start = BASE_NS + int(off_s * 1e9)
+        ss.spans.append(Span(
+            trace_id=tid,
+            span_id=bytes([tid_byte] * 7 + [len(ss.spans)]),
+            name=name,
+            kind=2,
+            start_unix_nano=start,
+            end_unix_nano=start + int(dur_s * 1e9),
+            attrs=dict(attrs),
+        ))
+    rs.scope_spans.append(ss)
+    t.resource_spans.append(rs)
+    return tid, t
+
+
+@pytest.fixture(scope="module")
+def fixture_db(tmp_path_factory):
+    """Two blocks with hand-placed span start times:
+
+    svc 'a' (span.foo = "bar"): offsets 1, 11, 12, 35 s  -> [1, 2, 0, 1]
+    svc 'b' (span.foo = "bar"): offsets 5, 25 s          -> [1, 0, 1, 0]
+    svc 'a' (foo = "other"):    offset 2 s               -> filtered out
+    svc 'a' (foo = "bar"):      offset 45 s              -> out of range
+    over start=BASE_S, end=BASE_S+40, step=10s (4 buckets).
+    """
+    root = tmp_path_factory.mktemp("metrics-db")
+    db = TempoDB(TempoDBConfig(
+        backend={"backend": "local", "path": str(root / "store")},
+        wal_path=str(root / "wal"),
+    ))
+    batch1 = [
+        _trace(1, "a", [("op1", 1, 0.5, {"foo": "bar", "w": 2.0}),
+                        ("op2", 11, 1.5, {"foo": "bar", "w": 4.0})]),
+        _trace(2, "b", [("op1", 5, 2.0, {"foo": "bar", "w": 10.0})]),
+    ]
+    batch2 = [
+        _trace(3, "a", [("op1", 12, 2.5, {"foo": "bar", "w": 6.0}),
+                        ("op3", 2, 1.0, {"foo": "other"}),
+                        ("op1", 45, 1.0, {"foo": "bar", "w": 99.0})]),
+        _trace(4, "a", [("op2", 35, 3.0, {"foo": "bar", "w": 8.0})]),
+        _trace(5, "b", [("op2", 25, 4.0, {"foo": "bar", "w": 20.0})]),
+    ]
+    batch1.sort(key=lambda p: p[0])
+    batch2.sort(key=lambda p: p[0])
+    m1 = db.write_block("t", batch1)
+    m2 = db.write_block("t", batch2)
+    yield db, [m1, m2]
+    db.close()
+
+
+RATE_Q = '{ span.foo = "bar" } | rate() by(resource.service.name)'
+
+
+def _req(query, step_s=10, start=BASE_S, end=BASE_S + 40):
+    return align_params(query, start, end, step_s)
+
+
+def test_rate_by_hand_computed(fixture_db):
+    db, metas = fixture_db
+    req = _req(RATE_Q)
+    blocks = [db.open_block(m) for m in metas]
+    resp = metrics_query_range_blocks(blocks, req)
+    assert resp.label_names == ("resource.service.name",)
+    assert set(resp.series) == {("a",), ("b",)}
+    assert resp.series[("a",)]["count"].tolist() == [1, 2, 0, 1]
+    assert resp.series[("b",)]["count"].tolist() == [1, 0, 1, 0]
+    vals = series_values(resp, resp.series[("a",)])
+    assert vals.tolist() == [0.1, 0.2, 0.0, 0.1]  # count / 10 s step
+    prom = to_prometheus(resp)
+    assert prom["status"] == "success"
+    assert prom["data"]["resultType"] == "matrix"
+    a = next(r for r in prom["data"]["result"]
+             if r["metric"] == {"resource.service.name": "a"})
+    assert a["values"][0] == [float(BASE_S), "0.1"]
+
+
+def test_value_folds_hand_computed(fixture_db):
+    db, metas = fixture_db
+    blocks = [db.open_block(m) for m in metas]
+    # avg of span attr w per bucket across both services
+    resp = metrics_query_range_blocks(
+        blocks, _req('{ span.foo = "bar" } | avg_over_time(.w)'))
+    vals = series_values(resp, resp.series[()])
+    # bucket 0: w=2,10 -> 6; bucket 1: w=4,6 -> 5; bucket 2: w=20; bucket 3: w=8
+    assert vals.tolist() == [6.0, 5.0, 20.0, 8.0]
+    # min/max over duration in seconds
+    resp2 = metrics_query_range_blocks(
+        blocks, _req('{ span.foo = "bar" } | max_over_time(duration)'))
+    vals2 = series_values(resp2, resp2.series[()])
+    assert vals2.tolist() == [2.0, 2.5, 4.0, 3.0]
+    resp3 = metrics_query_range_blocks(
+        blocks, _req('{ span.foo = "bar" } | min_over_time(duration) by(resource.service.name)'))
+    assert np.allclose(series_values(resp3, resp3.series[("a",)]),
+                       [0.5, 1.5, np.nan, 3.0], equal_nan=True)
+
+
+def test_step_realignment_independent_of_request_jitter(fixture_db):
+    """The grid depends only on step, not the request instant: shifting
+    start/end inside one step changes nothing but edge buckets."""
+    db, metas = fixture_db
+    blocks = [db.open_block(m) for m in metas]
+    r1 = metrics_query_range_blocks(blocks, _req(RATE_Q, start=BASE_S + 3, end=BASE_S + 37))
+    # floors to BASE_S, ceils to BASE_S+40: identical to the aligned axis
+    assert r1.start_ms == BASE_S * 1000 and r1.n_buckets == 4
+    assert r1.series[("a",)]["count"].tolist() == [1, 2, 0, 1]
+
+
+ENGINE_QUERIES = [
+    RATE_Q,
+    '{ span.foo = "bar" } | count_over_time() by(name)',
+    '{ true } | rate() by(kind)',
+    '{ true } | avg_over_time(duration) by(resource.service.name)',
+    '{ true } | sum_over_time(.w)',
+    '{ span.foo = "bar" } | max_over_time(.w) by(resource.service.name)',
+    # float-valued by(): every engine must route exact (a columnar drop
+    # would disagree with the exact engine's float labels)
+    '{ span.foo = "bar" } | rate() by(.w)',
+]
+
+
+def test_device_host_exact_engine_equality(fixture_db):
+    """The three engines must agree series-for-series on the same block
+    set (counts exactly; float folds to f32 tolerance)."""
+    db, metas = fixture_db
+    blocks = [db.open_block(m) for m in metas]
+    for query in ENGINE_QUERIES:
+        q = parse_metrics_query(query)
+        req = _req(query)
+        out = {}
+        for mode in ("host", "device", "exact"):
+            resp = MetricsResponse(fn=q.agg.fn, start_ms=req.start_ms,
+                                   step_ms=req.step_ms, n_buckets=req.n_buckets)
+            for b in blocks:
+                metrics_block(b, q, req, resp, mode=mode)
+            out[mode] = resp
+        keys = set(out["host"].series)
+        for mode in ("device", "exact"):
+            assert set(out[mode].series) == keys, (query, mode)
+            for k in keys:
+                for f, arr in out["host"].series[k].items():
+                    assert np.allclose(arr, out[mode].series[k][f],
+                                       rtol=1e-5, equal_nan=True), (query, mode, k, f)
+
+
+def test_exact_fallback_on_lossy_and_pipeline(fixture_db):
+    """needs_verify plans (float compares) and pipelines with
+    intermediate stages route through the exact engine and still
+    produce correct, mergeable series."""
+    db, metas = fixture_db
+    blocks = [db.open_block(m) for m in metas]
+    resp = metrics_query_range_blocks(blocks, _req('{ .w > 5.0 } | rate()'))
+    # w in {6, 8, 10, 20, 99(out of range)} -> buckets [1, 1, 1, 1]
+    assert resp.series[()]["count"].tolist() == [1, 1, 1, 1]
+    resp2 = metrics_query_range_blocks(
+        blocks, _req('{ span.foo = "bar" } | count() = 1 | rate()'))
+    # traces with exactly one matching span: trace2 (5s), trace4 (35s),
+    # trace5 (25s), trace3 counts 12s+45s=2 spans -> excluded
+    assert resp2.series[()]["count"].tolist() == [1, 0, 1, 1]
+
+
+def test_prometheus_value_precision():
+    from tempo_tpu.db.metrics_exec import _fmt_value
+
+    assert _fmt_value(0.1) == "0.1"
+    assert _fmt_value(1234567.0) == "1234567"  # no %g 6-digit truncation
+    assert float(_fmt_value(1 / 3)) == 1 / 3  # round-trips exactly
+
+
+def test_wire_roundtrip(fixture_db):
+    db, metas = fixture_db
+    blocks = [db.open_block(m) for m in metas]
+    resp = metrics_query_range_blocks(blocks, _req(RATE_Q))
+    back = response_from_dict(response_to_dict(resp))
+    assert set(back.series) == set(resp.series)
+    for k in resp.series:
+        for f, arr in resp.series[k].items():
+            assert (back.series[k][f] == arr).all()
+
+
+def test_mesh_path_matches_per_block(fixture_db):
+    """The stacked shard_map fold (psum combine, globalized group keys)
+    equals the per-block engines on the virtual 8-device mesh."""
+    db, metas = fixture_db
+    blocks = [db.open_block(m) for m in metas]
+    req = _req(RATE_Q)
+    plain = metrics_query_range_blocks(blocks, req)
+    meshed = metrics_query_range_blocks(blocks, req, mesh=db.mesh)
+    assert set(meshed.series) == set(plain.series)
+    for k in plain.series:
+        assert (meshed.series[k]["count"] == plain.series[k]["count"]).all()
+
+
+def test_frontend_shards_and_merges(fixture_db):
+    """The frontend splits the range into >= 2 step-aligned jobs and the
+    merged output equals the unsharded result."""
+    from tempo_tpu.services.frontend import Frontend
+    from tempo_tpu.services.querier import Querier
+
+    db, metas = fixture_db
+    fe = Frontend(Querier(db, None, lambda a: None), n_workers=2)
+    fe.METRICS_BUCKETS_PER_JOB = 2  # force several shards at 4 buckets
+    try:
+        req = _req(RATE_Q)
+        sharded = fe.metrics_query_range("t", req)
+        direct = db.metrics_query_range("t", req)
+        assert fe.stats_jobs_local >= 2
+        assert set(sharded.series) == set(direct.series)
+        for k in direct.series:
+            assert (sharded.series[k]["count"] == direct.series[k]["count"]).all()
+    finally:
+        fe.stop()
+
+
+# ----------------------------------------------------------- HTTP e2e
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+def test_http_query_range_round_trip(tmp_path):
+    from tempo_tpu.services.app import App, AppConfig
+    from tempo_tpu.services.ingester import IngesterConfig
+    from tempo_tpu.wire import otlp_json
+
+    cfg = AppConfig(
+        storage_path=str(tmp_path), http_port=_free_port(),
+        compaction_cycle_s=9999,
+        ingester=IngesterConfig(max_trace_idle_s=0.0, max_block_age_s=0.0,
+                                flush_check_period_s=9999),
+    )
+    app = App(cfg)
+    app.start()
+    app.serve_http(background=True)
+    base = f"http://127.0.0.1:{cfg.http_port}"
+    try:
+        for tid_b, svc, spans in [
+            (1, "web", [("h", 1, 0.5, {"foo": "bar"}), ("h", 11, 0.5, {"foo": "bar"})]),
+            (2, "db", [("q", 5, 0.5, {"foo": "bar"})]),
+        ]:
+            _, tr = _trace(tid_b, svc, spans)
+            urllib.request.urlopen(urllib.request.Request(
+                base + "/v1/traces", data=otlp_json.dumps(tr).encode(),
+                headers={"Content-Type": "application/json"})).read()
+        app.ingester.flush_all()
+        app.db.poll_now()
+
+        qs = urllib.parse.urlencode({
+            "q": RATE_Q, "start": BASE_S, "end": BASE_S + 20, "step": 10})
+        out = json.loads(urllib.request.urlopen(
+            f"{base}/api/metrics/query_range?{qs}").read())
+        assert out["status"] == "success"
+        assert out["data"]["resultType"] == "matrix"
+        by_label = {r["metric"]["resource.service.name"]: r["values"]
+                    for r in out["data"]["result"]}
+        assert by_label["web"] == [[float(BASE_S), "0.1"],
+                                   [float(BASE_S + 10), "0.1"]]
+        assert by_label["db"][0] == [float(BASE_S), "0.1"]
+
+        # non-metrics query on the metrics endpoint -> 400
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                base + "/api/metrics/query_range?q="
+                + urllib.parse.quote("{ true }"))
+        assert ei.value.code == 400
+        # metrics query on the search endpoint -> 400
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                base + "/api/search?q="
+                + urllib.parse.quote("{ true } | rate()"))
+        assert ei.value.code == 400
+    finally:
+        app.stop()
