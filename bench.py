@@ -81,13 +81,42 @@ def best_window(fn, windows: int = 3, max_windows: int | None = None):
     return adaptive_min(sample, windows, max_windows or 2 * windows)
 
 
-def _emit(metric: str, value: float, unit: str, vs_baseline: float) -> None:
-    print(json.dumps({
+def _tel_mark() -> tuple[int, float, float]:
+    """Kernel-telemetry mark: (compiles, device_seconds, wall_t0). Take
+    one per measured section; _emit(tel=mark) folds the deltas into the
+    bench row so the perf trajectory separates compile cost from
+    steady-state device time."""
+    from tempo_tpu.util.kerneltel import TEL
+
+    c, d = TEL.totals()
+    return c, d, time.perf_counter()
+
+
+def _tel_close(mark: tuple[int, float, float]) -> dict:
+    """Close a telemetry section at its end (call BEFORE unrelated work
+    runs): compile count + share of the section's wall time the device
+    spent executing (under sync timing; dispatch share otherwise) --
+    distinguishes "slow because recompiling" from "slow kernel"."""
+    from tempo_tpu.util.kerneltel import TEL
+
+    c0, d0, t0 = mark
+    c1, d1 = TEL.totals()
+    wall = time.perf_counter() - t0
+    return {"compiles": c1 - c0,
+            "device_time_share": round((d1 - d0) / wall, 4) if wall > 0 else 0.0}
+
+
+def _emit(metric: str, value: float, unit: str, vs_baseline: float,
+          tel: dict | tuple | None = None) -> None:
+    row = {
         "metric": metric,
         "value": round(float(value), 4),
         "unit": unit,
         "vs_baseline": round(float(vs_baseline), 3),
-    }), flush=True)
+    }
+    if tel is not None:
+        row.update(_tel_close(tel) if isinstance(tel, tuple) else tel)
+    print(json.dumps(row), flush=True)
 
 
 # ------------------------------------------------------------------ synth
@@ -279,6 +308,7 @@ def bench_kernel() -> None:
         return eval_block((tree, conds), dcols, operands, N_SPANS, N_TRACES,
                           N_SPANS, N_RES, N_TRACES)
 
+    mark = _tel_mark()
     jax.block_until_ready(run(1, 500_000, 3, 17))
     iters = 10
 
@@ -290,9 +320,10 @@ def bench_kernel() -> None:
     # windows are ~0.1 s here, so sample generously: the kernel line is
     # the ceiling metric and must not record a neighbor's timeslice
     dt = best_window(window, windows=6, max_windows=15)
+    tel = _tel_close(mark)
     sps = N_SPANS * iters / dt
     _emit("traceql_filter_kernel_spans_per_sec_per_chip", sps, "spans/s",
-          sps / BASELINE_SPANS_PER_SEC)
+          sps / BASELINE_SPANS_PER_SEC, tel=tel)
     # roofline accounting: unique input column bytes the query touches
     # per iteration / kernel time, as a fraction of the chip's peak HBM
     # bandwidth -- says whether the kernel is near the memory roofline
@@ -301,10 +332,10 @@ def bench_kernel() -> None:
     bps = bytes_touched * iters / dt
     peak = _HBM_PEAK_BPS.get(jax.devices()[0].platform, 0.0)
     _emit("traceql_filter_kernel_bytes_per_sec", bps, "B/s",
-          bps / peak if peak else 0.0)
+          bps / peak if peak else 0.0, tel=tel)
 
 
-def bench_find_and_search(tmp: str) -> tuple[float, float]:
+def bench_find_and_search(tmp: str) -> tuple[float, float, dict, dict]:
     """BASELINE config #2 shape: a 10-block local backend holding the
     reference's own dataset size (~150 K traces / 10.4 M spans total,
     docs/design-proposals/2022-04 Parquet.md:211-218), searched through
@@ -332,6 +363,7 @@ def bench_find_and_search(tmp: str) -> tuple[float, float]:
     # row-group chunk cache first (the production querier's long-lived
     # readers sit on hot caches; the reference's 0.18 s figure likewise
     # rides the OS page cache)
+    mark = _tel_mark()
     group_traces = (1 << 16) // spans_per  # traces per 64Ki-span row group
     for b in range(n_blocks):
         for sid in range(0, n_traces, group_traces):
@@ -344,7 +376,8 @@ def bench_find_and_search(tmp: str) -> tuple[float, float]:
         got = db.find_trace_by_id("bench", tid)
         lat.append(time.perf_counter() - t0)
         assert got is not None
-    _emit("find_trace_by_id_p50_ms", float(np.median(lat) * 1e3), "ms", 0.0)
+    _emit("find_trace_by_id_p50_ms", float(np.median(lat) * 1e3), "ms", 0.0,
+          tel=_tel_close(mark))
 
     # --- batched lookup, production auto path (the frontend ID-shard /
     # multi-block unit): on one chip this is the host vectorized
@@ -353,6 +386,7 @@ def bench_find_and_search(tmp: str) -> tuple[float, float]:
     from tempo_tpu.ops.find import lookup_ids_blocks_cached
 
     blocks = [db.open_block(m) for m in metas]
+    mark = _tel_mark()
     Q = 256
     qidx = rng.integers(0, n_traces, size=Q)
     qcodes = (ids_per[0][qidx].view(">u4").astype(np.int64) - 0x80000000).astype(np.int32).reshape(Q, 4)
@@ -364,11 +398,13 @@ def bench_find_and_search(tmp: str) -> tuple[float, float]:
         windows=3)
     # ids RESOLVED per second (each call answers Q ids against all 10
     # blocks' indexes); the per-block bisection work is 10x that
-    _emit("find_batched_device_ids_per_sec", Q * iters_f / dt, "ids/s", 0.0)
+    _emit("find_batched_device_ids_per_sec", Q * iters_f / dt, "ids/s", 0.0,
+          tel=_tel_close(mark))
 
     # --- e2e search over the 10-block backend through TempoDB.search.
     # Correctness gate first: the fused device engine must agree with a
     # per-block host-engine scan.
+    mark = _tel_mark()
     req = SearchRequest(tags={"service.name": "svc-003"},
                         min_duration_ms=100, limit=50)
     # touch 1 = host engine; touch 2 = staging upload; touch 3+ = pure
@@ -419,6 +455,8 @@ def bench_find_and_search(tmp: str) -> tuple[float, float]:
         return dt
 
     cold = total_spans / adaptive_min(cold_sample, iters, 2 * iters)
+    cold_tel = _tel_close(mark)
+    mark = _tel_mark()
 
     # hot: long-lived readers (the production querier pattern over
     # immutable blocks) => staged device arrays cached; ~one device sync
@@ -432,6 +470,7 @@ def bench_find_and_search(tmp: str) -> tuple[float, float]:
         return dt
 
     warm = total_spans / adaptive_min(warm_sample, 2 * iters, 4 * iters)
+    warm_tel = _tel_close(mark)
 
     # --- TraceQL metrics range query over the same 10-block backend
     # (db/metrics_exec): fused filter->bucketize->fold per block, device
@@ -440,6 +479,7 @@ def bench_find_and_search(tmp: str) -> tuple[float, float]:
     # so vs_baseline stays 0.0.
     from tempo_tpu.db.metrics_exec import align_params
 
+    mark = _tel_mark()
     base_s = 1_700_000_000
     mreq = align_params(
         '{ span.http.status_code >= 200 } | rate() by(resource.service.name)',
@@ -457,10 +497,11 @@ def bench_find_and_search(tmp: str) -> tuple[float, float]:
         return dt
 
     msec = adaptive_min(metrics_sample, 4, 10)
-    _emit("metrics_query_range_spans_per_sec", total_spans / msec, "spans/s", 0.0)
+    _emit("metrics_query_range_spans_per_sec", total_spans / msec, "spans/s", 0.0,
+          tel=_tel_close(mark))
 
     db.close()
-    return cold, warm
+    return cold, warm, cold_tel, warm_tel
 
 
 def bench_compaction(tmp: str) -> None:
@@ -554,29 +595,31 @@ def bench_spanmetrics() -> None:
     sid = rng.integers(0, S, size=N).astype(np.int32)
     dur = rng.random(N).astype(np.float32) * 10.0
     edges = tuple(float(2.0 ** (i - 6)) for i in range(14))
+    mark = _tel_mark()
     span_metrics_reduce(sid, dur, S, edges)  # compile
     iters = 5
     dt = best_window(
         lambda: [span_metrics_reduce(sid, dur, S, edges) for _ in range(iters)],
         windows=3)
-    _emit("spanmetrics_reduce_spans_per_sec", N * iters / dt, "spans/s", 0.0)
+    _emit("spanmetrics_reduce_spans_per_sec", N * iters / dt, "spans/s", 0.0,
+          tel=_tel_close(mark))
 
 
 def main() -> None:
     bench_kernel()
     tmp = tempfile.mkdtemp(prefix="tempo-tpu-bench-")
     try:
-        cold, warm = bench_find_and_search(tmp)
+        cold, warm, cold_tel, warm_tel = bench_find_and_search(tmp)
         bench_compaction(tmp)
         bench_ingest(tmp)
         bench_spanmetrics()
         _emit("search_block_e2e_cold_spans_per_sec", cold, "spans/s",
-              cold / BASELINE_SPANS_PER_SEC)
+              cold / BASELINE_SPANS_PER_SEC, tel=cold_tel)
         # headline LAST: hot-block search (cached device staging), the
         # production querier pattern; cold line above is the every-byte-
         # from-disk comparable to the reference's 0.18 s figure
         _emit("search_block_e2e_spans_per_sec", warm, "spans/s",
-              warm / BASELINE_SPANS_PER_SEC)
+              warm / BASELINE_SPANS_PER_SEC, tel=warm_tel)
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
 

@@ -22,7 +22,11 @@ import threading
 from collections import OrderedDict
 
 import numpy as np
-import zstandard
+
+try:
+    import zstandard
+except ModuleNotFoundError:  # image without the wheel: zlib-backed shim
+    from ..util import zstdshim as zstandard
 
 MAGIC = b"VTPU"
 _TAIL = struct.Struct("<I4s")

@@ -24,7 +24,10 @@ from __future__ import annotations
 import struct
 
 import numpy as np
-import zstandard
+try:
+    import zstandard
+except ModuleNotFoundError:  # image without the wheel: zlib-backed shim
+    from ..util import zstdshim as zstandard
 
 from ..wire import pbwire as w
 

@@ -58,6 +58,14 @@ def cmd_query_trace(args):
     print(otlp_json.dumps(tr))
 
 
+def _print_kernel_stats():
+    """Post-query kernel telemetry on stderr (the CLI face of
+    /status/kernels): compiles, routing reasons, staging waste."""
+    from ..util.kerneltel import TEL
+
+    print(json.dumps(TEL.snapshot(), indent=2), file=sys.stderr)
+
+
 def cmd_search(args):
     from ..db.search import SearchRequest
 
@@ -69,6 +77,8 @@ def cmd_search(args):
     resp = db.search(args.tenant, SearchRequest(tags=tags, query=args.q or "", limit=args.limit))
     db.close()
     print(json.dumps({"traces": [t.to_dict() for t in resp.traces]}, indent=2))
+    if args.kernel_stats:
+        _print_kernel_stats()
 
 
 def cmd_query_range(args):
@@ -88,6 +98,8 @@ def cmd_query_range(args):
     finally:
         db.close()
     print(json.dumps(to_prometheus(resp), indent=2))
+    if args.kernel_stats:
+        _print_kernel_stats()
 
 
 def cmd_gen(args):
@@ -227,6 +239,8 @@ def main(argv=None):
     p.add_argument("--tags", nargs="*", help="k=v pairs")
     p.add_argument("-q", help="TraceQL query")
     p.add_argument("--limit", type=int, default=20)
+    p.add_argument("--kernel-stats", dest="kernel_stats", action="store_true",
+                   help="print kernel telemetry (compiles, routing) to stderr")
     p.set_defaults(fn=cmd_search)
 
     p = sub.add_parser("query-range",
@@ -237,6 +251,8 @@ def main(argv=None):
     p.add_argument("--start", type=float, default=None, help="unix seconds (default: end-3600)")
     p.add_argument("--end", type=float, default=None, help="unix seconds (default: now)")
     p.add_argument("--step", type=float, default=60.0, help="step seconds")
+    p.add_argument("--kernel-stats", dest="kernel_stats", action="store_true",
+                   help="print kernel telemetry (compiles, routing) to stderr")
     p.set_defaults(fn=cmd_query_range)
 
     p = sub.add_parser("gen", help="generate a synthetic block")

@@ -554,6 +554,11 @@ def metrics_block(blk: BackendBlock, q: MetricsQuery, req: MetricsRequest,
     b_off, nb, t0_rel = _block_axis(blk, req)
     if nb == 0:
         return
+    import time as _time
+
+    from ..util.kerneltel import TEL
+
+    t0_wall = _time.time()
     io0 = blk.pack.bytes_read
     planned = plan_metrics_filter(q, blk.dictionary)
     if planned.prune:
@@ -563,11 +568,23 @@ def metrics_block(blk: BackendBlock, q: MetricsQuery, req: MetricsRequest,
     has_val = q.agg.field is not None
     if groups is not None and has_val:
         vals = _value_column(blk, q.agg.field)
-    exact = (mode == "exact" or planned.needs_verify or groups is None
-             or (has_val and vals is None))
+    if mode == "exact":
+        exact, exact_reason = True, "forced"
+    elif planned.needs_verify:
+        exact, exact_reason = True, "lossy_plan"
+    elif groups is None:
+        exact, exact_reason = True, "unplannable_by"
+    elif has_val and vals is None:
+        exact, exact_reason = True, "unplannable_value"
+    else:
+        exact, exact_reason = False, ""
     if exact:
+        TEL.record_routing("metrics", "exact", exact_reason)
         _metrics_block_exact(blk, q, req, resp, planned, b_off, nb)
         resp.inspected_bytes += blk.pack.bytes_read - io0
+        TEL.child_span(f"block:{blk.meta.block_id[:8]}", t0_wall, _time.time(),
+                       {"engine": "exact", "reason": exact_reason,
+                        "compile": False})
         return
     gid, labels = groups
     if not labels:
@@ -594,21 +611,35 @@ def metrics_block(blk: BackendBlock, q: MetricsQuery, req: MetricsRequest,
         from ..ops.stage import stage_block
         from ..ops.timeseries import eval_timeseries_device
 
+        TEL.record_routing("metrics", "device",
+                           "forced" if mode == "device" else "hot_block")
         staged = stage_block(blk, needed)
         outs = eval_timeseries_device(
             query, staged, operands, gid, val, pres,
             t0_rel, req.step_ms, nb, len(labels))
+        info = TEL.last_launch()
+        span_attrs = {"engine": "device", "bucket": staged.n_spans_b,
+                      "compile": bool(info and info[0] == "timeseries"
+                                      and info[2])}
     else:
         from ..ops.timeseries import eval_timeseries_host
 
+        TEL.record_routing(
+            "metrics", "host",
+            "forced" if mode == "host"
+            else ("cold_block" if i32_ok else "i32_range"))
         cols = {n: blk.pack.read(n) for n in needed
                 if not n.startswith("span@") and blk.pack.has(n)}
         outs = eval_timeseries_host(
             query, cols, operands, n_spans, blk.meta.total_traces,
             gid, val, pres, t0_rel, req.step_ms, nb, len(labels))
+        span_attrs = {"engine": "host", "bucket": int(n_spans),
+                      "compile": False}
     _outs_to_series(outs, q.agg.fn, labels, b_off, resp)
     resp.inspected_spans += n_spans
     resp.inspected_bytes += blk.pack.bytes_read - io0
+    TEL.child_span(f"block:{blk.meta.block_id[:8]}", t0_wall, _time.time(),
+                   span_attrs)
 
 
 # ------------------------------------------------------------ exact path
@@ -771,13 +802,20 @@ def metrics_query_range_blocks(
     if pool is not None:
         import threading
 
+        from ..util.kerneltel import TEL
+
         lock = threading.Lock()
+        self_trace = TEL.active_trace()  # pool threads lose the contextvar
 
         def run(blk):
+            token = TEL.set_active_trace(self_trace)
             part = MetricsResponse(fn=resp.fn, start_ms=resp.start_ms,
                                    step_ms=resp.step_ms, n_buckets=resp.n_buckets,
                                    label_names=resp.label_names)
-            metrics_block(blk, q, req, part, mode=mode)
+            try:
+                metrics_block(blk, q, req, part, mode=mode)
+            finally:
+                TEL.reset_active_trace(token)
             with lock:
                 resp.merge(part)
 

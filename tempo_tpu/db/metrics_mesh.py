@@ -25,6 +25,15 @@ from ..traceql.plan import plan_metrics_filter
 _MESH_MAX_BYTES = 512 << 20  # stacked-column budget (shared with search)
 
 
+def _fallback(reason: str, n: int = 1) -> bool:
+    """Record WHY the stacked mesh fold bowed out (the per-block engines
+    take over) and return False for the caller to propagate."""
+    from ..util.kerneltel import TEL
+
+    TEL.record_routing("metrics_mesh", "fallback", reason, n)
+    return False
+
+
 def try_metrics_mesh(mesh, blocks, q, req, resp) -> bool:
     """Attempt the stacked mesh fold; True when resp now holds the
     complete answer for `blocks`, False to fall back per-block."""
@@ -37,7 +46,7 @@ def try_metrics_mesh(mesh, blocks, q, req, resp) -> bool:
     )
 
     if req.step_ms >= 2**31:
-        return False  # the mesh kernel buckets in int32 ms
+        return _fallback("i32_step")  # the mesh kernel buckets in int32 ms
     has_val = q.agg.field is not None
     items = []
     for blk in blocks:
@@ -45,15 +54,15 @@ def try_metrics_mesh(mesh, blocks, q, req, resp) -> bool:
         if planned.prune:
             continue
         if planned.needs_verify:
-            return False
+            return _fallback("lossy_plan")
         if any(c.target not in MESH_TARGETS for c in planned.conds):
-            return False
+            return _fallback("attr_targets")
         groups = resolve_groups(blk, q.agg.by)
         if groups is None:
-            return False
+            return _fallback("unplannable_by")
         vals = _value_column(blk, q.agg.field) if has_val else None
         if has_val and vals is None:
-            return False
+            return _fallback("unplannable_value")
         _, nb, t0_rel = _block_axis(blk, req)
         if nb == 0:
             continue
@@ -61,10 +70,10 @@ def try_metrics_mesh(mesh, blocks, q, req, resp) -> bool:
         # origin must stay within the block's int32-relative-ms range
         t0_full = req.start_ms - blk.meta.start_time_unix_nano // 1_000_000
         if not -(2**31) < t0_full < 2**31:
-            return False
+            return _fallback("i32_origin")
         items.append((blk, planned, groups, vals, t0_full))
     if len(items) < 2:
-        return False
+        return _fallback("too_few_blocks")
 
     # global group table: label tuples are the cross-block join key
     label_index: dict[tuple, int] = {}
@@ -80,7 +89,7 @@ def try_metrics_mesh(mesh, blocks, q, req, resp) -> bool:
 
     NB = req.n_buckets
     if bucket(len(glabels)) * bucket(NB) > MAX_ACC_CELLS:
-        return False
+        return _fallback("cardinality")
 
     ndev = int(mesh.devices.size)
     by_plan: dict[tuple, list] = {}
@@ -116,7 +125,7 @@ def try_metrics_mesh(mesh, blocks, q, req, resp) -> bool:
         est = Bp * 4 * (S_b * (n_span_cols + 2 + (1 if has_val else 0))
                         + R_b * max(1, len(res_cols)) + NT_b * n_trace_cols)
         if est > _MESH_MAX_BYTES:
-            return False
+            return _fallback("pre_io_budget", n=len(its))
         per_block = [{n: blk.pack.read(n) for n in needed if blk.pack.has(n)}
                      for blk, *_ in its]
 
@@ -129,10 +138,10 @@ def try_metrics_mesh(mesh, blocks, q, req, resp) -> bool:
             elif n.startswith("trace."):
                 shape = (Bp, NT_b)
             else:
-                return False
+                return _fallback("axis_shape")
             first = next((c[n] for c in per_block if n in c), None)
             if first is None or first.dtype not in (np.int32, np.float32):
-                return False
+                return _fallback("dtype")
             fill = PAD_I32 if first.dtype == np.int32 else np.float32(0)
             out = np.full(shape, fill, dtype=first.dtype)
             for bi, cols in enumerate(per_block):
@@ -166,6 +175,9 @@ def try_metrics_mesh(mesh, blocks, q, req, resp) -> bool:
                      gid, val, pres))
 
     # every group passed: fold and merge (no fallback past this point)
+    from ..util.kerneltel import TEL
+
+    TEL.record_routing("metrics_mesh", "device", "stacked", n=len(items))
     for (tree, conds, operands, host, n_spans, t0_arr, gid, val, pres) in runs:
         outs = sharded_timeseries(
             mesh, tree, conds, operands, host, n_spans, t0_arr,

@@ -503,12 +503,23 @@ def search_block(
             est_bytes = n_rows * 4 * n_span_cols
         return est_bytes / _HOST_RATE_BPS * 1e3 < _link_rtt_ms()
 
-    use_device = mode == "device" or (
-        mode == "auto"
-        and (getattr(blk, "device_pinned", False)
-             or getattr(blk, "_staged_cache", None) is not None)
-        and not _host_cheaper()
-    )
+    from ..util.kerneltel import TEL
+
+    hot = (getattr(blk, "device_pinned", False)
+           or getattr(blk, "_staged_cache", None) is not None)
+    if mode != "auto":
+        use_device, reason = mode == "device", "forced"
+    elif not hot:
+        use_device, reason = False, "cold_block"
+    elif _host_cheaper():
+        use_device, reason = False, "host_scan_cheaper"
+    else:
+        use_device, reason = True, "hot_block"
+    TEL.record_routing("search_block", "device" if use_device else "host", reason)
+    import time as _time
+
+    t0_wall = _time.time()
+    compiles0 = TEL.totals()[0]  # delta covers every chunk of a streamed eval
 
     if use_device:
         if n_rows * 4 * n_span_cols > _STREAM_MIN_STAGE_BYTES:
@@ -560,6 +571,17 @@ def search_block(
         def selector(k):
             return select_topk_host(tm, key, counts, k)
 
+    # per-block self-trace span with kernel attrs: a slow query's flame
+    # view shows which block ran where and whether it recompiled
+    info = TEL.last_launch() if use_device else None
+    TEL.child_span(
+        f"block:{blk.meta.block_id[:8]}", t0_wall, _time.time(),
+        {"engine": "device" if use_device else "host",
+         "bucket": (int(info[1]) if info and info[0] == "filter" and use_device
+                    else n_rows),
+         "compile": use_device and TEL.totals()[0] > compiles0,
+         "reason": reason},
+    )
     results = _collect_topk(blk, req, planned.needs_verify, selector, limit)
     results.sort(key=lambda r: -r.start_time_unix_nano)
     resp.traces = results[:limit]
@@ -643,32 +665,50 @@ def search_blocks_fused(
     host_est_ms = scan_bytes / _HOST_RATE_BPS * 1e3
     prefer_host = host_est_ms < _link_rtt_ms()
 
+    from ..util.kerneltel import TEL
+
+    self_trace = TEL.active_trace()  # pool threads lose the contextvar
     dev_items: list[tuple[BackendBlock, object]] = []
     host_items: list[tuple[BackendBlock, object]] = []
+    decisions: list[tuple[str, str]] = []  # recorded only if we RUN here
     est = 0
     for blk, p in live:
         blk.search_touches = getattr(blk, "search_touches", 0) + 1
         needed = (tuple(required_columns(p.conds)) + tuple(p.extra_cols)
                   + ("trace@gkey_s",))
-        hot = not prefer_host and (
-            _staged_hit(blk, needed) or blk.search_touches >= promote_touches
-        )
+        staged_hit = _staged_hit(blk, needed)
+        hot = not prefer_host and (staged_hit or blk.search_touches >= promote_touches)
         if hot:
             n_span_cols = max(1, sum(
                 1 for n in needed if n.startswith(("span.", "sattr."))
             ))
             est += blk.pack.axes[S.AX_SPAN].n_rows * 4 * n_span_cols
             dev_items.append((blk, p))
+            decisions.append(("device", "staged_hit" if staged_hit else "promoted"))
         else:
+            # hot is false either because the whole query prefers host or
+            # because this block is cold (staged miss, below promotion)
             host_items.append((blk, p))
+            decisions.append(("host", "host_scan_cheaper" if prefer_host
+                              else "cold_block"))
     if est > _DEVICE_SEARCH_MAX_BYTES:
+        # caller falls back to per-block (streamed) search, which records
+        # its own per-block decisions -- recording the per-block choices
+        # above too would double-count every evaluation
+        TEL.record_routing("search_fused", "fallback", "pre_io_budget",
+                           n=len(dev_items))
         return None
+    for engine, reason in decisions:
+        TEL.record_routing("search_fused", engine, reason)
 
     io0 = {id(blk): blk.pack.bytes_read for blk, _ in live}
     results: list[tuple] = []  # _candidates records until the final merge
 
     def stage_and_eval(item):
+        import time as _time
+
         blk, p = item
+        t0w = _time.time()
         operands = Operands.build(p.rows, p.tables or None)
         needed = required_columns(p.conds) + list(p.extra_cols) + ["trace@gkey_s"]
         staged = stage_block(blk, needed)
@@ -678,12 +718,19 @@ def search_blocks_fused(
             staged.n_spans_b, staged.n_res_b, staged.n_traces_b,
             span_out=False,
         )
+        if self_trace is not None:
+            info = TEL.last_launch()
+            self_trace.child(
+                f"block:{blk.meta.block_id[:8]}", t0w, _time.time(),
+                {"engine": "device", "bucket": staged.n_spans_b,
+                 "compile": bool(info and info[0] == "filter" and info[2])})
         return tm, counts, staged.cols["trace@gkey_s"], staged.n_spans
 
     def host_eval_collect(item):
         import time as _time
 
         blk, p = item
+        t0w = _time.time()
         operands = Operands.build(p.rows, p.tables or None)
         # cold-scan detection BEFORE reading: cache-hit timings would
         # inflate the rate EMA and mislead the engine choice for
@@ -707,6 +754,11 @@ def search_blocks_fused(
                             _time.perf_counter() - t0)
         key = _start_key_host(blk)
         n_spans = blk.pack.axes[S.AX_SPAN].n_rows
+        if self_trace is not None:
+            self_trace.child(
+                f"block:{blk.meta.block_id[:8]}", t0w, _time.time(),
+                {"engine": "host", "bucket": int(n_spans), "compile": False,
+                 "cold": cold})
 
         if not p.needs_verify:
             # exact plans skip the per-block escalating collect: ONE
@@ -961,6 +1013,10 @@ def _search_group_device(items, tree, conds, req: SearchRequest, mesh, resp: Sea
         # exhausting device memory mid-program
         est += 6 * S_b * sp
     if Bp * est * 4 > _DEVICE_SEARCH_MAX_BYTES:
+        from ..util.kerneltel import TEL
+
+        TEL.record_routing("search_mesh", "fallback", "pre_io_budget",
+                           n=len(items))
         return None
 
     host: dict[str, np.ndarray] = {}

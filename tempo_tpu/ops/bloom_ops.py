@@ -28,13 +28,20 @@ def _union_kernel(stacked: jnp.ndarray) -> jnp.ndarray:
 def union_blooms(blooms: list[ShardedBloom]) -> ShardedBloom:
     """Device union of same-geometry blooms; falls back to ValueError on
     geometry mismatch (caller rebuilds instead)."""
+    import time as _time
+
+    from ..util.kerneltel import TEL
+
     first = blooms[0]
     for b in blooms[1:]:
         if b.n_shards != first.n_shards or b.shard_bits != first.shard_bits:
             raise ValueError("bloom geometry mismatch")
     stacked = jnp.asarray(np.stack([b.words for b in blooms]))
+    TEL.record_launch("bloom_union", ("union", stacked.shape), stacked.shape[0])
+    t0 = _time.perf_counter()
     out = ShardedBloom(first.n_shards, first.shard_bits)
     out.words = np.asarray(_union_kernel(stacked))
+    TEL.observe_device("bloom_union", stacked.shape[0], t0)
     return out
 
 
@@ -63,5 +70,15 @@ def batch_test(bloom_words: np.ndarray, shard_bits: int, n_shards: int, trace_id
         for j, pos in enumerate(bloom_hashes(tid, 7, shard_bits)):
             word_idx[i, j] = (shard, pos // 32)
             bit_idx[i, j] = pos % 32
-    out = _test_kernel(jnp.asarray(bloom_words), jnp.asarray(word_idx), jnp.asarray(bit_idx))
-    return np.asarray(out)
+    import time as _time
+
+    from ..util.kerneltel import TEL
+
+    TEL.record_launch("bloom_test", ("test", bloom_words.shape, q, k),
+                      bloom_words.shape[1])
+    t0 = _time.perf_counter()
+    out = np.asarray(
+        _test_kernel(jnp.asarray(bloom_words), jnp.asarray(word_idx), jnp.asarray(bit_idx))
+    )
+    TEL.observe_device("bloom_test", bloom_words.shape[1], t0)
+    return out

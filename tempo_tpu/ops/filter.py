@@ -459,7 +459,17 @@ def eval_block(
         for i in table_idxs
     ]
     fn = _compiled(tree, conds, table_idxs, n_spans_b, n_res_b, n_traces_b, span_out)
-    return fn(
+    from ..util.kerneltel import TEL
+
+    TEL.record_launch(
+        "filter",
+        ("filter", tree, conds, table_idxs, n_spans_b, n_res_b, n_traces_b, span_out),
+        n_spans_b,
+    )
+    import time as _time
+
+    t0 = _time.perf_counter()
+    out = fn(
         cols,
         operands.ints,
         operands.floats,
@@ -467,3 +477,4 @@ def eval_block(
         np.int32(n_spans),
         np.int32(n_traces),
     )
+    return TEL.observe_device("filter", n_spans_b, t0, out)

@@ -12,12 +12,14 @@ Find distributes (parallel/find.py).
 
 from __future__ import annotations
 
+import time as _time
 from functools import partial
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..util.kerneltel import TEL
 from .device import PAD_I32, bucket, pad_rows
 
 
@@ -146,18 +148,34 @@ def lookup_ids_blocks_cached(blocks: list, query_codes: np.ndarray,
     if B == 0 or q == 0:
         return np.full((B, q), -1, dtype=np.int32)
     if mode == "host" or (mode == "auto" and len(jax.devices()) == 1):
+        TEL.record_routing(
+            "find", "host", "forced" if mode == "host" else "single_chip_rtt")
         return lookup_ids_blocks_host(blocks, query_codes)
+    TEL.record_routing("find", "device", "forced" if mode == "device" else "mesh")
     qb = bucket(q)
     # host arrays ride the dispatch upload; eager jnp conversions here
     # would each pay a blocking host->device round trip
     queries = pad_rows(np.asarray(query_codes, np.int32), qb, PAD_I32)
     outs = []
+    t0 = _time.perf_counter()
+    buckets = []
     for blk in blocks:
         dev_ids, n = _device_ids(blk)
-        n_steps = int(dev_ids.shape[0]).bit_length()
+        tb = int(dev_ids.shape[0])  # id-row bucket: the launch key's label
+        n_steps = tb.bit_length()
+        TEL.record_launch("find", ("find1", tb, qb), tb)
+        buckets.append(tb)
         outs.append(_lookup_kernel(dev_ids, queries, np.int32(n), n_steps))
     stacked = jnp.stack(outs) if len(outs) > 1 else outs[0][None]
-    return np.asarray(stacked)[:, :q]
+    res = np.asarray(stacked)[:, :q]
+    # one timing window covers the whole batch (per-block syncs would
+    # serialize the pipeline): the histogram gets one observation, each
+    # launched bucket's kernel row an amortized share
+    dt = _time.perf_counter() - t0
+    TEL.device_time.observe(dt, 'op="find"')
+    for tb in buckets:
+        TEL.credit_device("find", tb, dt / len(buckets))
+    return res
 
 
 def lookup_ids_blocks(id_code_arrays: list[np.ndarray], query_codes: np.ndarray) -> np.ndarray:
@@ -179,8 +197,12 @@ def lookup_ids_blocks(id_code_arrays: list[np.ndarray], query_codes: np.ndarray)
     qb = bucket(q)
     queries = pad_rows(np.asarray(query_codes, dtype=np.int32), qb, PAD_I32)
     n_steps = int(T).bit_length()
+    TEL.record_launch("find", ("findB", B, T, qb), T)
+    t0 = _time.perf_counter()
     out = _lookup_blocks_kernel(ids, queries, n_valid, n_steps)
-    return np.asarray(out)[:, :q]
+    res = np.asarray(out)[:, :q]
+    TEL.observe_device("find", T, t0)
+    return res
 
 
 def lookup_ids(id_codes: np.ndarray, query_codes: np.ndarray) -> np.ndarray:
@@ -195,5 +217,9 @@ def lookup_ids(id_codes: np.ndarray, query_codes: np.ndarray) -> np.ndarray:
     ids = pad_rows(np.asarray(id_codes, dtype=np.int32), tb, np.int32(2**31 - 1))
     queries = pad_rows(np.asarray(query_codes, dtype=np.int32), qb, PAD_I32)
     n_steps = int(tb).bit_length()  # ceil(log2(tb)) + 1 covers the range
+    TEL.record_launch("find", ("find1", tb, qb), tb)
+    t0 = _time.perf_counter()
     out = _lookup_kernel(ids, queries, np.int32(n), n_steps)
-    return np.asarray(out)[:q]
+    res = np.asarray(out)[:q]
+    TEL.observe_device("find", tb, t0)
+    return res

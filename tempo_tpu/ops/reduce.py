@@ -74,10 +74,13 @@ def span_metrics_reduce(sid: np.ndarray, dur_s: np.ndarray, n_series: int,
         nb = len(bucket_edges) + 1
         return (np.zeros(n_series, np.int64), np.zeros(n_series, np.float64),
                 np.zeros((n_series, nb), np.int64))
+    from ..util.kerneltel import TEL
     from ..util.linkcost import link_rtt_ms
 
     if link_rtt_ms() > 2.0:
+        TEL.record_routing("spanmetrics", "host", "link_rtt")
         return _reduce_host(sid, dur_s, n_series, bucket_edges)
+    TEL.record_routing("spanmetrics", "device", "link_fast")
     nb = len(bucket_edges) + 1
     Np = pow2(n)
     Sb = pow2(n_series)
@@ -85,10 +88,16 @@ def span_metrics_reduce(sid: np.ndarray, dur_s: np.ndarray, n_series: int,
     sid_p[:n] = sid
     dur_p = np.zeros(Np, dtype=np.float32)
     dur_p[:n] = dur_s
+    import time as _time
+
+    TEL.record_launch("reduce", ("reduce", Np, Sb, nb), Np)
+    t0 = _time.perf_counter()
     calls, lsum, hist = _reduce_kernel(
         jnp.asarray(sid_p), jnp.asarray(dur_p), jnp.int32(n),
         jnp.asarray(np.asarray(bucket_edges, np.float32)), Sb, nb
     )
-    return (np.asarray(calls[:n_series]).astype(np.int64),
-            np.asarray(lsum[:n_series]).astype(np.float64),
-            np.asarray(hist[:n_series]).astype(np.int64))
+    out = (np.asarray(calls[:n_series]).astype(np.int64),
+           np.asarray(lsum[:n_series]).astype(np.float64),
+           np.asarray(hist[:n_series]).astype(np.int64))
+    TEL.observe_device("reduce", Np, t0)
+    return out

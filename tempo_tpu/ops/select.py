@@ -58,8 +58,15 @@ def select_topk_device(mask, key, counts, k: int):
     """mask/key/counts: same-length device (or host) arrays; k <= len.
     Returns (sids desc-by-key, counts at sids, n_match) as numpy --
     one device sync total."""
+    import time as _time
+
+    from ..util.kerneltel import TEL
+
     k = int(min(k, mask.shape[0]))
+    TEL.record_launch("select", ("sel1", k, int(mask.shape[0])), k)
+    t0 = _time.perf_counter()
     out = np.asarray(_compiled_select(k)(mask, key, counts))
+    TEL.observe_device("select", k, t0)
     sids, cnts, valid = out[:k], out[k : 2 * k], out[2 * k : 3 * k] > 0
     return sids[valid], cnts[valid], int(out[3 * k])
 
@@ -94,11 +101,19 @@ def select_topk_device_multi(masks, keys, counts, k: int):
     Returns (global_idx desc-by-key, counts at winners, total n_match);
     global_idx indexes the concatenation of the (padded) parts -- the
     caller maps it back to (block, sid) with the part offsets."""
+    import time as _time
+
+    from ..util.kerneltel import TEL
+
     total = int(sum(m.shape[0] for m in masks))
     k = int(min(k, total))
+    TEL.record_launch(
+        "select", ("selN", k, tuple(int(m.shape[0]) for m in masks)), k)
+    t0 = _time.perf_counter()
     out = np.asarray(
         _compiled_select_multi(k, len(masks))(tuple(masks), tuple(keys), tuple(counts))
     )
+    TEL.observe_device("select", k, t0)
     gids, cnts, valid = out[:k], out[k : 2 * k], out[2 * k : 3 * k] > 0
     return gids[valid], cnts[valid], int(out[3 * k])
 

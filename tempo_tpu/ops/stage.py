@@ -38,6 +38,28 @@ _lru: OrderedDict[tuple[int, tuple], tuple] = OrderedDict()  # -> (blk weakref, 
 _lru_bytes = 0
 
 
+def staged_cache_stats(max_entries: int = 32) -> dict:
+    """Point-in-time view of the device staged-column cache for
+    /status/kernels: aggregate occupancy plus the hottest (most recently
+    touched) entries' shape."""
+    with _lru_lock:
+        items = list(_lru.items())
+        total = _lru_bytes
+        budget = _GLOBAL_CACHE_BUDGET
+    entries = []
+    for (_bid, key), (wr, nbytes) in reversed(items[-max_entries:]):
+        blk = wr()
+        cols, groups = key
+        entries.append({
+            "block_id": getattr(getattr(blk, "meta", None), "block_id", "")[:8],
+            "columns": len(cols),
+            "groups": list(groups) if groups is not None else None,
+            "nbytes": int(nbytes),
+        })
+    return {"entries": len(items), "bytes": int(total),
+            "budget_bytes": int(budget), "hottest": entries}
+
+
 def set_staged_cache_budget(n_bytes: int) -> None:
     global _GLOBAL_CACHE_BUDGET
     _GLOBAL_CACHE_BUDGET = n_bytes
@@ -137,13 +159,18 @@ def stage_block(
     """Load `needed` columns (padded, on device). If `groups` is given,
     span/sattr-axis columns cover only those contiguous row groups.
     Results cache on the block object (blocks are immutable)."""
+    from ..util.kerneltel import TEL
+
     key = (tuple(needed), tuple(groups) if groups is not None else None)
     store: dict | None = getattr(blk, "_staged_cache", None) if cache else None
     if store is not None:
         hit = store.get(key)
         if hit is not None:
+            TEL.staged_cache_hits.inc()
             _lru_touch(blk, key, sum(a.nbytes for a in hit.cols.values()))
             return hit
+    if cache:
+        TEL.staged_cache_misses.inc()
     pack = blk.pack
     span_ax = pack.axes[S.AX_SPAN]
     if groups is None:
@@ -193,12 +220,14 @@ def stage_block(
     # owner, so the kernel aggregates with cumsum + offset gathers
     # (ops/filter._offset_counts) -- the owner row columns themselves
     # never need to reach the device.
+    real_rows: dict[str, int] = {}  # pre-padding lengths (telemetry)
     if "sattr.span" in host:
         owners = np.clip(host["sattr.span"] - span_base, 0, max(n_spans, 1) - 1)
         cnt = np.bincount(owners, minlength=max(n_spans, 1)) if owners.size else np.zeros(
             max(n_spans, 1), dtype=np.int64
         )
         off = np.concatenate([[0], np.cumsum(cnt)]).astype(np.int32)
+        real_rows["sattr.off"] = int(off.shape[0])
         host["sattr.off"] = pad_rows(off, n_spans_b + 1, off[-1] if off.size else 0)
         del host["sattr.span"]
     if "rattr.res" in host:
@@ -207,6 +236,7 @@ def stage_block(
             max(n_res, 1), dtype=np.int64
         )
         off = np.concatenate([[0], np.cumsum(cnt)]).astype(np.int32)
+        real_rows["rattr.off"] = int(off.shape[0])
         host["rattr.off"] = pad_rows(off, n_res_b + 1, off[-1] if off.size else 0)
         del host["rattr.res"]  # superseded on device by the offsets
 
@@ -246,6 +276,13 @@ def stage_block(
     # ONE batched transfer for the whole block: per-array device_puts
     # each pay a full link round trip on a high-latency tunnel
     staged.cols = dict(zip(padded, jax.device_put(list(padded.values()))))
+    # telemetry: upload volume + padding waste (padded vs real rows
+    # summed per column -- columns live on different axes)
+    TEL.record_transfer(
+        sum(int(a.nbytes) for a in padded.values()),
+        sum(real_rows.get(n, int(host[n].shape[0])) for n in padded),
+        sum(int(a.shape[0]) for a in padded.values()),
+    )
 
     # materialize requested res columns at SPAN level: the res->span
     # broadcast gather is query-independent, so paying it once here

@@ -23,6 +23,7 @@ skeleton combines the aggregated leaf vectors on host.
 
 from __future__ import annotations
 
+import time as _time
 from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
@@ -115,6 +116,11 @@ def eval_block_streamed(
         )
         return tm, sc  # device arrays, padded (n_traces_b,)
 
+    from ..util.kerneltel import TEL
+
+    TEL.record_routing("stream", "device", "chunked")
+    t0_stream = _time.perf_counter()
+
     single_tracify = sum(1 for lf in leaves if lf[0] == "tracify") == 1
     # cache=False: the streamed path exists because staging the whole
     # block exceeds the device budget, so pinning each chunk in the staged
@@ -144,6 +150,9 @@ def eval_block_streamed(
             n_spans_seen += staged.n_spans
     finally:
         nxt.cancel()  # abandoned prefetch on error mustn't leak device work
+    # whole-pipeline window (IO overlap included): the per-chunk filter
+    # kernels already record their own launches/compiles via eval_block
+    TEL.observe_device("stream", len(chunk_groups), t0_stream)
 
     if return_device:
         import jax.numpy as jnp

@@ -141,11 +141,24 @@ def eval_timeseries_device(query, staged, operands: Operands,
     else:
         val_p = pres_p = np.zeros(0, np.float32)
     t0 = int(np.clip(t0_rel_ms, -(2**31) + 1, 2**31 - 1))
+    import time as _time
+
+    from ..util.kerneltel import TEL
+
+    TEL.record_launch(
+        "timeseries",
+        ("ts", tree, conds, table_idxs, has_val, staged.n_spans_b,
+         staged.n_res_b, staged.n_traces_b, G_b, B_b),
+        staged.n_spans_b,
+    )
+    tw = _time.perf_counter()
     outs = fn(staged.cols, operands.ints, operands.floats, tabs,
               gid_p, val_p, pres_p,
               np.int32(t0), np.int32(max(1, step_ms)),
               np.int32(staged.n_spans), np.int32(n_buckets))
-    return tuple(np.asarray(o)[:n_groups, :n_buckets] for o in outs)
+    res = tuple(np.asarray(o)[:n_groups, :n_buckets] for o in outs)
+    TEL.observe_device("timeseries", staged.n_spans_b, tw)
+    return res
 
 
 def eval_timeseries_host(query, cols: dict[str, np.ndarray],

@@ -48,6 +48,16 @@ def sharded_bloom_union(mesh, blooms: list[ShardedBloom]) -> ShardedBloom:
     for i, b in enumerate(blooms):
         stacked[i] = b.words
     fn = make_sharded_union(mesh, K, first.words.shape[0], first.words.shape[1])
+    import time as _time
+
+    from ..util.kerneltel import TEL
+
+    TEL.record_launch("mesh_bloom", ("union", K, first.words.shape), K)
+    t0 = _time.perf_counter()
     out = ShardedBloom(first.n_shards, first.shard_bits)
-    out.words = np.asarray(fn(jnp.asarray(stacked)))
+    from .mesh import DISPATCH_LOCK
+
+    with DISPATCH_LOCK:  # collective programs must not interleave enqueues
+        out.words = np.asarray(fn(jnp.asarray(stacked)))
+    TEL.observe_device("mesh_bloom", K, t0)
     return out

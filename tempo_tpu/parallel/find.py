@@ -47,7 +47,10 @@ def make_sharded_find(mesh, B: int, T: int, Q: int):
         sids = jax.vmap(lambda a, nv: bisect_ids(a, queries, nv, n_steps))(
             ids_l, n_valid_l
         )  # (Bl, Q)
-        shard = jax.lax.axis_index("dp") * jax.lax.axis_size("sp") + jax.lax.axis_index("sp")
+        # psum(1, axis) == axis size (jax.lax.axis_size is not in this
+        # jax release)
+        sp_size = jax.lax.psum(1, "sp")
+        shard = jax.lax.axis_index("dp") * sp_size + jax.lax.axis_index("sp")
         gblock = shard * Bl + jnp.arange(Bl, dtype=jnp.int32)[:, None]  # (Bl, 1)
         # two-stage combine, no block*T+row packing (would overflow i32):
         # 1) pmax elects the winning block id per query
@@ -98,7 +101,17 @@ def sharded_find_rows(mesh, id_code_arrays: list[np.ndarray], query_codes: np.nd
     Qb = bucket(q)
     queries = pad_rows(np.asarray(query_codes, np.int32), Qb, np.int32(-(2**31)))
     fn = make_sharded_find_rows(mesh, ids.shape[0], T, Qb)
-    out = np.asarray(fn(jnp.asarray(ids), jnp.asarray(n_valid), jnp.asarray(queries)))
+    import time as _time
+
+    from ..util.kerneltel import TEL
+
+    TEL.record_launch("mesh_find", ("rows", ids.shape[0], T, Qb), T)
+    t0 = _time.perf_counter()
+    from .mesh import DISPATCH_LOCK
+
+    with DISPATCH_LOCK:  # collective programs must not interleave enqueues
+        out = np.asarray(fn(jnp.asarray(ids), jnp.asarray(n_valid), jnp.asarray(queries)))
+    TEL.observe_device("mesh_find", T, t0)
     return out[: len(id_code_arrays), :q]
 
 
@@ -128,7 +141,17 @@ def sharded_find(mesh, id_code_arrays: list[np.ndarray], query_codes: np.ndarray
     Qb = bucket(q)
     queries = pad_rows(np.asarray(query_codes, np.int32), Qb, np.int32(-(2**31)))
     fn = make_sharded_find(mesh, ids.shape[0], T, Qb)
-    out = np.asarray(fn(jnp.asarray(ids), jnp.asarray(n_valid), jnp.asarray(queries)))[:q]
+    import time as _time
+
+    from ..util.kerneltel import TEL
+
+    TEL.record_launch("mesh_find", ("elect", ids.shape[0], T, Qb), T)
+    t0 = _time.perf_counter()
+    from .mesh import DISPATCH_LOCK
+
+    with DISPATCH_LOCK:  # collective programs must not interleave enqueues
+        out = np.asarray(fn(jnp.asarray(ids), jnp.asarray(n_valid), jnp.asarray(queries)))[:q]
+    TEL.observe_device("mesh_find", T, t0)
     out = out.astype(np.int32, copy=True)
     out[out[:, 0] < 0] = -1  # normalize misses to (-1, -1)
     return out

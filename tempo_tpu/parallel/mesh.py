@@ -2,8 +2,20 @@
 
 from __future__ import annotations
 
+import threading
+
 import jax
 from jax.sharding import Mesh
+
+# Multi-device (collective) programs dispatched concurrently from
+# several host threads can interleave their per-device enqueue order --
+# thread A lands program1 on device 0 first while thread B lands
+# program2 on device 3 first -- and the collectives then wait on each
+# other forever (observed as a hard hang in test_stress's concurrent
+# searchers on the 8-device CPU mesh; the same cross-ordering hazard
+# exists on real chips). Every mesh host entry point serializes its
+# dispatch+fetch under this lock; single-device kernels are unaffected.
+DISPATCH_LOCK = threading.Lock()
 
 
 def smap(f, mesh, in_specs, out_specs):

@@ -343,6 +343,18 @@ def sharded_search(mesh, tree, conds, operands, cols: dict[str, np.ndarray],
     table_idxs = tuple(sorted(tabs))
     fn = make_sharded_search(mesh, tree, conds, names, B, S, R, NT, table_idxs)
     arrays = [jnp.asarray(tabs[i]) for i in table_idxs] + [jnp.asarray(cols[n]) for n in names]
-    tm, sc = fn(jnp.asarray(ints), jnp.asarray(floats),
-                jnp.asarray(n_spans, dtype=np.int32), *arrays)
-    return np.asarray(tm), np.asarray(sc)
+    import time as _time
+
+    from ..util.kerneltel import TEL
+
+    TEL.record_launch(
+        "mesh_search", ("search", tree, conds, names, B, S, R, NT, table_idxs), S)
+    t0 = _time.perf_counter()
+    from .mesh import DISPATCH_LOCK
+
+    with DISPATCH_LOCK:  # collective programs must not interleave enqueues
+        tm, sc = fn(jnp.asarray(ints), jnp.asarray(floats),
+                    jnp.asarray(n_spans, dtype=np.int32), *arrays)
+        out = np.asarray(tm), np.asarray(sc)
+    TEL.observe_device("mesh_search", S, t0)
+    return out

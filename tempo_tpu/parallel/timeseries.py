@@ -160,11 +160,24 @@ def sharded_timeseries(mesh, tree, conds, operands, cols: dict[str, np.ndarray],
         pres = np.zeros((B, 1), bool)
     arrays = [jnp.asarray(tabs[i]) for i in table_idxs]
     arrays += [jnp.asarray(cols[n]) for n in names]
-    outs = fn(jnp.asarray(ints), jnp.asarray(floats),
-              jnp.asarray(n_spans, np.int32), jnp.asarray(t0_rel, np.int32),
-              jnp.asarray(np.int32(max(1, step_ms))),
-              jnp.asarray(np.int32(n_buckets)),
-              jnp.asarray(np.asarray(gid, np.int32)),
-              jnp.asarray(np.asarray(val, np.float32)),
-              jnp.asarray(np.asarray(pres, bool)), *arrays)
-    return tuple(np.asarray(o)[:n_groups, :n_buckets] for o in outs)
+    import time as _time
+
+    from ..util.kerneltel import TEL
+
+    TEL.record_launch(
+        "mesh_timeseries",
+        ("ts", tree, conds, names, has_val, G_b, NB_b, NT, B, S, table_idxs), S)
+    tw = _time.perf_counter()
+    from .mesh import DISPATCH_LOCK
+
+    with DISPATCH_LOCK:  # collective programs must not interleave enqueues
+        outs = fn(jnp.asarray(ints), jnp.asarray(floats),
+                  jnp.asarray(n_spans, np.int32), jnp.asarray(t0_rel, np.int32),
+                  jnp.asarray(np.int32(max(1, step_ms))),
+                  jnp.asarray(np.int32(n_buckets)),
+                  jnp.asarray(np.asarray(gid, np.int32)),
+                  jnp.asarray(np.asarray(val, np.float32)),
+                  jnp.asarray(np.asarray(pres, bool)), *arrays)
+        res = tuple(np.asarray(o)[:n_groups, :n_buckets] for o in outs)
+    TEL.observe_device("mesh_timeseries", S, tw)
+    return res

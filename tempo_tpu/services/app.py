@@ -495,6 +495,10 @@ def _make_handler(app: App):
                     )
                 if u.path == "/status/config":
                     return self._send(200, json.dumps(_config_dict(app.cfg), indent=2))
+                if u.path == "/status/kernels":
+                    # kernel telemetry: compile/cache-hit table, staged-
+                    # cache contents, routing reasons, slow-query log
+                    return self._send(200, json.dumps(_kernel_status(app), indent=2))
                 if u.path == "/status/usage-stats":
                     return self._send(200, json.dumps(app.usage.report(app), indent=2))
                 if u.path == "/debug/threads":
@@ -814,6 +818,46 @@ def _sample_profile(seconds: float, hz: float = 200.0) -> str:
     return "".join(lines)
 
 
+def _kernel_status(app: App) -> dict:
+    """The /status/kernels payload: everything an operator needs to
+    answer "why was that query slow" one layer below HTTP -- per-op
+    compile/cache-hit counts and device time, the staged device-column
+    cache's contents, engine routing reasons, and the slowest recent
+    queries with their self-trace ids."""
+    from ..ops.stage import staged_cache_stats
+    from ..util.kerneltel import TEL
+
+    out = TEL.snapshot()
+    out["staged_cache"] = staged_cache_stats()
+    out["staged_cache"]["budget_note"] = (
+        "device HBM budget for staged block columns (ops/stage)")
+    return out
+
+
+# point-in-time gauges, set at scrape (the reference's promauto GaugeFunc)
+from ..util.metrics import Gauge as _Gauge  # noqa: E402
+
+_JIT_CACHE_GAUGE = _Gauge("tempo_kernel_jit_cache_entries",
+                          help="distinct compiled kernel signatures resident")
+_BLOCKLIST_GAUGE = _Gauge("tempo_blocklist_length",
+                          help="blocks across all tenants in the blocklist")
+_WAL_DEPTH_GAUGE = _Gauge("tempo_ingester_wal_bytes",
+                          help="bytes buffered in open WAL head blocks")
+
+# family -> help for the OpenMetrics renderer (families not listed get a
+# generated default; TYPE is inferred from the suffix conventions)
+_METRIC_HELP = {
+    "tempo_distributor_spans_received": "spans accepted by the distributor",
+    "tempo_distributor_push_failures": "quorum write failures (data loss)",
+    "tempo_frontend_query_duration_seconds": "frontend query latency by op",
+    "tempo_kernel_compiles": "XLA program compiles by op and shape bucket",
+    "tempo_kernel_cache_hits": "jit-cache hits by op and shape bucket",
+    "tempo_kernel_device_seconds": "per-op device wall time",
+    "tempo_engine_routing": "engine routing decisions (layer/engine/reason)",
+    "tempo_stage_transfer_bytes": "host->device staging upload bytes",
+}
+
+
 def _metrics_text(app: App) -> str:
     lines = []
     if app.distributor:
@@ -868,10 +912,9 @@ def _metrics_text(app: App) -> str:
         lines += app.compactor.compaction_duration.text()
     # storage-engine + backend-wrapper metrics (poller, cache, hedging)
     lines += app.db.polls.text() + app.db.poll_errors.text() + app.db.poll_duration.text()
-    lines.append(
-        "tempo_blocklist_length "
-        f"{sum(len(app.db.blocklist.metas(t)) for t in app.db.blocklist.tenants())}"
-    )
+    _BLOCKLIST_GAUGE.set(
+        sum(len(app.db.blocklist.metas(t)) for t in app.db.blocklist.tenants()))
+    lines += _BLOCKLIST_GAUGE.text()
     b = app.db.backend
     while b is not None:
         if hasattr(b, "hits"):
@@ -881,11 +924,6 @@ def _metrics_text(app: App) -> str:
         b = getattr(b, "inner", None)
     if app.frontend:
         lines += app.frontend.query_latency.text()
-    if app.querier:
-        lines += [
-            f"tempo_querier_traces_found_total {app.querier.stats.traces_found}",
-            f"tempo_querier_searches_total {app.querier.stats.searches}",
-        ]
     if app.querier_worker:
         lines += [
             f"tempo_querier_worker_jobs_executed_total {app.querier_worker.jobs_executed}",
@@ -898,7 +936,25 @@ def _metrics_text(app: App) -> str:
         ]
     if app.generator is not None:
         lines.extend(app.generator.metrics_text())
-    return "\n".join(lines) + "\n"
+    # kernel telemetry (compiles, cache hits, device time, staging,
+    # routing) + point-in-time gauges
+    from ..util.kerneltel import TEL
+    from ..util.metrics import render_openmetrics
+
+    lines += TEL.metrics_lines()
+    _JIT_CACHE_GAUGE.set(TEL.jit_cache_size())
+    lines += _JIT_CACHE_GAUGE.text()
+    if app.ingester:
+        try:
+            _WAL_DEPTH_GAUGE.set(sum(
+                inst.head.size_bytes()
+                for inst in list(app.ingester.instances.values())))
+        except Exception:
+            pass  # scrape raced a head-block cut; keep the last value
+        lines += _WAL_DEPTH_GAUGE.text()
+    helps = dict(_METRIC_HELP)
+    helps.update(TEL.help_entries())
+    return render_openmetrics(lines, helps=helps)
 
 
 def _config_dict(cfg: AppConfig) -> dict:

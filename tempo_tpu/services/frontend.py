@@ -120,6 +120,9 @@ class _Job:
     started_wall: float = 0.0  # wall clock (self-trace spans)
     done_at: float = 0.0  # wall clock
     batch_cv: threading.Condition | None = None
+    # active SelfTracer trace, parked in the kerneltel contextvar around
+    # local execution so engine code can attach per-block kernel spans
+    trace: object = None
 
     def finish(self) -> None:
         if not self.done.is_set():  # a late hedge twin must not clobber
@@ -202,6 +205,10 @@ class Frontend:
             if job.cancelled or job.done.is_set():
                 job.finish()
                 continue
+            from ..util.kerneltel import TEL
+
+            token = (TEL.set_active_trace(job.trace)
+                     if job.trace is not None else None)
             try:
                 res = job.fn(*job.args)
                 if not job.done.is_set():
@@ -223,6 +230,9 @@ class Frontend:
                         pass
                 if not job.done.is_set():
                     job.error = e
+            finally:
+                if token is not None:
+                    TEL.reset_active_trace(token)
             job.finish()
 
     # ------------------------------------------------ remote querier pull
@@ -383,16 +393,24 @@ class Frontend:
         combined (tracebyidsharding.go:30-48 splits the ID space; here
         the candidate block set IS the shardable space, since the device
         engine answers a whole partition in one batched lookup)."""
+        from ..util.kerneltel import TEL
         from ..util.metrics import timed
 
-        with timed(self.query_latency, 'op="traces"'):
-            if self.self_tracer is None or tenant == self.self_tracer.tenant:
-                return self._find_trace_by_id(tenant, trace_id, time_start, time_end)
-            with self.self_tracer.trace(
-                "frontend.find_trace_by_id", {"tenant": tenant}
-            ) as t:
-                return self._find_trace_by_id(tenant, trace_id, time_start, time_end,
-                                              trace=t)
+        t0 = time.perf_counter()
+        self_tid = ""
+        try:
+            with timed(self.query_latency, 'op="traces"'):
+                if self.self_tracer is None or tenant == self.self_tracer.tenant:
+                    return self._find_trace_by_id(tenant, trace_id, time_start, time_end)
+                with self.self_tracer.trace(
+                    "frontend.find_trace_by_id", {"tenant": tenant}
+                ) as t:
+                    self_tid = t.trace_id.hex()
+                    return self._find_trace_by_id(tenant, trace_id, time_start, time_end,
+                                                  trace=t)
+        finally:
+            TEL.record_query("traces", time.perf_counter() - t0, self_tid,
+                             trace_id.hex())
 
     def _find_trace_by_id(self, tenant: str, trace_id: bytes,
                           time_start: int = 0, time_end: int = 0, trace=None):
@@ -413,6 +431,8 @@ class Frontend:
                 fn=self.querier.find_in_blocks,
                 args=(tenant, trace_id, part),
             ))
+        for j in jobs:
+            j.trace = trace
         self._run_jobs(tenant, jobs)
         if trace is not None:
             self._emit_self_trace(jobs, trace)
@@ -433,15 +453,24 @@ class Frontend:
         """Sharded search: ingester job + block-batch jobs (+ row-group
         shard jobs for oversized blocks), bounded concurrency, early
         exit at limit."""
+        from ..util.kerneltel import TEL
         from ..util.metrics import timed
 
-        with timed(self.query_latency, 'op="search"'):
-            if self.self_tracer is None or tenant == self.self_tracer.tenant:
-                return self._search(tenant, req)
-            with self.self_tracer.trace(
-                "frontend.search", {"tenant": tenant, "q": req.query or ""}
-            ) as t:
-                return self._search(tenant, req, trace=t)
+        t0 = time.perf_counter()
+        self_tid = ""
+        try:
+            with timed(self.query_latency, 'op="search"'):
+                if self.self_tracer is None or tenant == self.self_tracer.tenant:
+                    return self._search(tenant, req)
+                with self.self_tracer.trace(
+                    "frontend.search", {"tenant": tenant, "q": req.query or ""}
+                ) as t:
+                    self_tid = t.trace_id.hex()
+                    return self._search(tenant, req, trace=t)
+        finally:
+            TEL.record_query("search", time.perf_counter() - t0, self_tid,
+                             req.query or " ".join(
+                                 f"{k}={v}" for k, v in req.tags.items()))
 
     def _search(self, tenant: str, req: SearchRequest, trace=None) -> SearchResponse:
         limit = req.limit or 20
@@ -488,6 +517,9 @@ class Frontend:
             batch_bytes += size
         flush_batch()
 
+        for j in jobs:
+            j.trace = trace
+
         def early():
             with lock:
                 return len(resp.traces) >= limit
@@ -523,15 +555,23 @@ class Frontend:
         local worker or a remote querier pull, partial series merged by
         label -- alignment to one global grid makes the shard merge
         exact (metrics_exec.align_params)."""
+        from ..util.kerneltel import TEL
         from ..util.metrics import timed
 
-        with timed(self.query_latency, 'op="metrics"'):
-            if self.self_tracer is None or tenant == self.self_tracer.tenant:
-                return self._metrics_query_range(tenant, req)
-            with self.self_tracer.trace(
-                "frontend.metrics_query_range", {"tenant": tenant, "q": req.query}
-            ) as t:
-                return self._metrics_query_range(tenant, req, trace=t)
+        t0 = time.perf_counter()
+        self_tid = ""
+        try:
+            with timed(self.query_latency, 'op="metrics"'):
+                if self.self_tracer is None or tenant == self.self_tracer.tenant:
+                    return self._metrics_query_range(tenant, req)
+                with self.self_tracer.trace(
+                    "frontend.metrics_query_range", {"tenant": tenant, "q": req.query}
+                ) as t:
+                    self_tid = t.trace_id.hex()
+                    return self._metrics_query_range(tenant, req, trace=t)
+        finally:
+            TEL.record_query("metrics", time.perf_counter() - t0, self_tid,
+                             req.query)
 
     def _metrics_query_range(self, tenant: str, req, trace=None):
         from ..db.metrics_exec import (
@@ -562,6 +602,8 @@ class Frontend:
                 payload={"req": metrics_request_to_dict(sub)},
                 fn=self.querier.metrics_query_range, args=(tenant, sub),
             ))
+        for j in jobs:
+            j.trace = trace
         self._run_jobs(tenant, jobs)
         if trace is not None:
             self._emit_self_trace(jobs, trace)

@@ -29,6 +29,14 @@ class SelfTracer:
         self.service = service
         self.spans_emitted = 0
         self._lock = threading.Lock()
+        # processed-counter ack instead of polling queue emptiness:
+        # _q.empty() flips true the instant the shipper DEQUEUES, before
+        # its push (and the spans_emitted update) completes, so a flush
+        # built on emptiness could return while the last trace was still
+        # in flight
+        self._done = threading.Condition(self._lock)
+        self._enqueued = 0
+        self._processed = 0
         import queue
 
         self._q: queue.SimpleQueue = queue.SimpleQueue()
@@ -40,6 +48,8 @@ class SelfTracer:
         return _ActiveTrace(self, name, attrs or {})
 
     def _enqueue(self, rs, n_spans: int) -> None:
+        with self._lock:
+            self._enqueued += 1
         self._q.put((rs, n_spans))
 
     def _ship_loop(self) -> None:
@@ -51,12 +61,23 @@ class SelfTracer:
                     self.spans_emitted += n_spans
             except Exception:
                 pass  # self-observability must never fail anything
+            finally:
+                with self._done:
+                    self._processed += 1
+                    self._done.notify_all()
 
     def flush(self, timeout_s: float = 2.0) -> None:
-        """Best-effort drain (tests): wait until the queue empties."""
+        """Best-effort drain (tests): wait until every trace enqueued
+        BEFORE this call has fully shipped (push returned and
+        spans_emitted updated), not merely left the queue."""
         deadline = time.time() + timeout_s
-        while not self._q.empty() and time.time() < deadline:
-            time.sleep(0.01)
+        with self._done:
+            target = self._enqueued
+            while self._processed < target:
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    break
+                self._done.wait(remaining)
 
 
 class _ActiveTrace:

@@ -55,7 +55,13 @@ def _r_uvarint(b: memoryview, pos: int) -> tuple[int, int]:
 
 def _seal(body: bytes) -> bytes:
     if len(body) >= _COMPRESS_MIN:
-        import zstandard
+        try:
+            import zstandard
+        except ModuleNotFoundError:  # image without the wheel
+            # frames cross NODE boundaries: a zlib-shim body tagged
+            # _FLAG_ZSTD would be undecodable by a peer that has the
+            # real wheel (mixed-image fleet), so ship uncompressed
+            return MAGIC + bytes([0]) + body
 
         comp = zstandard.ZstdCompressor(level=1).compress(body)
         if len(comp) < len(body):
@@ -69,7 +75,10 @@ def _open(data: bytes) -> memoryview:
     flags = data[4]
     body = data[5:]
     if flags & _FLAG_ZSTD:
-        import zstandard
+        try:
+            import zstandard
+        except ModuleNotFoundError:  # image without the wheel
+            from ..util import zstdshim as zstandard
 
         body = zstandard.ZstdDecompressor().decompress(body)
     return memoryview(body)

@@ -1,0 +1,330 @@
+"""Kernel telemetry: device-execution observability for the read path.
+
+The HTTP layer says how long a query took; this subsystem says WHY --
+recompile storm, host fallback, padding waste, or transfer stall. One
+process-wide registry (TEL) collects, from every device entry point in
+ops/ and parallel/:
+
+  * compile vs jit-cache-hit counters keyed by (op, shape-bucket): the
+    ops pad every axis to a power-of-two bucket (ops/device.bucket), so
+    the (op, bucket-signature) pair IS the XLA program key. The model
+    tracks OUR cache key, not XLA's internals, so an lru_cache eviction
+    that forces a silent re-trace undercounts -- acceptable for an
+    operational signal (evictions mean 256+ live program shapes).
+  * per-op device wall-time histograms. When sync timing is on the
+    observer calls block_until_ready, so the histogram records true
+    device time rather than Python dispatch; on a high-latency link that
+    sync would cost a full RTT per kernel, so the default follows the
+    measured link (util/linkcost): sync when RTT <= SYNC_RTT_MS,
+    dispatch-only otherwise. TEMPO_KERNELTEL_SYNC=0|1 overrides.
+  * host->device transfer bytes + padding-waste rows per staging call
+    (ops/stage), plus staged-cache hit/miss counters.
+  * engine routing decisions WITH reasons (cold block, pre-IO budget
+    exceeded, lossy/unplannable plan, mesh fallback, ...) from
+    db/search, db/metrics_exec and db/metrics_mesh.
+  * a bounded recent-query log (slowest first in /status/kernels), each
+    entry carrying its self-trace id so a slow query links straight to
+    its flame view.
+
+Self-trace plumbing: the frontend parks the active SelfTracer trace in
+a contextvar (set_active_trace) around local job execution; engine code
+deep in db/ attaches per-block child spans with kernel attrs
+(engine=device|host, bucket=..., compile=true) through child_span()
+without any signature threading. Everything here is advisory -- no
+method may raise into the query path.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import os
+import threading
+import time
+from collections import OrderedDict, deque
+
+from .metrics import Counter, Histogram
+
+# device kernels run sub-ms to ~seconds: a finer low end than the
+# request-latency default buckets
+DEVICE_TIME_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005,
+                       0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0)
+
+QUERY_LOG_SIZE = 64  # recent queries kept for the slow-query log
+SYNC_RTT_MS = 2.0  # block_until_ready timing only below this link RTT
+# bound on remembered compile signatures: full query structures key the
+# set, so an unbounded set would grow forever in a long-lived querier.
+# LRU eviction mirrors what the jitted functions' lru_caches do anyway
+# (an evicted program recompiles on next use, and we count it again).
+SEEN_SIGNATURES_MAX = 4096
+
+_active_trace: contextvars.ContextVar = contextvars.ContextVar(
+    "tempo_selftrace", default=None)
+
+
+class KernelTelemetry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._tls = threading.local()
+        self._sync: bool | None = None
+        self.compiles = Counter(
+            "tempo_kernel_compiles_total",
+            help="XLA program compiles by op and shape bucket")
+        self.cache_hits = Counter(
+            "tempo_kernel_cache_hits_total",
+            help="jit-cache hits by op and shape bucket")
+        self.device_time = Histogram(
+            "tempo_kernel_device_seconds", buckets=DEVICE_TIME_BUCKETS,
+            help="per-op device wall time (block_until_ready when the "
+                 "link is fast; dispatch time otherwise)")
+        self.transfer_bytes = Counter(
+            "tempo_stage_transfer_bytes_total",
+            help="host->device bytes uploaded by block staging")
+        self.staged_rows_real = Counter(
+            "tempo_stage_rows_real_total",
+            help="real (pre-padding) rows staged to device")
+        self.staged_rows_padded = Counter(
+            "tempo_stage_rows_padded_total",
+            help="rows staged to device after bucket padding")
+        self.staged_cache_hits = Counter(
+            "tempo_stage_cache_hits_total",
+            help="staged-column device cache hits")
+        self.staged_cache_misses = Counter(
+            "tempo_stage_cache_misses_total",
+            help="staged-column device cache misses (uploads)")
+        self.routing = Counter(
+            "tempo_engine_routing_total",
+            help="engine routing decisions by layer, engine and reason")
+        # full compile-key signatures, LRU-bounded (SEEN_SIGNATURES_MAX)
+        self._seen: OrderedDict = OrderedDict()
+        # (op, bucket-label) -> aggregate row for /status/kernels
+        self._kernels: dict[tuple[str, str], dict] = {}
+        self._routing: dict[tuple[str, str, str], int] = {}
+        self._queries: deque = deque(maxlen=QUERY_LOG_SIZE)
+
+    # ------------------------------------------------------------ config
+    def sync_timing(self) -> bool:
+        """Whether device timers block_until_ready (true device time) or
+        record dispatch time only. Resolved once per process."""
+        if self._sync is None:
+            env = os.environ.get("TEMPO_KERNELTEL_SYNC", "")
+            if env in ("0", "1"):
+                self._sync = env == "1"
+            else:
+                try:
+                    from .linkcost import link_rtt_ms
+
+                    self._sync = link_rtt_ms() <= SYNC_RTT_MS
+                except Exception:
+                    self._sync = False
+        return self._sync
+
+    # ----------------------------------------------------------- kernels
+    def record_launch(self, op: str, key, bucket) -> bool:
+        """Note one kernel launch. `key` is the full compile signature
+        (everything that keys the jitted program: tree/cond structure +
+        every padded axis bucket); `bucket` is the primary shape bucket
+        used as the metric label. Returns True on a new compile."""
+        blab = str(bucket)
+        try:
+            with self._lock:
+                new = key not in self._seen
+                if new:
+                    self._seen[key] = True
+                    while len(self._seen) > SEEN_SIGNATURES_MAX:
+                        self._seen.popitem(last=False)
+                else:
+                    self._seen.move_to_end(key)
+                k = self._kernels.get((op, blab))
+                if k is None:
+                    k = self._kernels[(op, blab)] = {
+                        "compiles": 0, "cache_hits": 0, "calls": 0,
+                        "device_seconds": 0.0, "last_compile_unix": 0.0,
+                    }
+                if new:
+                    k["compiles"] += 1
+                    k["last_compile_unix"] = time.time()
+                else:
+                    k["cache_hits"] += 1
+            labels = f'op="{op}",bucket="{blab}"'
+            (self.compiles if new else self.cache_hits).inc(labels=labels)
+            self._tls.last = (op, blab, new)
+            return new
+        except Exception:
+            return False
+
+    def last_launch(self) -> tuple[str, str, bool] | None:
+        """(op, bucket, compiled) of this thread's most recent launch --
+        lets the search layer stamp compile=true on the block's
+        self-trace span without threading flags through every return."""
+        return getattr(self._tls, "last", None)
+
+    def observe_device(self, op: str, bucket, t0: float, out=None):
+        """Close a device timing window opened at perf_counter() t0.
+        With sync timing on and device outputs given, waits for them
+        first so the window covers device execution, not just dispatch.
+        Returns `out` for call-site chaining."""
+        try:
+            if out is not None and self.sync_timing():
+                import jax
+
+                jax.block_until_ready(out)
+            dt = time.perf_counter() - t0
+            self.device_time.observe(dt, f'op="{op}"')
+            with self._lock:
+                k = self._kernels.get((op, str(bucket)))
+                if k is not None:
+                    k["calls"] += 1
+                    k["device_seconds"] += dt
+        except Exception:
+            pass
+        return out
+
+    def credit_device(self, op: str, bucket, seconds: float) -> None:
+        """Credit a kernel-table row with one call and a share of a
+        batch's timing window WITHOUT a histogram observation -- for
+        call sites that launch several per-bucket programs under one
+        measured window (the batched find loop)."""
+        try:
+            with self._lock:
+                k = self._kernels.get((op, str(bucket)))
+                if k is not None:
+                    k["calls"] += 1
+                    k["device_seconds"] += seconds
+        except Exception:
+            pass
+
+    # ----------------------------------------------------------- staging
+    def record_transfer(self, nbytes: int, rows_real: int, rows_padded: int) -> None:
+        self.transfer_bytes.inc(nbytes)
+        self.staged_rows_real.inc(rows_real)
+        self.staged_rows_padded.inc(rows_padded)
+
+    # ----------------------------------------------------------- routing
+    def record_routing(self, layer: str, engine: str, reason: str, n: int = 1) -> None:
+        """One engine decision: which engine ran (or why the fast path
+        fell back) and the reason the router chose it."""
+        self.routing.inc(
+            n, labels=f'layer="{layer}",engine="{engine}",reason="{reason}"')
+        with self._lock:
+            key = (layer, engine, reason)
+            self._routing[key] = self._routing.get(key, 0) + n
+
+    def routing_counts(self) -> dict[tuple[str, str, str], int]:
+        with self._lock:
+            return dict(self._routing)
+
+    # --------------------------------------------------------- query log
+    def record_query(self, op: str, seconds: float, trace_id: str = "",
+                     detail: str = "") -> None:
+        with self._lock:
+            self._queries.append({
+                "op": op,
+                "seconds": round(float(seconds), 6),
+                "self_trace_id": trace_id,
+                "detail": detail[:200],
+                "at_unix": round(time.time(), 3),
+            })
+
+    def slow_queries(self, k: int = 10) -> list[dict]:
+        with self._lock:
+            recent = list(self._queries)
+        return sorted(recent, key=lambda q: -q["seconds"])[:k]
+
+    # --------------------------------------------------------- self-trace
+    def set_active_trace(self, trace):
+        """Park the active SelfTracer trace for this execution context;
+        returns a token for reset_active_trace."""
+        return _active_trace.set(trace)
+
+    def reset_active_trace(self, token) -> None:
+        try:
+            _active_trace.reset(token)
+        except Exception:
+            pass
+
+    def active_trace(self):
+        return _active_trace.get()
+
+    def child_span(self, name: str, t0: float, t1: float,
+                   attrs: dict | None = None) -> None:
+        """Attach one child span (wall-clock seconds) to the active
+        self-trace, if any. Engine code calls this per block."""
+        t = _active_trace.get()
+        if t is not None:
+            try:
+                t.child(name, t0, t1, attrs or {})
+            except Exception:
+                pass  # observability must never fail a query
+
+    # ----------------------------------------------------------- readout
+    def jit_cache_size(self) -> int:
+        with self._lock:
+            return len(self._seen)
+
+    def totals(self) -> tuple[int, float]:
+        """(total compiles, total device seconds) -- bench deltas."""
+        with self._lock:
+            return (sum(k["compiles"] for k in self._kernels.values()),
+                    sum(k["device_seconds"] for k in self._kernels.values()))
+
+    def snapshot(self, slow_k: int = 10) -> dict:
+        """The /status/kernels payload."""
+        with self._lock:
+            kernels = [
+                {"op": op, "bucket": b, **dict(stats)}
+                for (op, b), stats in sorted(self._kernels.items())
+            ]
+            rows_real = self.staged_rows_real.get()
+            rows_padded = self.staged_rows_padded.get()
+            routing = [
+                {"layer": l, "engine": e, "reason": r, "count": n}
+                for (l, e, r), n in sorted(self._routing.items())
+            ]
+        return {
+            "jit_cache": {
+                "entries": self.jit_cache_size(),
+                "compiles_total": sum(k["compiles"] for k in kernels),
+                "cache_hits_total": sum(k["cache_hits"] for k in kernels),
+            },
+            "kernels": kernels,
+            "staging": {
+                "transfer_bytes_total": int(self.transfer_bytes.get()),
+                "rows_real_total": int(rows_real),
+                "rows_padded_total": int(rows_padded),
+                "padding_waste_ratio": round(
+                    rows_padded / rows_real, 4) if rows_real else 0.0,
+                "cache_hits": int(self.staged_cache_hits.get()),
+                "cache_misses": int(self.staged_cache_misses.get()),
+            },
+            "routing": routing,
+            "slow_queries": self.slow_queries(slow_k),
+        }
+
+    def metrics_lines(self) -> list[str]:
+        """Exposition sample lines for /metrics."""
+        out: list[str] = []
+        for inst in (self.compiles, self.cache_hits, self.device_time,
+                     self.transfer_bytes, self.staged_rows_real,
+                     self.staged_rows_padded, self.staged_cache_hits,
+                     self.staged_cache_misses, self.routing):
+            out += inst.text()
+        return out
+
+    def help_entries(self) -> dict[str, str]:
+        """family -> help for the exposition renderer."""
+        out = {}
+        for inst in (self.compiles, self.cache_hits, self.device_time,
+                     self.transfer_bytes, self.staged_rows_real,
+                     self.staged_rows_padded, self.staged_cache_hits,
+                     self.staged_cache_misses, self.routing):
+            fam = inst.name[:-6] if inst.name.endswith("_total") else inst.name
+            out[fam] = inst.help
+        return out
+
+    def reset(self) -> None:
+        """Fresh state (tests). Callers must reference instruments via
+        TEL attributes, never cache them across a reset."""
+        self.__init__()
+
+
+TEL = KernelTelemetry()

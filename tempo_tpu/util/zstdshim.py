@@ -1,0 +1,54 @@
+"""zlib-backed stand-in for the `zstandard` wheel.
+
+Some images lack the zstandard wheel; importing it at module scope took
+the whole block layer (and ~20 test modules) down with it. The wheel
+stays the real codec wherever it exists -- import sites gate on
+ModuleNotFoundError and fall back here, which implements exactly the
+API surface this repo touches (ZstdCompressor(level=).compress,
+ZstdDecompressor().decompress(data, max_output_size=)) over stdlib
+zlib.
+
+Compatibility contract: within one deployment the shim is
+self-consistent (blocks written under it read back under it). It can
+NEVER decode a real zstd frame -- attempting to read a block produced
+by an environment that had the wheel fails loudly with the actual
+cause instead of zlib garbage. The inverse also holds: STORAGE objects
+(block chunks, dictionaries) written under the shim are readable only
+by shim environments, so don't share a backend across mixed images.
+The transport layer is exempt by construction -- frames._seal ships
+uncompressed rather than tag shim output as zstd, so RPC stays
+compatible across a mixed-image fleet.
+"""
+
+from __future__ import annotations
+
+import zlib
+
+_ZSTD_MAGIC = b"\x28\xb5\x2f\xfd"
+
+
+class ZstdError(Exception):
+    pass
+
+
+class ZstdCompressor:
+    def __init__(self, level: int = 3, **_kw):
+        # zstd levels run 1..22, zlib 1..9: clamp rather than scale --
+        # the callers only use small levels (1..6)
+        self.level = max(1, min(int(level), 9))
+
+    def compress(self, data: bytes) -> bytes:
+        return zlib.compress(bytes(data), self.level)
+
+
+class ZstdDecompressor:
+    def decompress(self, data: bytes, max_output_size: int = 0) -> bytes:
+        if bytes(data[:4]) == _ZSTD_MAGIC:
+            raise ZstdError(
+                "real zstd frame but the zstandard wheel is not installed "
+                "(this data was written by an environment that had it)")
+        out = zlib.decompress(bytes(data))
+        if max_output_size and len(out) > max_output_size:
+            raise ZstdError(
+                f"decompressed {len(out)} bytes > max_output_size {max_output_size}")
+        return out

@@ -1,0 +1,447 @@
+"""Kernel telemetry end-to-end: compile/cache-hit counters keyed by
+(op, shape bucket), routing-reason counters on forced fallbacks,
+/status/kernels + strict-OpenMetrics /metrics over the single-binary
+app, self-trace spans carrying kernel attrs, the SelfTracer flush ack,
+and the new Gauge instrument."""
+
+import json
+import re
+import socket
+import threading
+import time
+import urllib.parse
+import urllib.request
+
+import numpy as np
+import pytest
+
+from tempo_tpu.util.kerneltel import TEL
+from tempo_tpu.util.metrics import Gauge, render_openmetrics
+
+
+@pytest.fixture(autouse=True)
+def _fresh_telemetry():
+    TEL.reset()
+    yield
+
+
+# ------------------------------------------------- compile vs cache hit
+
+
+def _sorted_ids(n: int) -> np.ndarray:
+    ids = np.zeros((n, 4), np.int32)
+    ids[:, 3] = np.arange(n, dtype=np.int32)
+    return ids
+
+
+def _kernel_row(op: str, bucket):
+    for row in TEL.snapshot()["kernels"]:
+        if row["op"] == op and row["bucket"] == str(bucket):
+            return row
+    return None
+
+
+def test_compile_counter_once_per_op_bucket():
+    """First launch of an (op, shape-bucket) signature is a compile;
+    repeats are cache hits; a NOVEL bucket compiles exactly once more."""
+    from tempo_tpu.ops.find import lookup_ids
+
+    ids = _sorted_ids(100)  # bucket 1024
+    queries = ids[:3]
+    assert (lookup_ids(ids, queries) == [0, 1, 2]).all()
+    row = _kernel_row("find", 1024)
+    assert row is not None
+    assert row["compiles"] == 1 and row["cache_hits"] == 0
+    assert row["last_compile_unix"] > 0
+
+    lookup_ids(ids, queries)  # same buckets: hit, no new compile
+    row = _kernel_row("find", 1024)
+    assert row["compiles"] == 1 and row["cache_hits"] == 1
+
+    # forced recompile: novel shape bucket (2000 rows -> 2048)
+    ids2 = _sorted_ids(2000)
+    lookup_ids(ids2, ids2[:3])
+    row2 = _kernel_row("find", 2048)
+    assert row2 is not None and row2["compiles"] == 1
+    assert TEL.snapshot()["jit_cache"]["entries"] == 2
+    # device-time histogram observed per call
+    assert row["calls"] >= 1 and row["device_seconds"] >= 0.0
+    assert any('op="find"' in ln for ln in TEL.device_time.text())
+
+
+def test_filter_kernel_compile_and_staging_telemetry(tmp_path):
+    """The search filter kernel records compiles, staging records
+    transfer bytes + padding waste, and search_block(mode=...) records
+    forced routing reasons."""
+    from tempo_tpu.db.search import SearchRequest, search_block
+    from tempo_tpu.db.tempodb import TempoDB, TempoDBConfig
+    from tempo_tpu.util.testdata import make_traces
+
+    db = TempoDB(TempoDBConfig(
+        backend={"backend": "local", "path": str(tmp_path / "store")},
+        wal_path=str(tmp_path / "wal")))
+    meta = db.write_block("t1", make_traces(16, seed=5, n_spans=4))
+    blk = db.open_block(meta)
+    req = SearchRequest(tags={"service.name": "db"}, limit=10)
+
+    search_block(blk, req, mode="device")
+    snap = TEL.snapshot()
+    assert any(k["op"] == "filter" and k["compiles"] >= 1 for k in snap["kernels"])
+    st = snap["staging"]
+    assert st["transfer_bytes_total"] > 0
+    assert st["rows_padded_total"] >= st["rows_real_total"] > 0
+    assert st["padding_waste_ratio"] >= 1.0
+    assert ("search_block", "device", "forced") in TEL.routing_counts()
+
+    # second identical query: staged cache + jit cache both hit
+    search_block(blk, req, mode="device")
+    snap2 = TEL.snapshot()
+    frow = [k for k in snap2["kernels"] if k["op"] == "filter"]
+    assert sum(k["cache_hits"] for k in frow) >= 1
+    assert snap2["staging"]["cache_hits"] >= 1
+
+    # forced host fallback is a routing fact too
+    search_block(blk, req, mode="host")
+    assert ("search_block", "host", "forced") in TEL.routing_counts()
+    db.close()
+
+
+def test_routing_reason_cold_block(tmp_path):
+    """Auto mode on a block with no pinned/staged device columns routes
+    host with reason cold_block."""
+    from tempo_tpu.db.search import SearchRequest, search_block
+    from tempo_tpu.db.tempodb import TempoDB, TempoDBConfig
+    from tempo_tpu.util.testdata import make_traces
+
+    db = TempoDB(TempoDBConfig(
+        backend={"backend": "local", "path": str(tmp_path / "store")},
+        wal_path=str(tmp_path / "wal"), device_search=False))
+    meta = db.write_block("t1", make_traces(8, seed=6, n_spans=3))
+    blk = db.open_block(meta)  # device_pinned False (device_search off)
+    search_block(blk, SearchRequest(tags={"service.name": "db"}), mode="auto")
+    assert ("search_block", "host", "cold_block") in TEL.routing_counts()
+    db.close()
+
+
+def test_metrics_engine_routing_reasons(tmp_path):
+    """The metrics executor labels exact-engine fallbacks with the
+    reason (forced here) and device/host engines by temperature."""
+    from tempo_tpu.db.metrics_exec import (
+        MetricsResponse, align_params, metrics_block, parse_metrics_query,
+    )
+    from tempo_tpu.db.tempodb import TempoDB, TempoDBConfig
+    from tempo_tpu.util.testdata import make_traces
+
+    db = TempoDB(TempoDBConfig(
+        backend={"backend": "local", "path": str(tmp_path / "store")},
+        wal_path=str(tmp_path / "wal")))
+    meta = db.write_block("t1", make_traces(8, seed=7, n_spans=3))
+    blk = db.open_block(meta)
+    base_s = meta.start_time_unix_nano // 1_000_000_000
+    req = align_params("{ true } | rate()", base_s, base_s + 60, 10)
+    q = parse_metrics_query(req.query)
+
+    resp = MetricsResponse(fn="rate", start_ms=req.start_ms,
+                           step_ms=req.step_ms, n_buckets=req.n_buckets)
+    metrics_block(blk, q, req, resp, mode="exact")
+    assert ("metrics", "exact", "forced") in TEL.routing_counts()
+
+    metrics_block(blk, q, req, resp, mode="device")
+    rc = TEL.routing_counts()
+    assert ("metrics", "device", "forced") in rc
+    assert any(k["op"] == "timeseries" and k["compiles"] >= 1
+               for k in TEL.snapshot()["kernels"])
+    db.close()
+
+
+# --------------------------------------------------- self-trace attrs
+
+
+def test_selftrace_block_spans_carry_kernel_attrs():
+    """A self-traced query's flame view shows which block ran on which
+    engine and whether it recompiled: per-block child spans carry
+    engine/bucket/compile attrs (acceptance: forced recompile + forced
+    host fallback both visible end-to-end)."""
+    import tempfile
+
+    from tempo_tpu.db.search import SearchRequest, search_block
+    from tempo_tpu.db.tempodb import TempoDB, TempoDBConfig
+    from tempo_tpu.services.selftrace import SelfTracer
+    from tempo_tpu.util.testdata import make_traces
+
+    with tempfile.TemporaryDirectory() as tmp:
+        db = TempoDB(TempoDBConfig(
+            backend={"backend": "local", "path": tmp + "/store"},
+            wal_path=tmp + "/wal"))
+        meta = db.write_block("t1", make_traces(8, seed=9, n_spans=3))
+        blk = db.open_block(meta)
+        shipped = []
+        st = SelfTracer(lambda tenant, rss: shipped.extend(rss))
+        req = SearchRequest(min_duration_ms=1, limit=5)  # never prunes
+
+        with st.trace("frontend.search") as t:
+            token = TEL.set_active_trace(t)
+            try:
+                search_block(blk, req, mode="device")  # forced recompile path
+                search_block(blk, req, mode="host")  # forced host fallback
+            finally:
+                TEL.reset_active_trace(token)
+        st.flush()
+        spans = [sp for rs in shipped for ss in rs.scope_spans for sp in ss.spans]
+        block_spans = [sp for sp in spans if sp.name.startswith("block:")]
+        assert len(block_spans) == 2
+        by_engine = {sp.attrs["engine"]: sp.attrs for sp in block_spans}
+        assert by_engine["device"]["compile"] is True
+        assert by_engine["device"]["bucket"] >= 1024
+        assert by_engine["host"]["compile"] is False
+        # and the routing counters saw both forced decisions
+        rc = TEL.routing_counts()
+        assert ("search_block", "device", "forced") in rc
+        assert ("search_block", "host", "forced") in rc
+        db.close()
+
+
+# ------------------------------------------------ SelfTracer flush ack
+
+
+def test_selftracer_flush_waits_for_push():
+    """flush() must wait for the shipper's push to COMPLETE, not just
+    for the queue to drain (the old emptiness poll returned while the
+    last push was mid-flight and spans_emitted unread)."""
+    from tempo_tpu.services.selftrace import SelfTracer
+
+    release = threading.Event()
+    pushed = []
+
+    def slow_push(tenant, rss):
+        release.wait(5.0)
+        pushed.append(rss)
+
+    st = SelfTracer(slow_push)
+    with st.trace("op"):
+        pass
+    # shipper is now blocked inside push; queue is already empty
+    time.sleep(0.05)
+    release.set()
+    st.flush(timeout_s=5.0)
+    assert pushed and st.spans_emitted == 1
+
+
+# ------------------------------------------------------- instruments
+
+
+def test_gauge_instrument():
+    g = Gauge("tempo_test_gauge", help="h")
+    g.set(3)
+    g.inc()
+    g.dec(0.5)
+    assert g.get() == 3.5
+    assert g.text() == ["tempo_test_gauge 3.5"]  # no empty {}
+    g.set(1, labels='tenant="a"')
+    assert 'tempo_test_gauge{tenant="a"} 1' in g.text()
+
+
+def test_render_openmetrics_families():
+    text = render_openmetrics([
+        "foo_total 3",
+        'bar_bucket{le="1"} 1',
+        'bar_bucket{le="+Inf"} 2',
+        "bar_sum{} 1.5",  # empty braces must be stripped
+        "bar_count 2",
+        "baz 7",
+        "foo_total 3",  # duplicate dropped
+    ], helps={"foo": "a counter"})
+    assert "# TYPE foo counter" in text
+    assert "# HELP foo a counter" in text
+    assert "# TYPE bar histogram" in text
+    assert "# TYPE baz gauge" in text
+    assert "bar_sum 1.5" in text and "{}" not in text
+    assert text.count("foo_total 3") == 1
+
+
+# ----------------------------------------- strict OpenMetrics parser
+
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^{}]*\})? (-?(?:[0-9.eE+-]+|NaN|[+-]?Inf))"
+    r"(?: # \{[^{}]*\} [0-9.eE+-]+)?$")  # optional exemplar
+
+
+def parse_openmetrics_strict(text: str) -> dict:
+    """Validating parser per the OpenMetrics text format: EOF marker,
+    TYPE before samples, suffix rules per type, no empty label sets,
+    family samples contiguous, no duplicate sample lines."""
+    assert text.endswith("# EOF\n"), "missing EOF marker"
+    body = text[: -len("# EOF\n")]
+    families: dict[str, str] = {}
+    current = None
+    seen_lines = set()
+    n_samples = 0
+    for ln in body.splitlines():
+        if not ln:
+            continue
+        if ln.startswith("# HELP "):
+            continue
+        if ln.startswith("# TYPE "):
+            _, _, fam, typ = ln.split(" ")
+            assert fam not in families, f"family {fam} declared twice"
+            assert typ in ("counter", "gauge", "histogram"), typ
+            families[fam] = typ
+            current = fam
+            continue
+        assert not ln.startswith("#"), f"unknown comment line {ln!r}"
+        m = _SAMPLE_RE.match(ln)
+        assert m, f"unparseable sample line {ln!r}"
+        name, labels = m.group(1), m.group(2)
+        assert labels != "{}", f"empty label set in {ln!r}"
+        assert ln not in seen_lines, f"duplicate sample {ln!r}"
+        seen_lines.add(ln)
+        assert current is not None, f"sample {ln!r} before any TYPE"
+        typ = families[current]
+        if typ == "counter":
+            assert name == current + "_total", (name, current)
+        elif typ == "histogram":
+            assert name in (current + "_bucket", current + "_sum",
+                            current + "_count"), (name, current)
+            if name.endswith("_bucket"):
+                assert 'le="' in (labels or ""), f"bucket without le: {ln!r}"
+        else:
+            assert name == current, (name, current)
+        n_samples += 1
+    assert n_samples > 0
+    return families
+
+
+# ------------------------------------------------- HTTP end-to-end
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+def test_status_kernels_and_strict_metrics(tmp_path):
+    """After a search + metrics query, /status/kernels returns per-op
+    compile counts, cache hits, device-time totals and routing-reason
+    counters, and /metrics passes a strict OpenMetrics parse."""
+    from tempo_tpu.services.app import App, AppConfig
+    from tempo_tpu.services.ingester import IngesterConfig
+    from tempo_tpu.util.testdata import make_traces
+    from tempo_tpu.wire import otlp_json
+
+    cfg = AppConfig(
+        storage_path=str(tmp_path / "store"),
+        http_port=_free_port(),
+        compaction_cycle_s=9999,
+        ingester=IngesterConfig(max_trace_idle_s=0.0, max_block_age_s=0.0,
+                                flush_check_period_s=9999),
+    )
+    app = App(cfg)
+    app.start()
+    app.serve_http(background=True)
+    base = f"http://127.0.0.1:{cfg.http_port}"
+    try:
+        for _, tr in make_traces(6, seed=11, n_spans=4):
+            urllib.request.urlopen(urllib.request.Request(
+                base + "/v1/traces", data=otlp_json.dumps(tr).encode(),
+                headers={"Content-Type": "application/json"}), timeout=10)
+        app.ingester.flush_all()
+        app.db.poll_now()
+
+        # backend search + metrics range query through the frontend
+        q = urllib.parse.quote('{ resource.service.name = "db" }')
+        urllib.request.urlopen(f"{base}/api/search?q={q}&limit=10", timeout=15)
+        mq = urllib.parse.quote("{ true } | rate()")
+        urllib.request.urlopen(
+            f"{base}/api/metrics/query_range?q={mq}&start=1&end=3600&step=60",
+            timeout=15)
+        # one forced-device per-block search so the kernel table has a
+        # compiled filter entry even where auto-routing prefers host
+        from tempo_tpu.db.search import SearchRequest, search_block
+
+        meta = app.db.blocklist.metas("single-tenant")[0]
+        search_block(app.db.open_block(meta),
+                     SearchRequest(min_duration_ms=1), mode="device")
+
+        with urllib.request.urlopen(base + "/status/kernels", timeout=10) as r:
+            status = json.loads(r.read())
+        assert status["jit_cache"]["entries"] >= 1
+        assert any(k["op"] == "filter" and k["compiles"] >= 1
+                   for k in status["kernels"])
+        assert status["routing"], "no routing decisions recorded"
+        assert {"layer", "engine", "reason", "count"} <= set(status["routing"][0])
+        assert status["staging"]["transfer_bytes_total"] > 0
+        assert "hottest" in status["staged_cache"]
+        # slow-query log carries ops + durations (self-trace id empty
+        # when self-tracing is off)
+        assert any(sq["op"] in ("search", "metrics")
+                   for sq in status["slow_queries"])
+
+        with urllib.request.urlopen(base + "/metrics", timeout=10) as r:
+            text = r.read().decode()
+        fams = parse_openmetrics_strict(text)
+        assert fams.get("tempo_kernel_compiles") == "counter"
+        assert fams.get("tempo_kernel_device_seconds") == "histogram"
+        assert fams.get("tempo_engine_routing") == "counter"
+        assert fams.get("tempo_kernel_jit_cache_entries") == "gauge"
+        assert fams.get("tempo_blocklist_length") == "gauge"
+        assert fams.get("tempo_ingester_wal_bytes") == "gauge"
+        assert fams.get("tempo_frontend_query_duration_seconds") == "histogram"
+    finally:
+        app.stop()
+
+
+def test_self_traced_http_search_has_block_spans(tmp_path):
+    """With self-tracing on and blocks in the backend, a user search
+    yields a self trace whose job runs carry per-block kernel child
+    spans -- and the slow-query log records the self-trace id."""
+    from tempo_tpu.services.app import App, AppConfig
+    from tempo_tpu.services.ingester import IngesterConfig
+    from tempo_tpu.util.testdata import make_traces
+    from tempo_tpu.wire import otlp_json
+
+    cfg = AppConfig(
+        storage_path=str(tmp_path / "store"),
+        http_port=_free_port(),
+        multitenancy=True,
+        self_tracing_tenant="self",
+        compaction_cycle_s=9999,
+        ingester=IngesterConfig(max_trace_idle_s=0.0, max_block_age_s=0.0,
+                                flush_check_period_s=9999),
+    )
+    app = App(cfg)
+    app.start()
+    app.serve_http(background=True)
+    base = f"http://127.0.0.1:{cfg.http_port}"
+    try:
+        for _, tr in make_traces(5, seed=13, n_spans=3):
+            urllib.request.urlopen(urllib.request.Request(
+                base + "/v1/traces", data=otlp_json.dumps(tr).encode(),
+                headers={"Content-Type": "application/json",
+                         "X-Scope-OrgID": "t1"}), timeout=10)
+        app.ingester.flush_all()
+        app.db.poll_now()
+        urllib.request.urlopen(urllib.request.Request(
+            base + "/api/search?limit=10",
+            headers={"X-Scope-OrgID": "t1"}), timeout=15)
+        app.frontend.self_tracer.flush()
+
+        sq = [q for q in TEL.slow_queries(20) if q["op"] == "search"
+              and q["self_trace_id"]]
+        assert sq, "slow-query log missing self-trace id"
+        tid = sq[0]["self_trace_id"]
+        with urllib.request.urlopen(urllib.request.Request(
+                base + f"/api/traces/{tid}",
+                headers={"X-Scope-OrgID": "self"}), timeout=15) as r:
+            tr = otlp_json.loads(r.read())
+        names = [sp.name for _, _, sp in tr.all_spans()]
+        blocks = [sp for _, _, sp in tr.all_spans()
+                  if sp.name.startswith("block:")]
+        assert "frontend.search" in names
+        assert blocks, f"no per-block kernel spans in {names}"
+        assert all("engine" in sp.attrs and "compile" in sp.attrs
+                   for sp in blocks)
+    finally:
+        app.stop()
