@@ -16,6 +16,9 @@ Lines, in order:
      splice), vs the reference's 15 MB/s per-tenant rate-limit default.
   5. spanmetrics_reduce_spans_per_sec -- BASELINE config #5: span-metrics
      segmented reduce (calls + latency sum + histogram) on device.
+  5b. search_concurrent_p50_ms -- Q parallel identical-shape queries on
+     one hot block through the cross-query batching executor
+     (db/batchexec): p50/p95 latency, launches-per-query, occupancy.
   6. search_block_e2e_cold_spans_per_sec -- BASELINE config #2, fresh
      reader each query: every byte from disk + staged to device.
   7. search_block_e2e_spans_per_sec -- BASELINE config #2 (headline):
@@ -585,6 +588,60 @@ def bench_ingest(tmp: str) -> None:
         app.stop()
 
 
+def bench_search_concurrent(tmp: str) -> None:
+    """Cross-query batching executor (db/batchexec): Q parallel
+    identical-shape queries against ONE hot staged block. Reports
+    per-query p50/p95 latency plus launches-per-query and batch
+    occupancy from kernel telemetry -- the sequential comparable is 2
+    launches per query (filter + select); a healthy batcher lands well
+    under 1."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    from tempo_tpu.backend.local import LocalBackend
+    from tempo_tpu.db import TempoDB, TempoDBConfig
+    from tempo_tpu.db.search import SearchRequest
+    from tempo_tpu.util.kerneltel import TEL
+
+    rng = np.random.default_rng(23)
+    backend = LocalBackend(tmp + "/store-conc")
+    meta, _ = synth_block(backend, "bench", rng, 1 << 15, 32)  # 1.05 M spans
+    db = TempoDB(
+        TempoDBConfig(wal_path=tmp + "/wal-conc", device_promote_touches=1),
+        backend=backend)
+    db.poll_now()
+    req = SearchRequest(query="{ duration > 100ms }", limit=20)
+    Q, iters = 16, 3
+
+    def one(_):
+        t0 = time.perf_counter()
+        r = db.search_blocks("bench", [meta], req)
+        assert r.traces
+        return time.perf_counter() - t0
+
+    with ThreadPoolExecutor(Q) as ex:  # warm: staging + both compiles
+        list(ex.map(one, range(Q)))
+    mark = _tel_mark()
+    l0 = TEL.launch_count()
+    s0 = TEL.batch_stats().get("search", {"groups": 0, "queries": 0})
+    lats: list[float] = []
+    for _ in range(iters):
+        with ThreadPoolExecutor(Q) as ex:
+            lats.extend(ex.map(one, range(Q)))
+    launches = TEL.launch_count() - l0
+    s1 = TEL.batch_stats().get("search", {"groups": 0, "queries": 0})
+    groups = s1["groups"] - s0.get("groups", 0)
+    queries = s1["queries"] - s0.get("queries", 0)
+    tel = _tel_close(mark)
+    tel.update({
+        "p95_ms": round(float(np.percentile(lats, 95)) * 1e3, 3),
+        "launches_per_query": round(launches / (Q * iters), 3),
+        "batch_occupancy": round(queries / groups, 2) if groups else 0.0,
+    })
+    _emit("search_concurrent_p50_ms", float(np.median(lats)) * 1e3, "ms",
+          0.0, tel=tel)
+    db.close()
+
+
 def bench_spanmetrics() -> None:
     import jax
 
@@ -613,6 +670,7 @@ def main() -> None:
         bench_compaction(tmp)
         bench_ingest(tmp)
         bench_spanmetrics()
+        bench_search_concurrent(tmp)
         _emit("search_block_e2e_cold_spans_per_sec", cold, "spans/s",
               cold / BASELINE_SPANS_PER_SEC, tel=cold_tel)
         # headline LAST: hot-block search (cached device staging), the

@@ -74,7 +74,39 @@ def cmd_search(args):
     for part in args.tags or []:
         k, _, v = part.partition("=")
         tags[k] = v
-    resp = db.search(args.tenant, SearchRequest(tags=tags, query=args.q or "", limit=args.limit))
+    req = SearchRequest(tags=tags, query=args.q or "", limit=args.limit)
+    if args.concurrency > 1:
+        # drive the cross-query batching executor by hand: N identical
+        # queries in parallel; latency + launch/occupancy summary on
+        # stderr, first response on stdout
+        import time
+        from concurrent.futures import ThreadPoolExecutor
+
+        from ..util.kerneltel import TEL
+
+        db.search(args.tenant, req)  # warm: staging + compiles
+
+        def one(_):
+            t0 = time.perf_counter()
+            r = db.search(args.tenant, req)
+            return time.perf_counter() - t0, r
+
+        l0 = TEL.launch_count()
+        with ThreadPoolExecutor(args.concurrency) as ex:
+            outs = list(ex.map(one, range(args.concurrency)))
+        launches = TEL.launch_count() - l0
+        lats = sorted(dt for dt, _ in outs)
+        resp = outs[0][1]
+        summary = {
+            "concurrency": args.concurrency,
+            "p50_ms": round(lats[len(lats) // 2] * 1e3, 3),
+            "p95_ms": round(lats[min(len(lats) - 1, int(len(lats) * 0.95))] * 1e3, 3),
+            "launches_per_query": round(launches / args.concurrency, 3),
+            "batching": TEL.batch_stats(),
+        }
+        print(json.dumps(summary, indent=2), file=sys.stderr)
+    else:
+        resp = db.search(args.tenant, req)
     db.close()
     print(json.dumps({"traces": [t.to_dict() for t in resp.traces]}, indent=2))
     if args.kernel_stats:
@@ -239,6 +271,10 @@ def main(argv=None):
     p.add_argument("--tags", nargs="*", help="k=v pairs")
     p.add_argument("-q", help="TraceQL query")
     p.add_argument("--limit", type=int, default=20)
+    p.add_argument("--concurrency", type=int, default=1,
+                   help="run N identical queries in parallel through the "
+                        "cross-query batching executor; latency/launch "
+                        "summary on stderr")
     p.add_argument("--kernel-stats", dest="kernel_stats", action="store_true",
                    help="print kernel telemetry (compiles, routing) to stderr")
     p.set_defaults(fn=cmd_search)

@@ -48,6 +48,11 @@ class TempoDBConfig:
     # searches of a block before its columns are staged on device (first
     # touches run the zero-RTT host engine; see search_blocks_fused)
     device_promote_touches: int = 2
+    # cross-query batching executor (db/batchexec): None fields resolve
+    # from the TEMPO_BATCH / TEMPO_BATCH_WINDOW_MS / TEMPO_BATCH_MAX env
+    batch_enabled: bool | None = None
+    batch_window_ms: float | None = None
+    batch_max: int | None = None
     compaction: comp.CompactorConfig = field(default_factory=comp.CompactorConfig)
 
 
@@ -74,6 +79,13 @@ class TempoDB:
         self._poll_thread: threading.Thread | None = None
         self._stop = threading.Event()
         self._mesh = None
+        # cross-query batching: concurrent search / find jobs that share
+        # a coalesce key merge into one fused kernel launch (batchexec)
+        from .batchexec import QueryBatchers
+
+        self.batchers = QueryBatchers(
+            enabled=cfg.batch_enabled, window_ms=cfg.batch_window_ms,
+            max_batch=cfg.batch_max)
         # compaction ownership + dedupe hooks, overridden by the service layer
         self.owns_job = lambda job_hash: True
         from ..util.metrics import Counter, Histogram
@@ -150,6 +162,12 @@ class TempoDB:
         partitions the candidate blocks, we execute one partition)."""
         if not candidates:
             return None
+        if self.cfg.device_find and self.batchers.enabled:
+            # concurrent lookups against the same candidate partition
+            # share one batched bisection (the Q axis of ops/find)
+            from .batchexec import batched_find
+
+            return batched_find(self.batchers.find, self, candidates, trace_id)
         if self.cfg.device_find:
             found = self._device_find(candidates, trace_id)
         else:
@@ -160,6 +178,30 @@ class TempoDB:
         if not found:
             return None
         return combine_traces(found)
+
+    def find_in_blocks_multi(self, items: list) -> list:
+        """Many (tenant, trace_id, candidates) lookups at once: jobs
+        sharing a candidate partition submit to the find batcher as one
+        group from this thread (and merge with any window-mates)."""
+        from .batchexec import _FindItem
+
+        out: list = [None] * len(items)
+        groups: dict[tuple, list[tuple[int, object]]] = {}
+        for i, (tenant, trace_id, candidates) in enumerate(items):
+            if not candidates:
+                continue
+            if not (self.cfg.device_find and self.batchers.enabled):
+                out[i] = self.find_in_blocks(tenant, trace_id, candidates)
+                continue
+            key = ("find", candidates[0].tenant_id,
+                   tuple(m.block_id for m in candidates))
+            groups.setdefault(key, []).append((i, _FindItem(
+                metas=candidates, trace_id=trace_id, db=self)))
+        for key, pairs in groups.items():
+            results = self.batchers.find.submit_many(key, [it for _, it in pairs])
+            for (i, _), r in zip(pairs, results):
+                out[i] = r
+        return out
 
     def _device_find(self, candidates: list[BlockMeta], trace_id: bytes) -> list[Trace]:
         """Device Find: host bloom gate (one ranged read per block), then
@@ -206,6 +248,17 @@ class TempoDB:
         resp = SearchResponse()
         if not metas:
             return resp
+        if self.cfg.device_search and len(metas) == 1 and self.batchers.enabled:
+            # single-block unit: concurrent queries against the same hot
+            # block coalesce into one fused multi-query launch
+            from .batchexec import batched_search_block
+
+            got = batched_search_block(
+                self.batchers.search, self.open_block(metas[0]), req,
+                promote_touches=self.cfg.device_promote_touches,
+                default_limit=self.cfg.search_default_limit)
+            if got is not None:
+                return got
         if self.cfg.device_search:
             if self.mesh.devices.size > 1 and len(metas) > 1:
                 from .search import search_blocks_device
@@ -235,8 +288,63 @@ class TempoDB:
         return resp
 
     def search_block_shard(self, tenant: str, meta: BlockMeta, req: SearchRequest, groups_range) -> SearchResponse:
-        """One sharded search job (frontend's StartPage/TotalPages analog)."""
-        return search_block(self.open_block(meta), req, groups_range=groups_range)
+        """One sharded search job (frontend's StartPage/TotalPages analog).
+        Concurrent shard jobs over the same row-group range coalesce
+        through the batching executor; ineligible plans run unchanged."""
+        blk = self.open_block(meta)
+        if self.cfg.device_search and self.batchers.enabled:
+            from .batchexec import batched_search_block
+
+            got = batched_search_block(
+                self.batchers.search, blk, req, groups_range=groups_range,
+                promote_touches=self.cfg.device_promote_touches)
+            if got is not None:
+                return got
+        return search_block(blk, req, groups_range=groups_range)
+
+    def search_block_shard_multi(self, items: list) -> list:
+        """Many (tenant, meta, req, groups_range) shard jobs at once;
+        same-shard jobs submit to the batcher together."""
+        from .batchexec import batched_search_block_many
+
+        out: list = [None] * len(items)
+        if self.cfg.device_search and self.batchers.enabled:
+            entries = [(self.open_block(m), req, groups)
+                       for (tenant, m, req, groups) in items]
+            out = batched_search_block_many(
+                self.batchers.search, entries,
+                promote_touches=self.cfg.device_promote_touches)
+        for i, (tenant, m, req, groups) in enumerate(items):
+            if out[i] is None:
+                out[i] = search_block(self.open_block(m), req,
+                                      groups_range=groups)
+        return out
+
+    def search_blocks_multi(self, items: list) -> list:
+        """Execute many (tenant, metas, req) search jobs at once -- the
+        frontend's batch-aware dequeue hands a whole burst here so even
+        a single worker thread forms full fused batches. Single-block
+        jobs group by coalesce key and join the batcher window together;
+        everything else runs the normal per-job path."""
+        from .batchexec import batched_search_block_many
+
+        out: list = [None] * len(items)
+        singles: list[tuple[int, tuple]] = []
+        for i, (tenant, metas, req) in enumerate(items):
+            if (self.cfg.device_search and self.batchers.enabled
+                    and len(metas) == 1):
+                singles.append((i, (self.open_block(metas[0]), req, None)))
+        if singles:
+            got = batched_search_block_many(
+                self.batchers.search, [e for _, e in singles],
+                promote_touches=self.cfg.device_promote_touches,
+                default_limit=self.cfg.search_default_limit)
+            for (i, _), r in zip(singles, got):
+                out[i] = r
+        for i, (tenant, metas, req) in enumerate(items):
+            if out[i] is None:
+                out[i] = self.search_blocks(tenant, metas, req)
+        return out
 
     # ------------------------------------------------------------ metrics
     def metrics_query_range(self, tenant: str, req) -> "object":

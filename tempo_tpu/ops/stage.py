@@ -67,6 +67,17 @@ def set_staged_cache_budget(n_bytes: int) -> None:
         _evict_over_budget_locked()
 
 
+def _sweep_dead_locked() -> None:
+    """Drop entries whose block weakref has died: their device arrays
+    are gone, so leaving their nbytes in _lru_bytes would make the HBM
+    budget evict live columns to pay for freed ones. Called under the
+    lock on every insert and eviction pass."""
+    global _lru_bytes
+    dead = [k for k, (wr, _) in _lru.items() if wr() is None]
+    for k in dead:
+        _lru_bytes -= _lru.pop(k)[1]
+
+
 def _lru_touch(blk, key: tuple, nbytes: int) -> None:
     global _lru_bytes
     k = (id(blk), key)
@@ -82,6 +93,8 @@ def _lru_touch(blk, key: tuple, nbytes: int) -> None:
             del _lru[k]
         _lru[k] = (weakref.ref(blk), nbytes)
         _lru_bytes += nbytes
+        # the eviction pass sweeps dead weakrefs first, so every insert
+        # restores the accounting invariant in one O(n) scan
         _evict_over_budget_locked()
 
 
@@ -97,6 +110,7 @@ def _lru_drop(blk, key: tuple) -> None:
 
 def _evict_over_budget_locked() -> None:
     global _lru_bytes
+    _sweep_dead_locked()  # freed arrays must not force live evictions
     while _lru_bytes > _GLOBAL_CACHE_BUDGET and len(_lru) > 1:
         (_bid, key), (wr, nbytes) = _lru.popitem(last=False)
         _lru_bytes -= nbytes

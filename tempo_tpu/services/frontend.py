@@ -59,7 +59,9 @@ def _retryable(e: Exception) -> bool:
 
 class RequestQueue:
     """Per-tenant fair FIFO: tenants round-robin, jobs FIFO within a
-    tenant (pkg/scheduler/queue/queue.go)."""
+    tenant (pkg/scheduler/queue/queue.go). Drained tenants are pruned
+    from the rotation (a churning tenant population used to grow
+    self.order without bound, and every dequeue scanned the corpses)."""
 
     def __init__(self, max_per_tenant: int = 2000):
         self.lock = threading.Lock()
@@ -80,22 +82,75 @@ class RequestQueue:
             q.append(job)
             self.cv.notify()
 
+    def _prune_locked(self, tenant: str, q) -> None:
+        """Drop a drained tenant from both maps (invariant: a tenant is
+        in self.order iff it has a non-empty deque)."""
+        if not q:
+            self.queues.pop(tenant, None)
+            try:
+                self.order.remove(tenant)
+            except ValueError:
+                pass
+
     def dequeue(self, timeout: float = 0.5, allowed=None):
         """Next (tenant, job), fair across tenants; allowed(tenant) False
         skips a tenant for THIS caller (per-tenant querier shuffle-shard,
         pkg/scheduler/queue/user_queues.go)."""
         with self.cv:
             while True:
-                for _ in range(len(self.order)):
+                n = len(self.order)
+                scanned = 0
+                while scanned < n:
                     tenant = self.order[0]
-                    self.order.rotate(-1)
                     q = self.queues.get(tenant)
-                    if q and (allowed is None or allowed(tenant)):
-                        return tenant, q.popleft()
+                    if not q:
+                        # drained (or orphaned) rotation slot: prune it
+                        self.order.popleft()
+                        self.queues.pop(tenant, None)
+                        n -= 1
+                        continue
+                    self.order.rotate(-1)
+                    scanned += 1
+                    if allowed is None or allowed(tenant):
+                        job = q.popleft()
+                        self._prune_locked(tenant, q)
+                        return tenant, job
                 if self.closed:
                     return None
                 if not self.cv.wait(timeout):
                     return None
+
+    def dequeue_batch(self, timeout: float = 0.5, allowed=None,
+                      max_batch: int = 1, key_fn=None):
+        """Fair dequeue of one job plus up to max_batch-1 ALREADY-QUEUED
+        jobs sharing its coalesce key (key_fn(job), None = unbatchable),
+        collected in one pass over the tenant rotation -- fairness within
+        the window means every tenant's matching head jobs join the same
+        fused launch rather than queueing behind it. Never waits for
+        more jobs, so a lone query is never delayed here (the admission
+        window lives in db/batchexec). Returns (tenant, job, extras)
+        where extras is a list of (tenant, job)."""
+        item = self.dequeue(timeout, allowed)
+        if item is None:
+            return None
+        tenant, job = item
+        extras: list = []
+        key = key_fn(job) if key_fn is not None else None
+        if key is not None and max_batch > 1:
+            with self.cv:
+                for _ in range(len(self.order)):
+                    if len(extras) >= max_batch - 1 or not self.order:
+                        break
+                    t2 = self.order[0]
+                    self.order.rotate(-1)
+                    q = self.queues.get(t2)
+                    if not q or (allowed is not None and not allowed(t2)):
+                        continue
+                    while (q and len(extras) < max_batch - 1
+                           and key_fn(q[0]) == key):
+                        extras.append((t2, q.popleft()))
+                    self._prune_locked(t2, q)
+        return tenant, job, extras
 
     def close(self):
         with self.cv:
@@ -123,6 +178,11 @@ class _Job:
     # active SelfTracer trace, parked in the kerneltel contextvar around
     # local execution so engine code can attach per-block kernel spans
     trace: object = None
+    # cross-query coalescing: jobs sharing a non-None batch_key target
+    # the same data unit (block batch / shard / candidate partition) and
+    # may execute together via batch_fn(group) -> list of results
+    batch_key: tuple | None = None
+    batch_fn: object = None
 
     def finish(self) -> None:
         if not self.done.is_set():  # a late hedge twin must not clobber
@@ -170,7 +230,9 @@ class Frontend:
         self.overrides = overrides
         self.worker_expiry_s = worker_expiry_s
         self._remote_workers: dict[str, float] = {}  # worker id -> last poll
-        self._leases: dict[str, tuple[str, _Job, float]] = {}
+        # lease id -> ([(tenant, job), ...], expiry); a `multi` wire job
+        # leases its whole merged batch under one id
+        self._leases: dict[str, tuple[list[tuple[str, _Job]], float]] = {}
         self._lease_lock = threading.Lock()
         self.stats_jobs_remote = 0
         self.stats_jobs_local = 0
@@ -194,46 +256,129 @@ class Frontend:
                          "error": j.error is not None})
 
     # ------------------------------------------------------- local workers
+    WORKER_DEQUEUE_BATCH = 16  # same-key jobs one worker drains per pull
+
     def _worker(self):
         while True:
-            item = self.queue.dequeue(timeout=1.0)
+            item = self.queue.dequeue_batch(
+                timeout=1.0, max_batch=self.WORKER_DEQUEUE_BATCH,
+                key_fn=lambda j: j.batch_key)
             if item is None:
                 if self.queue.closed:
                     return
                 continue
-            tenant, job = item
-            if job.cancelled or job.done.is_set():
-                job.finish()
+            tenant, job, extras = item
+            if extras and job.batch_fn is not None:
+                self._execute_batch([(tenant, job)] + extras)
                 continue
-            from ..util.kerneltel import TEL
+            self._execute_one(tenant, job)
+            for t2, j2 in extras:  # batch_fn-less jobs never batch
+                self._execute_one(t2, j2)
 
-            token = (TEL.set_active_trace(job.trace)
-                     if job.trace is not None else None)
-            try:
-                res = job.fn(*job.args)
-                if not job.done.is_set():
-                    job.result = res
-                self.stats_jobs_local += 1
-            except Exception as e:
-                # retry only transient failures (reference retries 5xx
-                # only, modules/frontend/retry.go); a parse error or bad
-                # argument fails identically every try. A hedge twin's
-                # failure must never clobber its sibling's success.
-                if job.done.is_set():
+    def _execute_batch(self, group: list) -> None:
+        """Run same-key jobs as ONE multi-job call (the coalesced db
+        APIs); any failure degrades to per-job execution so a batch is
+        never worse than the jobs run singly. Only the lead job's
+        self-trace is parked (the fused launch is one device step)."""
+        live = []
+        for t, j in group:
+            if j.cancelled or j.done.is_set():
+                j.finish()
+            else:
+                live.append((t, j))
+        if not live:
+            return
+        from ..util.kerneltel import TEL
+
+        lead = live[0][1]
+        token = (TEL.set_active_trace(lead.trace)
+                 if lead.trace is not None else None)
+        results = None
+        try:
+            results = lead.batch_fn(live)
+        except Exception:
+            results = None
+        finally:
+            if token is not None:
+                TEL.reset_active_trace(token)
+        if isinstance(results, list) and len(results) == len(live):
+            for (t, j), r in zip(live, results):
+                if isinstance(r, Exception):
+                    # per-item failure inside the batch: same retry
+                    # policy as single execution, isolated to this job
+                    self._fail_job(t, j, r)
                     continue
-                job.tries += 1
-                if _retryable(e) and job.tries < MAX_RETRIES:
-                    try:
-                        self.queue.enqueue(tenant, job)
-                        continue
-                    except TooManyRequests:
-                        pass
-                if not job.done.is_set():
-                    job.error = e
-            finally:
-                if token is not None:
-                    TEL.reset_active_trace(token)
+                if not j.done.is_set():
+                    j.result = r
+                self.stats_jobs_local += 1
+                j.finish()
+        else:
+            for t, j in live:
+                self._execute_one(t, j)
+
+    def _fail_job(self, tenant: str, job, e: Exception) -> None:
+        """Apply the single-job failure policy (transient -> re-enqueue
+        up to MAX_RETRIES, else error) to one job."""
+        if job.done.is_set():
+            return
+        job.tries += 1
+        if _retryable(e) and job.tries < MAX_RETRIES:
+            try:
+                self.queue.enqueue(tenant, job)
+                return
+            except TooManyRequests:
+                pass
+        job.error = e
+        job.finish()
+
+    def _execute_one(self, tenant: str, job) -> None:
+        if job.cancelled or job.done.is_set():
             job.finish()
+            return
+        from ..util.kerneltel import TEL
+
+        token = (TEL.set_active_trace(job.trace)
+                 if job.trace is not None else None)
+        try:
+            res = job.fn(*job.args)
+            if not job.done.is_set():
+                job.result = res
+            self.stats_jobs_local += 1
+        except Exception as e:
+            # retry only transient failures (reference retries 5xx
+            # only, modules/frontend/retry.go); a parse error or bad
+            # argument fails identically every try. A hedge twin's
+            # failure must never clobber its sibling's success.
+            if job.done.is_set():
+                return
+            job.tries += 1
+            if _retryable(e) and job.tries < MAX_RETRIES:
+                try:
+                    self.queue.enqueue(tenant, job)
+                    return
+                except TooManyRequests:
+                    pass
+            if not job.done.is_set():
+                job.error = e
+        finally:
+            if token is not None:
+                TEL.reset_active_trace(token)
+        job.finish()
+
+    # -------------------------------------------- coalesced job execution
+    def _batch_search_blocks(self, group: list) -> list:
+        """Same-key search_blocks jobs -> one multi-request db call (the
+        batching executor fuses eligible ones into one launch)."""
+        return self.querier.search_blocks_multi(
+            [(j.args[0], j.args[1], j.args[2]) for _, j in group])
+
+    def _batch_search_shards(self, group: list) -> list:
+        return self.querier.search_block_shard_multi(
+            [(j.args[0], j.args[1], j.args[2], j.args[3]) for _, j in group])
+
+    def _batch_find_blocks(self, group: list) -> list:
+        return self.querier.find_in_blocks_multi(
+            [(j.args[0], j.args[1], j.args[2]) for _, j in group])
 
     # ------------------------------------------------ remote querier pull
     def _tenant_allowed(self, tenant: str, worker_id: str) -> bool:
@@ -263,10 +408,15 @@ class Frontend:
         rng = random.Random(fnv1a_32(tenant.encode()))
         return worker_id in rng.sample(workers, k)
 
+    REMOTE_BATCH_MAX = 8  # same-key jobs merged into one wire pull
+
     def poll_job(self, wait_s: float = 5.0, worker_id: str = ""):
         """Long-poll dequeue for a remote querier worker
         (frontend_processor.go's stream recv). Returns a wire job dict
-        or None on timeout. Expired leases re-enter the queue first."""
+        or None on timeout. Same-key jobs queued at poll time merge into
+        ONE `multi` wire job (the remote face of the batch-aware
+        dequeue), leased together. Expired leases re-enter the queue
+        first."""
         if worker_id:
             with self._lease_lock:
                 self._remote_workers[worker_id] = time.monotonic()
@@ -277,58 +427,90 @@ class Frontend:
             remaining = deadline - time.monotonic()
             if remaining <= 0:
                 return None
-            item = self.queue.dequeue(timeout=min(remaining, 1.0), allowed=allowed)
+            item = self.queue.dequeue_batch(
+                timeout=min(remaining, 1.0), allowed=allowed,
+                max_batch=self.REMOTE_BATCH_MAX,
+                key_fn=lambda j: j.batch_key)
             if item is None:
                 if self.queue.closed:
                     return None
                 continue
-            tenant, job = item
-            if job.cancelled or job.done.is_set():
-                job.finish()
+            tenant, job, extras = item
+            pairs = []
+            for t, j in [(tenant, job)] + list(extras):
+                if j.cancelled or j.done.is_set():
+                    j.finish()
+                else:
+                    pairs.append((t, j))
+            if not pairs:
                 continue
             jid = uuid.uuid4().hex
             with self._lease_lock:
-                self._leases[jid] = (tenant, job, time.monotonic() + self.lease_s)
-            return {"id": jid, "tenant": tenant, "kind": job.kind, "payload": job.payload}
+                self._leases[jid] = (pairs, time.monotonic() + self.lease_s)
+            if len(pairs) == 1:
+                t0, j0 = pairs[0]
+                return {"id": jid, "tenant": t0, "kind": j0.kind,
+                        "payload": j0.payload}
+            return {"id": jid, "tenant": pairs[0][0], "kind": "multi",
+                    "payload": {"kind": pairs[0][1].kind,
+                                "tenants": [t for t, _ in pairs],
+                                "jobs": [j.payload for _, j in pairs]}}
 
     def complete_job(self, jid: str, ok: bool, result: dict | None = None,
                      error: str = "", retryable: bool = False) -> None:
-        """Remote worker posts a job result. Unknown/expired lease ids
-        are dropped (the job was re-dispatched or timed out)."""
+        """Remote worker posts a job result (or a `multi` result list,
+        demuxed per leased job). Unknown/expired lease ids are dropped
+        (the job was re-dispatched or timed out)."""
         with self._lease_lock:
             lease = self._leases.pop(jid, None)
         if lease is None:
             return
-        tenant, job, _ = lease
-        if job.done.is_set():
-            return
-        if ok:
-            try:
-                job.result = decode_job_result(job.kind, result or {})
-            except Exception as e:  # malformed result from a buggy worker:
-                # treat as a retryable failure so the request doesn't hang
-                # until the dispatch deadline with its lease already popped
-                ok, retryable, error = False, True, f"undecodable result: {e}"
-            else:
-                self.stats_jobs_remote += 1
-        if not ok:
-            job.tries += 1
-            if retryable and job.tries < MAX_RETRIES:
+        pairs, _ = lease
+        results: list = [result or {}]
+        if ok and len(pairs) > 1:
+            results = (result or {}).get("results") or []
+            if len(results) != len(pairs):
+                ok, retryable = False, True
+                error = error or "multi result arity mismatch"
+        for i, (tenant, job) in enumerate(pairs):
+            if job.done.is_set():
+                continue
+            job_ok, job_retryable, job_error = ok, retryable, error
+            res_i = results[i] if len(pairs) > 1 else results[0]
+            if job_ok and isinstance(res_i, dict) and "__job_error__" in res_i:
+                # per-job failure marker from a multi worker: only THIS
+                # job fails/retries, its window-mates keep their results
+                job_ok = False
+                job_retryable = bool(res_i.get("__retryable__"))
+                job_error = str(res_i["__job_error__"])
+            elif job_ok:
                 try:
-                    self.queue.enqueue(tenant, job)
-                    return
-                except TooManyRequests:
-                    pass
-            job.error = RuntimeError(error or "remote job failed")
-        job.finish()
+                    job.result = decode_job_result(job.kind, res_i)
+                except Exception as e:  # malformed result from a buggy
+                    # worker: treat as a retryable failure so the request
+                    # doesn't hang with its lease already popped
+                    job_ok, job_retryable = False, True
+                    job_error = f"undecodable result: {e}"
+                else:
+                    self.stats_jobs_remote += 1
+            if not job_ok:
+                job.tries += 1
+                if job_retryable and job.tries < MAX_RETRIES:
+                    try:
+                        self.queue.enqueue(tenant, job)
+                        continue
+                    except TooManyRequests:
+                        pass
+                job.error = RuntimeError(job_error or "remote job failed")
+            job.finish()
 
     def _requeue_expired(self) -> None:
         now = time.monotonic()
         expired = []
         with self._lease_lock:
-            for jid, (tenant, job, exp) in list(self._leases.items()):
+            for jid, (pairs, exp) in list(self._leases.items()):
                 if exp < now:
-                    expired.append((tenant, job))
+                    expired.extend(pairs)
                     del self._leases[jid]
         for tenant, job in expired:
             if not (job.done.is_set() or job.cancelled):
@@ -430,6 +612,9 @@ class Frontend:
                          "block_ids": [m.block_id for m in part]},
                 fn=self.querier.find_in_blocks,
                 args=(tenant, trace_id, part),
+                batch_key=("find_blocks", tenant,
+                           tuple(m.block_id for m in part)),
+                batch_fn=self._batch_find_blocks,
             ))
         for j in jobs:
             j.trace = trace
@@ -497,6 +682,9 @@ class Frontend:
                     kind="search_blocks",
                     payload={"req": req_d, "block_ids": [m.block_id for m in part]},
                     fn=self.querier.search_blocks, args=(tenant, part, req),
+                    batch_key=("search_blocks", tenant,
+                               tuple(m.block_id for m in part)),
+                    batch_fn=self._batch_search_blocks,
                 ))
                 batch, batch_bytes = [], 0
 
@@ -509,6 +697,9 @@ class Frontend:
                         kind="search_block_shard",
                         payload={"req": req_d, "block_id": m.block_id, "groups": groups},
                         fn=self.querier.search_block_shard, args=(tenant, m, req, groups),
+                        batch_key=("search_block_shard", tenant, m.block_id,
+                                   tuple(groups)),
+                        batch_fn=self._batch_search_shards,
                     ))
                 continue
             if batch_bytes + size > self.batch_bytes or len(batch) >= MAX_BLOCKS_PER_BATCH:

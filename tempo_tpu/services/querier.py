@@ -116,6 +116,16 @@ class Querier:
                 return resp
         return self.db.search_block_shard(tenant, meta, req, groups)
 
+    def search_block_shard_multi(self, items: list) -> list:
+        """Many shard jobs at once (the frontend's batch-aware dequeue):
+        local execution goes through the coalescing db API; external
+        serverless dispatch stays per-job (each leg hedges on its own)."""
+        if self.external_endpoints:
+            # search_block_shard counts its own stats per job
+            return [self.search_block_shard(*it) for it in items]
+        self.stats.searches += len(items)
+        return self.db.search_block_shard_multi(items)
+
     def _external_candidates(self) -> list[str]:
         """Endpoints not in breaker cooldown (all of them when every
         breaker is open -- a dead fleet still gets probed)."""
@@ -214,6 +224,17 @@ class Querier:
         round trip."""
         self.stats.searches += 1
         return self.db.search_blocks(tenant, metas, req)
+
+    def search_blocks_multi(self, items: list) -> list:
+        """Many block-batch jobs at once: eligible single-block jobs
+        coalesce into fused multi-query launches (db/batchexec)."""
+        self.stats.searches += len(items)
+        return self.db.search_blocks_multi(items)
+
+    def find_in_blocks_multi(self, items: list) -> list:
+        """Many explicit-block lookups at once: jobs sharing a candidate
+        partition share one batched bisection (db/batchexec)."""
+        return self.db.find_in_blocks_multi(items)
 
     def metrics_query_range(self, tenant: str, req):
         """One metrics time-shard job: a step-aligned sub-range of the

@@ -20,9 +20,71 @@ from ..wire import otlp_json
 from .querier import Querier
 
 
+def _metas_for(querier: Querier, tenant: str, block_ids: list):
+    """Resolve block ids against the local blocklist, refreshing once on
+    poll lag (the same retry the single-job kinds do)."""
+    metas = querier.db.blocklist.metas_by_id(tenant, block_ids)
+    if len(metas) != len(block_ids):
+        querier.db.poll_now()
+        metas = querier.db.blocklist.metas_by_id(tenant, block_ids)
+        if len(metas) != len(block_ids):
+            raise OSError("blocklist lags the frontend: unknown block ids")
+    return metas
+
+
 def execute_job(querier: Querier, tenant: str, kind: str, payload: dict) -> dict:
     """Run one wire job against the local querier; returns the wire
     result dict (the inverse of frontend.decode_job_result)."""
+    if kind == "multi":
+        # frontend-merged same-key jobs: execute as ONE coalesced call so
+        # the fused kernel batch forms here too (db/batchexec); kinds
+        # without a multi API fall back to a per-job loop. Per-job
+        # failures ship as __job_error__ markers so one poisoned query
+        # never fails (or retries) its window-mates at the frontend.
+        sub = payload["kind"]
+        tenants = payload["tenants"]
+        jobs = payload["jobs"]
+
+        def wire(r, encode):
+            if isinstance(r, Exception):
+                from .frontend import _retryable
+
+                return {"__job_error__": f"{type(r).__name__}: {r}",
+                        "__retryable__": _retryable(r)}
+            return encode(r)
+
+        try:
+            if sub == "search_blocks":
+                items = [(t, _metas_for(querier, t, p["block_ids"]),
+                          request_from_dict(p["req"]))
+                         for t, p in zip(tenants, jobs)]
+                return {"results": [
+                    wire(r, response_to_dict)
+                    for r in querier.search_blocks_multi(items)]}
+            if sub == "search_block_shard":
+                items = [(t, _metas_for(querier, t, [p["block_id"]])[0],
+                          request_from_dict(p["req"]), p["groups"])
+                         for t, p in zip(tenants, jobs)]
+                return {"results": [
+                    wire(r, response_to_dict)
+                    for r in querier.search_block_shard_multi(items)]}
+            if sub == "find_blocks":
+                items = [(t, bytes.fromhex(p["trace_id"]),
+                          _metas_for(querier, t, p["block_ids"]))
+                         for t, p in zip(tenants, jobs)]
+                return {"results": [
+                    wire(tr, lambda v: {"trace": otlp_json.dumps(v)
+                                        if v is not None else None})
+                    for tr in querier.find_in_blocks_multi(items)]}
+        except Exception:
+            pass  # coalesced call itself failed: degrade to per job
+        out = []
+        for t, p in zip(tenants, jobs):
+            try:
+                out.append(execute_job(querier, t, sub, p))
+            except Exception as e:
+                out.append(wire(e, None))
+        return {"results": out}
     if kind == "search_recent":
         req = request_from_dict(payload["req"])
         return response_to_dict(querier.search_recent(tenant, req))

@@ -94,6 +94,25 @@ class KernelTelemetry:
         self.routing = Counter(
             "tempo_engine_routing_total",
             help="engine routing decisions by layer, engine and reason")
+        # cross-query batching executor (db/batchexec): fused launches
+        self.batch_groups = Counter(
+            "tempo_batch_groups_total",
+            help="fused batch launches by executor")
+        self.batch_queries = Counter(
+            "tempo_batch_queries_total",
+            help="queries admitted into the batching executor")
+        self.batch_occupancy = Histogram(
+            "tempo_batch_occupancy_queries",
+            buckets=(1, 2, 4, 8, 16, 32, 64),
+            help="queries coalesced per fused launch group")
+        self.batch_window_wait = Histogram(
+            "tempo_batch_window_wait_seconds",
+            buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1),
+            help="admission-window wait paid by each batch leader")
+        self.batch_demux = Counter(
+            "tempo_batch_demux_total",
+            help="per-query results demultiplexed out of fused launches")
+        self._batches: dict[str, dict] = {}
         # full compile-key signatures, LRU-bounded (SEEN_SIGNATURES_MAX)
         self._seen: OrderedDict = OrderedDict()
         # (op, bucket-label) -> aggregate row for /status/kernels
@@ -213,6 +232,42 @@ class KernelTelemetry:
         with self._lock:
             return dict(self._routing)
 
+    # ---------------------------------------------------------- batching
+    def record_batch(self, name: str, occupancy: int, window_wait_s: float) -> None:
+        """One fused batch group executed: its occupancy (queries per
+        launch group) and the admission-window wait its leader paid."""
+        try:
+            labels = f'exec="{name}"'
+            self.batch_groups.inc(labels=labels)
+            self.batch_queries.inc(occupancy, labels=labels)
+            self.batch_occupancy.observe(float(occupancy), labels)
+            self.batch_window_wait.observe(float(window_wait_s), labels)
+            with self._lock:
+                b = self._batches.setdefault(
+                    name, {"groups": 0, "queries": 0, "max_occupancy": 0})
+                b["groups"] += 1
+                b["queries"] += int(occupancy)
+                b["max_occupancy"] = max(b["max_occupancy"], int(occupancy))
+        except Exception:
+            pass
+
+    def record_demux(self, name: str, n: int = 1) -> None:
+        try:
+            self.batch_demux.inc(n, labels=f'exec="{name}"')
+        except Exception:
+            pass
+
+    def batch_stats(self) -> dict:
+        """Per-executor batching aggregates for /status/kernels.
+        coalesce_ratio = queries per fused group (1.0 = no coalescing)."""
+        with self._lock:
+            out = {}
+            for name, b in self._batches.items():
+                out[name] = dict(b)
+                out[name]["coalesce_ratio"] = round(
+                    b["queries"] / b["groups"], 3) if b["groups"] else 0.0
+            return out
+
     # --------------------------------------------------------- query log
     def record_query(self, op: str, seconds: float, trace_id: str = "",
                      detail: str = "") -> None:
@@ -267,6 +322,14 @@ class KernelTelemetry:
             return (sum(k["compiles"] for k in self._kernels.values()),
                     sum(k["device_seconds"] for k in self._kernels.values()))
 
+    def launch_count(self) -> int:
+        """Total device-kernel launches recorded (compiles + jit-cache
+        hits across every op) -- the batching tests and the concurrent
+        bench measure launches-per-query as deltas of this."""
+        with self._lock:
+            return sum(k["compiles"] + k["cache_hits"]
+                       for k in self._kernels.values())
+
     def snapshot(self, slow_k: int = 10) -> dict:
         """The /status/kernels payload."""
         with self._lock:
@@ -297,6 +360,7 @@ class KernelTelemetry:
                 "cache_misses": int(self.staged_cache_misses.get()),
             },
             "routing": routing,
+            "batching": self.batch_stats(),
             "slow_queries": self.slow_queries(slow_k),
         }
 
@@ -306,7 +370,10 @@ class KernelTelemetry:
         for inst in (self.compiles, self.cache_hits, self.device_time,
                      self.transfer_bytes, self.staged_rows_real,
                      self.staged_rows_padded, self.staged_cache_hits,
-                     self.staged_cache_misses, self.routing):
+                     self.staged_cache_misses, self.routing,
+                     self.batch_groups, self.batch_queries,
+                     self.batch_occupancy, self.batch_window_wait,
+                     self.batch_demux):
             out += inst.text()
         return out
 
@@ -316,7 +383,10 @@ class KernelTelemetry:
         for inst in (self.compiles, self.cache_hits, self.device_time,
                      self.transfer_bytes, self.staged_rows_real,
                      self.staged_rows_padded, self.staged_cache_hits,
-                     self.staged_cache_misses, self.routing):
+                     self.staged_cache_misses, self.routing,
+                     self.batch_groups, self.batch_queries,
+                     self.batch_occupancy, self.batch_window_wait,
+                     self.batch_demux):
             fam = inst.name[:-6] if inst.name.endswith("_total") else inst.name
             out[fam] = inst.help
         return out
