@@ -277,6 +277,22 @@ def synth_block(backend, tenant: str, rng: np.random.Generator, n_traces: int,
 
 
 # ------------------------------------------------------------ benchmarks
+def bench_analysis() -> None:
+    """Static-checker cost, tracked beside kernel perf: the tier-1 gate
+    runs on every CI pass, so its wall time is part of the build budget.
+    The row carries rule and file counts so a scan-scope regression
+    (rules silently skipping files) shows up as a trend break."""
+    from tempo_tpu.analysis import RULES, default_root, run_analysis
+
+    t0 = time.perf_counter()
+    report = run_analysis(default_root())
+    wall_ms = (time.perf_counter() - t0) * 1e3
+    _emit("static_analysis_ms", wall_ms, "ms", 0.0,
+          tel={"rules": len(RULES), "files_scanned": report.files_scanned,
+               "findings": len(report.findings),
+               "suppressed": report.suppressed})
+
+
 def bench_kernel() -> None:
     import jax
     import jax.numpy as jnp
@@ -663,6 +679,7 @@ def bench_spanmetrics() -> None:
 
 
 def main() -> None:
+    bench_analysis()
     bench_kernel()
     tmp = tempfile.mkdtemp(prefix="tempo-tpu-bench-")
     try:

@@ -1,0 +1,141 @@
+"""tempo_tpu.analysis: kernel-contract & concurrency static checker.
+
+Build-time enforcement of the invariants the device read path depends
+on but no runtime test can check structurally: shape-only launch keys,
+no host syncs inside jitted bodies, a numpy twin behind every device
+kernel the executors dispatch, and lock-guarded module registries.
+
+Run it:  python -m tempo_tpu.analysis --strict
+Tier-1:  tests/test_analysis.py runs the same passes over the live
+         tree (must stay clean) and over a seeded-violation corpus
+         (every rule must still fire).
+
+Scopes (directories relative to the scanned root, normally the
+tempo_tpu package):
+
+  * kernel-contract rules (jit-*):   ops/, parallel/
+  * concurrency rules (global-/lock-*): services/, util/, ops/, db/
+  * twin registry rules (twin-*):    ops/ + parallel/ vs db/ executors
+  * parse-error:                     every scanned file
+
+All passes are pure-AST and stdlib-only: the checker never imports jax
+or the code under analysis, so it runs in milliseconds anywhere.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+from .concurrency import run_concurrency_rules
+from .core import (  # noqa: F401  (re-exported API)
+    RULES,
+    Finding,
+    Report,
+    SourceModule,
+    apply_baseline,
+    load_baseline,
+    walk_py,
+)
+from .jitrules import run_jit_rules, run_value_key_cross
+from .twinrules import run_twin_rules
+
+KERNEL_SCOPE = ("ops/", "parallel/")
+CONCURRENCY_SCOPE = ("services/", "util/", "ops/", "db/")
+
+
+def default_root() -> Path:
+    """The tempo_tpu package directory this checker ships inside."""
+    return Path(__file__).resolve().parents[1]
+
+
+def _resolve_package_roots(root: Path) -> list[Path]:
+    """Re-root a scan aimed above the package (e.g. the repo checkout
+    dir): a root whose scope directories hold no Python at all would
+    silently run zero scoped rules and report deceptively clean. A
+    scope dir counts only if it actually contains .py files, so the
+    repo-level ops/ bundle (dashboards, yaml) does not qualify.
+    Several sibling packages under one root all get scanned -- falling
+    back to the unscoped parent would be the deceptive-clean outcome
+    this function exists to prevent."""
+    def has_scoped_py(d: Path) -> bool:
+        return any(
+            next((d / s).glob("*.py"), None) is not None
+            for s in ("ops", "parallel", "services", "util", "db"))
+
+    if has_scoped_py(root):
+        return [root]
+    candidates = [c for c in sorted(root.iterdir())
+                  if c.is_dir() and not c.name.startswith(".")
+                  and has_scoped_py(c)]
+    return candidates or [root]
+
+
+def run_analysis(root: Path | None = None,
+                 files: list[Path] | None = None) -> Report:
+    """Scan a package root (directory walk + scoped passes + twin
+    cross-check) or an explicit file list (every per-file pass, no twin
+    check -- there is no tree to cross-reference)."""
+    report = Report()
+    root = Path(root) if root is not None else default_root()
+
+    if files is not None:
+        # key by the path as given, not the basename: same-named files
+        # in different directories must not collide (and baseline
+        # matching on (file, rule) must distinguish them)
+        todo = [(Path(f), str(f)) for f in files]
+        scoped = False
+    else:
+        roots = _resolve_package_roots(root)
+        if len(roots) > 1:
+            # sibling packages: full scoped run per package, findings
+            # prefixed with the package dir so they stay distinguishable
+            from dataclasses import replace
+
+            for r in roots:
+                sub = run_analysis(r)
+                report.findings.extend(
+                    replace(f, file=f"{r.name}/{f.file}")
+                    for f in sub.findings)
+                report.parse_errors.extend(
+                    replace(f, file=f"{r.name}/{f.file}")
+                    for f in sub.parse_errors)
+                report.files_scanned += sub.files_scanned
+                report.suppressed += sub.suppressed
+            report.findings.sort(key=lambda f: (f.file, f.line, f.rule))
+            return report
+        root = roots[0]
+        todo = walk_py(root)
+        scoped = True
+
+    modules: dict[str, SourceModule] = {}
+    for path, rel in todo:
+        report.files_scanned += 1
+        try:
+            modules[rel] = SourceModule.load(path, rel)
+        except SyntaxError as e:
+            report.parse_errors.append(Finding(
+                rel, e.lineno or 1, "parse-error",
+                f"does not parse: {e.msg}",
+                "fix the syntax error (or run with --skip-unparsable to "
+                "scan past it)"))
+        except (UnicodeDecodeError, ValueError, OSError) as e:
+            report.parse_errors.append(Finding(
+                rel, 1, "parse-error", f"unreadable: {e}",
+                "fix the encoding (or run with --skip-unparsable)"))
+
+    for rel, mod in modules.items():
+        # files at the root of a flat scan (no package layout) get every
+        # per-file pass; inside a package layout the directory scopes
+        # keep orchestration-only layers out of the kernel rules
+        flat = "/" not in rel
+        if not scoped or flat or rel.startswith(KERNEL_SCOPE):
+            run_jit_rules(mod, report)
+        if not scoped or flat or rel.startswith(CONCURRENCY_SCOPE):
+            run_concurrency_rules(mod, report)
+
+    if scoped:
+        run_twin_rules(modules, report)
+        run_value_key_cross(modules, report)
+
+    report.findings.sort(key=lambda f: (f.file, f.line, f.rule))
+    return report

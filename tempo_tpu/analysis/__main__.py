@@ -1,0 +1,111 @@
+"""CLI for the static checker.
+
+    python -m tempo_tpu.analysis [paths...] [--strict] [--json]
+                                 [--baseline FILE] [--skip-unparsable]
+                                 [--list-rules]
+
+Paths may be package roots (directory: full scoped run including the
+twin cross-check) or individual .py files (per-file passes only).
+Default: the tempo_tpu package this module ships in.
+
+Exit codes:
+  0  clean (or findings only outside --strict / covered by --baseline)
+  1  findings remain under --strict
+  2  a scanned file does not parse (unless --skip-unparsable): an
+     unparsable file is an unvouched-for file, not a clean one
+  3  invocation error (e.g. the --baseline file is missing or corrupt)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+from . import RULES, Report, apply_baseline, default_root, load_baseline, run_analysis
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tempo_tpu.analysis",
+        description="kernel-contract & concurrency static checker")
+    ap.add_argument("paths", nargs="*",
+                    help="package roots or .py files (default: tempo_tpu)")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit 1 on any finding not covered by --baseline")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable report on stdout")
+    ap.add_argument("--baseline", metavar="FILE",
+                    help="accepted-findings JSON (ANALYSIS_BASELINE.json "
+                         "format); matching (file, rule) pairs don't fail "
+                         "--strict")
+    ap.add_argument("--skip-unparsable", action="store_true",
+                    help="report parse failures as findings but do not "
+                         "exit 2 for them")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print every rule id and description, then exit")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rid, desc in sorted(RULES.items()):
+            print(f"{rid}: {desc}")
+        return 0
+
+    t0 = time.perf_counter()
+    roots: list[Path] = []
+    files: list[Path] = []
+    for p in args.paths:
+        (roots if Path(p).is_dir() else files).append(Path(p))
+    if not roots and not files:
+        roots = [default_root()]
+
+    report = Report()
+    for root in roots:
+        sub = run_analysis(root)
+        _merge(report, sub)
+    if files:
+        _merge(report, run_analysis(files[0].parent, files=files))
+
+    if args.baseline:
+        try:
+            apply_baseline(report, load_baseline(Path(args.baseline)))
+        except (OSError, ValueError, KeyError, TypeError) as e:
+            print(f"error: cannot read baseline {args.baseline}: {e}",
+                  file=sys.stderr)
+            return 3
+
+    wall_ms = (time.perf_counter() - t0) * 1e3
+    if args.as_json:
+        out = report.to_dict()
+        out["wall_ms"] = round(wall_ms, 2)
+        print(json.dumps(out, indent=2))
+    else:
+        for f in report.parse_errors:
+            print(f.render())
+        for f in report.findings:
+            print(f.render())
+        print(f"{report.files_scanned} files, {len(RULES)} rules, "
+              f"{len(report.findings)} finding(s), "
+              f"{len(report.parse_errors)} parse error(s), "
+              f"{report.suppressed} suppressed, "
+              f"{report.baselined} baselined, {wall_ms:.0f} ms")
+
+    if report.parse_errors and not args.skip_unparsable:
+        return 2
+    if args.strict and report.findings:
+        return 1
+    return 0
+
+
+def _merge(into: Report, sub: Report) -> None:
+    into.findings.extend(sub.findings)
+    into.parse_errors.extend(sub.parse_errors)
+    into.files_scanned += sub.files_scanned
+    into.suppressed += sub.suppressed
+    into.baselined += sub.baselined
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,311 @@
+"""Concurrency lint over services/, util/, ops/ and db/.
+
+The process-wide registries this codebase leans on (TEL, the staged
+LRU, RequestQueue rotation) are exactly the state the mesh-dispatch
+race and the staged-cache weakref leak corrupted at runtime in earlier
+PRs. These passes make the locking discipline structural:
+
+  * module-level mutable state (dicts/lists/sets/deques, and module
+    globals rebound via `global`) must be mutated under a lock.
+    Convention: functions named `*_locked` are exempt -- their contract
+    is "caller holds the lock" (ops/stage._evict_over_budget_locked);
+    module top-level statements run at import time, single-threaded.
+  * nested lock acquisitions must order consistently module-wide; an
+    inverted pair in two call paths is a deadlock waiting for load.
+  * bare `lock.acquire()` without an immediate try/finally release
+    leaks the lock on any exception between acquire and release.
+
+Lock identification is heuristic on purpose: any `with` context whose
+dotted name contains "lock" counts as holding one, and a statement-form
+`lock.acquire()` immediately followed by a try whose finally releases
+the same lock counts for the try body. We verify that *a* lock is held,
+not that it is the right one -- the wrong-lock case is rare and the
+pragma escape hatch documents the intentional ones. Value-form acquires
+(`ok = lock.acquire(timeout=...)`, `if lock.acquire(blocking=False):`)
+are deliberately out of scope: those are try-lock idioms that cannot
+use `with`, and their release discipline is control-flow-dependent in
+ways a lexical pass would only misjudge.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from .core import Report, SourceModule, dotted_name, emit, register_rule
+
+R_GLOBAL = register_rule(
+    "global-mutation-unlocked",
+    "module-level mutable state mutated outside any lock: concurrent "
+    "queriers interleave and corrupt the registry")
+R_LOCK_ORDER = register_rule(
+    "lock-order",
+    "locks acquired in inconsistent nesting order across functions in "
+    "this module: two threads taking opposite orders deadlock")
+R_BARE_ACQUIRE = register_rule(
+    "lock-bare-acquire",
+    "lock.acquire() without an immediate try/finally release leaks the "
+    "lock on any exception in between")
+
+MUTATORS = {"append", "add", "update", "pop", "popitem", "setdefault",
+            "remove", "discard", "clear", "extend", "insert",
+            "appendleft", "popleft", "move_to_end", "__setitem__"}
+MUTABLE_CTORS = {"dict", "list", "set", "defaultdict", "deque",
+                 "OrderedDict", "Counter"}
+
+
+_LOCK_TOKENS = {"lock", "rlock", "mutex"}
+
+
+def _is_lockish(expr: ast.AST) -> bool:
+    """Token match, not substring: this codebase's primary domain noun
+    is 'block', so `with staged_block:` must NOT read as a lock."""
+    d = dotted_name(expr)
+    if d is None and isinstance(expr, ast.Call):
+        d = dotted_name(expr.func)
+    if d is None:
+        return False
+    return bool(_LOCK_TOKENS & set(re.split(r"[._]+", d.lower())))
+
+
+def _module_mutables(tree: ast.Module) -> dict[str, int]:
+    """name -> definition line for module-level mutable containers."""
+    out: dict[str, int] = {}
+    for n in tree.body:
+        targets: list[ast.expr] = []
+        value = None
+        if isinstance(n, ast.Assign):
+            targets, value = n.targets, n.value
+        elif isinstance(n, ast.AnnAssign) and n.value is not None:
+            targets, value = [n.target], n.value
+        if value is None:
+            continue
+        mutable = isinstance(value, (ast.Dict, ast.List, ast.Set,
+                                     ast.DictComp, ast.ListComp, ast.SetComp))
+        if isinstance(value, ast.Call):
+            f = value.func
+            fname = f.id if isinstance(f, ast.Name) else (
+                f.attr if isinstance(f, ast.Attribute) else "")
+            mutable = fname in MUTABLE_CTORS
+        if not mutable:
+            continue
+        for t in targets:
+            if isinstance(t, ast.Name):
+                out[t.id] = n.lineno
+    return out
+
+
+def _root_name(expr: ast.AST) -> str | None:
+    """Leftmost Name of an attribute/subscript chain."""
+    while isinstance(expr, (ast.Attribute, ast.Subscript)):
+        expr = expr.value
+    return expr.id if isinstance(expr, ast.Name) else None
+
+
+class _FnLint(ast.NodeVisitor):
+    """One function body: mutations vs. held locks, lock sequences,
+    bare acquires. Nested defs are visited as part of their parent
+    (a closure mutating module state needs the same lock)."""
+
+    def __init__(self, mod: SourceModule, report: Report,
+                 mutables: dict[str, int], exempt: bool, class_name: str):
+        self.mod = mod
+        self.report = report
+        self.mutables = mutables
+        self.exempt = exempt
+        self.class_name = class_name
+        self.lock_depth = 0
+        self.held_stack: list[str] = []  # dotted lock names, outer->inner
+        self.pairs: list[tuple[str, str, int]] = []  # (outer, inner, line)
+        self.global_names: set[str] = set()
+
+    def visit_FunctionDef(self, node) -> None:
+        # a def nested under `with lock:` runs LATER, without the lock:
+        # its body must not inherit the lexically-held lock state
+        saved_depth, saved_stack = self.lock_depth, self.held_stack
+        self.lock_depth, self.held_stack = 0, []
+        self.generic_visit(node)
+        self.lock_depth, self.held_stack = saved_depth, saved_stack
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_Lambda = visit_FunctionDef
+
+    # ------------------------------------------------------------ locks
+    def _lock_label(self, expr: ast.AST) -> str:
+        d = dotted_name(expr) or (
+            dotted_name(expr.func) if isinstance(expr, ast.Call) else None)
+        d = d or "<lock>"
+        if d.startswith("self.") and self.class_name:
+            d = f"{self.class_name}.{d[5:]}"
+        return d
+
+    def visit_With(self, node: ast.With) -> None:
+        lock_items = [it for it in node.items
+                      if _is_lockish(it.context_expr)]
+        for it in lock_items:
+            label = self._lock_label(it.context_expr)
+            for outer in self.held_stack:
+                if outer != label:
+                    self.pairs.append((outer, label, it.context_expr.lineno))
+            self.held_stack.append(label)
+        self.lock_depth += len(lock_items)
+        self.generic_visit(node)
+        self.lock_depth -= len(lock_items)
+        del self.held_stack[len(self.held_stack) - len(lock_items):]
+
+    visit_AsyncWith = visit_With
+
+    def visit_Expr(self, node: ast.Expr) -> None:
+        v = node.value
+        if (isinstance(v, ast.Call) and isinstance(v.func, ast.Attribute)
+                and v.func.attr == "acquire" and _is_lockish(v.func.value)):
+            self._check_bare_acquire(node, v)
+        self.generic_visit(node)
+
+    def visit_Try(self, node: ast.Try) -> None:
+        # acquire(); try: ... finally: release() -- the sanctioned
+        # non-with form (lock-bare-acquire's own fix hint): the try body
+        # holds every lock the finalbody releases
+        released = []
+        for fin in node.finalbody:
+            for el in ast.walk(fin):
+                if (isinstance(el, ast.Call)
+                        and isinstance(el.func, ast.Attribute)
+                        and el.func.attr == "release"
+                        and _is_lockish(el.func.value)):
+                    released.append(self._lock_label(el.func.value))
+        self.lock_depth += len(released)
+        self.held_stack.extend(released)
+        # handlers run before finally, so they hold the lock too
+        for stmt in node.body + node.orelse:
+            self.visit(stmt)
+        for h in node.handlers:
+            for stmt in h.body:
+                self.visit(stmt)
+        self.lock_depth -= len(released)
+        del self.held_stack[len(self.held_stack) - len(released):]
+        for stmt in node.finalbody:
+            self.visit(stmt)
+
+    def _check_bare_acquire(self, stmt: ast.Expr, call: ast.Call) -> None:
+        parent_body = getattr(stmt, "_parent_body", None)
+        ok = False
+        if parent_body is not None:
+            idx = parent_body.index(stmt)
+            lock_name = dotted_name(call.func.value)
+            for follower in parent_body[idx + 1:idx + 2]:
+                if isinstance(follower, ast.Try):
+                    for fin in follower.finalbody:
+                        for el in ast.walk(fin):
+                            if (isinstance(el, ast.Call)
+                                    and isinstance(el.func, ast.Attribute)
+                                    and el.func.attr == "release"
+                                    and dotted_name(el.func.value) == lock_name):
+                                ok = True
+        if not ok:
+            emit(self.mod, self.report, call.lineno, R_BARE_ACQUIRE,
+                 f"{dotted_name(call.func.value)}.acquire() without an "
+                 "immediate try/finally release",
+                 "use `with lock:` (or wrap the critical section in "
+                 "try/finally releasing the lock)")
+
+    # -------------------------------------------------------- mutations
+    def visit_Global(self, node: ast.Global) -> None:
+        self.global_names.update(node.names)
+
+    def _flag(self, line: int, name: str, what: str) -> None:
+        if self.exempt or self.lock_depth > 0:
+            return
+        emit(self.mod, self.report, line, R_GLOBAL,
+             f"{what} of module-level '{name}' outside any lock",
+             "guard with the module lock, or suffix the function _locked "
+             "if the caller holds it")
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for t in node.targets:
+            self._check_target(t, node.lineno)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._check_target(node.target, node.lineno, aug=True)
+        self.generic_visit(node)
+
+    def visit_Delete(self, node: ast.Delete) -> None:
+        for t in node.targets:
+            if isinstance(t, ast.Subscript):
+                root = _root_name(t)
+                if root in self.mutables:
+                    self._flag(node.lineno, root, "del on item")
+        self.generic_visit(node)
+
+    def _check_target(self, t: ast.expr, line: int, aug: bool = False) -> None:
+        if isinstance(t, ast.Name):
+            # plain rebind of a module global (requires `global` stmt)
+            if t.id in self.global_names:
+                self._flag(line, t.id, "rebind")
+        elif isinstance(t, ast.Subscript):
+            root = _root_name(t)
+            if root in self.mutables:
+                self._flag(line, root, "item assignment")
+
+    def visit_Call(self, node: ast.Call) -> None:
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr in MUTATORS:
+            root = _root_name(f.value)
+            if root in self.mutables and isinstance(f.value, ast.Name):
+                self._flag(node.lineno, root, f".{f.attr}()")
+        self.generic_visit(node)
+
+
+def _link_parents(tree: ast.AST) -> None:
+    """Stamp statements with their containing body list (for the
+    acquire-then-try lookahead)."""
+    for node in ast.walk(tree):
+        for field in ("body", "orelse", "finalbody"):
+            body = getattr(node, field, None)
+            if isinstance(body, list):
+                for child in body:
+                    child._parent_body = body
+        for h in getattr(node, "handlers", []) or []:
+            for child in h.body:
+                child._parent_body = h.body
+
+
+def run_concurrency_rules(mod: SourceModule, report: Report) -> None:
+    tree = mod.tree
+    mutables = _module_mutables(tree)
+    _link_parents(tree)
+
+    pair_order: dict[frozenset, tuple[str, str]] = {}
+
+    def lint_fn(fn: ast.FunctionDef, class_name: str) -> None:
+        exempt = fn.name.endswith("_locked")
+        # `global X` must lexically precede any binding of X, so
+        # visit_Global has always populated global_names (scalars count
+        # too: _HOST_RATE_BPS-style EMAs are registries of one value)
+        # by the time a rebind of X is visited
+        lint = _FnLint(mod, report, mutables, exempt, class_name)
+        for stmt in fn.body:
+            lint.visit(stmt)
+        for outer, inner, line in lint.pairs:
+            key = frozenset((outer, inner))
+            seen = pair_order.get(key)
+            if seen is None:
+                pair_order[key] = (outer, inner)
+            elif seen != (outer, inner):
+                emit(mod, report, line, R_LOCK_ORDER,
+                     f"acquires '{inner}' while holding '{outer}', but "
+                     f"another path in this module acquires "
+                     f"'{seen[1]}' while holding '{seen[0]}'",
+                     "pick one module-wide acquisition order and stick to it")
+
+    def walk_defs(owner: ast.AST, class_name: str) -> None:
+        for child in ast.iter_child_nodes(owner):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                lint_fn(child, class_name)
+            elif isinstance(child, ast.ClassDef):
+                walk_defs(child, child.name)
+            elif isinstance(child, (ast.If, ast.Try, ast.With)):
+                walk_defs(child, class_name)
+
+    walk_defs(tree, "")

@@ -1,0 +1,153 @@
+"""Shared plumbing for the static checker: findings, rule registry,
+ignore pragmas, file walking, the baseline filter, and the report.
+
+Everything in this package is stdlib-only on purpose -- importing jax
+just to *lint* kernel code would cost seconds of startup and tie the
+checker to an accelerator runtime it never needs. The passes see the
+tree exactly as `ast` parses it; nothing is imported or executed.
+
+Suppression: a finding is dropped when its line (or the line above it)
+carries `# tempo: ignore[rule-id]` (comma-separate several ids; a bare
+`# tempo: ignore` suppresses every rule on that line). Pragmas should
+carry a reason after the bracket -- the fixture tests keep the live
+tree honest, but the reason is for the human reading the code.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+
+# rule-id -> one-line description; passes register at import time so the
+# CLI's --list-rules and the bench row see one authoritative set
+RULES: dict[str, str] = {
+    "parse-error": "file does not parse; the checker cannot vouch for it",
+}
+
+IGNORE_RE = re.compile(r"#\s*tempo:\s*ignore(?:\[([A-Za-z0-9_\-, ]+)\])?")
+
+
+def register_rule(rule_id: str, description: str) -> str:
+    RULES[rule_id] = description
+    return rule_id
+
+
+@dataclass(frozen=True)
+class Finding:
+    file: str  # path relative to the scan root
+    line: int
+    rule: str
+    message: str
+    hint: str = ""
+
+    def render(self) -> str:
+        s = f"{self.file}:{self.line}: [{self.rule}] {self.message}"
+        if self.hint:
+            s += f" (fix: {self.hint})"
+        return s
+
+    def to_dict(self) -> dict:
+        return {"file": self.file, "line": self.line, "rule": self.rule,
+                "message": self.message, "hint": self.hint}
+
+
+@dataclass
+class SourceModule:
+    """One parsed file plus its pragma index."""
+
+    path: Path
+    rel: str  # forward-slash path relative to the scan root
+    text: str
+    tree: ast.Module
+    # line -> set of suppressed rule ids ("*" = all)
+    pragmas: dict[int, set[str]] = field(default_factory=dict)
+
+    @classmethod
+    def load(cls, path: Path, rel: str) -> "SourceModule":
+        text = path.read_text(encoding="utf-8")
+        tree = ast.parse(text, filename=str(path))  # SyntaxError -> caller
+        pragmas: dict[int, set[str]] = {}
+        for i, line in enumerate(text.splitlines(), start=1):
+            m = IGNORE_RE.search(line)
+            if m:
+                rules = m.group(1)
+                pragmas[i] = ({r.strip() for r in rules.split(",")} if rules
+                              else {"*"})
+        return cls(path=path, rel=rel, text=text, tree=tree, pragmas=pragmas)
+
+    def suppressed(self, line: int, rule: str) -> bool:
+        for ln in (line, line - 1):
+            rules = self.pragmas.get(ln)
+            if rules and ("*" in rules or rule in rules):
+                return True
+        return False
+
+
+@dataclass
+class Report:
+    findings: list[Finding] = field(default_factory=list)
+    parse_errors: list[Finding] = field(default_factory=list)
+    files_scanned: int = 0
+    suppressed: int = 0
+    baselined: int = 0
+
+    def to_dict(self) -> dict:
+        return {
+            "rules": dict(sorted(RULES.items())),
+            "files_scanned": self.files_scanned,
+            "suppressed": self.suppressed,
+            "baselined": self.baselined,
+            "findings": [f.to_dict() for f in sorted(
+                self.findings, key=lambda f: (f.file, f.line, f.rule))],
+            "parse_errors": [f.to_dict() for f in self.parse_errors],
+        }
+
+
+def load_baseline(path: Path) -> set[tuple[str, str]]:
+    """Accepted-findings file: matches on (file, rule) so line drift in
+    unrelated edits does not resurrect an accepted finding."""
+    data = json.loads(path.read_text(encoding="utf-8"))
+    return {(f["file"], f["rule"]) for f in data.get("findings", [])}
+
+
+def apply_baseline(report: Report, baseline: set[tuple[str, str]]) -> None:
+    kept = []
+    for f in report.findings:
+        if (f.file, f.rule) in baseline:
+            report.baselined += 1
+        else:
+            kept.append(f)
+    report.findings = kept
+
+
+def walk_py(root: Path) -> list[tuple[Path, str]]:
+    out = []
+    for p in sorted(root.rglob("*.py")):
+        if "__pycache__" in p.parts:
+            continue
+        out.append((p, p.relative_to(root).as_posix()))
+    return out
+
+
+def emit(module: SourceModule, report: Report, line: int, rule: str,
+         message: str, hint: str = "") -> None:
+    """Route one raw finding through the pragma filter into the report."""
+    if module.suppressed(line, rule):
+        report.suppressed += 1
+        return
+    report.findings.append(Finding(module.rel, line, rule, message, hint))
+
+
+def dotted_name(node: ast.AST) -> str | None:
+    """'a.b.c' for Name/Attribute chains, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None

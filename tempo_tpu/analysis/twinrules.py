@@ -1,0 +1,240 @@
+"""Device/host twin cross-check.
+
+Every device kernel the read path dispatches must have a numpy twin the
+exact-verify machinery can fall back to -- the device encodings are
+conservative (ops/filter docstring), so a kernel without a host twin is
+a kernel whose over-matches can never be settled. The contract lives in
+`ops/twins.py` as plain data; this pass keeps it honest from both ends:
+
+  * twin-missing: a jit-reachable function in ops/ or parallel/ is
+    imported by one of the db executor modules (search, metrics_exec,
+    metrics_mesh, batchexec) but has no DEVICE_HOST_TWINS entry and no
+    declared DEVICE_ONLY exemption.
+  * twin-unresolvable: a registry entry names a device function or host
+    twin that does not exist -- or a "host" twin that itself reaches
+    jit, which would make exact-verify recurse onto the device.
+
+Jit-reachability is a module-level call-graph fixpoint over ops/ and
+parallel/: a function is device-touching if its body uses jax.jit or
+calls (by local or imported name) another device-touching function.
+Everything is AST-only; nothing is imported.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+
+from .core import Report, SourceModule, dotted_name, emit, register_rule
+
+R_MISSING = register_rule(
+    "twin-missing",
+    "device kernel used by a db executor has no numpy twin registered "
+    "in ops/twins.py (exact-verify cannot settle its over-matches)")
+R_UNRESOLVABLE = register_rule(
+    "twin-unresolvable",
+    "ops/twins.py entry does not resolve to a real function (stale "
+    "registry), or the registered host twin itself reaches jit")
+
+# the executors whose device dispatches the registry must cover
+DB_EXECUTORS = ("db/search.py", "db/metrics_exec.py", "db/metrics_mesh.py",
+                "db/batchexec.py")
+KERNEL_PKGS = ("ops", "parallel")
+
+
+def _fq_module(rel: str) -> str:
+    """'ops/filter.py' -> 'ops.filter' (package-root-relative)."""
+    return rel[:-3].replace("/", ".")
+
+
+def _resolve_import(cur_pkg: str, node: ast.ImportFrom) -> str | None:
+    """Package-root-relative module for an ImportFrom, or None when it
+    points outside the scanned root (stdlib, third-party)."""
+    mod = node.module or ""
+    if node.level == 0:
+        # absolute: accept tempo_tpu.ops.x / <root>.ops.x by stripping
+        # leading segments until a kernel package name
+        parts = mod.split(".")
+        for i, p in enumerate(parts):
+            if p in KERNEL_PKGS:
+                return ".".join(parts[i:])
+        return None
+    parts = cur_pkg.split("/") if cur_pkg else []
+    # level=1 -> same package, level=2 -> parent, ...
+    base = parts[:len(parts) - (node.level - 1)] if node.level - 1 else parts
+    if node.level - 1 > len(parts):
+        return None
+    prefix = ".".join(base)
+    return f"{prefix}.{mod}" if prefix and mod else (mod or prefix or None)
+
+
+class _ModuleFacts:
+    """Per-module: top-level defs, their called names, jit usage."""
+
+    def __init__(self, mod: SourceModule):
+        self.rel = mod.rel
+        self.fq = _fq_module(mod.rel)
+        self.imports: dict[str, str] = {}  # local name -> fq function name
+        self.defs: dict[str, ast.FunctionDef] = {}
+        self.classes: set[str] = set()
+        cur_pkg = "/".join(Path(mod.rel).parts[:-1])
+        for n in ast.walk(mod.tree):
+            if isinstance(n, ast.ImportFrom):
+                target = _resolve_import(cur_pkg, n)
+                if target is None:
+                    continue
+                for al in n.names:
+                    self.imports[al.asname or al.name] = f"{target}.{al.name}"
+        for n in mod.tree.body:
+            if isinstance(n, ast.FunctionDef):
+                self.defs[n.name] = n
+            elif isinstance(n, ast.ClassDef):
+                self.classes.add(n.name)
+
+    def direct_jit(self, fn: ast.FunctionDef) -> bool:
+        """One definition of 'jitted' shared with the jit rules: the
+        two passes must never disagree about it. ast.walk yields fn
+        itself first, so its own decorators are covered too."""
+        from .jitrules import _is_jax_jit, _jit_decorator_info
+
+        for n in ast.walk(fn):
+            if isinstance(n, ast.Call) and _is_jax_jit(n.func):
+                return True
+            if isinstance(n, ast.FunctionDef) and _jit_decorator_info(n)[0]:
+                return True
+        return False
+
+    def calls_of(self, fn: ast.FunctionDef) -> set[str]:
+        """fq names of functions this def references (call or bare name:
+        kernels get passed to executors/vmaps as values too)."""
+        out: set[str] = set()
+        for n in ast.walk(fn):
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load):
+                if n.id in self.defs:
+                    out.add(f"{self.fq}.{n.id}")
+                elif n.id in self.imports:
+                    out.add(self.imports[n.id])
+        return out
+
+
+def _jit_reachable(kernel_mods: list[_ModuleFacts]) -> set[str]:
+    direct: set[str] = set()
+    edges: dict[str, set[str]] = {}
+    for m in kernel_mods:
+        for name, fn in m.defs.items():
+            fq = f"{m.fq}.{name}"
+            if m.direct_jit(fn):
+                direct.add(fq)
+            edges[fq] = m.calls_of(fn)
+    reach = set(direct)
+    changed = True
+    while changed:
+        changed = False
+        for fq, callees in edges.items():
+            if fq not in reach and callees & reach:
+                reach.add(fq)
+                changed = True
+    return reach
+
+
+def _parse_registry(mod: SourceModule) -> tuple[dict, dict, dict[str, int]]:
+    """(DEVICE_HOST_TWINS, DEVICE_ONLY, key -> line) via literal eval."""
+    twins: dict = {}
+    device_only: dict = {}
+    lines: dict[str, int] = {}
+    for n in mod.tree.body:
+        if isinstance(n, ast.Assign) and len(n.targets) == 1:
+            target = n.targets[0]
+        elif isinstance(n, ast.AnnAssign):
+            target = n.target
+        else:
+            continue
+        if not (isinstance(target, ast.Name)
+                and isinstance(n.value, ast.Dict)):
+            continue
+        name = target.id
+        if name not in ("DEVICE_HOST_TWINS", "DEVICE_ONLY"):
+            continue
+        try:
+            value = ast.literal_eval(n.value)
+        except ValueError:
+            continue
+        (twins if name == "DEVICE_HOST_TWINS" else device_only).update(value)
+        for k in n.value.keys:
+            if isinstance(k, ast.Constant):
+                lines[k.value] = k.lineno
+    return twins, device_only, lines
+
+
+def run_twin_rules(modules: dict[str, SourceModule], report: Report) -> None:
+    """`modules` is rel-path -> SourceModule for one scanned root."""
+    reg_mod = modules.get("ops/twins.py")
+    kernel_mods = [_ModuleFacts(m) for rel, m in modules.items()
+                   if rel.split("/")[0] in KERNEL_PKGS
+                   and rel != "ops/twins.py"]
+    if not kernel_mods:
+        return
+    by_fq = {m.fq: m for m in kernel_mods}
+    reachable = _jit_reachable(kernel_mods)
+
+    twins: dict = {}
+    device_only: dict = {}
+    reg_lines: dict[str, int] = {}
+    if reg_mod is not None:
+        twins, device_only, reg_lines = _parse_registry(reg_mod)
+
+    def resolves(fq_func: str) -> bool:
+        mod_fq, _, func = fq_func.rpartition(".")
+        m = by_fq.get(mod_fq)
+        return m is not None and func in m.defs
+
+    # registry -> tree direction
+    if reg_mod is not None:
+        for dev, host in twins.items():
+            line = reg_lines.get(dev, 1)
+            if not resolves(dev):
+                emit(reg_mod, report, line, R_UNRESOLVABLE,
+                     f"device entry '{dev}' does not resolve to a function "
+                     "in ops/ or parallel/",
+                     "delete the stale entry or fix the dotted path")
+            if not resolves(host):
+                emit(reg_mod, report, line, R_UNRESOLVABLE,
+                     f"host twin '{host}' does not resolve to a function",
+                     "point the entry at the numpy twin the exact-verify "
+                     "path calls")
+            elif host in reachable:
+                emit(reg_mod, report, line, R_UNRESOLVABLE,
+                     f"host twin '{host}' itself reaches jax.jit: "
+                     "exact-verify would recurse onto the device",
+                     "register the pure-numpy implementation instead")
+        for dev in device_only:
+            if not resolves(dev):
+                emit(reg_mod, report, reg_lines.get(dev, 1), R_UNRESOLVABLE,
+                     f"DEVICE_ONLY entry '{dev}' does not resolve to a "
+                     "function in ops/ or parallel/",
+                     "delete the stale exemption")
+
+    # tree -> registry direction: every device kernel a db executor
+    # imports must be covered
+    for rel in DB_EXECUTORS:
+        m = modules.get(rel)
+        if m is None:
+            continue
+        cur_pkg = "/".join(Path(rel).parts[:-1])
+        for n in ast.walk(m.tree):
+            if not isinstance(n, ast.ImportFrom):
+                continue
+            target = _resolve_import(cur_pkg, n)
+            if target is None or target.split(".")[0] not in KERNEL_PKGS:
+                continue
+            for al in n.names:
+                fq = f"{target}.{al.name}"
+                if fq not in reachable:
+                    continue  # host helper, class, or constant
+                if fq in twins or fq in device_only:
+                    continue
+                emit(m, report, n.lineno, R_MISSING,
+                     f"'{fq}' is a device kernel (reaches jax.jit) with no "
+                     "registered numpy twin",
+                     "add a DEVICE_HOST_TWINS entry in ops/twins.py (or a "
+                     "DEVICE_ONLY exemption with a reason)")

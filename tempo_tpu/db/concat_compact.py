@@ -28,6 +28,7 @@ parts are all consumed ages out as a whole.
 from __future__ import annotations
 
 import json
+import threading
 import time
 import uuid
 
@@ -93,8 +94,12 @@ def compact_concat(backend: RawBackend, job, cfg) -> "CompactionResult":
 
 # markers are monotonic (a part never un-compacts), so positive results
 # cache process-wide: a K-part compound costs K marker probes per poll
-# only while its parts are still being consumed
+# only while its parts are still being consumed. Bounded: entries for
+# aged-out compounds are never probed again, so a long-lived process
+# with compaction churn would otherwise grow this forever
+_MARKER_CACHE_MAX = 4096
 _marker_cache: dict[tuple[str, str], float] = {}
+_marker_lock = threading.Lock()
 
 
 def expand_compound(backend: RawBackend, tenant: str, doc: dict):
@@ -120,7 +125,13 @@ def expand_compound(backend: RawBackend, tenant: str, doc: dict):
                 stamp = float(json.loads(marker).get("compacted_at_unix", 0.0))
             except (ValueError, TypeError):
                 stamp = time.time()  # corrupt marker: hold, don't age out
-            _marker_cache[key] = stamp = stamp or time.time()
+            stamp = stamp or time.time()
+            with _marker_lock:
+                while len(_marker_cache) >= _MARKER_CACHE_MAX:
+                    # insertion order ~ discovery order: oldest parts
+                    # age out of their compound docs first anyway
+                    _marker_cache.pop(next(iter(_marker_cache)))
+                _marker_cache[key] = stamp
         meta.compacted_at_unix = stamp
         out.append((meta, True))
     return out

@@ -85,6 +85,9 @@ _HOST_RATE_BPS: float = 1.5e9  # EMA, seeded at DDR-ish single-core scan rate
 def _note_host_rate(n_bytes: int, seconds: float) -> None:
     global _HOST_RATE_BPS
     if seconds > 1e-5 and n_bytes > (1 << 20):
+        # lossy EMA on the hot host-scan path: racing writers converge
+        # on the same steady state and a lock would serialize every scan
+        # tempo: ignore[global-mutation-unlocked] intentional lock-free EMA
         _HOST_RATE_BPS = 0.7 * _HOST_RATE_BPS + 0.3 * (n_bytes / seconds)
 
 

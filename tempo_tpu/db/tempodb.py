@@ -238,17 +238,21 @@ class TempoDB:
         metas = [m for m in self.blocklist.metas(tenant) if m.overlaps_time(req.start, req.end)]
         return self.search_blocks(tenant, metas, req)
 
-    def search_blocks(self, tenant: str, metas: list[BlockMeta], req: SearchRequest) -> SearchResponse:
+    def search_blocks(self, tenant: str, metas: list[BlockMeta], req: SearchRequest,
+                      _skip_batcher: bool = False) -> SearchResponse:
         """Search a set of blocks as one unit -- the execution engine
         behind both TempoDB.search and the frontend's block-batch jobs.
         Single chip: fused per-block kernels + ONE cross-block device
         top-k sync (db/search.search_blocks_fused). Mesh: the stacked
         sharded program (parallel/search.py). Falls back to per-block
-        search when the device budget or plan shape demands it."""
+        search when the device budget or plan shape demands it.
+        _skip_batcher: the caller already probed batch eligibility for
+        this query and got a fallback -- don't plan and count it twice."""
         resp = SearchResponse()
         if not metas:
             return resp
-        if self.cfg.device_search and len(metas) == 1 and self.batchers.enabled:
+        if (self.cfg.device_search and len(metas) == 1
+                and self.batchers.enabled and not _skip_batcher):
             # single-block unit: concurrent queries against the same hot
             # block coalesce into one fused multi-query launch
             from .batchexec import batched_search_block
@@ -343,7 +347,10 @@ class TempoDB:
                 out[i] = r
         for i, (tenant, metas, req) in enumerate(items):
             if out[i] is None:
-                out[i] = self.search_blocks(tenant, metas, req)
+                # single-block entries were already probed (and refused)
+                # by the batcher above: go straight to the engine
+                out[i] = self.search_blocks(tenant, metas, req,
+                                            _skip_batcher=len(metas) == 1)
         return out
 
     # ------------------------------------------------------------ metrics

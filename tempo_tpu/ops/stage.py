@@ -62,8 +62,11 @@ def staged_cache_stats(max_entries: int = 32) -> dict:
 
 def set_staged_cache_budget(n_bytes: int) -> None:
     global _GLOBAL_CACHE_BUDGET
-    _GLOBAL_CACHE_BUDGET = n_bytes
     with _lru_lock:
+        # budget write must be inside the lock: an eviction pass racing
+        # an unlocked shrink could evict against the stale budget and
+        # leave the cache over the new one
+        _GLOBAL_CACHE_BUDGET = n_bytes
         _evict_over_budget_locked()
 
 

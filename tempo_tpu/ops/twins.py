@@ -1,0 +1,59 @@
+"""Device/host twin registry: the exact-verify contract as data.
+
+Device kernels are *conservative* (clamped encodings may over-match,
+never under-match; ops/filter docstring), so every device kernel a db
+executor dispatches needs a pure-numpy twin the verify path can replay
+candidates through bit-exactly. This module records that pairing
+explicitly; `tempo_tpu.analysis` cross-checks it both ways at build
+time (twin-missing / twin-unresolvable), so adding a kernel without a
+twin -- or deleting a twin a kernel still relies on -- fails tier-1.
+
+Names are dotted paths relative to the tempo_tpu package. Several
+device kernels share one host twin: the fused multi-query program and
+the mesh variants demux to per-query/per-block calls whose semantics
+are exactly the single-block host evaluator's.
+"""
+
+from __future__ import annotations
+
+DEVICE_HOST_TWINS: dict[str, str] = {
+    # single-block filter program and its streamed wrapper
+    "ops.filter.eval_block": "ops.hostfilter.eval_block_host",
+    "ops.stream.eval_block_streamed": "ops.hostfilter.eval_block_host",
+    # top-k selection (single and cross-shard merge forms)
+    "ops.select.select_topk_device": "ops.select.select_topk_host",
+    "ops.select.select_topk_device_multi": "ops.select.select_topk_host_multi",
+    # TraceQL metrics time-bucketed folds
+    "ops.timeseries.eval_timeseries_device": "ops.timeseries.eval_timeseries_host",
+    "parallel.timeseries.sharded_timeseries": "ops.timeseries.eval_timeseries_host",
+    # fused multi-query batch programs: demuxed per query, each query's
+    # exact-verify replays through the single-block host evaluator
+    "ops.multiquery.eval_multiquery": "ops.hostfilter.eval_block_host",
+    "ops.multiquery.select_multiquery": "ops.select.select_topk_host",
+    # trace-id bisection (single-chip, batched, and mesh-sharded forms)
+    "ops.find.lookup_ids": "ops.find.lookup_ids_blocks_host",
+    "ops.find.lookup_ids_blocks": "ops.find.lookup_ids_blocks_host",
+    "ops.find.lookup_ids_blocks_cached": "ops.find.lookup_ids_blocks_host",
+    "parallel.find.sharded_find_rows": "ops.find.lookup_ids_blocks_host",
+    # mesh search: per-block results match the host evaluator per block
+    "parallel.search.sharded_search": "ops.hostfilter.eval_block_host",
+    # span-metrics segmented reduce routes to its host fold internally
+    "ops.reduce.span_metrics_reduce": "ops.reduce._reduce_host",
+}
+
+# Device entry points with no host twin BY DESIGN; each carries the
+# reason exact-verify does not need it. The checker accepts these but
+# flags stale names.
+DEVICE_ONLY: dict[str, str] = {
+    # staging is transport, not evaluation: the host path reads columns
+    # straight from the pack (db/search._host_cols), so there is no
+    # semantic result to mirror
+    "ops.stage.stage_block": "transport only; host path reads raw columns",
+    # probabilistic admission gate: a false positive only costs an exact
+    # downstream lookup, and misses are impossible by construction
+    "ops.bloom_ops.batch_test": "conservative gate; hits are re-verified "
+                                "by exact id bisection",
+    "ops.bloom_ops.union_blooms": "ingest-side aggregation of filter "
+                                  "words; nothing to verify",
+    "parallel.bloom.sharded_bloom_union": "mesh variant of union_blooms",
+}

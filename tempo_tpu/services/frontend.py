@@ -328,7 +328,11 @@ class Frontend:
                 return
             except TooManyRequests:
                 pass
-        job.error = e
+        # re-check: a hedge twin may have succeeded while we attempted
+        # the re-enqueue -- its result must not be clobbered with an
+        # error the waiter would raise
+        if not job.done.is_set():
+            job.error = e
         job.finish()
 
     def _execute_one(self, tenant: str, job) -> None:
@@ -349,17 +353,8 @@ class Frontend:
             # only, modules/frontend/retry.go); a parse error or bad
             # argument fails identically every try. A hedge twin's
             # failure must never clobber its sibling's success.
-            if job.done.is_set():
-                return
-            job.tries += 1
-            if _retryable(e) and job.tries < MAX_RETRIES:
-                try:
-                    self.queue.enqueue(tenant, job)
-                    return
-                except TooManyRequests:
-                    pass
-            if not job.done.is_set():
-                job.error = e
+            self._fail_job(tenant, job, e)
+            return
         finally:
             if token is not None:
                 TEL.reset_active_trace(token)
@@ -476,7 +471,14 @@ class Frontend:
             if job.done.is_set():
                 continue
             job_ok, job_retryable, job_error = ok, retryable, error
-            res_i = results[i] if len(pairs) > 1 else results[0]
+            # results may be short (worker posted ok=False, or a multi
+            # arity mismatch): never index past it -- every leased job
+            # must still reach the retry/fail policy below, not hang
+            # until the dispatch deadline on an IndexError
+            if len(pairs) == 1:
+                res_i = results[0]
+            else:
+                res_i = results[i] if i < len(results) else None
             if job_ok and isinstance(res_i, dict) and "__job_error__" in res_i:
                 # per-job failure marker from a multi worker: only THIS
                 # job fails/retries, its window-mates keep their results

@@ -8,28 +8,35 @@ faster than one round trip. Measure once per process, don't assume."""
 
 from __future__ import annotations
 
+import threading
+
 import numpy as np
 
 _LINK_RTT_MS: float | None = None
+_rtt_lock = threading.Lock()
 
 
 def link_rtt_ms() -> float:
     """One tiny put+compute+fetch round trip, measured at first use
-    (first rep absorbs backend init + the +1 kernel compile)."""
+    (first rep absorbs backend init + the +1 kernel compile). The lock
+    keeps concurrent first callers from racing duplicate probes (and
+    double-paying the backend-init rep)."""
     global _LINK_RTT_MS
     if _LINK_RTT_MS is None:
-        try:
-            import time as _time
+        with _rtt_lock:
+            if _LINK_RTT_MS is None:
+                try:
+                    import time as _time
 
-            import jax.numpy as jnp
+                    import jax.numpy as jnp
 
-            probe = np.zeros(8, np.int32)
-            best = float("inf")
-            for _ in range(3):
-                t0 = _time.perf_counter()
-                np.asarray(jnp.asarray(probe) + 1)
-                best = min(best, _time.perf_counter() - t0)
-            _LINK_RTT_MS = best * 1e3
-        except Exception:
-            _LINK_RTT_MS = 0.0
+                    probe = np.zeros(8, np.int32)
+                    best = float("inf")
+                    for _ in range(3):
+                        t0 = _time.perf_counter()
+                        np.asarray(jnp.asarray(probe) + 1)
+                        best = min(best, _time.perf_counter() - t0)
+                    _LINK_RTT_MS = best * 1e3
+                except Exception:
+                    _LINK_RTT_MS = 0.0
     return _LINK_RTT_MS

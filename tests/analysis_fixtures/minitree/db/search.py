@@ -1,0 +1,16 @@
+"""Fixture executor: imports one registered kernel and one orphan."""
+
+from ..ops.hostk import search_host
+from ..ops.kern import make_kern
+from ..ops.kern import orphan_kernel, search_kernel  # EXPECT: twin-missing
+
+
+def run(x):
+    return search_kernel(x), orphan_kernel(x), search_host(x)
+
+
+def run_compile_storm(x):
+    # executor-side value-keyed factory call: the cross-module pass
+    # must catch what the per-module pass cannot see
+    fn = make_kern(int(x.max()))  # EXPECT: jit-value-key
+    return fn(x)

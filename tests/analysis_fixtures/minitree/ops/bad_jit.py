@@ -1,0 +1,129 @@
+"""Seeded kernel-contract violations. Every EXPECT marker is asserted
+by tests/test_analysis.py to produce exactly that finding on exactly
+that line -- and nothing else. This file is never imported."""
+
+from functools import lru_cache, partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def sync_kernel(x):
+    total = x.sum()
+    host = float(x)  # EXPECT: jit-host-sync
+    v = total.item()  # EXPECT: jit-host-sync
+    arr = np.asarray(x)  # EXPECT: jit-host-sync
+    y = np.where(x > 0, 1, 0)  # EXPECT: jit-numpy
+    return jnp.sum(x) + host + v + arr.shape[0] + y
+
+
+@partial(jax.jit, static_argnames=("n_steps",))
+def timed_kernel(x, n_steps):
+    y = x * n_steps
+    y.block_until_ready()  # EXPECT: jit-host-sync
+    return y
+
+
+@jax.jit
+def ok_kernel(x):
+    # dtype constructors and static shape math are legitimate in-trace
+    n = np.int32(x.shape[0])
+    return jnp.cumsum(x.astype(jnp.float32)) + n
+
+
+def make_kernel(n):  # EXPECT: jit-uncached-factory
+    def body(x):
+        return jnp.sum(x) * n
+
+    return jax.jit(body)
+
+
+@lru_cache(maxsize=8)
+def make_loop_kernels(count: int):
+    kernels = []
+    for scale in range(count):
+
+        @jax.jit
+        def body(x):
+            return x * scale  # EXPECT: jit-nonstatic-capture
+
+        kernels.append(body)
+    return kernels
+
+
+@lru_cache(maxsize=8)
+def compiled_scale(k: int):
+    @jax.jit
+    def body(x):
+        return x * k  # enclosing-factory param: static by construction
+
+    return body
+
+
+def run_scaled(x):
+    fn = compiled_scale(int(x.max()))  # EXPECT: jit-value-key
+    return fn(x)
+
+
+def run_scaled_ok(x):
+    # shape-derived key: the sanctioned pattern (ops/device.bucket)
+    fn = compiled_scale(int(x.shape[0]))
+    return fn(x)
+
+
+@partial(jax.jit, static_argnames=("n_steps",))
+def stepped_kernel(x, n_steps):
+    for _ in range(n_steps):
+        x = x * 2
+    return x
+
+
+def run_stepped(x):
+    # static_argnames passed by KEYWORD key compiles just like
+    # positional static args
+    return stepped_kernel(x, n_steps=x.max())  # EXPECT: jit-value-key
+
+
+def run_stepped_ok(x):
+    return stepped_kernel(x, n_steps=x.shape[0].bit_length())
+
+
+@lru_cache(maxsize=8)
+def make_branch_kernel(flag: bool):
+    # bound once per call across disjoint branches: static for the
+    # closure, must NOT fire the capture rule
+    if flag:
+        scale2 = 1
+    else:
+        scale2 = 2
+
+    @jax.jit
+    def body(x):
+        return x * scale2
+
+    return body
+
+
+def entry_with_cached_factory(x, n):
+    # outer wrapper around a properly cached factory: must NOT fire
+    # jit-uncached-factory (the cached def owns the jit creation)
+    @lru_cache(maxsize=4)
+    def factory(k: int):
+        @jax.jit
+        def body(v):
+            return v * k
+
+        return body
+
+    return factory(n)(x)
+
+
+def _wrapped_impl(x):
+    v = x.sum().item()  # EXPECT: jit-host-sync
+    return x * v
+
+
+# module-level jit wrapping (no decorator) is a jit region too
+wrapped_kernel = jax.jit(_wrapped_impl)

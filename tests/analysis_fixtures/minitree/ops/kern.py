@@ -1,0 +1,25 @@
+"""Device kernels for the twin-registry fixtures."""
+
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def search_kernel(x):
+    return jnp.cumsum(x)
+
+
+@jax.jit
+def orphan_kernel(x):
+    return x * 2
+
+
+@lru_cache(maxsize=8)
+def make_kern(n: int):
+    @jax.jit
+    def body(x):
+        return x * n
+
+    return body

@@ -1,0 +1,10 @@
+"""Twin registry for the fixture minitree: one good entry, one stale."""
+
+DEVICE_HOST_TWINS = {
+    "ops.kern.search_kernel": "ops.hostk.search_host",
+    "ops.kern.gone_kernel": "ops.hostk.search_host",  # EXPECT: twin-unresolvable
+}
+
+DEVICE_ONLY = {
+    "ops.kern.make_kern": "compile factory, not an eval entry point",
+}

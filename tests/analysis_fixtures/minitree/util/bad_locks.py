@@ -1,0 +1,95 @@
+"""Seeded concurrency violations (and their sanctioned counterparts)."""
+
+import threading
+
+_lock = threading.Lock()
+_other_lock = threading.Lock()
+_registry: dict[str, int] = {}
+_BUDGET = 100
+
+
+def record(name):
+    _registry[name] = _registry.get(name, 0) + 1  # EXPECT: global-mutation-unlocked
+
+
+def forget(name):
+    _registry.pop(name, None)  # EXPECT: global-mutation-unlocked
+
+
+def set_budget(n):
+    global _BUDGET
+    _BUDGET = n  # EXPECT: global-mutation-unlocked
+
+
+def set_budget_intentional(n):
+    global _BUDGET
+    # tempo: ignore[global-mutation-unlocked] benign config rebind, test fixture
+    _BUDGET = n
+
+
+def record_guarded(name):
+    with _lock:
+        _registry[name] = 1
+
+
+def _trim_locked():
+    # *_locked convention: the caller holds the lock
+    _registry.clear()
+
+
+def nested_ab():
+    with _lock:
+        with _other_lock:
+            return dict(_registry)
+
+
+def nested_ba():
+    with _other_lock:
+        with _lock:  # EXPECT: lock-order
+            return len(_registry)
+
+
+def grab_unsafe():
+    _lock.acquire()  # EXPECT: lock-bare-acquire
+    n = len(_registry)
+    _lock.release()
+    return n
+
+
+def grab_safe():
+    # the sanctioned non-with form: the try body (and handlers) hold
+    # the lock, so the mutation inside must NOT fire the global rule
+    _lock.acquire()
+    try:
+        _registry["grab"] = 1
+        return len(_registry)
+    finally:
+        _lock.release()
+
+
+class _Blockish:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False
+
+
+staged_block = _Blockish()
+
+
+def block_is_not_a_lock():
+    # 'block' contains 'lock' as a substring but is NOT a lock: this
+    # mutation must still fire, and the with must not join lock-order
+    with staged_block:
+        _registry["b"] = 1  # EXPECT: global-mutation-unlocked
+
+
+def deferred_callback(register):
+    with _lock:
+        # the closure runs AFTER the with-block exits: lexical nesting
+        # under the lock must not count as holding it
+        def cb(k):
+            _registry[k] = 1  # EXPECT: global-mutation-unlocked
+
+        register(cb)

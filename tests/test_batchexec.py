@@ -348,6 +348,40 @@ def test_frontend_poll_merges_same_key_jobs():
         fe.stop()
 
 
+def test_frontend_multi_failure_fails_every_leased_job():
+    """A worker posting ok=False (or a short results list) for a multi
+    lease must fail/retry EVERY leased job -- a short list must never
+    strand window-mates until the dispatch deadline."""
+    from tempo_tpu.services.frontend import Frontend, _Job
+    from tempo_tpu.services.querier import Querier
+
+    db = _mkdb()
+    m = db.write_block(TENANT, make_traces(10, seed=42, n_spans=3))
+    querier = Querier(db, ring=None, client_for=lambda a: None)
+    fe = Frontend(querier, n_workers=0)
+    try:
+        for bad_result in (None, {"results": []}):
+            jobs = []
+            for i in range(3):
+                j = _Job(kind="search_blocks",
+                         payload={"req": {"limit": 5}, "block_ids": [m.block_id]},
+                         fn=None, args=(),
+                         batch_key=("search_blocks", TENANT, (m.block_id,)))
+                j.tries = 99  # exhaust retries: failure must surface now
+                jobs.append(j)
+                fe.queue.enqueue(TENANT, j)
+            wire = fe.poll_job(wait_s=1.0)
+            assert wire is not None and wire["kind"] == "multi"
+            fe.complete_job(wire["id"], ok=bad_result is not None,
+                            result=bad_result, error="worker exploded",
+                            retryable=True)
+            for j in jobs:
+                assert j.done.is_set()  # not stranded
+                assert j.error is not None
+    finally:
+        fe.stop()
+
+
 def test_worker_executes_multi_wire_job():
     from tempo_tpu.db.search import request_to_dict
     from tempo_tpu.services.querier import Querier
