@@ -83,6 +83,20 @@ class RawBackend(abc.ABC):
     def open_append(self, tenant: str, block_id: str, name: str) -> Appender:
         return Appender(self, tenant, block_id, name)
 
+    def copy_object(self, tenant: str, src_block_id: str, name: str,
+                    dst_block_id: str) -> int:
+        """Copy one immutable object between blocks of the same tenant,
+        backend-side where the store supports it (local backend:
+        hardlink; S3: CopyObject; others fall back here). Default: read
+        + write through the client. Returns bytes copied, or -1 when
+        the backend copied server-side without learning the size. The
+        concat compactor's verbatim part copies ride this, so
+        "compacting" a small block never moves its bytes through Python
+        when the backend can copy server-side."""
+        data = self.read(tenant, src_block_id, name)
+        self.write(tenant, dst_block_id, name, data)
+        return len(data)
+
     @abc.abstractmethod
     def write_tenant_object(self, tenant: str, name: str, data: bytes) -> None: ...
 

@@ -92,6 +92,25 @@ class LocalBackend(RawBackend):
     def open_append(self, tenant: str, block_id: str, name: str) -> Appender:
         return _FileAppender(self, tenant, block_id, name)
 
+    def copy_object(self, tenant: str, src_block_id: str, name: str,
+                    dst_block_id: str) -> int:
+        """Server-side copy as a hardlink: block objects are immutable
+        and writes replace directory entries (tmp + rename), never
+        inodes, so sharing the inode is safe -- and the concat
+        compactor's part copies become pure metadata ops. Falls back to
+        the read+write default when the filesystem refuses (cross-device
+        links, exotic mounts)."""
+        src = self._obj_path(tenant, src_block_id, name)
+        dst = self._obj_path(tenant, dst_block_id, name)
+        try:
+            os.makedirs(os.path.dirname(dst), exist_ok=True)
+            os.link(src, dst)
+            return os.path.getsize(dst)
+        except FileNotFoundError:
+            raise DoesNotExist(src) from None
+        except OSError:
+            return super().copy_object(tenant, src_block_id, name, dst_block_id)
+
     def write_tenant_object(self, tenant: str, name: str, data: bytes) -> None:
         self._write_file(os.path.join(self.path, tenant, _TENANT_OBJECT_DIR, name), data)
 

@@ -44,7 +44,11 @@ class SigV4:
         k = _hmac(k, self.service)
         return _hmac(k, "aws4_request")
 
-    def sign(self, method: str, url: str, payload_sha: str, now=None) -> dict[str, str]:
+    def sign(self, method: str, url: str, payload_sha: str, now=None,
+             extra_headers: dict[str, str] | None = None) -> dict[str, str]:
+        """extra_headers: additional x-amz-* request headers to SIGN and
+        send (e.g. x-amz-copy-source for server-side CopyObject); names
+        must be lowercase."""
         u = urllib.parse.urlsplit(url)
         now = now or datetime.datetime.now(datetime.timezone.utc)
         amz_date = now.strftime("%Y%m%dT%H%M%SZ")
@@ -59,6 +63,7 @@ class SigV4:
             )
         )
         headers = {"host": u.netloc, "x-amz-content-sha256": payload_sha, "x-amz-date": amz_date}
+        headers.update(extra_headers or {})
         signed_headers = ";".join(sorted(headers))
         canonical_headers = "".join(f"{k}:{headers[k]}\n" for k in sorted(headers))
         # u.path is already percent-encoded by the caller (_url); re-quoting
@@ -78,6 +83,7 @@ class SigV4:
         return {
             "x-amz-content-sha256": payload_sha,
             "x-amz-date": amz_date,
+            **(extra_headers or {}),
             "Authorization": (
                 f"AWS4-HMAC-SHA256 Credential={self.access_key}/{scope}, "
                 f"SignedHeaders={signed_headers}, Signature={sig}"
@@ -108,11 +114,13 @@ class S3Backend(RawBackend):
         return base
 
     def _request(self, method: str, url: str, data: bytes | None = None,
-                 range_hdr: str | None = None) -> tuple[int, bytes]:
+                 range_hdr: str | None = None,
+                 extra_headers: dict[str, str] | None = None) -> tuple[int, bytes]:
         payload_sha = hashlib.sha256(data).hexdigest() if data else _EMPTY_SHA
-        headers = {}
+        headers = dict(extra_headers or {})
         if self.signer:
-            headers.update(self.signer.sign(method, url, payload_sha))
+            headers.update(self.signer.sign(method, url, payload_sha,
+                                            extra_headers=extra_headers))
         if range_hdr:
             headers["Range"] = range_hdr
         req = urllib.request.Request(url, data=data, headers=headers, method=method)
@@ -132,6 +140,22 @@ class S3Backend(RawBackend):
 
     def write_tenant_object(self, tenant: str, name: str, data: bytes) -> None:
         self._request("PUT", self._url(self._key(f"{tenant}/{name}")), data)
+
+    def copy_object(self, tenant: str, src_block_id: str, name: str,
+                    dst_block_id: str) -> int:
+        """True server-side CopyObject: PUT with a signed
+        x-amz-copy-source header, zero payload -- the part bytes never
+        transit the client. Returns -1 (size unknown without a HEAD;
+        no caller needs it). S3 reports copy errors either as non-2xx
+        or as a 200 carrying an <Error> document -- both raise."""
+        src_key = self._key(block_object_path(tenant, src_block_id, name))
+        dst_url = self._url(self._key(block_object_path(tenant, dst_block_id, name)))
+        src_hdr = urllib.parse.quote(f"/{self.bucket}/{src_key}")
+        status, body = self._request(
+            "PUT", dst_url, extra_headers={"x-amz-copy-source": src_hdr})
+        if b"<Error>" in body:
+            raise BackendError(f"s3 copy {src_key}: {body[:200]!r}")
+        return -1
 
     # ------------------------------------------------------------- read
     def read(self, tenant: str, block_id: str, name: str) -> bytes:

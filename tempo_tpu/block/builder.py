@@ -522,7 +522,8 @@ def _column_level(name: str):
 
 
 def write_block(backend: RawBackend, fin: FinalizedBlock, level: int = 3,
-                codec: str = "zstd", version: str | None = None) -> BlockMeta:
+                codec: str = "zstd", version: str | None = None,
+                defer_meta: bool = False) -> BlockMeta:
     """Write all block objects; meta.json last so pollers never see a
     partial block (reference writes meta last for the same reason).
     codec selects the chunk compression (colio codec matrix); readers
@@ -531,7 +532,13 @@ def write_block(backend: RawBackend, fin: FinalizedBlock, level: int = 3,
     version: block encoding version to WRITE (default: the registry's
     CURRENT_VERSION). "vtpu1" emits the JSON pack footer that pre-binary
     readers parse; "vtpu2" the binary footer. The convert tool and
-    mixed-version tests are the down-level writers."""
+    mixed-version tests are the down-level writers.
+
+    defer_meta=True holds back the meta.json write -- the block stays
+    INVISIBLE to pollers until publish_block_meta. The compaction
+    pipeline uses this to commit a multi-output job atomically: every
+    output's data is durable before the first meta appears, so a crash
+    between outputs leaves nothing half-visible."""
     from .versioned import CURRENT_VERSION
 
     m = fin.meta
@@ -602,8 +609,15 @@ def write_block(backend: RawBackend, fin: FinalizedBlock, level: int = 3,
     for i in range(fin.bloom.n_shards):
         backend.write(m.tenant_id, m.block_id, f"{BLOOM_PREFIX}{i}", fin.bloom.shard_bytes(i))
     m.size_bytes = app.bytes_written
-    backend.write(m.tenant_id, m.block_id, "meta.json", m.to_json())
+    if not defer_meta:
+        backend.write(m.tenant_id, m.block_id, "meta.json", m.to_json())
     return m
+
+
+def publish_block_meta(backend: RawBackend, meta: BlockMeta) -> None:
+    """Commit a block written with defer_meta=True: the meta.json write
+    is the visibility point for pollers."""
+    backend.write(meta.tenant_id, meta.block_id, "meta.json", meta.to_json())
 
 
 def build_block_from_traces(

@@ -482,11 +482,16 @@ class ColumnPack:
         """Fetch the WHOLE pack with one ranged read and serve later
         reads from memory. For small blocks (compaction inputs, the
         many-tiny-blocks shape) this replaces dozens of per-chunk
-        backend reads/opens with one."""
+        backend reads/opens with one. Idempotent: the compaction
+        pipeline's prefetch stage may run it before the merge stage
+        calls it again; the second call must not re-copy the pack."""
+        if getattr(self, "_preloaded", False):
+            return
         data = self._read_range(0, self._size)
         self._count_read(len(data))
         self._read_range = lambda off, ln: data[off : off + ln]
         self._count_read = lambda n: None  # already counted in full
+        self._preloaded = True
 
     @staticmethod
     def _dctx() -> "zstandard.ZstdDecompressor":

@@ -24,6 +24,8 @@ within design capacity (ops/bloom_ops.py), else rebuilt batch-native.
 
 from __future__ import annotations
 
+from dataclasses import dataclass
+
 import numpy as np
 
 from ..backend.base import RawBackend
@@ -556,13 +558,39 @@ def _assemble(tenant: str, sources: list[_Source],
     return FinalizedBlock(m, cols, axes, col_axis, merged, bloom)
 
 
-def compact_columnar(backend: RawBackend, job: CompactionJob, cfg: CompactorConfig) -> CompactionResult:
+@dataclass
+class ColumnarPlan:
+    """Output of the fetch+merge stages (plan_columnar): everything the
+    assemble/write stages need. The pipeline executor runs plan and
+    assemble on different schedules; the sequential driver
+    (compact_columnar) runs them back to back."""
+
+    tenant: str
+    job: CompactionJob
+    blocks: list[BackendBlock]
+    sources: list[_Source]
+    merged: Dictionary | None
+    out_level: int
+    # (src, sid_lo, sid_hi) run arrays per output block; empty when the
+    # inputs hold zero traces (mark-only job)
+    chunk_lists: list[tuple[np.ndarray, np.ndarray, np.ndarray]]
+    single_est: bool
+
+
+def plan_columnar(backend: RawBackend, job: CompactionJob, cfg: CompactorConfig,
+                  blocks: list[BackendBlock] | None = None) -> ColumnarPlan:
+    """Fetch + merge planning: decode sources, compute the global merge
+    order (collisions combined), merge dictionaries, cut the run table
+    into per-output chunk lists. Raises UnsupportedColumnar when the
+    inputs can't merge columnar-ly. `blocks`: already-opened readers
+    (the pipeline's prefetch stage passes preloaded ones)."""
     tenant = job.tenant
     from ..block.versioned import open_block_versioned
 
     # version dispatch: an unknown-format input must fail the job
     # loudly, never be misparsed as vtpu1 bytes
-    blocks = [open_block_versioned(backend, m) for m in job.blocks]
+    if blocks is None:
+        blocks = [open_block_versioned(backend, m) for m in job.blocks]
     # one output block => consume-as-you-go pays; multi-output jobs never
     # consume, so skip the per-column copies (estimate from input bytes:
     # single iff everything fits one target block, the common L0->L1 case)
@@ -619,9 +647,9 @@ def compact_columnar(backend: RawBackend, job: CompactionJob, cfg: CompactorConf
     else:
         run_src = run_lo = run_hi = np.empty(0, np.int64)
     if run_src.size == 0:
-        for m in job.blocks:
-            backend.mark_compacted(tenant, m.block_id)
-        return CompactionResult(compacted_ids=[m.block_id for m in job.blocks])
+        # zero input traces: nothing to assemble, mark-only job
+        return ColumnarPlan(tenant, job, blocks, sources, None,
+                            out_level, [], single_est)
 
     # merged dictionary via native K-way byte-level merge (no string
     # decode anywhere) + one remap gather per source (axis columns
@@ -646,7 +674,6 @@ def compact_columnar(backend: RawBackend, job: CompactionJob, cfg: CompactorConf
     target = cfg.target_block_bytes or cfg.max_block_bytes
     cap_traces = max(1, int(max(target - len(blob), target // 4) / bpt))
 
-    result = CompactionResult()
     # split the run table into per-output-block slices at cap_traces
     # boundaries (vectorized; a run straddling a cut is split in two)
     lens = run_hi - run_lo
@@ -675,18 +702,36 @@ def compact_columnar(backend: RawBackend, job: CompactionJob, cfg: CompactorConf
                 chunk_lists.append((s_src[keep], s_lo[keep], s_hi[keep]))
             prev_run, prev_off = r, off_in_r
 
-    single_out = len(chunk_lists) == 1
-    for cl in chunk_lists:
-        bloom = _union_input_blooms(blocks) if single_out else None
-        fin = _assemble(tenant, sources, cl, merged, out_level,
-                        cfg.row_group_spans, bloom,
-                        consume=single_out and single_est)
-        meta = write_block(backend, fin, level=cfg.level_for(out_level))
+    return ColumnarPlan(tenant, job, blocks, sources, merged,
+                        out_level, chunk_lists, single_est)
+
+
+def iter_outputs(plan: ColumnarPlan, cfg: CompactorConfig):
+    """Assemble the plan's output blocks one at a time. Yield order and
+    contents are deterministic: a pipelined consumer that writes each
+    FinalizedBlock produces bit-identical blocks to the sequential
+    driver."""
+    single_out = len(plan.chunk_lists) == 1
+    for cl in plan.chunk_lists:
+        bloom = _union_input_blooms(plan.blocks) if single_out else None
+        yield _assemble(plan.tenant, plan.sources, cl, plan.merged,
+                        plan.out_level, cfg.row_group_spans, bloom,
+                        consume=single_out and plan.single_est)
+
+
+def compact_columnar(backend: RawBackend, job: CompactionJob, cfg: CompactorConfig) -> CompactionResult:
+    """Sequential driver: plan, then assemble+write each output block
+    back to back. The pipelined driver (db/compact_pipeline.py) runs the
+    same plan/iter_outputs stages with assemble/write overlapped."""
+    plan = plan_columnar(backend, job, cfg)
+    result = CompactionResult()
+    for fin in iter_outputs(plan, cfg):
+        meta = write_block(backend, fin, level=cfg.level_for(plan.out_level))
         result.new_blocks.append(meta)
         result.traces_out += fin.meta.total_traces
         result.spans_out += fin.meta.total_spans
 
     result.compacted_ids = [m.block_id for m in job.blocks]
     for m in job.blocks:
-        backend.mark_compacted(tenant, m.block_id)
+        backend.mark_compacted(job.tenant, m.block_id)
     return result

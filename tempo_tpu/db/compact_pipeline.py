@@ -1,0 +1,475 @@
+"""Pipelined concurrent compaction: overlap fetch, merge, assemble and
+write across jobs and output blocks.
+
+The sequential driver (db/compactor.compact) runs one job, one stage at
+a time: backend IO, the numpy/native merge, and zstd+write never overlap
+even though jobs own disjoint block sets. This executor turns the
+compactor into a bounded, memory-budgeted pipeline (the write-side
+analog of PR 3's admission-window batching on the query side):
+
+  * job-level concurrency: TEMPO_COMPACT_CONCURRENCY worker threads run
+    whole jobs in parallel. Compaction is IO + C-extension work (ranged
+    reads, memcpy gathers, zstd/zlib), all of which drops the GIL, so
+    even the 1-2 core compactor box overlaps one job's reads with
+    another's compress+write.
+  * admission gate: a job's estimated peak host RAM is
+    sum(input size_bytes) x pipeline_expansion; jobs wait at the gate
+    while the in-flight estimate would exceed the budget. One job always
+    admits, so an oversized job stalls the pipeline instead of
+    deadlocking it.
+  * per-tenant round-robin fairness: the admission order interleaves
+    tenants (the RequestQueue rotation shape, applied to a fixed job
+    set), so one tenant's backlog can't starve the others.
+  * input prefetch: while admitted jobs merge, a prefetch thread runs up
+    to prefetch_depth jobs ahead, opening readers and preloading small
+    packs via the existing one-ranged-read path (_Source.PRELOAD_MAX
+    _BYTES), charged against the same memory budget.
+  * assemble/write double-buffering: within a multi-output columnar job,
+    output block k+1 assembles while block k compresses and streams
+    through write_block's ordered writer thread -- a bounded queue of
+    depth 1, so at most one finalized block waits in memory.
+
+Crash/ordering safety: outputs are written with defer_meta=True and
+their meta.json objects publish only after EVERY output's data is
+durable; input blocks are mark_compacted strictly after the last
+publish. A crash anywhere before the publish point (the whole
+fetch/merge/assemble/write span) leaves nothing visible to blocklist
+polling and no input consumed, so a re-run converges. The publish loop
+itself is the one narrow window left: a crash between meta publishes
+surfaces some outputs with inputs unmarked -- the rerun duplicates
+those traces, which query-time dedupe (wire/combine) already renders
+harmless (the same double-visibility the poller's swap-window grace
+relies on) until the next level folds them. Output bytes are
+bit-identical to a sequential run: the pipeline reorders WORK, never
+data.
+
+Everything observable lands in util/kerneltel.TEL: per-stage wall-time
+histograms, jobs/bytes in flight, admission queue depth, prefetch
+hit/miss/waste, and the per-run overlap ratio -- surfaced through
+/metrics and /status/kernels.
+"""
+
+from __future__ import annotations
+
+import os
+import queue as _queue
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass
+
+from ..backend.base import RawBackend
+from ..block.builder import publish_block_meta, write_block
+from ..util.kerneltel import TEL
+from .compactor import (
+    CompactionJob,
+    CompactionResult,
+    CompactorConfig,
+    _compact_wire,
+    compact,
+    concat_eligible,
+)
+
+DEFAULT_MEM_BUDGET_BYTES = 1 << 30
+
+
+def resolve_concurrency(cfg: CompactorConfig) -> int:
+    """Worker count: config wins, then TEMPO_COMPACT_CONCURRENCY, then 1
+    (sequential)."""
+    if cfg.concurrency is not None:
+        return max(1, int(cfg.concurrency))
+    try:
+        return max(1, int(os.environ.get("TEMPO_COMPACT_CONCURRENCY", "") or 1))
+    except ValueError:
+        return 1
+
+
+def resolve_mem_budget(cfg: CompactorConfig) -> int:
+    """Admission budget in bytes: config, then TEMPO_COMPACT_MEM_BUDGET,
+    then 1 GiB."""
+    if cfg.pipeline_mem_budget_bytes is not None:
+        return max(1, int(cfg.pipeline_mem_budget_bytes))
+    try:
+        return max(1, int(os.environ.get("TEMPO_COMPACT_MEM_BUDGET", "")
+                          or DEFAULT_MEM_BUDGET_BYTES))
+    except ValueError:
+        return DEFAULT_MEM_BUDGET_BYTES
+
+
+@dataclass
+class JobOutcome:
+    """One job's terminal state; exactly one of result/error is set."""
+
+    tenant: str
+    job: CompactionJob
+    result: CompactionResult | None = None
+    error: Exception | None = None
+
+
+@dataclass
+class _Ticket:
+    """One scheduled job plus its pipeline bookkeeping. All mutable
+    fields are read/written under the pipeline's condition variable."""
+
+    tenant: str
+    job: CompactionJob
+    est_bytes: int
+    fetch_claimed: bool = False  # someone (prefetcher or worker) owns the fetch
+    pf_accounted: bool = False  # est_bytes already charged by the prefetcher
+    pf_failed: bool = False  # prefetch errored; the worker refetches
+    blocks: list | None = None  # opened readers, packs preloaded
+    fetch_seconds: float = 0.0
+
+
+class CompactionPipeline:
+    """Bounded pipeline executor over a fixed set of compaction jobs.
+
+    One instance runs one job set (`run`); construct per sweep. Ring
+    ownership is the CALLER's concern -- pass only owned jobs. Results
+    surface in admission order; `on_result` (blocklist update hook)
+    fires from worker threads as each job commits."""
+
+    def __init__(self, backend: RawBackend, cfg: CompactorConfig,
+                 concurrency: int | None = None):
+        self.backend = backend
+        self.cfg = cfg
+        self.concurrency = max(1, concurrency if concurrency is not None
+                               else resolve_concurrency(cfg))
+        self.budget = resolve_mem_budget(cfg)
+        self.expansion = max(1.0, float(cfg.pipeline_expansion))
+        self.prefetch_depth = max(0, int(cfg.prefetch_depth))
+        self._cv = threading.Condition()
+        # ---- guarded by _cv ----
+        self._tickets: list[_Ticket] = []
+        self._next = 0  # admission cursor into _tickets
+        self._inflight_jobs = 0
+        self._inflight_bytes = 0  # admitted + prefetch-charged estimates
+        self._stop = False
+
+    # ------------------------------------------------------------ schedule
+    def _round_robin(self, jobs_by_tenant: dict[str, list[CompactionJob]]
+                     ) -> list[_Ticket]:
+        """Deterministic admission order: tenants rotate, jobs FIFO
+        within a tenant (the RequestQueue fairness pattern over a fixed
+        job set)."""
+        order = sorted(t for t, jobs in jobs_by_tenant.items() if jobs)
+        queues: dict[str, deque] = {t: deque(jobs_by_tenant[t]) for t in order}
+        out: list[_Ticket] = []
+        while order:
+            for t in list(order):
+                q = queues[t]
+                job = q.popleft()
+                est = int(sum(m.size_bytes for m in job.blocks) * self.expansion)
+                out.append(_Ticket(t, job, est_bytes=max(1, est)))
+                if not q:
+                    order.remove(t)
+        return out
+
+    # ---------------------------------------------------------------- run
+    def run(self, jobs_by_tenant: dict[str, list[CompactionJob]],
+            on_result=None) -> list[JobOutcome]:
+        """Execute every job; returns outcomes in admission order.
+        on_result(tenant, job, result) runs on the worker thread right
+        after a job's commit point (outputs published, inputs marked) --
+        an exception there converts the outcome to an error."""
+        tickets = self._round_robin(jobs_by_tenant)
+        if not tickets:
+            return []
+        TEL.begin_compact_run()
+        t_run = time.perf_counter()
+        with self._cv:
+            self._tickets = tickets
+            self._next = 0
+            self._inflight_jobs = 0
+            self._inflight_bytes = 0
+            self._stop = False
+        outcomes: list[JobOutcome | None] = [None] * len(tickets)
+        n_workers = min(self.concurrency, len(tickets))
+        workers = [
+            threading.Thread(target=self._worker, args=(outcomes, on_result),
+                             name=f"compact-worker-{i}", daemon=True)
+            for i in range(n_workers)
+        ]
+        prefetcher = None
+        if (self.prefetch_depth > 0 and len(tickets) > 1
+                and any(self._prefetchable(t) for t in tickets)):
+            # all-concat sweeps (the many-tiny-blocks shape) have nothing
+            # to prefetch; don't run a thread that would only poll the cv
+            prefetcher = threading.Thread(
+                target=self._prefetcher, name="compact-prefetch", daemon=True)
+            prefetcher.start()
+        for w in workers:
+            w.start()
+        for w in workers:
+            w.join()
+        with self._cv:
+            self._stop = True
+            self._cv.notify_all()
+        if prefetcher is not None:
+            prefetcher.join()
+        TEL.compact_inflight(0, 0, 0)
+        TEL.record_compact_run(time.perf_counter() - t_run)
+        return [oc for oc in outcomes if oc is not None]
+
+    # ------------------------------------------------------------- workers
+    def _worker(self, outcomes: list, on_result) -> None:
+        while True:
+            with self._cv:
+                while True:
+                    if self._next >= len(self._tickets):
+                        return
+                    t = self._tickets[self._next]
+                    extra = 0 if t.pf_accounted else t.est_bytes
+                    if (self._inflight_jobs == 0
+                            or self._inflight_bytes + extra <= self.budget):
+                        i = self._next
+                        self._next += 1
+                        self._inflight_jobs += 1
+                        self._inflight_bytes += extra
+                        break
+                    # re-check on release notifications; the timeout only
+                    # guards against a lost wakeup, not correctness
+                    self._cv.wait(0.1)
+                jobs_now = self._inflight_jobs
+                bytes_now = self._inflight_bytes
+                queued = len(self._tickets) - self._next
+            TEL.compact_inflight(jobs_now, bytes_now, queued)
+            in_bytes = sum(m.size_bytes for m in t.job.blocks)
+            try:
+                res = self._run_job(t)
+                if on_result is not None:
+                    on_result(t.tenant, t.job, res)
+                outcomes[i] = JobOutcome(t.tenant, t.job, result=res)
+                TEL.record_compact_job(in_bytes, ok=True)
+            except Exception as e:  # noqa: BLE001 - one job must not kill the sweep
+                outcomes[i] = JobOutcome(t.tenant, t.job, error=e)
+                TEL.record_compact_job(in_bytes, ok=False)
+            finally:
+                with self._cv:
+                    self._inflight_jobs -= 1
+                    self._inflight_bytes -= t.est_bytes
+                    jobs_now = self._inflight_jobs
+                    bytes_now = self._inflight_bytes
+                    queued = len(self._tickets) - self._next
+                    self._cv.notify_all()
+                # re-publish on release too, or the gauges overstate
+                # occupancy for the whole drain tail of a run
+                TEL.compact_inflight(jobs_now, bytes_now, queued)
+
+    # ------------------------------------------------------------ prefetch
+    def _prefetcher(self) -> None:
+        """Run ahead of the admission cursor, opening readers and
+        preloading small packs (one ranged read per pack) for jobs the
+        workers will pick up next. Lookahead and bytes are bounded: at
+        most prefetch_depth jobs past the active window, charged against
+        the same admission budget."""
+        while True:
+            with self._cv:
+                if self._stop:
+                    return
+                if self._next >= len(self._tickets):
+                    return
+                target = None
+                hi = min(len(self._tickets),
+                         self._next + self.concurrency + self.prefetch_depth)
+                for j in range(self._next, hi):
+                    c = self._tickets[j]
+                    if c.fetch_claimed or not self._prefetchable(c):
+                        continue
+                    if self._inflight_bytes + c.est_bytes > self.budget:
+                        # budget full: don't pile decode RAM ahead. No
+                        # one-job exemption here -- skipping a prefetch
+                        # can't deadlock (workers fetch for themselves),
+                        # while exempting it would let charges stack past
+                        # the budget whenever workers are between jobs
+                        continue
+                    c.fetch_claimed = True
+                    c.pf_accounted = True
+                    self._inflight_bytes += c.est_bytes
+                    target = c
+                    break
+                if target is None:
+                    self._cv.wait(0.05)
+                    continue
+            try:
+                blocks, dt = self._fetch(target)
+            except Exception:  # noqa: BLE001 - worker refetches and surfaces it
+                blocks, dt = None, 0.0
+                # the IO done before the failure is thrown away: the
+                # worker refetches from scratch
+                TEL.record_compact_prefetch("waste")
+            with self._cv:
+                if blocks is None:
+                    target.pf_failed = True
+                else:
+                    target.blocks = blocks
+                    target.fetch_seconds = dt
+                self._cv.notify_all()
+
+    def _prefetchable(self, t: _Ticket) -> bool:
+        """Only columnar jobs consume opened readers; concat jobs copy
+        raw objects and wire-merge jobs are the rare fallback."""
+        return self.cfg.columnar and not concat_eligible(t.job, self.cfg)
+
+    def _fetch(self, t: _Ticket) -> tuple[list, float]:
+        """The IO stage: open every input's reader; small packs preload
+        with one ranged read (idempotent -- _Source.from_block's own
+        preload becomes a no-op)."""
+        from ..block.versioned import open_block_versioned
+        from .columnar_compact import _Source
+
+        t0 = time.perf_counter()
+        blocks = []
+        for m in t.job.blocks:
+            b = open_block_versioned(self.backend, m)
+            pack = getattr(b, "pack", None)
+            if (pack is not None and m.size_bytes
+                    and m.size_bytes <= _Source.PRELOAD_MAX_BYTES):
+                pack.preload()
+            blocks.append(b)
+        return blocks, time.perf_counter() - t0
+
+    def _take_fetched(self, t: _Ticket) -> list:
+        """Fetch stage from the worker's side: use the prefetched
+        readers (hit), wait for an in-flight prefetch, or do the IO
+        here (miss)."""
+        with self._cv:
+            wait_for_pf = t.fetch_claimed
+            if not t.fetch_claimed:
+                t.fetch_claimed = True
+            while wait_for_pf and t.blocks is None and not t.pf_failed:
+                self._cv.wait(0.05)
+            blocks = t.blocks
+            # drop the ticket's reference: tickets outlive their jobs
+            # (the whole run), and a retained reader pins its preloaded
+            # pack bytes -- the admission budget must be the only thing
+            # holding job memory alive
+            t.blocks = None
+        if blocks is not None:
+            TEL.record_compact_stage("fetch", t.fetch_seconds)
+            TEL.record_compact_prefetch("hit")
+            return blocks
+        blocks, dt = self._fetch(t)
+        TEL.record_compact_stage("fetch", dt)
+        TEL.record_compact_prefetch("miss")
+        return blocks
+
+    # ------------------------------------------------------------ job body
+    def _run_job(self, t: _Ticket) -> CompactionResult:
+        """One job through the staged path. Concat and wire-merge jobs
+        run their existing (already meta-last, mark-after-durable)
+        bodies -- job-level concurrency is the win there; columnar jobs
+        additionally overlap assemble with compress+write."""
+        job, cfg = t.job, self.cfg
+        is_concat = concat_eligible(job, cfg)
+        if not cfg.columnar or is_concat:
+            # unstaged job bodies get their own stage labels so the
+            # per-stage histogram doesn't misattribute concat IO (ranged
+            # reads + object copies) to the columnar write stage
+            stage = "concat" if is_concat else "wire"
+            t0 = time.perf_counter()
+            res = compact(self.backend, job, cfg)
+            TEL.record_compact_stage(stage, time.perf_counter() - t0)
+            return res
+        blocks = self._take_fetched(t)
+        from .columnar_compact import UnsupportedColumnar, plan_columnar
+
+        t0 = time.perf_counter()
+        try:
+            plan = plan_columnar(self.backend, job, cfg, blocks=blocks)
+        except UnsupportedColumnar:
+            TEL.record_compact_stage("merge", time.perf_counter() - t0)
+            # rare fallback, straight to the wire merge: re-entering
+            # compact() would re-fetch and re-decode every input just to
+            # raise the same refusal again before landing there anyway
+            t1 = time.perf_counter()
+            res = _compact_wire(self.backend, job, cfg)
+            TEL.record_compact_stage("wire", time.perf_counter() - t1)
+            return res
+        TEL.record_compact_stage("merge", time.perf_counter() - t0)
+        try:
+            return self._write_outputs(plan)
+        except UnsupportedColumnar:
+            # _assemble can refuse LATE (e.g. unknown column family).
+            # Go STRAIGHT to the wire merge: re-entering the columnar
+            # driver via compact() would publish early outputs
+            # (defer_meta=False there) before deterministically refusing
+            # again -- orphaned duplicates. _write_outputs already
+            # reclaimed its unpublished outputs and no input is marked.
+            t1 = time.perf_counter()
+            res = _compact_wire(self.backend, job, cfg)
+            TEL.record_compact_stage("wire", time.perf_counter() - t1)
+            return res
+
+    def _write_outputs(self, plan) -> CompactionResult:
+        """Assemble/write double-buffer with an atomic commit: data for
+        ALL outputs lands (defer_meta) before the first meta.json
+        publishes; inputs mark_compacted only after every publish. The
+        depth-1 queue bounds memory to one finalized block waiting."""
+        from .columnar_compact import iter_outputs
+
+        cfg = self.cfg
+        result = CompactionResult()
+        metas: list = []
+        fins: _queue.Queue = _queue.Queue(maxsize=1)
+        werr: list[BaseException] = []
+
+        def _writer():
+            # keep draining after a failure so the assembler never
+            # deadlocks on put(); the error surfaces after join
+            while True:
+                fin = fins.get()
+                if fin is None:
+                    return
+                if werr:
+                    continue
+                t0 = time.perf_counter()
+                try:
+                    metas.append(write_block(
+                        self.backend, fin,
+                        level=cfg.level_for(plan.out_level), defer_meta=True))
+                except BaseException as e:  # noqa: BLE001 - surfaced after join
+                    werr.append(e)
+                finally:
+                    TEL.record_compact_stage("write", time.perf_counter() - t0)
+
+        wt = threading.Thread(target=_writer, name="compact-block-writer",
+                              daemon=True)
+        wt.start()
+        aerr: BaseException | None = None
+        try:
+            it = iter_outputs(plan, cfg)
+            while True:
+                t0 = time.perf_counter()
+                try:
+                    fin = next(it)
+                except StopIteration:
+                    break
+                TEL.record_compact_stage("assemble", time.perf_counter() - t0)
+                if werr:
+                    break
+                fins.put(fin)
+        except BaseException as e:  # noqa: BLE001 - re-raised below
+            aerr = e
+        finally:
+            fins.put(None)
+            wt.join()
+        if werr or aerr is not None:
+            # unpublished outputs (no meta.json) are invisible to
+            # pollers; reclaim their data objects best-effort
+            for m in metas:
+                try:
+                    self.backend.delete_block(m.tenant_id, m.block_id)
+                except Exception:  # noqa: BLE001 - cleanup only
+                    pass
+            raise werr[0] if werr else aerr
+        # ---- commit point ----
+        for m in metas:
+            publish_block_meta(self.backend, m)
+            result.new_blocks.append(m)
+            result.traces_out += m.total_traces
+            result.spans_out += m.total_spans
+        result.compacted_ids = [m.block_id for m in plan.job.blocks]
+        for m in plan.job.blocks:
+            self.backend.mark_compacted(plan.tenant, m.block_id)
+        return result

@@ -77,6 +77,23 @@ class CompactorConfig:
     # 0 disables. Parts surface one level up, where the ordinary
     # columnar rewrite merges them for real.
     concat_small_input_bytes: int = 8 << 20
+    # ---- pipelined concurrent execution (db/compact_pipeline.py) ----
+    # worker threads running jobs concurrently; None resolves from the
+    # TEMPO_COMPACT_CONCURRENCY env (default 1 = sequential). Jobs own
+    # disjoint input block sets, so they are safe to run in parallel.
+    concurrency: int | None = None
+    # host-RAM admission budget for in-flight jobs; None resolves from
+    # TEMPO_COMPACT_MEM_BUDGET (bytes, default 1 GiB). A job's estimated
+    # peak is sum(input size_bytes) * pipeline_expansion; jobs above the
+    # remaining budget wait at the admission gate (one always admits, so
+    # an oversized job stalls the pipeline rather than deadlocking it).
+    pipeline_mem_budget_bytes: int | None = None
+    # decoded-columns + merge-scratch expansion over compressed input
+    # bytes, for the admission estimate
+    pipeline_expansion: float = 3.0
+    # how many not-yet-admitted jobs the prefetch stage may run ahead of
+    # the workers (ranged-read pack preloads; 0 disables prefetch)
+    prefetch_depth: int = 2
 
     def level_for(self, out_level: int) -> int:
         """Output zstd level for a block produced at out_level: final
@@ -111,6 +128,12 @@ def select_jobs(tenant: str, metas: list[BlockMeta], cfg: CompactorConfig, now: 
         batch: list[BlockMeta] = []
         size = 0
         for m in group:
+            if m.size_bytes > cfg.max_block_bytes:
+                # already over the output cap on its own: merging it with
+                # ANY neighbor exceeds max_block_bytes, so it never joins
+                # a batch -- skip it WITHOUT cutting the batch in
+                # progress, so its neighbors still compact
+                continue
             if len(batch) >= cfg.max_input_blocks or (batch and size + m.size_bytes > cfg.max_block_bytes):
                 if len(batch) >= cfg.min_input_blocks:
                     jobs.append(CompactionJob(tenant, batch))
@@ -169,18 +192,25 @@ def _union_input_blooms(blocks: list[BackendBlock]):
     return union_blooms(sbs)
 
 
+def concat_eligible(job: CompactionJob, cfg: CompactorConfig) -> bool:
+    """True when the job takes the no-decode concat path (all-small
+    level-0 inputs). Shared with the pipeline executor so both drivers
+    route identically."""
+    return bool(cfg.concat_small_input_bytes
+                and len(job.blocks) >= 2
+                and all(m.compaction_level == 0
+                        and m.version in ("vtpu1", "vtpu2")
+                        and 0 < m.size_bytes <= cfg.concat_small_input_bytes
+                        for m in job.blocks))
+
+
 def compact(backend: RawBackend, job: CompactionJob, cfg: CompactorConfig) -> CompactionResult:
     """Run one compaction job: no-decode CONCAT for all-small level-0
     inputs (concat_compact.py: verbatim copies into one compound block
     at backend IO speed), the columnar numpy-level merge
     (columnar_compact.py) otherwise, falling back to the wire-level
     merge only when the inputs aren't columnar-mergeable."""
-    if (cfg.concat_small_input_bytes
-            and len(job.blocks) >= 2
-            and all(m.compaction_level == 0
-                    and m.version in ("vtpu1", "vtpu2")
-                    and 0 < m.size_bytes <= cfg.concat_small_input_bytes
-                    for m in job.blocks)):
+    if concat_eligible(job, cfg):
         from .concat_compact import compact_concat
 
         return compact_concat(backend, job, cfg)

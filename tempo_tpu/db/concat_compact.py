@@ -60,8 +60,9 @@ def compact_concat(backend: RawBackend, job, cfg) -> "CompactionResult":
         ]
         for name in names:
             try:
-                backend.write(tenant, part_id, name,
-                              backend.read(tenant, m.block_id, name))
+                # backend-side copy (local: hardlink; stores: server-side
+                # copy API) -- part bytes never move through Python
+                backend.copy_object(tenant, m.block_id, name, part_id)
             except DoesNotExist:
                 if name == DATA_NAME:
                     raise  # a block without data is corrupt; fail the job

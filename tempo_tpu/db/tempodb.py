@@ -412,24 +412,63 @@ class TempoDB:
         self._poll_thread.start()
 
     # --------------------------------------------------------- compaction
+    def _apply_compaction_result(self, tenant: str, res: comp.CompactionResult,
+                                 metas_by_id: dict[str, BlockMeta]) -> None:
+        """Apply one job's result to the blocklist -- shared by the
+        sequential (compact_once) and pipelined (compact_tenants) sweeps
+        so their post-job state can't drift."""
+        removed = set(res.compacted_ids)
+        self.blocklist.update(
+            tenant,
+            add=res.new_blocks,
+            remove=list(removed),
+            add_compacted=[m for bid, m in metas_by_id.items() if bid in removed],
+        )
+
     def compact_once(self, tenant: str) -> list[comp.CompactionResult]:
         """One compaction sweep for a tenant: select jobs, run owned ones."""
         metas = self.blocklist.metas(tenant)
+        metas_by_id = {m.block_id: m for m in metas}
         jobs = comp.select_jobs(tenant, metas, self.cfg.compaction)
         results = []
         for job in jobs:
             if not self.owns_job(job.hash):
                 continue
             res = comp.compact(self.backend, job, self.cfg.compaction)
-            removed = set(res.compacted_ids)
-            self.blocklist.update(
-                tenant,
-                add=res.new_blocks,
-                remove=list(removed),
-                add_compacted=[m for m in metas if m.block_id in removed],
-            )
+            self._apply_compaction_result(tenant, res, metas_by_id)
             results.append(res)
         return results
+
+    def compact_tenants(self, tenants: list[str] | None = None) -> list:
+        """Concurrent compaction sweep across tenants through the
+        pipeline executor (db/compact_pipeline): select owned jobs per
+        tenant, run them with TEMPO_COMPACT_CONCURRENCY workers under the
+        host-RAM admission budget (per-tenant round-robin admission),
+        and apply each job's blocklist update the moment it commits --
+        exactly the update compact_once makes, from the worker thread
+        (Blocklist.update is lock-guarded). Returns the pipeline's
+        JobOutcome list; per-job errors ride in the outcomes rather than
+        aborting the sweep."""
+        from .compact_pipeline import CompactionPipeline
+
+        if tenants is None:
+            tenants = self.tenants()
+        jobs_by_tenant: dict[str, list[comp.CompactionJob]] = {}
+        metas_by_tenant: dict[str, dict[str, BlockMeta]] = {}
+        for tenant in tenants:
+            metas = self.blocklist.metas(tenant)
+            jobs = [j for j in comp.select_jobs(tenant, metas, self.cfg.compaction)
+                    if self.owns_job(j.hash)]
+            if jobs:
+                jobs_by_tenant[tenant] = jobs
+                metas_by_tenant[tenant] = {m.block_id: m for m in metas}
+
+        def on_result(tenant: str, job: comp.CompactionJob,
+                      res: comp.CompactionResult) -> None:
+            self._apply_compaction_result(tenant, res, metas_by_tenant[tenant])
+
+        pipeline = CompactionPipeline(self.backend, self.cfg.compaction)
+        return pipeline.run(jobs_by_tenant, on_result=on_result)
 
     def retention_once(self, tenant: str) -> comp.RetentionResult:
         res = comp.apply_retention(

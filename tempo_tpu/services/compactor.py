@@ -44,14 +44,43 @@ class Compactor:
             self.db.owns_job = lambda h: ring.owns(instance_id, h)
 
     def run_once(self) -> None:
+        from ..db.compact_pipeline import resolve_concurrency
         from ..util.metrics import timed
 
         self.stats.runs += 1
+        if resolve_concurrency(self.db.cfg.compaction) > 1:
+            self._run_once_pipelined()
+            return
         for tenant in self.db.tenants():
             try:
                 with timed(self.compaction_duration):
                     results = self.db.compact_once(tenant)
                 self.stats.blocks_compacted += sum(len(r.compacted_ids) for r in results)
+                ret = self.db.retention_once(tenant)
+                self.stats.blocks_retained += len(ret.deleted) if ret else 0
+            except Exception as e:
+                self.stats.errors.append(e)
+
+    def _run_once_pipelined(self) -> None:
+        """Concurrent sweep: every tenant's owned jobs run through the
+        compaction pipeline (TEMPO_COMPACT_CONCURRENCY workers, host-RAM
+        admission gate, per-tenant round-robin); retention stays
+        per-tenant sequential -- it's marker/delete IO, not a hot path,
+        and ring ownership filtering is identical either way."""
+        from ..util.metrics import timed
+
+        try:
+            with timed(self.compaction_duration):
+                outcomes = self.db.compact_tenants()
+            for oc in outcomes:
+                if oc.error is not None:
+                    self.stats.errors.append(oc.error)
+                else:
+                    self.stats.blocks_compacted += len(oc.result.compacted_ids)
+        except Exception as e:
+            self.stats.errors.append(e)
+        for tenant in self.db.tenants():
+            try:
                 ret = self.db.retention_once(tenant)
                 self.stats.blocks_retained += len(ret.deleted) if ret else 0
             except Exception as e:

@@ -42,12 +42,17 @@ import threading
 import time
 from collections import OrderedDict, deque
 
-from .metrics import Counter, Histogram
+from .metrics import Counter, Gauge, Histogram
 
 # device kernels run sub-ms to ~seconds: a finer low end than the
 # request-latency default buckets
 DEVICE_TIME_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005,
                        0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0)
+
+# compaction pipeline stages span sub-ms (tiny-block fetch) to tens of
+# seconds (a big level-1 merge)
+COMPACT_STAGE_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                         0.25, 0.5, 1.0, 2.5, 5.0, 15.0, 60.0)
 
 QUERY_LOG_SIZE = 64  # recent queries kept for the slow-query log
 SYNC_RTT_MS = 2.0  # block_until_ready timing only below this link RTT
@@ -113,6 +118,51 @@ class KernelTelemetry:
             "tempo_batch_demux_total",
             help="per-query results demultiplexed out of fused launches")
         self._batches: dict[str, dict] = {}
+        # compaction pipeline (db/compact_pipeline): per-stage wall
+        # times, admission-gate occupancy, prefetch effectiveness
+        self.compact_stage_time = Histogram(
+            "tempo_compaction_stage_seconds", buckets=COMPACT_STAGE_BUCKETS,
+            help="per-stage wall time of compaction pipeline jobs")
+        self.compact_jobs = Counter(
+            "tempo_compaction_jobs_total",
+            help="compaction jobs executed by the pipeline by outcome")
+        self.compact_input_bytes = Counter(
+            "tempo_compaction_input_bytes_total",
+            help="compaction input bytes consumed by completed jobs")
+        self.compact_prefetch = Counter(
+            "tempo_compaction_prefetch_total",
+            help="pipeline input-prefetch outcomes by kind (hit/miss/waste)")
+        self.compact_jobs_inflight = Gauge(
+            "tempo_compaction_jobs_inflight",
+            help="compaction jobs currently admitted into the pipeline")
+        self.compact_bytes_inflight = Gauge(
+            "tempo_compaction_bytes_inflight",
+            help="estimated peak host-RAM bytes of admitted compaction jobs")
+        self.compact_queue_depth = Gauge(
+            "tempo_compaction_queue_depth",
+            help="compaction jobs waiting at the pipeline admission gate")
+        self._compaction: dict = {
+            "runs": 0, "wall_seconds": 0.0, "stage_seconds": {},
+            "jobs": 0, "errors": 0, "input_bytes": 0,
+            "prefetch": {"hit": 0, "miss": 0, "waste": 0},
+            "max_jobs_inflight": 0,  # process lifetime
+            "run_max_jobs_inflight": 0,  # current/most-recent pipeline run
+        }
+        # every instrument exported through /metrics -- ONE list shared
+        # by metrics_lines() and help_entries() so an instrument can't
+        # ship samples without its HELP (or vice versa)
+        self._instruments = (
+            self.compiles, self.cache_hits, self.device_time,
+            self.transfer_bytes, self.staged_rows_real,
+            self.staged_rows_padded, self.staged_cache_hits,
+            self.staged_cache_misses, self.routing,
+            self.batch_groups, self.batch_queries,
+            self.batch_occupancy, self.batch_window_wait,
+            self.batch_demux, self.compact_stage_time,
+            self.compact_jobs, self.compact_input_bytes,
+            self.compact_prefetch, self.compact_jobs_inflight,
+            self.compact_bytes_inflight, self.compact_queue_depth,
+        )
         # full compile-key signatures, LRU-bounded (SEEN_SIGNATURES_MAX)
         self._seen: OrderedDict = OrderedDict()
         # (op, bucket-label) -> aggregate row for /status/kernels
@@ -268,6 +318,99 @@ class KernelTelemetry:
                     b["queries"] / b["groups"], 3) if b["groups"] else 0.0
             return out
 
+    # --------------------------------------------------------- compaction
+    def record_compact_stage(self, stage: str, seconds: float) -> None:
+        """One pipeline stage (fetch/merge/assemble/write) finished for
+        one job: observe its wall time."""
+        try:
+            self.compact_stage_time.observe(float(seconds), f'stage="{stage}"')
+            with self._lock:
+                ss = self._compaction["stage_seconds"]
+                ss[stage] = ss.get(stage, 0.0) + float(seconds)
+        except Exception:
+            pass
+
+    def record_compact_job(self, input_bytes: int, ok: bool = True) -> None:
+        try:
+            self.compact_jobs.inc(
+                labels=f'outcome="{"ok" if ok else "error"}"')
+            with self._lock:
+                if ok:
+                    self._compaction["jobs"] += 1
+                    self._compaction["input_bytes"] += int(input_bytes)
+                else:
+                    self._compaction["errors"] += 1
+            if ok:
+                self.compact_input_bytes.inc(int(input_bytes))
+        except Exception:
+            pass
+
+    def record_compact_prefetch(self, kind: str, n: int = 1) -> None:
+        """Prefetch outcome: hit (worker found its inputs preloaded),
+        miss (worker fetched them itself), waste (a prefetch attempt
+        failed mid-IO and its work was thrown away -- the worker
+        refetched from scratch)."""
+        try:
+            self.compact_prefetch.inc(n, labels=f'kind="{kind}"')
+            with self._lock:
+                p = self._compaction["prefetch"]
+                p[kind] = p.get(kind, 0) + n
+        except Exception:
+            pass
+
+    def compact_inflight(self, jobs: int, est_bytes: int, queued: int) -> None:
+        """Point-in-time pipeline occupancy from the admission gate."""
+        try:
+            self.compact_jobs_inflight.set(jobs)
+            self.compact_bytes_inflight.set(est_bytes)
+            self.compact_queue_depth.set(queued)
+            with self._lock:
+                if jobs > self._compaction["max_jobs_inflight"]:
+                    self._compaction["max_jobs_inflight"] = jobs
+                if jobs > self._compaction["run_max_jobs_inflight"]:
+                    self._compaction["run_max_jobs_inflight"] = jobs
+        except Exception:
+            pass
+
+    def begin_compact_run(self) -> None:
+        """Open one pipeline run: resets the run-scoped occupancy peak
+        (the lifetime max stays monotonic)."""
+        try:
+            with self._lock:
+                self._compaction["run_max_jobs_inflight"] = 0
+        except Exception:
+            pass
+
+    def record_compact_run(self, wall_seconds: float) -> None:
+        """Close one pipeline run (a whole admitted job set)."""
+        try:
+            with self._lock:
+                self._compaction["runs"] += 1
+                self._compaction["wall_seconds"] += float(wall_seconds)
+        except Exception:
+            pass
+
+    def compaction_stats(self) -> dict:
+        """Pipeline aggregates for /status/kernels and the bench rows.
+        overlap_ratio = total stage seconds / run wall seconds: 1.0 means
+        strictly sequential execution, >1 means stages (or jobs) actually
+        overlapped in time."""
+        with self._lock:
+            c = {k: v for k, v in self._compaction.items()
+                 if k not in ("stage_seconds", "prefetch")}
+            c["stage_seconds"] = {
+                k: round(v, 6)
+                for k, v in self._compaction["stage_seconds"].items()}
+            c["prefetch"] = dict(self._compaction["prefetch"])
+        wall = c["wall_seconds"]
+        stage_total = sum(c["stage_seconds"].values())
+        c["overlap_ratio"] = round(stage_total / wall, 3) if wall > 0 else 0.0
+        c["wall_seconds"] = round(wall, 6)
+        c["jobs_inflight"] = int(self.compact_jobs_inflight.get())
+        c["bytes_inflight"] = int(self.compact_bytes_inflight.get())
+        c["queue_depth"] = int(self.compact_queue_depth.get())
+        return c
+
     # --------------------------------------------------------- query log
     def record_query(self, op: str, seconds: float, trace_id: str = "",
                      detail: str = "") -> None:
@@ -361,32 +504,21 @@ class KernelTelemetry:
             },
             "routing": routing,
             "batching": self.batch_stats(),
+            "compaction": self.compaction_stats(),
             "slow_queries": self.slow_queries(slow_k),
         }
 
     def metrics_lines(self) -> list[str]:
         """Exposition sample lines for /metrics."""
         out: list[str] = []
-        for inst in (self.compiles, self.cache_hits, self.device_time,
-                     self.transfer_bytes, self.staged_rows_real,
-                     self.staged_rows_padded, self.staged_cache_hits,
-                     self.staged_cache_misses, self.routing,
-                     self.batch_groups, self.batch_queries,
-                     self.batch_occupancy, self.batch_window_wait,
-                     self.batch_demux):
+        for inst in self._instruments:
             out += inst.text()
         return out
 
     def help_entries(self) -> dict[str, str]:
         """family -> help for the exposition renderer."""
         out = {}
-        for inst in (self.compiles, self.cache_hits, self.device_time,
-                     self.transfer_bytes, self.staged_rows_real,
-                     self.staged_rows_padded, self.staged_cache_hits,
-                     self.staged_cache_misses, self.routing,
-                     self.batch_groups, self.batch_queries,
-                     self.batch_occupancy, self.batch_window_wait,
-                     self.batch_demux):
+        for inst in self._instruments:
             fam = inst.name[:-6] if inst.name.endswith("_total") else inst.name
             out[fam] = inst.help
         return out

@@ -1,0 +1,47 @@
+"""Seeded violations in the compaction-pipeline lock shape: an
+admission condition guarding in-flight job/byte registries plus a
+separate stats lock -- the lock pairs db/compact_pipeline.py uses, so
+the concurrency rules provably cover this module shape."""
+
+import threading
+
+_admission_lock = threading.Condition()
+_stats_lock = threading.Lock()
+_inflight: dict[str, int] = {}
+_stage_seconds: dict[str, float] = {}
+
+
+def admit(job_id, est):
+    _inflight[job_id] = est  # EXPECT: global-mutation-unlocked
+
+
+def release(job_id):
+    with _admission_lock:
+        _inflight.pop(job_id, None)
+
+
+def record_stage_ab(stage, dt):
+    with _admission_lock:
+        with _stats_lock:
+            _stage_seconds[stage] = _stage_seconds.get(stage, 0.0) + dt
+
+
+def snapshot_ba():
+    with _stats_lock:
+        with _admission_lock:  # EXPECT: lock-order
+            return dict(_inflight), dict(_stage_seconds)
+
+
+def drain_unsafe():
+    _admission_lock.acquire()  # EXPECT: lock-bare-acquire
+    n = len(_inflight)
+    _admission_lock.release()
+    return n
+
+
+def drain_safe():
+    _admission_lock.acquire()
+    try:
+        _inflight.clear()
+    finally:
+        _admission_lock.release()

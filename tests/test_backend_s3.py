@@ -66,6 +66,24 @@ class _FakeS3(BaseHTTPRequestHandler):
             self.send_header("Content-Length", "0")
             self.end_headers()
             return
+        copy_src = self.headers.get("x-amz-copy-source", "")
+        if copy_src:
+            # server-side CopyObject: /bucket/key -> this key
+            src_key = unquote(copy_src).lstrip("/").split("/", 1)[1]
+            with self.lock:
+                data = self.store.get(src_key)
+                if data is None:
+                    self.send_response(404)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
+                self.store[self._key()] = data
+            resp = b"<CopyObjectResult><ETag>x</ETag></CopyObjectResult>"
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(resp)))
+            self.end_headers()
+            self.wfile.write(resp)
+            return
         with self.lock:
             self.store[self._key()] = body
         self.send_response(200)
@@ -168,6 +186,18 @@ def test_s3_object_roundtrip(s3):
     assert not s3.has_object(TENANT, "blk-1", "meta.json")
     s3.delete_block(TENANT, "blk-1")
     assert s3.blocks(TENANT) == []
+
+
+def test_s3_server_side_copy(s3):
+    """copy_object issues a signed x-amz-copy-source PUT: bytes land
+    under the destination without transiting the client, and a missing
+    source surfaces as DoesNotExist."""
+    payload = bytes(range(256)) * 8
+    s3.write(TENANT, "blk-src", "data.vtpu", payload)
+    s3.copy_object(TENANT, "blk-src", "data.vtpu", "blk-dst/p0")
+    assert s3.read(TENANT, "blk-dst/p0", "data.vtpu") == payload
+    with pytest.raises(DoesNotExist):
+        s3.copy_object(TENANT, "blk-src", "missing", "blk-dst/p1")
 
 
 def test_tempodb_over_s3(s3, tmp_path):
