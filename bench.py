@@ -20,7 +20,13 @@ Lines, in order:
      one hot block through the cross-query batching executor
      (db/batchexec): p50/p95 latency, launches-per-query, occupancy.
   6. search_block_e2e_cold_spans_per_sec -- BASELINE config #2, fresh
-     reader each query: every byte from disk + staged to device.
+     reader each query: every byte from disk + staged to device through
+     the cold-read streaming pipeline (ops/stream); the row carries
+     per-stage ms and the overlap ratio.
+  6b. search_block_e2e_cold_find_p50_ms -- trace-ID lookup with fresh
+     readers per query: bloom shard, trace index and the trace's
+     row-group chunks all come from disk through the pipeline's
+     plan -> ranged-fetch -> threaded-decode stages.
   7. search_block_e2e_spans_per_sec -- BASELINE config #2 (headline):
      hot immutable block, staged device arrays cached (the production
      querier pattern; the reference's hot path re-decodes parquet from
@@ -462,8 +468,11 @@ def bench_find_and_search(tmp: str) -> tuple[float, float, dict, dict]:
     # ever ADDS time, so the minimum is the measurement of the engine
     # and the median is a measurement of the neighbors.
     iters = 6
+    smark = _stream_mark()
+    n_cold = {"n": 0}
 
     def cold_sample() -> float:
+        n_cold["n"] += 1
         dbc = TempoDB(TempoDBConfig(wal_path=tmp + "/wal"), backend=backend)
         dbc.poll_now()
         t0 = time.perf_counter()
@@ -474,7 +483,28 @@ def bench_find_and_search(tmp: str) -> tuple[float, float, dict, dict]:
         return dt
 
     cold = total_spans / adaptive_min(cold_sample, iters, 2 * iters)
-    cold_tel = _tel_close(mark)
+    cold_tel = {**_tel_close(mark), **_stream_close(smark, per=n_cold["n"])}
+
+    # cold find p50: fresh readers per lookup, so the bloom shard, the
+    # trace index and the trace's row-group chunks all come off disk
+    # through the pipeline's plan -> ranged-fetch -> threaded-decode
+    # stages (colio plan_fetch/_run_plan)
+    mark = _tel_mark()
+    smark = _stream_mark()
+    fpicks = rng.integers(0, n_traces, size=9)
+    flat = []
+    for i, p in enumerate(fpicks):
+        dbf = TempoDB(TempoDBConfig(wal_path=tmp + "/wal"), backend=backend)
+        dbf.poll_now()
+        tid = ids_per[i % n_blocks][int(p)].tobytes()
+        t0 = time.perf_counter()
+        got = dbf.find_trace_by_id("bench", tid)
+        flat.append(time.perf_counter() - t0)
+        assert got is not None
+        dbf.close()
+    _emit("search_block_e2e_cold_find_p50_ms", float(np.median(flat) * 1e3),
+          "ms", 0.0,
+          tel={**_tel_close(mark), **_stream_close(smark, per=len(flat))})
     mark = _tel_mark()
 
     # hot: long-lived readers (the production querier pattern over
@@ -521,6 +551,34 @@ def bench_find_and_search(tmp: str) -> tuple[float, float, dict, dict]:
 
     db.close()
     return cold, warm, cold_tel, warm_tel
+
+
+def _stream_mark() -> dict:
+    """Cold-read stream-pipeline telemetry mark (kerneltel stream stats)."""
+    from tempo_tpu.util.kerneltel import TEL
+
+    return TEL.stream_stats()
+
+
+def _stream_close(mark: dict, per: int = 1) -> dict:
+    """Close a cold-read section: per-query stage ms (fetch/decompress/
+    assemble/upload) and the overlap ratio (stage seconds / pipeline
+    wall seconds; >1 = stages of different units genuinely ran at the
+    same time) -- the "where did the cold time go" row extension."""
+    from tempo_tpu.util.kerneltel import TEL
+
+    now = TEL.stream_stats()
+    per = max(1, per)
+    stage_s = {k: v - mark["stage_seconds"].get(k, 0.0)
+               for k, v in now["stage_seconds"].items()}
+    wall = now["wall_seconds"] - mark["wall_seconds"]
+    return {"stream": {
+        "runs": now["runs"] - mark["runs"],
+        "units": now["units"] - mark["units"],
+        "stage_ms_per_query": {k: round(v * 1000 / per, 2)
+                               for k, v in stage_s.items()},
+        "overlap_ratio": round(sum(stage_s.values()) / wall, 3) if wall > 0 else 0.0,
+    }}
 
 
 def _compact_mark() -> dict:

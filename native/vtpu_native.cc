@@ -18,7 +18,30 @@
 #include <thread>
 #include <vector>
 
+// images with the runtime libzstd but no dev headers (dpkg ships
+// libzstd1 without libzstd-dev) still build: the handful of stable-ABI
+// symbols used below are declared directly and the Makefile links the
+// soname file (-l:libzstd.so.1) when the dev symlink is absent
+#if defined(__has_include) && !__has_include(<zstd.h>)
+extern "C" {
+typedef struct ZSTD_CCtx_s ZSTD_CCtx;
+typedef struct ZSTD_DCtx_s ZSTD_DCtx;
+static const int ZSTD_c_compressionLevel = 100;
+size_t ZSTD_compressBound(size_t srcSize);
+unsigned ZSTD_isError(size_t code);
+ZSTD_CCtx* ZSTD_createCCtx(void);
+size_t ZSTD_freeCCtx(ZSTD_CCtx* cctx);
+size_t ZSTD_CCtx_setParameter(ZSTD_CCtx* cctx, int param, int value);
+size_t ZSTD_compress2(ZSTD_CCtx* cctx, void* dst, size_t dstCapacity,
+                      const void* src, size_t srcSize);
+ZSTD_DCtx* ZSTD_createDCtx(void);
+size_t ZSTD_freeDCtx(ZSTD_DCtx* dctx);
+size_t ZSTD_decompressDCtx(ZSTD_DCtx* dctx, void* dst, size_t dstCapacity,
+                           const void* src, size_t srcSize);
+}
+#else
 #include <zstd.h>
+#endif
 
 extern "C" {
 
@@ -610,6 +633,396 @@ int vtpu_zstd_decompress_batch(const uint8_t* src, const int64_t* in_offsets,
   };
   // calling thread is worker 0: single-threaded calls (1-core hosts,
   // small batches) pay zero spawn/join overhead
+  int nt = std::max(1, std::min(n_threads, n_chunks));
+  std::vector<std::thread> ts;
+  for (int t = 1; t < nt; t++) ts.emplace_back(work);
+  work();
+  for (auto& t : ts) t.join();
+  return failed.load();
+}
+
+// ----------------------------------------------------- snappy block codec
+//
+// Hand-rolled snappy + lz4 block codecs (reference: tempodb/backend/
+// encoding.go carries both; klauspost's Go implementations are the
+// upstream analog). Both are self-contained -- no external library --
+// and ship threaded batch entry points shaped exactly like the zstd
+// ones above, so the column layer's cold-read pipeline can decompress
+// any registered codec's chunk batch on native threads. Formats are the
+// standard public ones (snappy raw block framing, lz4 block format), so
+// chunks interoperate with any other conformant implementation.
+
+}  // pause extern "C": internal helpers use C++ linkage freely
+
+// snappy raw block format: uvarint uncompressed length, then elements
+// tagged by the low 2 bits (00 literal, 01/10/11 copies with 1/2/4-byte
+// offsets). Compression works in 64 KiB fragments (like upstream) so a
+// 16-bit position table suffices and every copy fits the 2-byte-offset
+// form.
+static const int kSnHashBits = 14;
+
+static inline uint32_t sn_hash(uint32_t v) { return (v * 0x1e35a7bdu) >> (32 - kSnHashBits); }
+
+static inline uint8_t* sn_emit_literal(uint8_t* p, const uint8_t* s, size_t len) {
+  while (len > 0) {
+    size_t l = len > 65536 ? 65536 : len;
+    size_t n1 = l - 1;
+    if (n1 < 60) {
+      *p++ = (uint8_t)(n1 << 2);
+    } else if (n1 < 256) {
+      *p++ = 60 << 2;
+      *p++ = (uint8_t)n1;
+    } else {
+      *p++ = 61 << 2;
+      *p++ = (uint8_t)(n1 & 0xff);
+      *p++ = (uint8_t)(n1 >> 8);
+    }
+    memcpy(p, s, l);
+    p += l;
+    s += l;
+    len -= l;
+  }
+  return p;
+}
+
+static inline uint8_t* sn_emit_copy(uint8_t* p, size_t offset, size_t len) {
+  while (len > 0) {
+    size_t l = len > 64 ? 64 : len;
+    *p++ = (uint8_t)(((l - 1) << 2) | 2);  // type 10: 2-byte offset
+    *p++ = (uint8_t)(offset & 0xff);
+    *p++ = (uint8_t)(offset >> 8);
+    len -= l;
+  }
+  return p;
+}
+
+// one 64 KiB fragment: greedy 4-byte hash matching within the fragment
+static uint8_t* sn_compress_fragment(const uint8_t* src, size_t n, uint8_t* p,
+                                     uint16_t* table) {
+  memset(table, 0, sizeof(uint16_t) << kSnHashBits);
+  size_t i = 0, lit = 0;
+  if (n >= 16) {
+    size_t limit = n - 15;
+    while (i < limit) {
+      uint32_t v;
+      memcpy(&v, src + i, 4);
+      uint32_t h = sn_hash(v);
+      size_t cand = table[h];
+      table[h] = (uint16_t)i;
+      uint32_t w;
+      memcpy(&w, src + cand, 4);
+      if (cand < i && w == v) {
+        size_t len = 4;
+        while (i + len < n && src[cand + len] == src[i + len]) len++;
+        p = sn_emit_literal(p, src + lit, i - lit);
+        p = sn_emit_copy(p, i - cand, len);
+        i += len;
+        lit = i;
+      } else {
+        i++;
+      }
+    }
+  }
+  return sn_emit_literal(p, src + lit, n - lit);
+}
+
+static size_t snappy_compress_one(const uint8_t* src, size_t n, uint8_t* dst,
+                                  uint16_t* table) {
+  uint8_t* p = dst;
+  uint64_t v = n;
+  while (v >= 128) {
+    *p++ = (uint8_t)(v | 0x80);
+    v >>= 7;
+  }
+  *p++ = (uint8_t)v;
+  for (size_t off = 0; off < n; off += 65536) {
+    size_t frag = n - off > 65536 ? 65536 : n - off;
+    p = sn_compress_fragment(src + off, frag, p, table);
+  }
+  return (size_t)(p - dst);
+}
+
+static int snappy_decompress_one(const uint8_t* src, size_t n, uint8_t* dst,
+                                 size_t dn) {
+  size_t pos = 0;
+  uint64_t len = 0;
+  int shift = 0;
+  for (;;) {
+    if (pos >= n || shift > 35) return 1;
+    uint8_t b = src[pos++];
+    len |= (uint64_t)(b & 0x7f) << shift;
+    if (!(b & 0x80)) break;
+    shift += 7;
+  }
+  if (len != (uint64_t)dn) return 1;
+  size_t d = 0;
+  while (pos < n) {
+    uint8_t tag = src[pos++];
+    int type = tag & 3;
+    if (type == 0) {
+      size_t l = (size_t)(tag >> 2) + 1;
+      if (l > 60) {
+        int extra = (int)l - 60;  // 1..4 length bytes, little endian
+        if (pos + (size_t)extra > n) return 1;
+        l = 0;
+        for (int k = 0; k < extra; k++) l |= (size_t)src[pos + k] << (8 * k);
+        l += 1;
+        pos += (size_t)extra;
+      }
+      if (pos + l > n || d + l > dn) return 1;
+      memcpy(dst + d, src + pos, l);
+      pos += l;
+      d += l;
+      continue;
+    }
+    size_t l, off;
+    if (type == 1) {
+      if (pos >= n) return 1;
+      l = (size_t)((tag >> 2) & 7) + 4;
+      off = ((size_t)(tag >> 5) << 8) | src[pos++];
+    } else if (type == 2) {
+      if (pos + 2 > n) return 1;
+      l = (size_t)(tag >> 2) + 1;
+      off = (size_t)src[pos] | ((size_t)src[pos + 1] << 8);
+      pos += 2;
+    } else {
+      if (pos + 4 > n) return 1;
+      l = (size_t)(tag >> 2) + 1;
+      off = (size_t)src[pos] | ((size_t)src[pos + 1] << 8) |
+            ((size_t)src[pos + 2] << 16) | ((size_t)src[pos + 3] << 24);
+      pos += 4;
+    }
+    if (off == 0 || off > d || d + l > dn) return 1;
+    const uint8_t* s = dst + d - off;
+    if (off >= l) {
+      memcpy(dst + d, s, l);
+    } else {
+      for (size_t k = 0; k < l; k++) dst[d + k] = s[k];  // overlapped RLE copy
+    }
+    d += l;
+  }
+  return d == dn ? 0 : 1;
+}
+
+// lz4 block format: sequences of [token][lit-ext][literals][2B offset]
+// [match-ext]; the final sequence is literals-only. End-of-block rules
+// honored: the last match starts >= 12 bytes before the end and never
+// covers the last 5 bytes.
+static inline uint32_t lz4_hash(uint32_t v) { return (v * 2654435761u) >> 16; }
+
+static size_t lz4_compress_one(const uint8_t* src, size_t n, uint8_t* dst,
+                               int32_t* table) {
+  memset(table, 0xff, sizeof(int32_t) << 16);  // -1 = empty
+  uint8_t* p = dst;
+  size_t i = 0, lit = 0;
+  if (n > 16) {
+    size_t mflimit = n - 12;  // last match must start before here
+    while (i < mflimit) {
+      uint32_t v;
+      memcpy(&v, src + i, 4);
+      uint32_t h = lz4_hash(v);
+      int32_t cand = table[h];
+      table[h] = (int32_t)i;
+      uint32_t w = 0;
+      if (cand >= 0) memcpy(&w, src + cand, 4);
+      if (cand >= 0 && w == v && i - (size_t)cand <= 65535) {
+        size_t maxlen = n - 5 - i;  // never cover the last 5 bytes
+        size_t len = 4;
+        while (len < maxlen && src[(size_t)cand + len] == src[i + len]) len++;
+        size_t ll = i - lit, ml = len - 4;
+        uint8_t* tok = p++;
+        if (ll >= 15) {
+          *tok = 0xF0;
+          size_t r = ll - 15;
+          while (r >= 255) {
+            *p++ = 255;
+            r -= 255;
+          }
+          *p++ = (uint8_t)r;
+        } else {
+          *tok = (uint8_t)(ll << 4);
+        }
+        memcpy(p, src + lit, ll);
+        p += ll;
+        size_t off = i - (size_t)cand;
+        *p++ = (uint8_t)(off & 0xff);
+        *p++ = (uint8_t)(off >> 8);
+        if (ml >= 15) {
+          *tok |= 0x0F;
+          size_t r = ml - 15;
+          while (r >= 255) {
+            *p++ = 255;
+            r -= 255;
+          }
+          *p++ = (uint8_t)r;
+        } else {
+          *tok |= (uint8_t)ml;
+        }
+        i += len;
+        lit = i;
+      } else {
+        i++;
+      }
+    }
+  }
+  size_t ll = n - lit;  // final literals-only sequence
+  uint8_t* tok = p++;
+  if (ll >= 15) {
+    *tok = 0xF0;
+    size_t r = ll - 15;
+    while (r >= 255) {
+      *p++ = 255;
+      r -= 255;
+    }
+    *p++ = (uint8_t)r;
+  } else {
+    *tok = (uint8_t)(ll << 4);
+  }
+  memcpy(p, src + lit, ll);
+  p += ll;
+  return (size_t)(p - dst);
+}
+
+static int lz4_decompress_one(const uint8_t* src, size_t n, uint8_t* dst,
+                              size_t dn) {
+  size_t pos = 0, d = 0;
+  if (n == 0) return dn == 0 ? 0 : 1;
+  while (pos < n) {
+    uint8_t tok = src[pos++];
+    size_t ll = (size_t)(tok >> 4);
+    if (ll == 15) {
+      uint8_t b;
+      do {
+        if (pos >= n) return 1;
+        b = src[pos++];
+        ll += b;
+      } while (b == 255);
+    }
+    if (pos + ll > n || d + ll > dn) return 1;
+    memcpy(dst + d, src + pos, ll);
+    pos += ll;
+    d += ll;
+    if (pos == n) break;  // final literals-only sequence
+    if (pos + 2 > n) return 1;
+    size_t off = (size_t)src[pos] | ((size_t)src[pos + 1] << 8);
+    pos += 2;
+    size_t ml = (size_t)(tok & 15);
+    if (ml == 15) {
+      uint8_t b;
+      do {
+        if (pos >= n) return 1;
+        b = src[pos++];
+        ml += b;
+      } while (b == 255);
+    }
+    ml += 4;
+    if (off == 0 || off > d || d + ml > dn) return 1;
+    const uint8_t* s = dst + d - off;
+    if (off >= ml) {
+      memcpy(dst + d, s, ml);
+    } else {
+      for (size_t k = 0; k < ml; k++) dst[d + k] = s[k];
+    }
+    d += ml;
+  }
+  return d == dn ? 0 : 1;
+}
+
+extern "C" {
+
+// worst-case bounds (callers size dst per chunk, like vtpu_zstd_bound)
+int64_t vtpu_snappy_bound(int64_t n) { return 32 + n + n / 6; }
+int64_t vtpu_lz4_bound(int64_t n) { return 16 + n + n / 255; }
+
+int vtpu_snappy_compress_batch(const uint8_t* src, const int64_t* in_offsets,
+                               const int64_t* in_lens, uint8_t* dst,
+                               const int64_t* out_offsets, int64_t* out_lens,
+                               int n_chunks, int n_threads) {
+  std::atomic<int> next(0);
+  auto work = [&]() {
+    std::vector<uint16_t> table((size_t)1 << kSnHashBits);
+    for (;;) {
+      int i = next.fetch_add(1);
+      if (i >= n_chunks) break;
+      out_lens[i] = (int64_t)snappy_compress_one(
+          src + in_offsets[i], (size_t)in_lens[i], dst + out_offsets[i],
+          table.data());
+    }
+  };
+  int nt = std::max(1, std::min(n_threads, n_chunks));
+  std::vector<std::thread> ts;
+  for (int t = 1; t < nt; t++) ts.emplace_back(work);
+  work();  // calling thread is worker 0 (no spawn cost when nt == 1)
+  for (auto& t : ts) t.join();
+  return 0;
+}
+
+int vtpu_snappy_decompress_batch(const uint8_t* src, const int64_t* in_offsets,
+                                 const int64_t* in_lens, uint8_t* dst,
+                                 const int64_t* out_offsets,
+                                 const int64_t* out_lens, int n_chunks,
+                                 int n_threads) {
+  std::atomic<int> next(0), failed(0);
+  auto work = [&]() {
+    for (;;) {
+      int i = next.fetch_add(1);
+      if (i >= n_chunks) break;
+      if (snappy_decompress_one(src + in_offsets[i], (size_t)in_lens[i],
+                                dst + out_offsets[i], (size_t)out_lens[i])) {
+        failed.store(1);
+        break;
+      }
+    }
+  };
+  int nt = std::max(1, std::min(n_threads, n_chunks));
+  std::vector<std::thread> ts;
+  for (int t = 1; t < nt; t++) ts.emplace_back(work);
+  work();
+  for (auto& t : ts) t.join();
+  return failed.load();
+}
+
+int vtpu_lz4_compress_batch(const uint8_t* src, const int64_t* in_offsets,
+                            const int64_t* in_lens, uint8_t* dst,
+                            const int64_t* out_offsets, int64_t* out_lens,
+                            int n_chunks, int n_threads) {
+  std::atomic<int> next(0);
+  auto work = [&]() {
+    std::vector<int32_t> table((size_t)1 << 16);
+    for (;;) {
+      int i = next.fetch_add(1);
+      if (i >= n_chunks) break;
+      out_lens[i] = (int64_t)lz4_compress_one(src + in_offsets[i],
+                                              (size_t)in_lens[i],
+                                              dst + out_offsets[i],
+                                              table.data());
+    }
+  };
+  int nt = std::max(1, std::min(n_threads, n_chunks));
+  std::vector<std::thread> ts;
+  for (int t = 1; t < nt; t++) ts.emplace_back(work);
+  work();
+  for (auto& t : ts) t.join();
+  return 0;
+}
+
+int vtpu_lz4_decompress_batch(const uint8_t* src, const int64_t* in_offsets,
+                              const int64_t* in_lens, uint8_t* dst,
+                              const int64_t* out_offsets,
+                              const int64_t* out_lens, int n_chunks,
+                              int n_threads) {
+  std::atomic<int> next(0), failed(0);
+  auto work = [&]() {
+    for (;;) {
+      int i = next.fetch_add(1);
+      if (i >= n_chunks) break;
+      if (lz4_decompress_one(src + in_offsets[i], (size_t)in_lens[i],
+                             dst + out_offsets[i], (size_t)out_lens[i])) {
+        failed.store(1);
+        break;
+      }
+    }
+  };
   int nt = std::max(1, std::min(n_threads, n_chunks));
   std::vector<std::thread> ts;
   for (int t = 1; t < nt; t++) ts.emplace_back(work);

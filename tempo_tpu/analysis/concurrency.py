@@ -54,12 +54,15 @@ MUTABLE_CTORS = {"dict", "list", "set", "defaultdict", "deque",
                  "OrderedDict", "Counter"}
 
 
-_LOCK_TOKENS = {"lock", "rlock", "mutex"}
+_LOCK_TOKENS = {"lock", "rlock", "mutex", "cv", "cond", "condition"}
 
 
 def _is_lockish(expr: ast.AST) -> bool:
     """Token match, not substring: this codebase's primary domain noun
-    is 'block', so `with staged_block:` must NOT read as a lock."""
+    is 'block', so `with staged_block:` must NOT read as a lock.
+    Condition variables count (cv/cond tokens): `with self._cv:` holds
+    the condition's underlying lock -- the stream/compaction pipelines'
+    turnstile-and-gate shape."""
     d = dotted_name(expr)
     if d is None and isinstance(expr, ast.Call):
         d = dotted_name(expr.func)

@@ -20,6 +20,7 @@ import json
 import struct
 import threading
 from collections import OrderedDict
+from dataclasses import dataclass
 
 import numpy as np
 
@@ -44,11 +45,11 @@ _MIN_COMPRESS = 128
 _CONST_MIN = 64  # don't bother const-marking chunks smaller than this
 
 # codec matrix (reference: tempodb/backend/encoding.go's nine codecs).
-# zstd is the default and the only one with a native threaded batch
-# path; the stdlib codecs trade ratio/CPU differently (gzip/zlib for
-# interop, lz4-class speed isn't in the stdlib so snappy/lz4 map to
-# "none" guidance in docs). Decode always dispatches on the chunk's
-# recorded codec, so blocks written with any codec stay readable.
+# zstd is the default; snappy and lz4 (block/blockcodecs.py) are the
+# speed tier with native threaded batch paths next to the zstd ones and
+# pure-Python fallbacks; the stdlib codecs (gzip/lzma) trade ratio/CPU
+# for interop. Decode always dispatches on the chunk's recorded codec,
+# so blocks written with any codec stay readable.
 
 
 def is_broadcast(arr: np.ndarray) -> bool:
@@ -86,10 +87,40 @@ def _lzma_d(data: bytes, raw_len: int) -> bytes:
     return lzma.decompress(data)
 
 
+def _snappy_c(data: bytes, level: int) -> bytes:
+    from .blockcodecs import snappy_compress
+
+    return snappy_compress(data)  # snappy has no levels
+
+
+def _snappy_d(data: bytes, raw_len: int) -> bytes:
+    from .blockcodecs import snappy_decompress
+
+    return snappy_decompress(data, raw_len)
+
+
+def _lz4_c(data: bytes, level: int) -> bytes:
+    from .blockcodecs import lz4_compress
+
+    return lz4_compress(data)  # lz4 block format has no levels
+
+
+def _lz4_d(data: bytes, raw_len: int) -> bytes:
+    from .blockcodecs import lz4_decompress
+
+    return lz4_decompress(data, raw_len)
+
+
 _EXTRA_CODECS: dict[str, tuple] = {  # name -> (compress(data, level), decompress)
     "gzip": (_gzip_c, _gzip_d),
     "lzma": (_lzma_c, _lzma_d),
+    "snappy": (_snappy_c, _snappy_d),
+    "lz4": (_lz4_c, _lz4_d),
 }
+# codecs whose chunk batches the native layer can decompress in one
+# threaded ranges call (the cold pipeline's decode stage); everything
+# else decodes per chunk through _EXTRA_CODECS
+_NATIVE_RANGE_CODECS = frozenset({CODEC_ZSTD, "snappy", "lz4"})
 
 
 class AxisChunks:
@@ -235,10 +266,22 @@ def pack_columns_stream(
             compressed = dict(zip(to_compress, outs))
         elif to_compress:
             cfun = _EXTRA_CODECS[codec][0]  # unknown codec fails loudly here
-            compressed = {
-                i: cfun(buf[bounds[i][0] : bounds[i][1]].tobytes(), col_level)
-                for i in to_compress
-            }
+            outs = None
+            if codec in _NATIVE_RANGE_CODECS:
+                # snappy/lz4: one threaded native batch for the column's
+                # chunks, exactly like the zstd path above
+                from ..native import block_compress_chunks
+
+                outs = block_compress_chunks(
+                    codec,
+                    [buf[bounds[i][0] : bounds[i][1]].tobytes() for i in to_compress])
+            if outs is not None:
+                compressed = dict(zip(to_compress, outs))
+            else:
+                compressed = {
+                    i: cfun(buf[bounds[i][0] : bounds[i][1]].tobytes(), col_level)
+                    for i in to_compress
+                }
 
         recs: list[list] = []
         for i, (lo, hi) in enumerate(bounds):
@@ -439,6 +482,30 @@ def pack_columns(
 _DCTX_LOCAL = threading.local()  # per-thread zstd contexts (see _dctx)
 
 
+@dataclass
+class ColumnFetch:
+    """One planned cold read (ColumnPack.plan_fetch): the state the
+    fetch and decode phases share. The byte estimates feed the stream
+    pipeline's admission budget BEFORE any IO happens."""
+
+    pack: "ColumnPack"
+    full: list  # (name, meta, dst start) full-column wants
+    recs: list  # (chunk rec, dst_pos >= 0 | -1 for chunk-cache-only)
+    cached: list  # (raw bytes, dst_pos, raw_len) chunk-cache hits
+    runs: list  # coalesced (file off, end, members) ranged reads
+    raw_bytes: int  # full-column decode output (dst buffer size)
+    stored_bytes: int  # compressed bytes the fetch phase will read
+    bufs: list | None = None  # fetch output (run buffers)
+    src_pos: dict | None = None  # chunk file off -> offset in joined src
+
+    @property
+    def est_bytes(self) -> int:
+        """Peak host RAM of running this plan: fetched compressed bytes
+        + every decode destination."""
+        sliced = sum(r[2] for r, d in self.recs if d < 0)
+        return self.stored_bytes + self.raw_bytes + sliced
+
+
 class ColumnPack:
     """Lazy chunked-column reader over a backend object via range reads."""
 
@@ -504,6 +571,19 @@ class ColumnPack:
             d = _DCTX_LOCAL.d = zstandard.ZstdDecompressor()
         return d
 
+    def _zstd_one(self, data: bytes, raw_len: int) -> bytes:
+        """Decode ONE zstd chunk, native first: on wheel-less images the
+        python fallback is the zlib shim, which can't read the real zstd
+        frames the native compressor writes -- and vice versa, the
+        native decoder refuses shim (zlib) bytes, so each side's output
+        always finds its decoder."""
+        from ..native import block_decompress_chunks
+
+        outs = block_decompress_chunks("zstd", [data], [raw_len])
+        if outs is not None:
+            return outs[0]
+        return self._dctx().decompress(data, max_output_size=raw_len)
+
     @classmethod
     def from_bytes(cls, data: bytes) -> "ColumnPack":
         return cls(lambda off, ln: data[off : off + ln], len(data))
@@ -552,7 +632,7 @@ class ColumnPack:
         data = self._read_range(off, stored_len)
         self._count_read(stored_len)
         if codec == CODEC_ZSTD:
-            data = self._dctx().decompress(data, max_output_size=raw_len)
+            data = self._zstd_one(data, raw_len)
         elif codec == CODEC_CONST:
             data = data * (raw_len // stored_len)  # tile the stored row
         elif codec != CODEC_RAW:
@@ -586,6 +666,11 @@ class ColumnPack:
             if parts[i] is None:
                 parts[i] = self._chunk(recs[i])
         return b"".join(parts)
+
+    def chunk_codecs(self) -> set[str]:
+        """Every chunk codec present in the pack -- footer metadata
+        only, no IO (the compaction passthrough's codec-match gate)."""
+        return {r[3] for meta in self._cols.values() for r in meta["chunks"]}
 
     def has_cached_array(self, name: str) -> bool:
         """True when a full-column read of `name` is a cache hit (used by
@@ -758,84 +843,113 @@ class ColumnPack:
 
     def warm(self, wants: list[tuple[str, list[int] | None]]) -> None:
         """Prefetch + batch-decompress every missing chunk of the wanted
-        (column, groups) set into the chunk cache."""
-        recs = []
-        for name, groups in wants:
-            meta = self._cols.get(name)
-            if meta is None or self.has_cached_array(name):
-                continue  # read/read_groups serve it from the array cache
-            chunks = meta["chunks"]
-            recs.extend(chunks if groups is None else [chunks[g] for g in groups])
-        miss = [r for r in recs if r[3] == CODEC_ZSTD and self._cache_get(r[0]) is None]
-        if len(miss) <= 1:
-            return
-        from ..native import available, zstd_decompress_chunks
-
-        if not available():
-            return
-        outs = zstd_decompress_chunks(
-            [self._read_range(r[0], r[1]) for r in miss], [r[2] for r in miss]
-        )
-        if outs is not None:
-            self._count_read(sum(r[1] for r in miss))
-            for r, raw in zip(miss, outs):
-                self._cache_put(r[0], raw)
+        (column, groups) set (full columns land in the array cache,
+        group slices in the chunk cache)."""
+        self._run_plan(self.plan_fetch(wants))
 
     def warm_columns(self, names: list[str], gap_bytes: int = 256 << 10) -> None:
         """Cold-read accelerator: fetch EVERY missing chunk of the named
         columns with a few coalesced ranged reads (runs split only at
         gaps > gap_bytes, so interleaved unwanted columns aren't pulled
-        wholesale), decompress ALL of them with ONE threaded native
-        ranges call straight into one destination buffer, and cache the
-        assembled per-column arrays. A cold query touching 12 small
-        columns pays ~2 fixed IO costs instead of 12, with zero
-        intermediate bytes objects."""
-        from ..native import available, zstd_decompress_ranges
+        wholesale), decompress ALL of them in one batch (threaded native
+        when available) straight into one destination buffer, and cache
+        the assembled per-column arrays. A cold query touching 12 small
+        columns pays ~2 fixed IO costs instead of 12."""
+        self._run_plan(self.plan_fetch([(n, None) for n in names],
+                                       gap_bytes=gap_bytes))
 
-        if not available():
-            return  # read()'s own per-column paths handle the fallback
-        wanted: list[tuple[str, dict, int]] = []  # (name, meta, dst start)
-        recs: list[tuple[list, int]] = []  # (chunk rec, dst_pos)
+    def _run_plan(self, cf: "ColumnFetch | None") -> None:
+        """Run a fetch plan inline with the pipeline's per-stage
+        kerneltel timings -- the serial (no-overlap) form of the stream
+        stages, so EVERY cold ranged read shows up under
+        tempo_stream_stage_seconds whichever path issued it. The window
+        records as its own run: inline stage-seconds then contribute
+        matching wall-seconds, so overlap_ratio stays ~1 (honestly
+        sequential) for workloads that never pipeline, instead of
+        inflating the numerator against someone else's wall."""
+        if cf is None:
+            return
+        import time as _time
+
+        from ..util.kerneltel import TEL
+
+        t_run = _time.perf_counter()
+        t0 = t_run
+        self.fetch_ranges(cf)
+        TEL.record_stream_stage("fetch", _time.perf_counter() - t0)
+        t0 = _time.perf_counter()
+        self.decode_fetched(cf)
+        TEL.record_stream_stage("decompress", _time.perf_counter() - t0)
+        TEL.record_stream_run(_time.perf_counter() - t_run)
+
+    # ------------------------------------------------- staged cold reads
+    # The cold-read pipeline's unit of work: plan (footer metadata only)
+    # -> fetch (the ranged IO) -> decode (decompress + assemble). The
+    # streaming pipeline (ops/stream.py) runs the phases of DIFFERENT
+    # blocks concurrently -- block N decodes while block N+1's ranged
+    # reads are in flight; warm/warm_columns run them back to back.
+
+    def plan_fetch(self, wants: list[tuple[str, list[int] | None]],
+                   gap_bytes: int = 256 << 10) -> "ColumnFetch | None":
+        """Build the fetch/decode plan for (column, groups|None) wants
+        from footer metadata + cache state alone -- no IO. None when
+        every want is already cached (nothing to do)."""
+        full: list[tuple[str, dict, int]] = []  # (name, meta, dst start)
+        recs: list[tuple[list, int]] = []  # (chunk rec, dst_pos; -1 = cache-only)
+        cached: list[tuple[bytes, int, int]] = []  # dst copies of cache hits
         pos = 0
-        for name in dict.fromkeys(names):  # dedupe; call sites overlap
+        seen: set[str] = set()
+        for name, groups in wants:
             meta = self._cols.get(name)
             if meta is None or self.has_cached_array(name):
-                continue
-            pos = (pos + 15) & ~15  # dtype-aligned column starts
-            wanted.append((name, meta, pos))
-            for r in meta["chunks"]:
-                if r[2] > 0:
-                    recs.append((r, pos))
+                continue  # read/read_groups serve it from the array cache
+            if groups is None:
+                if name in seen:
+                    continue  # dedupe; call sites overlap
+                seen.add(name)
+                pos = (pos + 15) & ~15  # dtype-aligned column starts
+                full.append((name, meta, pos))
+                for r in meta["chunks"]:
+                    if r[2] <= 0:
+                        continue
+                    hit = self._cache_get(r[0])
+                    if hit is not None:
+                        # already decoded (e.g. a prior find-by-id's
+                        # read_groups): copy into dst, no refetch
+                        cached.append((hit, pos, r[2]))
+                    else:
+                        recs.append((r, pos))
                     pos += r[2]
-        if len(recs) <= 1:
-            return
-        total_raw = pos
-        # chunks already decoded in the chunk cache (e.g. a prior
-        # find-by-id's read_groups) copy straight into dst: no refetch,
-        # no re-decompress
-        cached: list[tuple[bytes, int, int]] = []  # (raw, dst_pos, raw_len)
-        fetch: list[tuple[list, int]] = []
-        for r, dpos in recs:
-            hit = self._cache_get(r[0])
-            if hit is not None:
-                cached.append((hit, dpos, r[2]))
             else:
-                fetch.append((r, dpos))
-        recs = fetch
+                chunks = meta["chunks"]
+                for g in groups:
+                    r = chunks[g]
+                    if r[2] > 0 and self._cache_get(r[0]) is None:
+                        recs.append((r, -1))
+        if not full and not recs:
+            return None
+        # coalesce missing chunks into gap-bounded file runs
         by_off = sorted(recs, key=lambda t: t[0][0])
-        # coalesce into gap-bounded file runs
         runs: list[tuple[int, int, list]] = []  # (off, end, members)
         for r, dpos in by_off:
-            if runs and r[0] - runs[-1][1] <= gap_bytes:
+            if runs and r[0] - runs[-1][1] <= gap_bytes and r[0] >= runs[-1][0]:
                 off, end, members = runs[-1]
                 runs[-1] = (off, max(end, r[0] + r[1]), members + [(r, dpos)])
             else:
                 runs.append((r[0], r[0] + r[1], [(r, dpos)]))
+        return ColumnFetch(self, full, recs, cached, runs, pos,
+                           sum(r[1] for r, _ in recs))
+
+    def fetch_ranges(self, cf: "ColumnFetch") -> None:
+        """The IO phase: issue the plan's coalesced ranged reads.
+        Idempotent; counts inspected bytes as it reads."""
+        if cf.bufs is not None:
+            return
         src_parts: list[bytes] = []
         src_pos: dict[int, int] = {}  # chunk file off -> offset in joined src
         base = 0
         counted = 0
-        for off, end, members in runs:
+        for off, end, members in cf.runs:
             data = self._read_range(off, end - off)
             src_parts.append(data)
             counted += sum(m[0][1] for m in members)
@@ -843,45 +957,91 @@ class ColumnPack:
                 src_pos[r[0]] = base + (r[0] - off)
             base += len(data)
         self._count_read(counted)
-        src = (np.frombuffer(src_parts[0], np.uint8) if len(src_parts) == 1
-               else np.frombuffer(b"".join(src_parts), np.uint8)
-               ) if src_parts else np.empty(0, np.uint8)
-        dst = np.empty(total_raw, np.uint8)
-        for raw, dpos, raw_len in cached:
+        cf.bufs = src_parts
+        cf.src_pos = src_pos
+
+    def decode_fetched(self, cf: "ColumnFetch") -> None:
+        """The decode phase: decompress every fetched chunk (native
+        threaded batch per codec when available, per-chunk Python
+        otherwise), assemble full-column wants into the array cache and
+        sliced wants into the chunk cache."""
+        if cf.bufs is None:
+            raise ValueError("decode_fetched before fetch_ranges")
+        src_pos = cf.src_pos or {}
+        src = (np.frombuffer(cf.bufs[0], np.uint8) if len(cf.bufs) == 1
+               else np.frombuffer(b"".join(cf.bufs), np.uint8)
+               ) if cf.bufs else np.empty(0, np.uint8)
+        dst = np.empty(cf.raw_bytes, np.uint8)
+        for raw, dpos, raw_len in cf.cached:
             dst[dpos : dpos + raw_len] = np.frombuffer(raw, np.uint8)
-        zst = [(r, dpos) for r, dpos in recs if r[3] == CODEC_ZSTD]
-        if zst:
-            ok = zstd_decompress_ranges(
-                src,
-                np.asarray([src_pos[r[0]] for r, _ in zst], np.int64),
-                np.asarray([r[1] for r, _ in zst], np.int64),
-                dst,
-                np.asarray([d for _, d in zst], np.int64),
-                np.asarray([r[2] for r, _ in zst], np.int64),
-            )
-            if not ok:
-                return  # corrupt chunk: read()'s path reports it properly
-        for r, dpos in recs:
-            if r[3] == CODEC_ZSTD:
-                continue
+        # full-column chunks decode straight into dst; sliced (cache-only)
+        # chunks decode into a scratch tail appended after dst's columns
+        into_dst = [(r, d) for r, d in cf.recs if d >= 0]
+        sliced = [r for r, d in cf.recs if d < 0]
+        scratch = np.empty(sum(r[2] for r in sliced), np.uint8)
+        placed: list[tuple[list, np.ndarray, int]] = []  # (rec, buf, pos)
+        spos = 0
+        for r in sliced:
+            placed.append((r, scratch, spos))
+            spos += r[2]
+        for r, d in into_dst:
+            placed.append((r, dst, d))
+        # batch the native-range codecs per codec group; everything else
+        # (const/raw/gzip/lzma, or native refusal) decodes per chunk
+        from ..native import block_decompress_ranges
+
+        leftovers: list[tuple[list, np.ndarray, int]] = []
+        by_codec: dict[str, list[tuple[list, np.ndarray, int]]] = {}
+        for item in placed:
+            codec = item[0][3]
+            if codec in _NATIVE_RANGE_CODECS:
+                by_codec.setdefault(codec, []).append(item)
+            else:
+                leftovers.append(item)
+        for codec, items in by_codec.items():
+            # dst and scratch are distinct buffers: one ranges call per
+            # (codec, destination) pair
+            for buf in (dst, scratch):
+                part = [(r, p) for r, b, p in items if b is buf]
+                if not part:
+                    continue
+                ok = block_decompress_ranges(
+                    codec, src,
+                    np.asarray([src_pos[r[0]] for r, _ in part], np.int64),
+                    np.asarray([r[1] for r, _ in part], np.int64),
+                    buf,
+                    np.asarray([p for _, p in part], np.int64),
+                    np.asarray([r[2] for r, _ in part], np.int64),
+                )
+                if not ok:
+                    leftovers.extend((r, buf, p) for r, p in part)
+        for r, buf, p in leftovers:
             chunk = src[src_pos[r[0]] : src_pos[r[0]] + r[1]]
             if r[3] == CODEC_CONST:
-                dst[dpos : dpos + r[2]].reshape(-1, r[1])[:] = chunk
+                buf[p : p + r[2]].reshape(-1, r[1])[:] = chunk
             elif r[3] == CODEC_RAW:
-                dst[dpos : dpos + r[2]] = chunk
+                buf[p : p + r[2]] = chunk
+            elif r[3] == CODEC_ZSTD:
+                dec = self._zstd_one(chunk.tobytes(), r[2])
+                buf[p : p + r[2]] = np.frombuffer(dec, np.uint8)
             else:
                 dec = _EXTRA_CODECS[r[3]][1](chunk.tobytes(), r[2])
-                dst[dpos : dpos + r[2]] = np.frombuffer(dec, np.uint8)
+                buf[p : p + r[2]] = np.frombuffer(dec, np.uint8)
+        # sliced chunks land in the chunk cache for read_groups
+        for r, buf, p in placed:
+            if buf is scratch:
+                self._cache_put(r[0], buf[p : p + r[2]].tobytes())
         # COPY each column out of the shared buffer: cached views over
         # one big base would pin the whole buffer for as long as any one
         # entry lives, making LRU eviction free nothing (the copy is a
         # fraction of the decompress cost just paid)
-        for name, meta, start in wanted:
+        for name, meta, start in cf.full:
             n_bytes = sum(r[2] for r in meta["chunks"] if r[2] > 0)
             out = dst[start : start + n_bytes].copy().view(np.dtype(meta["dtype"]))
             out = out.reshape(meta["shape"])
             out.flags.writeable = False
             self._arrays_put(name, out)
+        cf.bufs = None  # free the fetched bytes; decode is one-shot
 
     def column_stats(self) -> list[dict]:
         """Per-column layout summary (name, dtype, rows, chunks, stored/

@@ -24,14 +24,24 @@ within design capacity (ops/bloom_ops.py), else rebuilt batch-native.
 
 from __future__ import annotations
 
+import os
+import uuid
 from dataclasses import dataclass
 
 import numpy as np
 
-from ..backend.base import RawBackend
+from ..backend.base import DoesNotExist, RawBackend
 from ..block import schema as S
 from ..block.bloom import ShardedBloom
-from ..block.builder import BlockBuilder, FinalizedBlock, compute_row_groups, write_block
+from ..block.builder import (
+    BLOOM_PREFIX,
+    DATA_NAME,
+    DICT_NAME,
+    BlockBuilder,
+    FinalizedBlock,
+    compute_row_groups,
+    write_block,
+)
 from ..block.colio import is_broadcast
 from ..block.dictionary import Dictionary, apply_remap
 from ..block.meta import BlockMeta
@@ -116,11 +126,11 @@ class _Source:
                 int(np.searchsorted(owner, hi, "left")))
 
 
-def _merge_order(sources: list[_Source]):
-    """Global id-sorted order over all source traces. Returns
-    (src_idx, sid, same_as_prev) arrays; same_as_prev marks duplicate-id
-    entries (collisions)."""
-    ids = [np.ascontiguousarray(s.cols["trace.id"]).reshape(-1, 16) for s in sources]
+def _merge_order(ids: list[np.ndarray]):
+    """Global id-sorted order over all source traces (one (n,16) id
+    array per source). Returns (src_idx, sid, same_as_prev) arrays;
+    same_as_prev marks duplicate-id entries (collisions)."""
+    ids = [np.ascontiguousarray(x).reshape(-1, 16) for x in ids]
     n = sum(len(x) for x in ids)
     if n == 0:
         z = np.empty(0, dtype=np.int32)
@@ -136,11 +146,13 @@ def _merge_order(sources: list[_Source]):
     return src[order], sid[order], same
 
 
-def _combine_collision(sources: list[_Source], blocks: list[BackendBlock],
+def _combine_collision(blocks: list[BackendBlock], base_names: set[str],
                        members: list[tuple[int, int]], tenant: str) -> _Source:
     """Materialize + combine one duplicated trace id, re-flatten through a
     one-trace builder into a columnar source of its own."""
-    tid = sources[members[0][0]].cols["trace.id"][members[0][1]].tobytes()
+    b0, sid0 = members[0]
+    tid = np.ascontiguousarray(
+        blocks[b0].pack.read("trace.id")).reshape(-1, 16)[sid0].tobytes()
     traces = [blocks[b].materialize_traces([sid])[0] for b, sid in members]
     combined = combine_traces(traces)
     b = BlockBuilder(tenant)
@@ -149,7 +161,6 @@ def _combine_collision(sources: list[_Source], blocks: list[BackendBlock],
     # today's builder may emit columns (e.g. tres.*) that pre-upgrade
     # input blocks lack; the merge machinery requires every source to
     # share one column set, so shape the collision source to the blocks'
-    base_names = set(sources[members[0][0]].cols)
     cols = {k: v for k, v in fin.cols.items() if k in base_names}
     if base_names - set(cols):
         raise UnsupportedColumnar(
@@ -568,13 +579,18 @@ class ColumnarPlan:
     tenant: str
     job: CompactionJob
     blocks: list[BackendBlock]
-    sources: list[_Source]
+    # indexed like the run tables; None holes are passthrough-only
+    # blocks whose columns were never decoded
+    sources: list[_Source | None]
     merged: Dictionary | None
     out_level: int
     # (src, sid_lo, sid_hi) run arrays per output block; empty when the
     # inputs hold zero traces (mark-only job)
     chunk_lists: list[tuple[np.ndarray, np.ndarray, np.ndarray]]
     single_est: bool
+    # per chunk list: the source block index whose compressed chunks
+    # copy through verbatim, or None for an ordinary rewrite output
+    passthrough: list[int | None]
 
 
 def plan_columnar(backend: RawBackend, job: CompactionJob, cfg: CompactorConfig,
@@ -596,13 +612,18 @@ def plan_columnar(backend: RawBackend, job: CompactionJob, cfg: CompactorConfig,
     # single iff everything fits one target block, the common L0->L1 case)
     target_est = cfg.target_block_bytes or cfg.max_block_bytes
     single_est = sum(m.size_bytes for m in job.blocks) <= target_est * 9 // 10
-    sources = [_Source.from_block(b, independent=single_est) for b in blocks]
-    names = set(sources[0].cols)
-    if any(set(s.cols) != names for s in sources[1:]):
+    names = set(blocks[0].pack.names())
+    if any(set(b.pack.names()) != names for b in blocks[1:]):
         raise UnsupportedColumnar("input blocks have differing column sets")
     out_level = max(m.compaction_level for m in job.blocks) + 1
 
-    src_arr, sid_arr, same = _merge_order(sources)
+    # merge order needs ONLY trace.id per block; full-column decode is
+    # deferred until the output cuts reveal which sources any rewrite
+    # output actually touches (a block that passes through whole never
+    # decompresses at all)
+    sources: list[_Source | None] = [None] * len(blocks)
+    src_arr, sid_arr, same = _merge_order(
+        [b.pack.read("trace.id") for b in blocks])
     n = len(src_arr)
     dup = same.copy()
     if n:
@@ -634,7 +655,7 @@ def plan_columnar(backend: RawBackend, job: CompactionJob, cfg: CompactorConfig,
                 groups[int(g)].append((int(src_arr[t]), int(sid_arr[t])))
             coll_src = []
             for members in groups:
-                sources.append(_combine_collision(sources, blocks, members, tenant))
+                sources.append(_combine_collision(blocks, names, members, tenant))
                 coll_src.append(len(sources) - 1)
             # splice the one-trace collision runs back at their merged
             # position (each group sits where its first member sorted)
@@ -649,19 +670,21 @@ def plan_columnar(backend: RawBackend, job: CompactionJob, cfg: CompactorConfig,
     if run_src.size == 0:
         # zero input traces: nothing to assemble, mark-only job
         return ColumnarPlan(tenant, job, blocks, sources, None,
-                            out_level, [], single_est)
+                            out_level, [], single_est, [])
 
     # merged dictionary via native K-way byte-level merge (no string
-    # decode anywhere) + one remap gather per source (axis columns
-    # defer their remap into _assemble's fused copy kernel)
+    # decode anywhere; dictionaries are their own objects, so this
+    # never decompresses column data) + one remap gather per decoded
+    # source below (axis columns defer their remap into _assemble's
+    # fused copy kernel)
     from ..native import available as native_available
     from ..native import dict_union
 
-    blob, offs, remaps = dict_union([s.dictionary.raw() for s in sources])
+    blob, offs, remaps = dict_union(
+        [b.dictionary.raw() for b in blocks]
+        + [s.dictionary.raw() for s in sources[len(blocks):]])
     merged = Dictionary.from_raw(blob, offs)
     fused = native_available()
-    for s, remap in zip(sources, remaps):
-        s.remap_codes(remap, fused=fused)
 
     # size-target output cuts, estimated from input bytes/trace. NOTE:
     # every output block carries the FULL merged dictionary (subsetting
@@ -702,17 +725,117 @@ def plan_columnar(backend: RawBackend, job: CompactionJob, cfg: CompactorConfig,
                 chunk_lists.append((s_src[keep], s_lo[keep], s_hi[keep]))
             prev_run, prev_off = r, off_in_r
 
+    # compressed-chunk passthrough: an output that is exactly one whole
+    # input block whose chunks are already the write codec inherits the
+    # block's compressed bytes verbatim (write_output copies objects);
+    # only sources a rewrite output touches ever decode their columns
+    if os.environ.get("TEMPO_COMPACT_PASSTHROUGH", "1") != "0":
+        passthrough = [_passthrough_source(blocks, cl) for cl in chunk_lists]
+    else:
+        passthrough = [None] * len(chunk_lists)
+    need = {int(s) for cl, pt in zip(chunk_lists, passthrough) if pt is None
+            for s in np.unique(cl[0])}
+    for si in sorted(need):
+        if si < len(blocks) and sources[si] is None:
+            sources[si] = _Source.from_block(blocks[si], independent=single_est)
+    for si, s in enumerate(sources):
+        if s is not None:
+            s.remap_codes(remaps[si], fused=fused)
+
     return ColumnarPlan(tenant, job, blocks, sources, merged,
-                        out_level, chunk_lists, single_est)
+                        out_level, chunk_lists, single_est, passthrough)
+
+
+def _passthrough_source(blocks: list[BackendBlock],
+                        cl: tuple[np.ndarray, np.ndarray, np.ndarray]) -> int | None:
+    """The input block whose ENTIRE trace set this output chunk list
+    covers verbatim, or None. Such an output's decoded contents equal
+    the input block's exactly (one run, whole block -- collisions always
+    split runs, so none involve it), so its compressed chunks copy
+    through without decompress->recompress. Gated on the chunks already
+    being the codec a rewrite would produce: a block written under a
+    different codec still rewrites, keeping the backend converging on
+    the configured one."""
+    csrc, clo, chi = cl
+    if len(csrc) != 1:
+        return None
+    si = int(csrc[0])
+    if si >= len(blocks):  # collision rebuilds always rewrite
+        return None
+    m = blocks[si].meta
+    if int(clo[0]) != 0 or int(chi[0]) != m.total_traces or not m.total_traces:
+        return None
+    from ..block.colio import CODEC_CONST, CODEC_RAW, CODEC_ZSTD
+
+    if not blocks[si].pack.chunk_codecs() <= {CODEC_ZSTD, CODEC_CONST, CODEC_RAW}:
+        return None
+    return si
+
+
+@dataclass
+class PassthroughOutput:
+    """One output block that inherits a single input block's compressed
+    objects verbatim (yielded by iter_outputs in place of a
+    FinalizedBlock; write_output copies instead of recompressing)."""
+
+    blk: BackendBlock
+    out_level: int
+
+    @property
+    def meta(self):  # the accounting surface FinalizedBlock exposes
+        return self.blk.meta
+
+
+def copy_block_through(backend: RawBackend, blk: BackendBlock, out_level: int,
+                       defer_meta: bool = False) -> BlockMeta:
+    """Produce a compaction output by verbatim object copy: data, dict
+    and bloom shards move backend-side (local: hardlink; stores:
+    server-side copy), compressed chunks never decode. Same meta-last /
+    defer_meta visibility contract as write_block."""
+    from ..util.kerneltel import TEL
+
+    src = blk.meta
+    m = BlockMeta.from_json(src.to_json())
+    m.block_id = str(uuid.uuid4())
+    m.compaction_level = out_level
+    names = [DATA_NAME, DICT_NAME] + [
+        f"{BLOOM_PREFIX}{s}" for s in range(src.bloom_shards)]
+    for name in names:
+        try:
+            backend.copy_object(src.tenant_id, src.block_id, name, m.block_id)
+        except DoesNotExist:
+            if name == DATA_NAME:
+                raise  # a block without data is corrupt; fail the job
+    TEL.record_passthrough(int(src.size_bytes))
+    if not defer_meta:
+        backend.write(m.tenant_id, m.block_id, "meta.json", m.to_json())
+    return m
+
+
+def write_output(backend: RawBackend, out, cfg: CompactorConfig,
+                 out_level: int, defer_meta: bool = False) -> BlockMeta:
+    """Write one iter_outputs product: FinalizedBlock -> full
+    recompress through write_block, PassthroughOutput -> verbatim
+    object copies. Both drivers (sequential + pipeline) route here so
+    the passthrough behaves identically under either."""
+    if isinstance(out, PassthroughOutput):
+        return copy_block_through(backend, out.blk, out_level,
+                                  defer_meta=defer_meta)
+    return write_block(backend, out, level=cfg.level_for(out_level),
+                       defer_meta=defer_meta)
 
 
 def iter_outputs(plan: ColumnarPlan, cfg: CompactorConfig):
     """Assemble the plan's output blocks one at a time. Yield order and
     contents are deterministic: a pipelined consumer that writes each
-    FinalizedBlock produces bit-identical blocks to the sequential
-    driver."""
+    output produces bit-identical blocks to the sequential driver.
+    Passthrough outputs yield as PassthroughOutput markers (no assemble
+    work; write_output performs the copy)."""
     single_out = len(plan.chunk_lists) == 1
-    for cl in plan.chunk_lists:
+    for cl, pt in zip(plan.chunk_lists, plan.passthrough):
+        if pt is not None:
+            yield PassthroughOutput(plan.blocks[pt], plan.out_level)
+            continue
         bloom = _union_input_blooms(plan.blocks) if single_out else None
         yield _assemble(plan.tenant, plan.sources, cl, plan.merged,
                         plan.out_level, cfg.row_group_spans, bloom,
@@ -726,10 +849,10 @@ def compact_columnar(backend: RawBackend, job: CompactionJob, cfg: CompactorConf
     plan = plan_columnar(backend, job, cfg)
     result = CompactionResult()
     for fin in iter_outputs(plan, cfg):
-        meta = write_block(backend, fin, level=cfg.level_for(plan.out_level))
+        meta = write_output(backend, fin, cfg, plan.out_level)
         result.new_blocks.append(meta)
-        result.traces_out += fin.meta.total_traces
-        result.spans_out += fin.meta.total_spans
+        result.traces_out += meta.total_traces
+        result.spans_out += meta.total_spans
 
     result.compacted_ids = [m.block_id for m in job.blocks]
     for m in job.blocks:

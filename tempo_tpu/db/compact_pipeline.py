@@ -406,7 +406,7 @@ class CompactionPipeline:
         ALL outputs lands (defer_meta) before the first meta.json
         publishes; inputs mark_compacted only after every publish. The
         depth-1 queue bounds memory to one finalized block waiting."""
-        from .columnar_compact import iter_outputs
+        from .columnar_compact import iter_outputs, write_output
 
         cfg = self.cfg
         result = CompactionResult()
@@ -425,9 +425,9 @@ class CompactionPipeline:
                     continue
                 t0 = time.perf_counter()
                 try:
-                    metas.append(write_block(
-                        self.backend, fin,
-                        level=cfg.level_for(plan.out_level), defer_meta=True))
+                    metas.append(write_output(
+                        self.backend, fin, cfg, plan.out_level,
+                        defer_meta=True))
                 except BaseException as e:  # noqa: BLE001 - surfaced after join
                     werr.append(e)
                 finally:

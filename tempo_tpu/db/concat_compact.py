@@ -35,6 +35,7 @@ import uuid
 from ..backend.base import COMPACTED_META_NAME, DoesNotExist, RawBackend
 from ..block.builder import BLOOM_PREFIX, DATA_NAME, DICT_NAME
 from ..block.meta import BlockMeta
+from ..util.kerneltel import TEL
 
 COMPOUND_VERSION = "vtpu1c"
 
@@ -70,6 +71,7 @@ def compact_concat(backend: RawBackend, job, cfg) -> "CompactionResult":
         pm["block_id"] = part_id
         pm["compaction_level"] = out_level
         parts.append(pm)
+        TEL.record_passthrough(int(m.size_bytes))
         result.traces_out += m.total_traces
         result.spans_out += m.total_spans
     doc = {

@@ -547,8 +547,12 @@ def resolve_groups(blk: BackendBlock, by: tuple):
 
 
 def metrics_block(blk: BackendBlock, q: MetricsQuery, req: MetricsRequest,
-                  resp: MetricsResponse, mode: str = "auto") -> None:
-    """Evaluate one block's contribution and merge it into resp."""
+                  resp: MetricsResponse, mode: str = "auto",
+                  planned=None) -> None:
+    """Evaluate one block's contribution and merge it into resp.
+    planned: the block's plan_metrics_filter result when the driver
+    already computed it (the serial cold-prefetch loop); None plans
+    here."""
     if not blk.meta.overlaps_time(req.start_ms // 1000, -(-req.end_ms // 1000)):
         return
     b_off, nb, t0_rel = _block_axis(blk, req)
@@ -560,7 +564,8 @@ def metrics_block(blk: BackendBlock, q: MetricsQuery, req: MetricsRequest,
 
     t0_wall = _time.time()
     io0 = blk.pack.bytes_read
-    planned = plan_metrics_filter(q, blk.dictionary)
+    if planned is None:
+        planned = plan_metrics_filter(q, blk.dictionary)
     if planned.prune:
         return
     groups = None if mode == "exact" else resolve_groups(blk, q.agg.by)
@@ -628,8 +633,17 @@ def metrics_block(blk: BackendBlock, q: MetricsQuery, req: MetricsRequest,
             "metrics", "host",
             "forced" if mode == "host"
             else ("cold_block" if i32_ok else "i32_range"))
-        cols = {n: blk.pack.read(n) for n in needed
-                if not n.startswith("span@") and blk.pack.has(n)}
+        col_names = [n for n in needed
+                     if not n.startswith("span@") and blk.pack.has(n)]
+        if not all(blk.pack.has_cached_array(n) for n in col_names):
+            # cold block: one coalesced ranged read + one threaded
+            # decode for the whole eval set (ops/stream stage timings)
+            # instead of per-column fetches -- a no-op if the driver's
+            # HostPrefetch already ran these stages ahead
+            from ..ops.stream import staged_warm
+
+            staged_warm(blk, col_names)
+        cols = {n: blk.pack.read(n) for n in col_names}
         outs = eval_timeseries_host(
             query, cols, operands, n_spans, blk.meta.total_traces,
             gid, val, pres, t0_rel, req.step_ms, nb, len(labels))
@@ -678,9 +692,14 @@ def _metrics_block_exact(blk: BackendBlock, q: MetricsQuery, req: MetricsRequest
         sids = list(range(n_traces))
     else:
         operands = Operands.build(planned.rows, planned.tables or None)
-        cols = {n: blk.pack.read(n) for n in required_columns(planned.conds)
-                if not n.startswith("span@") and n != "trace.span_off"
-                and blk.pack.has(n)}
+        col_names = [n for n in required_columns(planned.conds)
+                     if not n.startswith("span@") and n != "trace.span_off"
+                     and blk.pack.has(n)]
+        if not all(blk.pack.has_cached_array(n) for n in col_names):
+            from ..ops.stream import staged_warm
+
+            staged_warm(blk, col_names)
+        cols = {n: blk.pack.read(n) for n in col_names}
         mask = eval_span_mask_host((planned.tree, planned.conds), cols,
                                    operands, n_spans, n_traces)
         tsid = cols.get("span.trace_sid")
@@ -769,6 +788,24 @@ def parse_metrics_query(query: str) -> MetricsQuery:
     return q
 
 
+def _cold_metric_wants(blk: BackendBlock, planned) -> list[str] | None:
+    """The disk-resident column set one metrics evaluation of blk will
+    read (filter columns + the bucket axis), or None when the block is
+    warm or pruned -- the cold streaming prefetch's want list. Group-by
+    and value columns aren't predicted here; they ride the same ranged
+    reads when adjacent and the engine's own cold read covers the rest."""
+    if planned.prune:
+        return None
+    names = [n for n in required_columns(planned.conds)
+             if n != "trace.span_off" and not n.startswith("span@")
+             and blk.pack.has(n)]
+    names.append("span.start_ms")
+    names = [n for n in dict.fromkeys(names) if blk.pack.has(n)]
+    if not names or all(blk.pack.has_cached_array(n) for n in names):
+        return None
+    return names
+
+
 def metrics_query_range_blocks(
     blocks: list[BackendBlock],
     req: MetricsRequest,
@@ -821,6 +858,28 @@ def metrics_query_range_blocks(
 
         list(pool.map(run, in_range))
     else:
-        for blk in in_range:
-            metrics_block(blk, q, req, resp, mode=mode)
+        # serial driver: run cold blocks' fetch+decompress stages ahead
+        # on the stream pipeline so block N+1's ranged reads and
+        # threaded decode are in flight while block N's engine
+        # evaluates -- same depth/byte budget as the search path. Plans
+        # are computed once here and handed through to metrics_block.
+        plans = {id(blk): plan_metrics_filter(q, blk.dictionary)
+                 for blk in in_range}
+        cold_wants = [
+            (blk, names) for blk in in_range
+            if (names := _cold_metric_wants(blk, plans[id(blk)])) is not None]
+        prefetch = None
+        if len(cold_wants) > 1:  # a lone cold block has nothing to overlap
+            from ..ops.stream import HostPrefetch
+
+            prefetch = HostPrefetch(cold_wants)
+        try:
+            for blk in in_range:
+                if prefetch is not None:
+                    prefetch.wait(blk)  # False (engine reads itself) on miss
+                metrics_block(blk, q, req, resp, mode=mode,
+                              planned=plans[id(blk)])
+        finally:
+            if prefetch is not None:
+                prefetch.close()
     return resp

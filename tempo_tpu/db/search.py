@@ -564,9 +564,13 @@ def search_block(
         # trace_sid column never needs to leave disk on the host path
         plan = None
         if groups_range is None:
+            from ..ops.stream import staged_warm
+
             plan = _host_plan(blk, planned, None)
-            blk.pack.warm_columns(
-                plan[0] + list(blk.SEARCH_TRACE_COLS) + ["trace.start_ms"])
+            # single-unit form of the cold pipeline: coalesced ranged
+            # fetch + one threaded decode, with per-stage kerneltel
+            staged_warm(
+                blk, plan[0] + list(blk.SEARCH_TRACE_COLS) + ["trace.start_ms"])
         tm, counts, _ = _host_eval(blk, planned, operands, groups_range, plan=plan)
         n_spans_seen = n_rows
         key = _start_key_host(blk)
@@ -707,6 +711,29 @@ def search_blocks_fused(
     io0 = {id(blk): blk.pack.bytes_read for blk, _ in live}
     results: list[tuple] = []  # _candidates records until the final merge
 
+    # cold host blocks run through the streaming read pipeline: block
+    # N+1's ranged reads and threaded decompress are in flight while
+    # block N's host engine evaluates -- the read-side analog of the
+    # compaction pipeline's input prefetch, depth/byte-budget bounded
+    # (ops/stream). Results are unaffected: the pipeline only moves the
+    # fetch+decode of exactly the columns host_eval_collect would read.
+    host_plans: dict[int, tuple] = {}
+    cold_ids: set[int] = set()
+    cold_wants: list[tuple[BackendBlock, list[str]]] = []
+    for blk, p in host_items:
+        plan = _host_plan(blk, p, None)
+        host_plans[id(blk)] = plan
+        if not all(blk.pack.has_cached_array(n) for n in plan[0]
+                   if blk.pack.has(n)):
+            cold_ids.add(id(blk))
+            cold_wants.append((blk, plan[0] + list(blk.SEARCH_TRACE_COLS)
+                               + ["trace.start_ms"]))
+    prefetch = None
+    if len(cold_wants) > 1:  # a lone cold block has nothing to overlap
+        from ..ops.stream import HostPrefetch
+
+        prefetch = HostPrefetch(cold_wants)
+
     def stage_and_eval(item):
         import time as _time
 
@@ -735,24 +762,30 @@ def search_blocks_fused(
         blk, p = item
         t0w = _time.time()
         operands = Operands.build(p.rows, p.tables or None)
-        # cold-scan detection BEFORE reading: cache-hit timings would
-        # inflate the rate EMA and mislead the engine choice for
-        # genuinely cold blocks (and the shared bytes_read counter can't
-        # distinguish this thread's IO from concurrent readers')
-        plan = _host_plan(blk, p, None)
+        # cold-scan detection from the PRE-prefetch snapshot (a pipeline
+        # hit still runs the host engine as a cold scan), but the rate
+        # EMA only learns from scans that paid their own IO: a block the
+        # prefetch served (fully or partly) times at somewhere between
+        # memory and IO speed and would inflate _HOST_RATE_BPS,
+        # misrouting the next lone cold block toward the host engine
+        plan = host_plans[id(blk)]
         host_needed = plan[0]
-        cold = not all(blk.pack.has_cached_array(n)
-                       for n in host_needed if blk.pack.has(n))
+        cold = id(blk) in cold_ids
+        paid_io = False
         t0 = _time.perf_counter()
         if cold:
             # one coalesced ranged read + one threaded decompress batch
             # for EVERYTHING this query touches (eval columns + the
             # candidate/result trace columns): a cold scan's cost is
-            # per-column fixed overheads, not bytes
-            blk.pack.warm_columns(
-                host_needed + list(blk.SEARCH_TRACE_COLS) + ["trace.start_ms"])
+            # per-column fixed overheads, not bytes. The pipeline ran
+            # (or is running) those stages ahead; wait for them, and do
+            # the read here only if the pipeline was skipped/cancelled.
+            if prefetch is None or not prefetch.wait(blk):
+                paid_io = True
+                blk.pack.warm_columns(
+                    host_needed + list(blk.SEARCH_TRACE_COLS) + ["trace.start_ms"])
         tm, counts, cols = _host_eval(blk, p, operands, None, plan=plan)
-        if cold:
+        if paid_io:
             _note_host_rate(sum(a.nbytes for a in cols.values()),
                             _time.perf_counter() - t0)
         key = _start_key_host(blk)
@@ -798,9 +831,13 @@ def search_blocks_fused(
                        f"{item[0].meta.block_id}: {traceback.format_exc()}")
             raise
 
-    outs = list(pool.map(run_item, tagged)) if pool is not None else [
-        run_item(t) for t in tagged
-    ]
+    try:
+        outs = list(pool.map(run_item, tagged)) if pool is not None else [
+            run_item(t) for t in tagged
+        ]
+    finally:
+        if prefetch is not None:
+            prefetch.close()  # an errored item mustn't leak pipeline work
     evald = [o for tag, o in outs if tag == "dev"]
     host_out = [(o, it) for (tag, o), (htag, it) in zip(outs, tagged) if tag == "host"]
 

@@ -78,6 +78,17 @@ def _bind(lib):
         ctypes.c_int, ctypes.c_int,
     ]
     lib.vtpu_zstd_decompress_batch.restype = ctypes.c_int
+    # snappy/lz4 block codecs: batch signatures mirror the zstd ones
+    # (minus the level param -- neither format has levels)
+    lib.vtpu_snappy_bound.argtypes = [ctypes.c_int64]
+    lib.vtpu_snappy_bound.restype = ctypes.c_int64
+    lib.vtpu_lz4_bound.argtypes = [ctypes.c_int64]
+    lib.vtpu_lz4_bound.restype = ctypes.c_int64
+    batch_args = [ctypes.c_void_p] * 6 + [ctypes.c_int, ctypes.c_int]
+    for fn in (lib.vtpu_snappy_compress_batch, lib.vtpu_snappy_decompress_batch,
+               lib.vtpu_lz4_compress_batch, lib.vtpu_lz4_decompress_batch):
+        fn.argtypes = batch_args
+        fn.restype = ctypes.c_int
     lib.vtpu_dict_union.argtypes = [
         ctypes.c_int64, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
         ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
@@ -222,6 +233,104 @@ def varint_frames(data: bytes) -> tuple[np.ndarray, np.ndarray, bool, int] | Non
 # least 2 workers so batch codecs overlap
 _CPUS = os.cpu_count() or 4
 _N_THREADS = 1 if _CPUS <= 1 else max(2, _CPUS // 2)
+
+
+# --------------------------------------------------- snappy / lz4 blocks
+# the non-zstd half of the codec matrix: hand-rolled native block codecs
+# with the same batch ABI as zstd. Per-codec (bound name, compress name,
+# decompress name) -- the worst-case bound comes from the library itself
+# so it can never drift from the compressor's actual emission; callers
+# fall back to the pure-Python codecs in block/blockcodecs.py when the
+# library is absent.
+_BLOCK_CODECS = {
+    "snappy": ("vtpu_snappy_bound",
+               "vtpu_snappy_compress_batch", "vtpu_snappy_decompress_batch"),
+    "lz4": ("vtpu_lz4_bound",
+            "vtpu_lz4_compress_batch", "vtpu_lz4_decompress_batch"),
+}
+_DECOMPRESS_RANGES = {
+    "zstd": "vtpu_zstd_decompress_batch",
+    "snappy": "vtpu_snappy_decompress_batch",
+    "lz4": "vtpu_lz4_decompress_batch",
+}
+
+
+def block_compress_chunks(codec: str, chunks: list[bytes]) -> list[bytes] | None:
+    """Batch-compress chunks with a non-zstd block codec on native
+    threads. None -> caller falls back to the pure-Python codec."""
+    lib = _load()
+    spec = _BLOCK_CODECS.get(codec)
+    n = len(chunks)
+    if lib is None or spec is None or n == 0:
+        return None
+    bound_name, comp_name, _ = spec
+    comp = getattr(lib, comp_name, None)
+    bound = getattr(lib, bound_name, None)
+    if comp is None or bound is None:
+        return None
+    src = np.frombuffer(b"".join(chunks), dtype=np.uint8)
+    in_lens = np.asarray([len(c) for c in chunks], dtype=np.int64)
+    in_offs = np.zeros(n, dtype=np.int64)
+    np.cumsum(in_lens[:-1], out=in_offs[1:]) if n > 1 else None
+    bounds = np.asarray([bound(int(l)) for l in in_lens], dtype=np.int64)
+    out_offs = np.zeros(n, dtype=np.int64)
+    np.cumsum(bounds[:-1], out=out_offs[1:]) if n > 1 else None
+    dst = np.empty(int(bounds.sum()), dtype=np.uint8)
+    out_lens = np.zeros(n, dtype=np.int64)
+    rc = comp(src.ctypes.data if len(src) else None,
+              in_offs.ctypes.data, in_lens.ctypes.data,
+              dst.ctypes.data, out_offs.ctypes.data, out_lens.ctypes.data,
+              n, _N_THREADS)
+    if rc != 0:
+        return None
+    return [dst[out_offs[i] : out_offs[i] + out_lens[i]].tobytes() for i in range(n)]
+
+
+def block_decompress_ranges(codec: str, src: np.ndarray, in_offs: np.ndarray,
+                            in_lens: np.ndarray, dst: np.ndarray,
+                            out_offs: np.ndarray, out_lens: np.ndarray) -> bool:
+    """Decompress frames of one contiguous source straight into dst
+    positions -- the zstd_decompress_ranges shape generalized over the
+    whole codec matrix (the cold pipeline's decode stage dispatches per
+    chunk-codec group through this)."""
+    lib = _load()
+    name = _DECOMPRESS_RANGES.get(codec)
+    n = len(in_offs)
+    if (lib is None or name is None or n == 0 or src.dtype != np.uint8
+            or not src.flags.c_contiguous):
+        return False
+    fn = getattr(lib, name, None)
+    if fn is None:
+        return False
+    in_offs = np.ascontiguousarray(in_offs, dtype=np.int64)
+    in_lens = np.ascontiguousarray(in_lens, dtype=np.int64)
+    out_offs = np.ascontiguousarray(out_offs, dtype=np.int64)
+    out_lens = np.ascontiguousarray(out_lens, dtype=np.int64)
+    rc = fn(src.ctypes.data if len(src) else None,
+            in_offs.ctypes.data, in_lens.ctypes.data,
+            dst.ctypes.data, out_offs.ctypes.data, out_lens.ctypes.data,
+            n, _N_THREADS)
+    return rc == 0
+
+
+def block_decompress_chunks(codec: str, chunks: list[bytes],
+                            out_sizes: list[int]) -> list[bytes] | None:
+    """Batch-decompress per-chunk bytes with any matrix codec. None ->
+    caller falls back to the per-chunk Python decoder."""
+    if not chunks:
+        return None
+    n = len(chunks)
+    src = np.frombuffer(b"".join(chunks), dtype=np.uint8)
+    in_lens = np.asarray([len(c) for c in chunks], dtype=np.int64)
+    in_offs = np.zeros(n, dtype=np.int64)
+    np.cumsum(in_lens[:-1], out=in_offs[1:]) if n > 1 else None
+    out_lens = np.asarray(out_sizes, dtype=np.int64)
+    out_offs = np.zeros(n, dtype=np.int64)
+    np.cumsum(out_lens[:-1], out=out_offs[1:]) if n > 1 else None
+    dst = np.empty(int(out_lens.sum()), dtype=np.uint8)
+    if not block_decompress_ranges(codec, src, in_offs, in_lens, dst, out_offs, out_lens):
+        return None
+    return [dst[out_offs[i] : out_offs[i] + out_lens[i]].tobytes() for i in range(n)]
 
 
 def zstd_compress_chunks(chunks: list[bytes], level: int = 3) -> list[bytes] | None:

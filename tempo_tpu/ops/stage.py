@@ -167,6 +167,64 @@ class StagedBlock:
     cols: dict[str, jnp.ndarray] = field(default_factory=dict)
 
 
+@dataclass
+class StagePlan:
+    """The name bookkeeping stage_block used to do inline, precomputed
+    so the pipeline can run the read / assemble / upload phases on
+    different schedules."""
+
+    read_names: list[str]  # real pack columns to read
+    materialize: list[str]  # res columns to broadcast to span level
+    want_gkey: bool
+    start_ms_for_gkey_only: bool
+
+
+def plan_stage(needed: list[str]) -> StagePlan:
+    materialize = [n.split("@", 1)[1] for n in needed if n.startswith("span@")]
+    want_gkey = "trace@gkey_s" in needed
+    read_names = [n for n in needed if not n.startswith(("span@", "trace@"))]
+    start_ms_for_gkey_only = want_gkey and "trace.start_ms" not in read_names
+    if start_ms_for_gkey_only:
+        read_names = read_names + ["trace.start_ms"]
+    return StagePlan(read_names, materialize, want_gkey, start_ms_for_gkey_only)
+
+
+def stage_fetch_wants(blk: BackendBlock, plan: StagePlan,
+                      groups: list[int] | None) -> list[tuple[str, list[int] | None]]:
+    """The (column, groups) set the read phase will touch, in
+    ColumnPack.plan_fetch form -- the pipeline's fetch/decompress stages
+    warm exactly these so read_stage_columns is pure cache assembly."""
+    span_ax = blk.pack.axes.get(S.AX_SPAN)
+    sliced = span_ax is not None and span_ax.n_groups > 0 and groups is not None
+    wants: list[tuple[str, list[int] | None]] = []
+    for name in plan.read_names:
+        ax = _AXIS_OF.get(name.split(".", 1)[0])
+        wants.append((name, list(groups) if (ax is not None and sliced) else None))
+    return wants
+
+
+def read_stage_columns(blk: BackendBlock, plan: StagePlan,
+                       groups: list[int]) -> tuple[dict, int]:
+    """The host-read phase: raw columns (sliced to `groups` on their
+    axis) + the res-axis row count."""
+    pack = blk.pack
+    span_ax = pack.axes[S.AX_SPAN]
+    host: dict[str, np.ndarray] = {}
+    n_res = 0
+    for name in plan.read_names:
+        pref = name.split(".", 1)[0]
+        ax = _AXIS_OF.get(pref)
+        if ax is None:
+            arr = pack.read(name)
+        else:
+            arr = pack.read_groups(name, groups) if span_ax.n_groups else pack.read(name)
+        host[name] = arr
+    for name, arr in host.items():
+        if name.startswith("res."):
+            n_res = max(n_res, arr.shape[0])
+    return host, n_res
+
+
 def stage_block(
     blk: BackendBlock,
     needed: list[str],
@@ -188,41 +246,46 @@ def stage_block(
             return hit
     if cache:
         TEL.staged_cache_misses.inc()
-    pack = blk.pack
-    span_ax = pack.axes[S.AX_SPAN]
+    plan = plan_stage(needed)
+    span_ax = blk.pack.axes[S.AX_SPAN]
     if groups is None:
         groups = list(range(span_ax.n_groups))
+    host, n_res = read_stage_columns(blk, plan, groups)
+    staged, padded, real_rows = assemble_stage(blk, plan, groups, host, n_res)
+    upload_stage(blk, plan, staged, padded, real_rows)
+    if cache:
+        nbytes = sum(a.nbytes for a in staged.cols.values())
+        if nbytes <= _CACHE_MAX_ENTRY_BYTES:
+            if store is None:
+                store = {}
+                blk._staged_cache = store
+            if len(store) >= _CACHE_MAX_ENTRIES:
+                victim = next(iter(store))
+                store.pop(victim)
+                _lru_drop(blk, victim)
+            store[key] = staged
+            _lru_touch(blk, key, nbytes)
+    return staged
+
+
+def assemble_stage(blk: BackendBlock, plan: StagePlan, groups: list[int],
+                   host: dict, n_res: int) -> tuple[StagedBlock, dict, dict]:
+    """The pad/assemble phase: owner-offset transforms, derived columns,
+    bucket padding. Pure host numpy -- no IO, no device."""
+    host = dict(host)  # owner-offset transforms mutate; callers may retry
+    pack = blk.pack
+    span_ax = pack.axes[S.AX_SPAN]
     span_base = span_ax.offsets[groups[0]] if groups else 0
     span_hi = span_ax.offsets[groups[-1] + 1] if groups else 0
-
-    host: dict[str, np.ndarray] = {}
-    n_res = 0
-    materialize = [n.split("@", 1)[1] for n in needed if n.startswith("span@")]
-    want_gkey = "trace@gkey_s" in needed
-    needed = [n for n in needed if not n.startswith(("span@", "trace@"))]
-    start_ms_for_gkey_only = want_gkey and "trace.start_ms" not in needed
-    if start_ms_for_gkey_only:
-        needed = needed + ["trace.start_ms"]
-    for name in needed:
-        pref = name.split(".", 1)[0]
-        ax = _AXIS_OF.get(pref)
-        if ax is None:
-            arr = pack.read(name)
-            if pref == "res" or name == "rattr.res":
-                n_res = max(n_res, arr.shape[0] if name.startswith("res.") else 0)
-        else:
-            arr = pack.read_groups(name, groups) if span_ax.n_groups else pack.read(name)
-        host[name] = arr
-
     n_spans = span_hi - span_base
     n_traces = blk.meta.total_traces
-    for name, arr in host.items():
-        if name.startswith("res."):
-            n_res = max(n_res, arr.shape[0])
 
     n_spans_b = bucket(max(n_spans, 1))
     n_traces_b = bucket(max(n_traces, 1))
     n_res_b = bucket(max(n_res, 1))
+
+    want_gkey = plan.want_gkey
+    start_ms_for_gkey_only = plan.start_ms_for_gkey_only
 
     staged = StagedBlock(
         n_spans=n_spans,
@@ -290,6 +353,18 @@ def stage_block(
             else:
                 continue  # host-only trace columns are not staged
         padded[name] = arr
+    # complete the per-column real (pre-padding) row counts for the
+    # upload phase's padding-waste telemetry
+    real_full = {n: real_rows.get(n, int(host[n].shape[0])) for n in padded}
+    return staged, padded, real_full
+
+
+def upload_stage(blk: BackendBlock, plan: StagePlan, staged: StagedBlock,
+                 padded: dict, real_rows: dict) -> StagedBlock:
+    """The host->device phase: one batched transfer + the query-
+    independent res->span materialization."""
+    from ..util.kerneltel import TEL
+
     # ONE batched transfer for the whole block: per-array device_puts
     # each pay a full link round trip on a high-latency tunnel
     staged.cols = dict(zip(padded, jax.device_put(list(padded.values()))))
@@ -297,7 +372,7 @@ def stage_block(
     # summed per column -- columns live on different axes)
     TEL.record_transfer(
         sum(int(a.nbytes) for a in padded.values()),
-        sum(real_rows.get(n, int(host[n].shape[0])) for n in padded),
+        sum(real_rows.values()),
         sum(int(a.shape[0]) for a in padded.values()),
     )
 
@@ -305,22 +380,10 @@ def stage_block(
     # broadcast gather is query-independent, so paying it once here
     # (cached with the staged entry) removes a span-length random gather
     # -- one of the most expensive TPU ops -- from every query's kernel
-    if materialize and "span.res_idx" in staged.cols:
-        for name in materialize:
+    if plan.materialize and "span.res_idx" in staged.cols:
+        for name in plan.materialize:
             if name in staged.cols:
                 staged.cols[f"span@{name}"] = _res_to_span(
                     staged.cols[name], staged.cols["span.res_idx"]
                 )
-    if cache:
-        nbytes = sum(a.nbytes for a in staged.cols.values())
-        if nbytes <= _CACHE_MAX_ENTRY_BYTES:
-            if store is None:
-                store = {}
-                blk._staged_cache = store
-            if len(store) >= _CACHE_MAX_ENTRIES:
-                victim = next(iter(store))
-                store.pop(victim)
-                _lru_drop(blk, victim)
-            store[key] = staged
-            _lru_touch(blk, key, nbytes)
     return staged

@@ -1,46 +1,445 @@
-"""Pipelined row-group streaming: overlap host IO with device compute.
+"""Cold-read streaming pipeline: overlap ranged IO, native decompress,
+pad/assemble and device upload across the units of a scan.
 
 The long-context story (SURVEY.md 5.7): a block's span axis is the
 "sequence", row groups are its chunks. Like ring attention streams KV
 blocks through device memory while the next block prefetches, the
-streamed search pipeline stages row-group chunk N+1 (backend range
-reads + decompression + padding) on a background thread while the
-filter kernel evaluates chunk N on device -- the role of the
-reference's prefetch iterators (vparquet/prefetch_iterator.go,
-v2/iterator_prefetch.go), with the device as the consumer.
+pipeline keeps every stage of the cold path busy at once -- while unit
+N's filter kernel runs on device, unit N+1 is uploading from the
+double buffer, unit N+2 is decompressing on native threads, and unit
+N+3's ranged reads are in flight. Units are row-group chunks of one
+block (the streamed device eval) or whole cold blocks of one query
+(the fused search/metrics host engines) -- the role of the reference's
+prefetch iterators (vparquet/prefetch_iterator.go,
+v2/iterator_prefetch.go), with the stages made explicit so each shows
+up in kerneltel (tempo_stream_stage_seconds{stage}) and the overlap
+ratio is measurable in /status/kernels.
 
-Chunks share one padded shape bucket, so every chunk reuses the same
-compiled program (ops/filter's lru-cached jit).
+Scheduling is budgeted, not best-effort:
 
-Cross-chunk correctness: a trace's spans can straddle chunk boundaries,
-so evaluating the FULL trace-level tree per chunk and OR-ing masks
-would drop traces whose AND-of-tracify legs hit in different chunks.
-Instead each trace-level LEAF (a tracify subtree or a trace-axis cond)
-aggregates across chunks first -- tracify leaves OR their per-chunk
-trace hits, trace-cond leaves are chunk-invariant -- and the boolean
-skeleton combines the aggregated leaf vectors on host.
+  * TEMPO_STREAM_PREFETCH_DEPTH (default 3) bounds how many units run
+    ahead of the consumer; depth 0 is the serial kill switch (same
+    stages, inline -- the differential tests' oracle).
+  * TEMPO_STREAM_MEM_BUDGET (default 256 MiB) gates admission on each
+    unit's estimated host bytes (compressed fetch + decode output,
+    known from footer metadata before any IO). Admission is strictly
+    in unit order per pipeline and one unit always admits, so an
+    oversized unit stalls its pipeline instead of deadlocking it --
+    the compact_pipeline admission-gate shape on the read side.
+  * TEMPO_STREAM_WORKERS sizes the shared stage executor (default
+    max(4, cpu/2)). The pool is process-wide; fairness across
+    concurrent pipelines comes from the per-pipeline depth bound and
+    the byte gate, not from pool ownership -- this replaces the old
+    module-global unbounded-fairness prefetch pool.
+  * uploads are double-buffered IN ORDER: unit i uploads only once the
+    consumer is within _UPLOAD_BUFFERS units of it, so at most two
+    staged-but-unconsumed uploads hold device memory.
+
+Cross-chunk correctness (the streamed device eval): a trace's spans can
+straddle chunk boundaries, so evaluating the FULL trace-level tree per
+chunk and OR-ing masks would drop traces whose AND-of-tracify legs hit
+in different chunks. Instead each trace-level LEAF (a tracify subtree
+or a trace-axis cond) aggregates across chunks first -- tracify leaves
+OR their per-chunk trace hits, trace-cond leaves are chunk-invariant --
+and the boolean skeleton combines the aggregated leaf vectors on host.
 """
 
 from __future__ import annotations
 
+import os
+import threading
 import time as _time
 from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass
 
 import numpy as np
 
+from ..block import schema as S
 from ..block.reader import BackendBlock
+from ..util.kerneltel import TEL
 from .filter import Operands, eval_block, normalize_tree
-from .stage import stage_block
+from .stage import (
+    assemble_stage,
+    plan_stage,
+    read_stage_columns,
+    stage_fetch_wants,
+    upload_stage,
+)
 
 DEFAULT_GROUPS_PER_CHUNK = 4
+_UPLOAD_BUFFERS = 2  # staged-but-unconsumed uploads allowed (double buffer)
 
-import os as _os
+_DEFAULT_DEPTH = 3
+_DEFAULT_MEM_BUDGET = 256 << 20
 
-# sized for concurrent streamed searches (the frontend dispatches many
-# jobs at once); each pipeline keeps at most one prefetch in flight
-_prefetch_pool = ThreadPoolExecutor(
-    max_workers=max(4, (_os.cpu_count() or 8) // 2), thread_name_prefix="stream-prefetch"
-)
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        v = os.environ.get(name, "")
+        return int(v) if v else default
+    except ValueError:
+        return default
+
+
+def prefetch_depth() -> int:
+    """Units the pipeline runs ahead of the consumer; 0 = serial."""
+    return max(0, _env_int("TEMPO_STREAM_PREFETCH_DEPTH", _DEFAULT_DEPTH))
+
+
+def mem_budget() -> int:
+    return max(1, _env_int("TEMPO_STREAM_MEM_BUDGET", _DEFAULT_MEM_BUDGET))
+
+
+_pool: ThreadPoolExecutor | None = None
+_pool_lock = threading.Lock()
+
+
+def _executor() -> ThreadPoolExecutor:
+    """The shared stage executor, sized once (TEMPO_STREAM_WORKERS)."""
+    global _pool
+    with _pool_lock:
+        if _pool is None:
+            workers = _env_int("TEMPO_STREAM_WORKERS", 0)
+            if workers <= 0:
+                workers = max(4, (os.cpu_count() or 8) // 2)
+            _pool = ThreadPoolExecutor(
+                max_workers=workers, thread_name_prefix="stream-stage")
+        return _pool
+
+
+class _ByteGate:
+    """Process-wide admission budget over every stream pipeline's
+    in-flight units. A unit holds its estimate from admission until its
+    stages finish (fetched bytes + decode buffers are host RAM for
+    exactly that window). Admission order within a pipeline is strictly
+    unit order (_PipeState.wait_admit_turn), so a pipeline's later
+    units can never hold budget while its head waits -- the classic
+    inversion deadlock. A unit always admits when nothing is in flight,
+    so one oversized unit stalls, never deadlocks."""
+
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._bytes = 0
+        self._holders = 0
+        self.peak_bytes = 0  # high-water mark (tests + /status)
+
+    def acquire(self, n: int, cancelled: threading.Event | None) -> bool:
+        with self._cv:
+            while True:
+                if cancelled is not None and cancelled.is_set():
+                    return False
+                if self._holders == 0 or self._bytes + n <= mem_budget():
+                    self._bytes += n
+                    self._holders += 1
+                    if self._bytes > self.peak_bytes:
+                        self.peak_bytes = self._bytes
+                    TEL.stream_inflight(self._bytes)
+                    return True
+                # re-check on release notifications; the timeout only
+                # guards against a lost cancellation wakeup
+                self._cv.wait(0.05)
+
+    def release(self, n: int) -> None:
+        with self._cv:
+            self._bytes -= n
+            self._holders -= 1
+            TEL.stream_inflight(self._bytes)
+            self._cv.notify_all()
+
+    def inflight_bytes(self) -> int:
+        with self._cv:
+            return self._bytes
+
+
+_GATE = _ByteGate()
+
+
+@dataclass
+class StreamUnit:
+    """One pipeline unit: a (block, columns, row-group slice) read.
+    upload=True stages padded device columns (the streamed device eval);
+    upload=False stops after fetch+decompress, leaving the columns
+    cache-resident for a host engine (the cold fused-search path)."""
+
+    blk: BackendBlock
+    needed: list[str]
+    groups: list[int] | None = None  # None = whole block
+    upload: bool = True
+    est_bytes: int = 0  # filled at plan time (admission gate)
+    index: int = 0  # position in its pipeline (set by _run_unit; the
+    # upload turnstile orders the double buffer by it)
+
+
+class _PipeState:
+    """Per-pipeline coordination: ordered admission, ordered
+    double-buffered upload, consumer progress, cancellation."""
+
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._admitted = 0  # units past the admission turnstile
+        self._consumed = 0  # units the consumer is done with
+        self.cancelled = threading.Event()
+
+    def wait_admit_turn(self, i: int) -> bool:
+        with self._cv:
+            while not self.cancelled.is_set() and i != self._admitted:
+                self._cv.wait(0.05)
+            return not self.cancelled.is_set()
+
+    def admit_done(self) -> None:
+        with self._cv:
+            self._admitted += 1
+            self._cv.notify_all()
+
+    def wait_upload_turn(self, i: int) -> bool:
+        """Unit i may upload once the consumer is within
+        _UPLOAD_BUFFERS units: device memory holds at most two staged
+        uploads the filter hasn't consumed yet."""
+        with self._cv:
+            while (not self.cancelled.is_set()
+                   and i >= self._consumed + _UPLOAD_BUFFERS):
+                self._cv.wait(0.05)
+            return not self.cancelled.is_set()
+
+    def advance(self) -> None:
+        with self._cv:
+            self._consumed += 1
+            self._cv.notify_all()
+
+    def cancel(self) -> None:
+        self.cancelled.set()
+        with self._cv:
+            self._cv.notify_all()
+
+
+def _unit_groups(u: StreamUnit) -> list[int]:
+    span_ax = u.blk.pack.axes.get(S.AX_SPAN)
+    if u.groups is not None:
+        return u.groups
+    return list(range(span_ax.n_groups)) if span_ax else []
+
+
+def _plan_unit(u: StreamUnit):
+    """(stage plan, column-fetch plan) for a unit -- footer metadata
+    only, no IO; fills u.est_bytes for the admission gate."""
+    if u.upload:
+        plan = plan_stage(u.needed)
+        wants = stage_fetch_wants(u.blk, plan, u.groups)
+    else:
+        plan = None
+        wants = [(n, None) for n in u.needed]
+    cf = u.blk.pack.plan_fetch(wants)
+    u.est_bytes = cf.est_bytes if cf is not None else 0
+    return plan, cf
+
+
+def _run_stages(u: StreamUnit, plan, cf, state: _PipeState | None):
+    """fetch -> decompress -> assemble -> upload for one unit, with
+    per-stage kerneltel timings. state=None runs without cancellation
+    checks (the serial path)."""
+    pack = u.blk.pack
+    t0 = _time.perf_counter()
+    if cf is not None:
+        pack.fetch_ranges(cf)
+    TEL.record_stream_stage("fetch", _time.perf_counter() - t0)
+    if state is not None and state.cancelled.is_set():
+        return None
+    t0 = _time.perf_counter()
+    if cf is not None:
+        pack.decode_fetched(cf)
+    if not u.upload:
+        TEL.record_stream_stage("decompress", _time.perf_counter() - t0)
+        return True  # columns are cache-resident; host engines read them
+    groups = _unit_groups(u)
+    host, n_res = read_stage_columns(u.blk, plan, groups)
+    TEL.record_stream_stage("decompress", _time.perf_counter() - t0)
+    if state is not None and state.cancelled.is_set():
+        return None
+    t0 = _time.perf_counter()
+    staged, padded, real_rows = assemble_stage(u.blk, plan, groups, host, n_res)
+    TEL.record_stream_stage("assemble", _time.perf_counter() - t0)
+    if state is not None and not state.wait_upload_turn(u.index):
+        return None  # cancelled: no device work for abandoned units
+    t0 = _time.perf_counter()
+    upload_stage(u.blk, plan, staged, padded, real_rows)
+    TEL.record_stream_stage("upload", _time.perf_counter() - t0)
+    return staged
+
+
+def _run_unit(u: StreamUnit, i: int, state: _PipeState):
+    """One unit through admission + stages on a pool worker."""
+    u.index = i
+    if not state.wait_admit_turn(i):
+        TEL.record_stream_unit("cancelled")
+        return None
+    ok = False
+    try:
+        plan, cf = _plan_unit(u)
+        ok = _GATE.acquire(u.est_bytes, state.cancelled)
+    except BaseException:
+        TEL.record_stream_unit("error")
+        raise
+    finally:
+        # unblock the next unit's turnstile on EVERY exit -- a planning
+        # error here must fail this unit, not stall the whole pipeline
+        # (HostPrefetch callers wait() with no timeout)
+        state.admit_done()
+    if not ok:
+        TEL.record_stream_unit("cancelled")
+        return None
+    try:
+        out = _run_stages(u, plan, cf, state)
+        TEL.record_stream_unit(
+            "cancelled" if state.cancelled.is_set() and out is None else "ok")
+        return out
+    except BaseException:
+        TEL.record_stream_unit("error")
+        raise
+    finally:
+        _GATE.release(u.est_bytes)
+
+
+def stream_staged(units: list[StreamUnit], depth: int | None = None):
+    """THE pipelined iterator: yields (unit, result) strictly in unit
+    order while later units' stages run ahead. result is a StagedBlock
+    for upload units, True for host units (their columns are left
+    cache-resident). Results are bit-identical to running the same
+    units serially -- the pipeline reorders WORK, never data.
+
+    On error or early close, every in-flight future is cancelled or
+    drained and admission bytes return to the gate: no leaked device
+    work, no leaked budget."""
+    if depth is None:
+        depth = prefetch_depth()
+    t_run = _time.perf_counter()
+    if depth <= 0 or len(units) <= 1:
+        # serial kill switch / degenerate pipeline: same stages, inline
+        try:
+            for u in units:
+                plan, cf = _plan_unit(u)
+                try:
+                    out = _run_stages(u, plan, cf, None)
+                except BaseException:
+                    TEL.record_stream_unit("error")
+                    raise
+                TEL.record_stream_unit("ok")
+                yield u, out
+        finally:
+            TEL.record_stream_run(_time.perf_counter() - t_run)
+        return
+    state = _PipeState()
+    pool = _executor()
+    futures = []
+
+    def submit(i: int) -> None:
+        futures.append(pool.submit(_run_unit, units[i], i, state))
+
+    try:
+        for i in range(min(depth + 1, len(units))):
+            submit(i)
+        for i in range(len(units)):
+            res = futures[i].result()
+            yield units[i], res
+            state.advance()  # consumer done with unit i
+            nxt = i + depth + 1
+            if nxt < len(units):
+                submit(nxt)
+    finally:
+        state.cancel()
+        for f in futures:
+            f.cancel()
+        for f in futures:
+            if not f.cancelled():
+                try:
+                    f.exception()  # drain started futures; nothing leaks
+                except BaseException:  # noqa: BLE001 - already surfaced
+                    pass
+        TEL.record_stream_run(_time.perf_counter() - t_run)
+
+
+class HostPrefetch:
+    """Handle over a host-flavor pipeline run (upload=False units): the
+    cold blocks' fetch+decompress stages run ahead on the stream
+    executor while the caller's host engines evaluate blocks as their
+    columns land. wait(blk) returns True once that block's columns are
+    cache-resident, False if the unit errored or was cancelled first
+    (callers then read the normal way, which surfaces any real error
+    itself). Host units never touch the device and never wait on the
+    consumer, so every unit is submitted up front -- the admission
+    turnstile + byte gate bound the actual in-flight work."""
+
+    def __init__(self, items: list[tuple[BackendBlock, list[str]]]):
+        self._state = _PipeState()
+        self._lock = threading.Lock()
+        self._done: dict[int, threading.Event] = {}
+        self._ok: dict[int, bool] = {}
+        self._t0 = _time.perf_counter()
+        self._futures: list = []
+        self._remaining = 0
+        if prefetch_depth() <= 0:
+            # serial kill switch: every wait() misses, so callers run
+            # their own inline reads -- the differential tests' oracle
+            return
+        units = []
+        for blk, names in items:
+            if id(blk) in self._done:
+                continue
+            units.append(StreamUnit(blk, list(names), None, upload=False))
+            self._done[id(blk)] = threading.Event()
+            self._ok[id(blk)] = False
+        self._remaining = len(units)
+        pool = _executor()
+        self._futures = [pool.submit(self._run, u, i)
+                         for i, u in enumerate(units)]
+
+    def _run(self, u: StreamUnit, i: int) -> None:
+        ok = False
+        try:
+            ok = _run_unit(u, i, self._state) is not None
+        except BaseException:  # noqa: BLE001 - the caller's own read re-raises
+            ok = False
+        finally:
+            self._ok[id(u.blk)] = ok
+            self._done[id(u.blk)].set()
+            with self._lock:
+                self._remaining -= 1
+                last = self._remaining == 0
+            if last:
+                TEL.record_stream_run(_time.perf_counter() - self._t0)
+
+    def wait(self, blk: BackendBlock, timeout: float | None = None) -> bool:
+        ev = self._done.get(id(blk))
+        if ev is None:
+            return False
+        ev.wait(timeout)
+        return self._ok.get(id(blk), False)
+
+    def close(self) -> None:
+        """Cancel outstanding work (idempotent); never strands a
+        waiter."""
+        self._state.cancel()
+        cancelled = sum(1 for f in self._futures if f.cancel())
+        self._futures = []
+        for ev in self._done.values():
+            ev.set()
+        if cancelled:
+            # queued units whose _run will never execute still owe
+            # their _remaining decrement, else the run is never
+            # recorded and overlap ratio drifts up after errored runs
+            with self._lock:
+                self._remaining -= cancelled
+                last = self._remaining == 0
+            if last:
+                TEL.record_stream_run(_time.perf_counter() - self._t0)
+
+
+def staged_warm(blk: BackendBlock, names: list[str]) -> None:
+    """Single-unit inline form of the pipeline's fetch+decompress
+    stages: one coalesced ranged read + one threaded decode into the
+    pack's caches, with the stage timings recorded (colio._run_plan).
+    The cold path of callers that handle one block at a time (per-block
+    search shards, the metrics executor)."""
+    blk.pack.warm_columns(names)
 
 
 def _chunks(n: int, per: int) -> list[list[int]]:
@@ -72,7 +471,7 @@ def eval_block_streamed(
     return_device: bool = False,
 ):
     """Evaluate a condition tree over a block by streaming row-group
-    chunks through the device. Returns (trace_mask (n_traces,),
+    chunks through the device pipeline. Returns (trace_mask (n_traces,),
     span_count (n_traces,), n_spans_seen) as numpy -- or, with
     return_device, (trace_mask_dev, counts_dev, n_spans_seen) as PADDED
     device arrays with no host sync at all: the caller's top-k selector
@@ -116,23 +515,18 @@ def eval_block_streamed(
         )
         return tm, sc  # device arrays, padded (n_traces_b,)
 
-    from ..util.kerneltel import TEL
-
     TEL.record_routing("stream", "device", "chunked")
     t0_stream = _time.perf_counter()
 
     single_tracify = sum(1 for lf in leaves if lf[0] == "tracify") == 1
-    # cache=False: the streamed path exists because staging the whole
-    # block exceeds the device budget, so pinning each chunk in the staged
-    # cache would be pure churn (per-block FIFO would evict before reuse)
-    nxt = _prefetch_pool.submit(stage_block, blk, needed, chunk_groups[0], cache=False)
+    # the streamed path exists because staging the whole block exceeds
+    # the device budget, so chunks never enter the staged cache (per-
+    # block FIFO would evict before reuse); the pipeline's own double
+    # buffer bounds device memory instead
+    units = [StreamUnit(blk, needed, cg, upload=True) for cg in chunk_groups]
+    it = stream_staged(units)
     try:
-        for ci in range(len(chunk_groups)):
-            staged = nxt.result()
-            if ci + 1 < len(chunk_groups):
-                nxt = _prefetch_pool.submit(
-                    stage_block, blk, needed, chunk_groups[ci + 1], cache=False
-                )
+        for ci, (_unit, staged) in enumerate(it):
             if tree is None:
                 tm, sc = run_tree(None, staged)
                 counts_dev = sc if counts_dev is None else counts_dev + sc
@@ -149,7 +543,7 @@ def eval_block_streamed(
                     counts_dev = sc if counts_dev is None else counts_dev + sc
             n_spans_seen += staged.n_spans
     finally:
-        nxt.cancel()  # abandoned prefetch on error mustn't leak device work
+        it.close()  # abandoned prefetch on error mustn't leak device work
     # whole-pipeline window (IO overlap included): the per-chunk filter
     # kernels already record their own launches/compiles via eval_block
     TEL.observe_device("stream", len(chunk_groups), t0_stream)

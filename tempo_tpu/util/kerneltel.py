@@ -148,6 +148,27 @@ class KernelTelemetry:
             "max_jobs_inflight": 0,  # process lifetime
             "run_max_jobs_inflight": 0,  # current/most-recent pipeline run
         }
+        self.compact_passthrough_bytes = Counter(
+            "tempo_compaction_passthrough_bytes_total",
+            help="compressed bytes compaction copied through verbatim "
+                 "(chunk passthrough + concat part copies) instead of "
+                 "decompress->recompress")
+        # cold-read streaming pipeline (ops/stream): per-stage wall
+        # times, admission-gate bytes, unit outcomes
+        self.stream_stage_time = Histogram(
+            "tempo_stream_stage_seconds", buckets=COMPACT_STAGE_BUCKETS,
+            help="per-stage wall time of cold-read stream pipeline units "
+                 "(fetch/decompress/assemble/upload)")
+        self.stream_units = Counter(
+            "tempo_stream_units_total",
+            help="cold-read stream pipeline units completed by outcome")
+        self.stream_bytes_inflight = Gauge(
+            "tempo_stream_bytes_inflight",
+            help="estimated host bytes of admitted stream pipeline units")
+        self._stream: dict = {
+            "runs": 0, "wall_seconds": 0.0, "stage_seconds": {},
+            "units": 0, "errors": 0, "cancelled": 0,
+        }
         # every instrument exported through /metrics -- ONE list shared
         # by metrics_lines() and help_entries() so an instrument can't
         # ship samples without its HELP (or vice versa)
@@ -162,6 +183,8 @@ class KernelTelemetry:
             self.compact_jobs, self.compact_input_bytes,
             self.compact_prefetch, self.compact_jobs_inflight,
             self.compact_bytes_inflight, self.compact_queue_depth,
+            self.compact_passthrough_bytes, self.stream_stage_time,
+            self.stream_units, self.stream_bytes_inflight,
         )
         # full compile-key signatures, LRU-bounded (SEEN_SIGNATURES_MAX)
         self._seen: OrderedDict = OrderedDict()
@@ -411,6 +434,71 @@ class KernelTelemetry:
         c["queue_depth"] = int(self.compact_queue_depth.get())
         return c
 
+    # ------------------------------------------------- cold-read streaming
+    def record_stream_stage(self, stage: str, seconds: float) -> None:
+        """One stream-pipeline stage (fetch/decompress/assemble/upload)
+        finished for one unit: observe its wall time."""
+        try:
+            self.stream_stage_time.observe(float(seconds), f'stage="{stage}"')
+            with self._lock:
+                ss = self._stream["stage_seconds"]
+                ss[stage] = ss.get(stage, 0.0) + float(seconds)
+        except Exception:
+            pass
+
+    def record_stream_unit(self, outcome: str = "ok") -> None:
+        """One pipeline unit reached a terminal state (ok / error /
+        cancelled)."""
+        try:
+            self.stream_units.inc(labels=f'outcome="{outcome}"')
+            with self._lock:
+                if outcome == "ok":
+                    self._stream["units"] += 1
+                elif outcome == "cancelled":
+                    self._stream["cancelled"] += 1
+                else:
+                    self._stream["errors"] += 1
+        except Exception:
+            pass
+
+    def stream_inflight(self, est_bytes: int) -> None:
+        try:
+            self.stream_bytes_inflight.set(est_bytes)
+        except Exception:
+            pass
+
+    def record_stream_run(self, wall_seconds: float) -> None:
+        """Close one pipeline run (one streamed iterator drained)."""
+        try:
+            with self._lock:
+                self._stream["runs"] += 1
+                self._stream["wall_seconds"] += float(wall_seconds)
+        except Exception:
+            pass
+
+    def stream_stats(self) -> dict:
+        """Stream-pipeline aggregates for /status/kernels and the cold
+        bench rows. overlap_ratio = total stage seconds / run wall
+        seconds: <=1.0 means effectively sequential, >1 means stages of
+        different units genuinely overlapped in time."""
+        with self._lock:
+            c = {k: v for k, v in self._stream.items() if k != "stage_seconds"}
+            c["stage_seconds"] = {
+                k: round(v, 6) for k, v in self._stream["stage_seconds"].items()}
+        wall = c["wall_seconds"]
+        stage_total = sum(c["stage_seconds"].values())
+        c["overlap_ratio"] = round(stage_total / wall, 3) if wall > 0 else 0.0
+        c["wall_seconds"] = round(wall, 6)
+        c["bytes_inflight"] = int(self.stream_bytes_inflight.get())
+        return c
+
+    def record_passthrough(self, nbytes: int) -> None:
+        """Compressed bytes a compaction output inherited verbatim."""
+        try:
+            self.compact_passthrough_bytes.inc(int(nbytes))
+        except Exception:
+            pass
+
     # --------------------------------------------------------- query log
     def record_query(self, op: str, seconds: float, trace_id: str = "",
                      detail: str = "") -> None:
@@ -505,6 +593,7 @@ class KernelTelemetry:
             "routing": routing,
             "batching": self.batch_stats(),
             "compaction": self.compaction_stats(),
+            "stream": self.stream_stats(),
             "slow_queries": self.slow_queries(slow_k),
         }
 

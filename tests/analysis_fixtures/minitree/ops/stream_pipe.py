@@ -1,0 +1,67 @@
+"""Seeded violations in the cold-read stream-pipeline lock shape: a
+lazily-built shared stage executor, a byte-budget admission gate
+(Condition) with a per-pipeline ordering turnstile, and a stats
+registry -- the lock pairs ops/stream.py uses, so the concurrency
+rules provably cover this module shape."""
+
+import threading
+
+_pool = None
+_pool_lock = threading.Lock()
+_gate_cv = threading.Condition()
+_turn_cv = threading.Condition()
+_inflight: dict[int, int] = {}
+_stage_seconds: dict[str, float] = {}
+
+
+def executor():
+    # sanctioned: the singleton rebind happens under its lock
+    global _pool
+    with _pool_lock:
+        if _pool is None:
+            _pool = object()
+        return _pool
+
+
+def executor_racy():
+    global _pool
+    if _pool is None:
+        _pool = object()  # EXPECT: global-mutation-unlocked
+    return _pool
+
+
+def admit(unit_id, est):
+    with _gate_cv:
+        _inflight[unit_id] = est
+        _gate_cv.notify_all()
+
+
+def admit_racy(unit_id, est):
+    _inflight[unit_id] = est  # EXPECT: global-mutation-unlocked
+
+
+def record_stage_gate_then_turn(stage, dt):
+    with _gate_cv:
+        with _turn_cv:
+            _stage_seconds[stage] = _stage_seconds.get(stage, 0.0) + dt
+
+
+def snapshot_turn_then_gate():
+    with _turn_cv:
+        with _gate_cv:  # EXPECT: lock-order
+            return dict(_inflight), dict(_stage_seconds)
+
+
+def gate_wait_unsafe():
+    _gate_cv.acquire()  # EXPECT: lock-bare-acquire
+    n = len(_inflight)
+    _gate_cv.release()
+    return n
+
+
+def gate_wait_safe():
+    _gate_cv.acquire()
+    try:
+        _inflight.clear()
+    finally:
+        _gate_cv.release()

@@ -168,13 +168,32 @@ def test_versioned_encoding_dispatch(tmp_path):
     assert isinstance(open_block_versioned(backend, meta), V9)
 
 
-@pytest.mark.parametrize("codec", ["zstd", "gzip", "lzma", "raw"])
-def test_codec_matrix_roundtrip(codec):
-    """Every codec in the matrix roundtrips through pack/read, and the
-    reader dispatches on the per-chunk codec (mixed backends are fine)."""
+@pytest.mark.parametrize("shim", [False, True], ids=["native", "no-native"])
+@pytest.mark.parametrize("codec", ["zstd", "gzip", "lzma", "raw", "snappy", "lz4"])
+def test_codec_matrix_roundtrip(codec, shim, monkeypatch):
+    """Every registered codec roundtrips through pack/read -- with the
+    native library present AND in shim mode (no shared library, zstd
+    through the zlib shim, snappy/lz4 through the pure-Python
+    blockcodecs) -- and the reader dispatches on the per-chunk codec
+    (mixed backends are fine)."""
     import numpy as np
 
     from tempo_tpu.block.colio import AxisChunks, ColumnPack, pack_columns
+
+    if shim:
+        import threading
+
+        import tempo_tpu.block.colio as colio
+        import tempo_tpu.native as native
+        from tempo_tpu.util import zstdshim
+
+        monkeypatch.setattr(colio, "zstandard", zstdshim)
+        # a REAL ZstdDecompressor cached by an earlier native-mode case
+        # must not decode this case's shim (zlib) frames
+        monkeypatch.setattr(colio, "_DCTX_LOCAL", threading.local())
+        monkeypatch.setattr(native, "_LIB", None)
+        monkeypatch.setattr(native, "_TRIED", True)
+        assert not native.available()
 
     rng = np.random.default_rng(5)
     cols = {
@@ -192,6 +211,56 @@ def test_codec_matrix_roundtrip(codec):
     out = ColumnPack.from_bytes(data).read_all()
     for name, arr in cols.items():
         assert (out[name] == arr).all(), (codec, name)
+    # the coalesced cold-read plan (plan_fetch -> fetch -> decode, the
+    # stream pipeline's stages) decodes the matrix too
+    pk = ColumnPack.from_bytes(data)
+    pk.warm_columns(list(cols))
+    for name, arr in cols.items():
+        assert (pk.read(name) == arr).all(), (codec, name)
+
+
+@pytest.mark.parametrize("codec", ["snappy", "lz4"])
+def test_speed_codec_cross_decode(codec):
+    """Native-compressed chunks decode through the pure-Python
+    decompressors and vice versa: both sides implement the same public
+    block formats, so blocks written on either kind of image stay
+    readable on the other."""
+    import numpy as np
+
+    import tempo_tpu.native as native
+    from tempo_tpu.block import blockcodecs as bc
+
+    if not native.available():
+        pytest.skip("native library not built")
+    py_c, py_d = ((bc.snappy_compress, bc.snappy_decompress) if codec == "snappy"
+                  else (bc.lz4_compress, bc.lz4_decompress))
+    rng = np.random.default_rng(11)
+    payloads = [
+        b"",
+        b"a" * 5,
+        b"ab" * 4000,                      # long periodic runs
+        bytes(rng.integers(0, 256, size=70_000, dtype=np.uint8)),  # entropy
+        np.zeros(130_000, np.uint8).tobytes(),                     # one run
+        bytes(rng.integers(0, 3, size=50_000, dtype=np.uint8)),    # low card
+    ]
+    native_out = native.block_compress_chunks(codec, payloads)
+    assert native_out is not None
+    for raw, comp in zip(payloads, native_out):
+        # native -> python decode
+        assert py_d(comp, len(raw)) == raw
+    # python (fallback) compressors -> native decode. Call the module-
+    # level fallback bodies directly: block_compress_chunks would route
+    # back to native.
+    import tempo_tpu.native as n
+
+    lib, tried = n._LIB, n._TRIED
+    try:
+        n._LIB, n._TRIED = None, True
+        py_out = [py_c(raw) for raw in payloads]
+    finally:
+        n._LIB, n._TRIED = lib, tried
+    back = native.block_decompress_chunks(codec, py_out, [len(r) for r in payloads])
+    assert back is not None and list(back) == payloads
 
 
 def test_const_chunks():

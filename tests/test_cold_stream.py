@@ -1,0 +1,290 @@
+"""Cold-read streaming pipeline (ops/stream + colio plan_fetch).
+
+The load-bearing guarantees, each with its own test:
+  * differential: pipelined cold search (TEMPO_STREAM_PREFETCH_DEPTH >
+    0, HostPrefetch running fetch/decompress ahead) returns
+    bit-identical results and ordering to the serial path (depth 0);
+  * the staged-upload pipeline (stream_staged) yields identical device
+    arrays pipelined vs serial, strictly in unit order;
+  * cancellation: a mid-stream error cancels in-flight units, leaks no
+    futures and returns every admitted byte to the gate; the executor
+    stays healthy for the next run;
+  * byte budget: many tiny units under a small TEMPO_STREAM_MEM_BUDGET
+    all complete, in order, with the admission high-water bounded;
+  * compaction passthrough: an output inheriting one whole input block
+    copies its compressed objects verbatim (byte-equal data object, no
+    recompress) and stays logically identical to a full rewrite.
+"""
+
+from __future__ import annotations
+
+import shutil
+import time
+
+import numpy as np
+import pytest
+
+import tempo_tpu.ops.stream as stream
+from tempo_tpu.backend.local import LocalBackend
+from tempo_tpu.db import TempoDB, TempoDBConfig
+from tempo_tpu.db.search import SearchRequest
+from tempo_tpu.util.kerneltel import TEL
+from tempo_tpu.util.testdata import make_traces
+
+TENANT = "t1"
+
+
+def _mk_backend(tmp_path, n_blocks=4, n_traces=40, seed0=20):
+    backend = LocalBackend(str(tmp_path / "store"))
+    db = TempoDB(TempoDBConfig(wal_path=str(tmp_path / "wal0")), backend=backend)
+    for b in range(n_blocks):
+        db.write_block(TENANT, make_traces(n_traces, seed=seed0 + b, n_spans=6))
+    db.close()
+    return backend
+
+
+def _cold_blocks(backend, tmp_path, tag="x"):
+    """Fresh BackendBlock readers (empty caches) over every block."""
+    db = TempoDB(TempoDBConfig(wal_path=str(tmp_path / f"wal_{tag}")),
+                 backend=backend)
+    db.poll_now()
+    metas = db.blocklist.metas(TENANT)
+    blocks = [db.open_block(m) for m in metas]
+    return db, blocks
+
+
+# ---------------------------------------------------------- differential
+def test_cold_search_pipelined_matches_serial(tmp_path, monkeypatch):
+    """The whole cold path through TempoDB.search: pipelined (prefetch
+    running ahead of the engines) vs serial (depth 0) must be
+    bit-identical in results AND ordering, query by query."""
+    backend = _mk_backend(tmp_path)
+    reqs = [
+        SearchRequest(tags={"service.name": "db"}, limit=100),
+        SearchRequest(min_duration_ms=1, limit=1000),
+        SearchRequest(tags={"http.method": "GET"}, limit=30),
+    ]
+
+    def run_cold(depth: int, tag: str):
+        monkeypatch.setenv("TEMPO_STREAM_PREFETCH_DEPTH", str(depth))
+        out = []
+        for qi, req in enumerate(reqs):
+            # fresh TempoDB per query: every byte comes off disk
+            db = TempoDB(TempoDBConfig(wal_path=str(tmp_path / f"w{tag}{qi}")),
+                         backend=backend)
+            db.poll_now()
+            resp = db.search(TENANT, req)
+            out.append((
+                [(r.trace_id, r.start_time_unix_nano, r.duration_ms,
+                  r.root_service_name, r.root_trace_name) for r in resp.traces],
+                resp.inspected_spans,
+            ))
+            db.close()
+        return out
+
+    serial = run_cold(0, "s")
+    piped = run_cold(3, "p")
+    assert piped == serial
+    assert any(traces for traces, _ in serial), "queries must match something"
+
+
+def test_stream_staged_pipelined_matches_serial(tmp_path):
+    """stream_staged over row-group chunk units: the pipeline reorders
+    WORK, never data -- staged device arrays and yield order identical
+    to the inline serial path."""
+    backend = _mk_backend(tmp_path, n_blocks=1, n_traces=120)
+
+    def staged_cols(depth: int, tag: str):
+        db, (blk,) = _cold_blocks(backend, tmp_path, tag)
+        needed = sorted(n for n in blk.pack.names()
+                        if n.startswith(("span.", "trace.")))[:6]
+        span_ax = blk.pack.axes["span"]
+        groups = list(range(span_ax.n_groups)) or [0]
+        units = [stream.StreamUnit(blk, needed, [g], upload=True)
+                 for g in groups]
+        out = []
+        for u, staged in stream.stream_staged(units, depth=depth):
+            assert staged is not None
+            out.append((u.groups,
+                        {k: np.asarray(v) for k, v in staged.cols.items()}))
+        db.close()
+        return out
+
+    serial = staged_cols(0, "a")
+    piped = staged_cols(3, "b")
+    assert len(serial) == len(piped) >= 1
+    for (gs, cs), (gp, cp) in zip(serial, piped):
+        assert gs == gp
+        assert sorted(cs) == sorted(cp)
+        for k in cs:
+            assert np.array_equal(cs[k], cp[k]), k
+
+
+# ---------------------------------------------------------- cancellation
+def test_midstream_error_cancels_and_drains(tmp_path, monkeypatch):
+    """A unit that dies mid-pipeline surfaces its error to the consumer,
+    cancels everything in flight, returns every admitted byte to the
+    gate and leaves the shared executor healthy."""
+    backend = _mk_backend(tmp_path, n_blocks=6, n_traces=20)
+    db, blocks = _cold_blocks(backend, tmp_path, "err")
+    names = [n for n in blocks[0].pack.names() if n.startswith("span.")][:4]
+
+    boom = blocks[2].pack
+    monkeypatch.setattr(
+        boom, "fetch_ranges",
+        lambda cf: (_ for _ in ()).throw(OSError("injected: fetch died")))
+
+    units = [stream.StreamUnit(b, list(names), None, upload=False)
+             for b in blocks]
+    it = stream.stream_staged(units, depth=3)
+    got = []
+    with pytest.raises(OSError, match="injected"):
+        for u, res in it:
+            got.append(u)
+    assert len(got) == 2  # units 0 and 1 yielded before the error
+    # the generator's finally drained every future and released the gate
+    assert stream._GATE.inflight_bytes() == 0
+
+    # early close (consumer abandons the stream) drains the same way
+    db2, blocks2 = _cold_blocks(backend, tmp_path, "close")
+    units2 = [stream.StreamUnit(b, list(names), None, upload=False)
+              for b in blocks2]
+    it2 = stream.stream_staged(units2, depth=3)
+    next(it2)
+    it2.close()
+    assert stream._GATE.inflight_bytes() == 0
+
+    # and the pool still serves a fresh, healthy run end to end
+    db3, blocks3 = _cold_blocks(backend, tmp_path, "ok")
+    units3 = [stream.StreamUnit(b, list(names), None, upload=False)
+              for b in blocks3]
+    outs = list(stream.stream_staged(units3, depth=3))
+    assert len(outs) == len(blocks3) and all(r for _, r in outs)
+    for db_ in (db, db2, db3):
+        db_.close()
+
+
+def test_plan_error_does_not_stall_turnstile(tmp_path, monkeypatch):
+    """Regression: an exception INSIDE unit planning (after passing the
+    admission turnstile, before admit_done) used to leave _admitted
+    stuck, spinning every later unit forever -- and HostPrefetch.wait()
+    has no timeout. The failing unit must fail alone; siblings complete
+    and every waiter returns."""
+    backend = _mk_backend(tmp_path, n_blocks=5, n_traces=20)
+    db, blocks = _cold_blocks(backend, tmp_path, "plan")
+    names = [n for n in blocks[0].pack.names() if n.startswith("span.")][:3]
+    monkeypatch.setattr(
+        blocks[1].pack, "plan_fetch",
+        lambda *a, **k: (_ for _ in ()).throw(MemoryError("injected plan")))
+    hp = stream.HostPrefetch([(b, list(names)) for b in blocks])
+    assert hp.wait(blocks[1], timeout=30) is False  # the faulty unit
+    for b in blocks:
+        if b is not blocks[1]:
+            assert hp.wait(b, timeout=30) is True  # siblings unaffected
+    hp.close()
+    assert stream._GATE.inflight_bytes() == 0
+    db.close()
+
+
+def test_host_prefetch_close_strands_no_waiter(tmp_path):
+    """HostPrefetch.close mid-flight: wait() never blocks forever, and
+    admitted bytes drain back to the gate."""
+    backend = _mk_backend(tmp_path, n_blocks=5, n_traces=20)
+    db, blocks = _cold_blocks(backend, tmp_path, "hp")
+    names = [n for n in blocks[0].pack.names() if n.startswith("span.")][:3]
+    hp = stream.HostPrefetch([(b, list(names)) for b in blocks])
+    hp.close()
+    for b in blocks:
+        assert hp.wait(b, timeout=5) in (True, False)  # returns, promptly
+    deadline = time.time() + 10
+    while stream._GATE.inflight_bytes() and time.time() < deadline:
+        time.sleep(0.01)  # started units finish their stage, then release
+    assert stream._GATE.inflight_bytes() == 0
+    db.close()
+
+
+# ----------------------------------------------------------- byte budget
+def test_byte_budget_admission_many_tiny_blocks(tmp_path, monkeypatch):
+    """A tiny TEMPO_STREAM_MEM_BUDGET over many tiny units: everything
+    still completes in order (one unit always admits -- stall, never
+    deadlock) and the admission high-water stays bounded by the budget
+    or by the single largest unit."""
+    backend = _mk_backend(tmp_path, n_blocks=10, n_traces=12)
+    db, blocks = _cold_blocks(backend, tmp_path, "bb")
+    names = [n for n in blocks[0].pack.names() if n.startswith("span.")][:4]
+    budget = 4096
+    monkeypatch.setenv("TEMPO_STREAM_MEM_BUDGET", str(budget))
+    stream._GATE.peak_bytes = 0
+    units = [stream.StreamUnit(b, list(names), None, upload=False)
+             for b in blocks]
+    outs = list(stream.stream_staged(units, depth=6))
+    assert [u for u, _ in outs] == units  # strict unit order
+    assert all(r for _, r in outs)
+    biggest = max(u.est_bytes for u in units)
+    assert stream._GATE.peak_bytes <= max(budget, biggest) + biggest
+    # the prefetched columns are genuinely cache-resident and correct
+    for b in blocks:
+        for n in names:
+            assert b.pack.has_cached_array(n)
+            assert b.pack.read(n) is not None
+    db.close()
+
+
+# ----------------------------------------------------------- passthrough
+def test_compaction_passthrough_bit_identical(tmp_path, monkeypatch):
+    """A compaction output that inherits one whole input block: with
+    passthrough ON the data object is a verbatim byte copy of the input
+    (never decompressed), and the decoded output is bit-identical to a
+    passthrough-OFF full rewrite."""
+    from tempo_tpu.block.builder import build_block_from_traces
+    from tempo_tpu.block.colio import ColumnPack
+    from tempo_tpu.db.compactor import CompactionJob, CompactorConfig, compact
+
+    a = LocalBackend(str(tmp_path / "a"))
+    traces = make_traces(50, seed=40, n_spans=5)
+    meta_a = build_block_from_traces(a, TENANT, traces)
+    shutil.copytree(str(tmp_path / "a"), str(tmp_path / "b"))
+    b = LocalBackend(str(tmp_path / "b"))
+    meta_b = meta_a  # same ids: b is a byte copy of a
+
+    cfg = CompactorConfig(concat_small_input_bytes=0)
+    pt0 = TEL.compact_passthrough_bytes.get()
+
+    monkeypatch.setenv("TEMPO_COMPACT_PASSTHROUGH", "0")
+    rw = compact(a, CompactionJob(TENANT, [meta_a]), cfg)
+    monkeypatch.setenv("TEMPO_COMPACT_PASSTHROUGH", "1")
+    pt = compact(b, CompactionJob(TENANT, [meta_b]), cfg)
+
+    assert len(rw.new_blocks) == len(pt.new_blocks) == 1
+    assert TEL.compact_passthrough_bytes.get() > pt0
+    assert (rw.traces_out, rw.spans_out) == (pt.traces_out, pt.spans_out)
+    m_rw, m_pt = rw.new_blocks[0], pt.new_blocks[0]
+    assert m_pt.compaction_level == m_rw.compaction_level
+
+    # verbatim: the passthrough output's data object is byte-equal to
+    # the INPUT block's (the rewrite's is not required to be)
+    assert (b.read(TENANT, m_pt.block_id, "data.vtpu")
+            == b.read(TENANT, meta_b.block_id, "data.vtpu"))
+
+    # logical bit-identity: every column decodes to the same arrays and
+    # the dictionaries resolve the same strings per trace. Compare via
+    # decoded columns + dictionary string lookups.
+    pack_rw = ColumnPack.from_bytes(a.read(TENANT, m_rw.block_id, "data.vtpu"))
+    pack_pt = ColumnPack.from_bytes(b.read(TENANT, m_pt.block_id, "data.vtpu"))
+    assert set(pack_rw.names()) == set(pack_pt.names())
+    from tempo_tpu.block.dictionary import Dictionary
+
+    d_rw = Dictionary.from_bytes(a.read(TENANT, m_rw.block_id, "dict.vtpu"))
+    d_pt = Dictionary.from_bytes(b.read(TENANT, m_pt.block_id, "dict.vtpu"))
+    for name in sorted(pack_rw.names()):
+        x, y = pack_rw.read(name), pack_pt.read(name)
+        assert x.shape == y.shape, name
+        if name.endswith("_id") or name.endswith(".key_id"):
+            # dictionary codes may differ; the STRINGS must not
+            assert [d_rw.string(int(v)) for v in np.asarray(x).ravel()[:200]] \
+                == [d_pt.string(int(v)) for v in np.asarray(y).ravel()[:200]], name
+        else:
+            assert np.array_equal(np.asarray(x), np.asarray(y)), name
+
+    # inputs consumed in both worlds
+    assert rw.compacted_ids == pt.compacted_ids == [meta_a.block_id]

@@ -126,7 +126,7 @@ def test_pipeline_crash_between_outputs_is_invisible(tmp_path, monkeypatch):
     """Fail the SECOND output write of a multi-output job: no input may
     be mark_compacted, no partial output may surface to blocklist
     polling, and an unpatched re-run converges."""
-    import tempo_tpu.db.compact_pipeline as cp
+    import tempo_tpu.db.columnar_compact as cc
 
     backend = MemBackend()
     metas = _build_inputs(backend, n_blocks=3, collide=False)
@@ -134,7 +134,7 @@ def test_pipeline_crash_between_outputs_is_invisible(tmp_path, monkeypatch):
                           prefetch_depth=0)
     job = CompactionJob(TENANT, list(metas))
 
-    real_write = cp.write_block
+    real_write = cc.write_block
     calls = {"n": 0}
 
     def boom(*args, **kw):
@@ -143,7 +143,7 @@ def test_pipeline_crash_between_outputs_is_invisible(tmp_path, monkeypatch):
             raise OSError("injected: disk died between outputs")
         return real_write(*args, **kw)
 
-    monkeypatch.setattr(cp, "write_block", boom)
+    monkeypatch.setattr(cc, "write_block", boom)
     outs = CompactionPipeline(backend, cfg, concurrency=2).run(
         {TENANT: [job]})
     assert len(outs) == 1 and isinstance(outs[0].error, OSError)
@@ -157,7 +157,7 @@ def test_pipeline_crash_between_outputs_is_invisible(tmp_path, monkeypatch):
     assert not compacted.get(TENANT)
 
     # re-run (no fault) converges
-    monkeypatch.setattr(cp, "write_block", real_write)
+    monkeypatch.setattr(cc, "write_block", real_write)
     outs2 = CompactionPipeline(backend, cfg, concurrency=2).run(
         {TENANT: [CompactionJob(TENANT, list(metas))]})
     assert outs2[0].error is None
