@@ -19,6 +19,12 @@ Lines, in order:
   5b. search_concurrent_p50_ms -- Q parallel identical-shape queries on
      one hot block through the cross-query batching executor
      (db/batchexec): p50/p95 latency, launches-per-query, occupancy.
+  5c. search_affinity_p99_ms -- the cache-affinity differential: 3
+     simulated querier workers (each its own TempoDB = its own staged-
+     cache domain), 4 tenants, 50 concurrent Zipf-mixed searches, HBM
+     budget pinched to ~1.35x one fleet copy; p99 + staged-cache hit
+     rate with affinity routing on vs off, and the re-upload bytes
+     affinity avoided.
   6. search_block_e2e_cold_spans_per_sec -- BASELINE config #2, fresh
      reader each query: every byte from disk + staged to device through
      the cold-read streaming pipeline (ops/stream); the row carries
@@ -773,6 +779,153 @@ def bench_search_concurrent(tmp: str) -> None:
     db.close()
 
 
+def bench_search_affinity(tmp: str) -> None:
+    """Cache-affinity scheduling differential (services/frontend): a
+    dispatcher-only frontend + 3 simulated remote querier workers, each
+    with its OWN TempoDB over one shared backend -- its own staged-cache
+    domain, the in-process analog of 3 chips' HBM. 4 tenants' blocks,
+    50 concurrent mixed-tenant searches with Zipf skew, and the staged
+    device budget pinched to ~1.35x ONE fleet copy of the working set,
+    so placement-blind dequeue (affinity off) duplicates staged columns
+    across workers and thrashes the cache while block->querier affinity
+    keeps each block staged on exactly one worker. Reports p99 and
+    fleet staged-cache hit rate for both modes plus the re-upload bytes
+    affinity avoided -- the differential soak gate's numbers."""
+    import gc
+    import threading as th
+    from concurrent.futures import ThreadPoolExecutor
+
+    from tempo_tpu.backend.local import LocalBackend
+    from tempo_tpu.db import TempoDB, TempoDBConfig
+    from tempo_tpu.db.search import SearchRequest
+    from tempo_tpu.ops import stage as stage_mod
+    from tempo_tpu.services.frontend import Frontend
+    from tempo_tpu.services.querier import Querier
+    from tempo_tpu.services.worker import execute_job
+    from tempo_tpu.util.kerneltel import TEL
+
+    rng = np.random.default_rng(31)
+    backend = LocalBackend(tmp + "/store-aff")
+    fleet, n_tenants, concurrency, n_queries = 3, 4, 50, 150
+    tenants = [f"tenant-{i}" for i in range(n_tenants)]
+    for t in tenants:
+        for _ in range(2):
+            synth_block(backend, t, rng, 1 << 12, 8, n_res=64)
+    req = SearchRequest(query="{ duration > 100ms }", limit=10)
+
+    def new_db():
+        db = TempoDB(TempoDBConfig(wal_path=tmp + "/wal-aff",
+                                   device_promote_touches=1), backend=backend)
+        db.poll_now()
+        return db
+
+    # measure ONE fleet copy of the staged working set, then pinch the
+    # budget: without pressure, placement-blind routing eventually warms
+    # every worker and the differential vanishes -- with it, off-mode
+    # duplication evicts and re-uploads forever (the million-user shape,
+    # where the working set never fits every chip)
+    old_budget = stage_mod.staged_cache_stats()["budget_bytes"]
+    stage_mod.set_staged_cache_budget(0)  # drop earlier benches' entries
+    stage_mod.set_staged_cache_budget(old_budget)
+    probe = new_db()
+    base = stage_mod.staged_cache_stats()["bytes"]
+    for t in tenants:
+        probe.search(t, req)  # stages both of t's blocks (promote=1)
+    footprint = stage_mod.staged_cache_stats()["bytes"] - base
+    probe.close()
+    del probe
+    gc.collect()
+    budget = max(1 << 20, int(footprint * 1.35))
+    stage_mod.set_staged_cache_budget(budget)
+
+    zipf = np.array([1.0 / (i + 1) ** 1.1 for i in range(n_tenants)])
+    q_tenants = rng.choice(n_tenants, size=n_queries, p=zipf / zipf.sum())
+
+    def run_mode(affinity: bool) -> dict:
+        fe_db = new_db()
+        fe = Frontend(Querier(fe_db, ring=None, client_for=lambda a: None),
+                      n_workers=0, hedge_after_s=0.0,
+                      affinity=affinity, affinity_steal_ms=75.0)
+        worker_dbs = [new_db() for _ in range(fleet)]
+        queriers = [Querier(db, ring=None, client_for=lambda a: None)
+                    for db in worker_dbs]
+        stop = th.Event()
+
+        def wloop(wid: int):
+            qr = queriers[wid]
+            while not stop.is_set():
+                job = fe.poll_job(wait_s=0.25, worker_id=f"w{wid}")
+                if job is None:
+                    continue
+                tok = TEL.set_affinity_placement(job.get("placement", ""))
+                try:
+                    try:
+                        res = execute_job(qr, job.get("tenant", ""),
+                                          job["kind"], job["payload"])
+                        fe.complete_job(job["id"], ok=True, result=res)
+                    except Exception as e:  # noqa: BLE001 - frontend retries
+                        fe.complete_job(job["id"], ok=False, error=str(e),
+                                        retryable=True)
+                finally:
+                    TEL.reset_affinity_placement(tok)
+
+        threads = [th.Thread(target=wloop, args=(i,), daemon=True)
+                   for i in range(fleet)]
+        for t in threads:
+            t.start()
+        h0, m0 = TEL.staged_cache_hits.get(), TEL.staged_cache_misses.get()
+        b0 = TEL.transfer_bytes.get()
+        lats: list[float] = []
+        lat_lock = th.Lock()
+
+        def one(i: int):
+            tenant = tenants[int(q_tenants[i])]
+            t0 = time.perf_counter()
+            r = fe.search(tenant, req)
+            dt = time.perf_counter() - t0
+            assert r.traces
+            with lat_lock:
+                lats.append(dt)
+
+        with ThreadPoolExecutor(concurrency) as ex:
+            list(ex.map(one, range(n_queries)))
+        stop.set()
+        for t in threads:
+            t.join(timeout=5)
+        fe.stop()
+        hits = TEL.staged_cache_hits.get() - h0
+        misses = TEL.staged_cache_misses.get() - m0
+        upload = TEL.transfer_bytes.get() - b0
+        fe_db.close()
+        for db in worker_dbs:
+            db.close()
+        gc.collect()  # free this fleet's staged entries before the next
+        return {
+            "p50_ms": round(float(np.median(lats)) * 1e3, 2),
+            "p99_ms": round(float(np.percentile(lats, 99)) * 1e3, 2),
+            "staged_hit_rate": round(hits / (hits + misses), 4)
+                               if hits + misses else 0.0,
+            "upload_bytes": int(upload),
+        }
+
+    a0 = TEL.affinity_stats()["jobs"]
+    on = run_mode(True)
+    a1 = TEL.affinity_stats()["jobs"]
+    off = run_mode(False)
+    stage_mod.set_staged_cache_budget(old_budget)
+    tel = {
+        "affinity_on": on,
+        "affinity_off": off,
+        "placements_on": {k: a1.get(k, 0) - a0.get(k, 0)
+                          for k in sorted(set(a0) | set(a1))},
+        "reupload_bytes_avoided": max(
+            0, off["upload_bytes"] - on["upload_bytes"]),
+        "workers": fleet, "tenants": n_tenants, "concurrency": concurrency,
+        "staged_budget_bytes": budget,
+    }
+    _emit("search_affinity_p99_ms", on["p99_ms"], "ms", 0.0, tel=tel)
+
+
 def bench_spanmetrics() -> None:
     import jax
 
@@ -803,6 +956,7 @@ def main() -> None:
         bench_ingest(tmp)
         bench_spanmetrics()
         bench_search_concurrent(tmp)
+        bench_search_affinity(tmp)
         _emit("search_block_e2e_cold_spans_per_sec", cold, "spans/s",
               cold / BASELINE_SPANS_PER_SEC, tel=cold_tel)
         # headline LAST: hot-block search (cached device staging), the

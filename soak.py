@@ -9,10 +9,20 @@ that were written, for a wall-clock duration; the run FAILS (exit 1)
 on any error, any written-then-unfindable trace at the end, or
 latency percentiles above thresholds.
 
+Mixed-tenant mode (--tenants N): writers round-robin across N tenants,
+readers draw their tenant from a Zipf distribution (--zipf skew, rank 1
+hottest) so a few heavy tenants dominate exactly like production read
+traffic, and the report carries per-tenant p50/p95/p99 plus per-tenant
+429 shed counts -- the harness the cache-affinity/QoS acceptance gates
+run on. 429 responses count as sheds (the per-tenant QoS budget doing
+its job), not errors.
+
 Run against a live instance:
     python soak.py --target http://localhost:3200 --duration 60
 or self-hosted (spawns a single-binary app on an ephemeral port):
     python soak.py --self-host --duration 30
+mixed-tenant with QoS overrides:
+    python soak.py --self-host --tenants 4 --overrides overrides.yaml
 """
 
 from __future__ import annotations
@@ -24,6 +34,7 @@ import random
 import sys
 import threading
 import time
+import urllib.error
 import urllib.request
 
 
@@ -34,32 +45,64 @@ def _pct(xs, p):
     return xs[min(len(xs) - 1, int(len(xs) * p))]
 
 
+def _lat_summary(xs) -> dict:
+    return {
+        "p50_ms": round(_pct(xs, 0.5) * 1e3, 2),
+        "p95_ms": round(_pct(xs, 0.95) * 1e3, 2),
+        "p99_ms": round(_pct(xs, 0.99) * 1e3, 2),
+        "n": len(xs),
+    }
+
+
 class Soak:
     def __init__(self, target: str, writers: int, readers: int,
-                 spans_per_trace: int = 8, batch: int = 5):
+                 spans_per_trace: int = 8, batch: int = 5,
+                 tenants: list[str] | None = None, zipf: float = 1.2):
         self.target = target.rstrip("/")
         self.writers = writers
         self.readers = readers
         self.spans_per_trace = spans_per_trace
         self.batch = batch
+        # "" = single-tenant (no X-Scope-OrgID header), today's default
+        self.tenants: list[str] = list(tenants) if tenants else [""]
+        # Zipf read skew over tenant rank: weight 1/(rank+1)^s
+        self.zipf_weights = [1.0 / (i + 1) ** zipf
+                             for i in range(len(self.tenants))]
         self.lock = threading.Lock()
-        self.written: list[str] = []  # hex trace ids pushed (ack'd)
+        self.written: dict[str, list[str]] = {t: [] for t in self.tenants}
         self.errors: list[str] = []
-        self.write_lat: list[float] = []
-        self.search_lat: list[float] = []
-        self.find_lat: list[float] = []
+        self.write_lat: dict[str, list[float]] = {t: [] for t in self.tenants}
+        self.search_lat: dict[str, list[float]] = {t: [] for t in self.tenants}
+        self.find_lat: dict[str, list[float]] = {t: [] for t in self.tenants}
+        self.sheds: dict[str, int] = {t: 0 for t in self.tenants}  # 429s
         self.found = 0
         self.not_yet = 0  # reads that raced ingest (retried at the end)
 
-    def _post(self, path: str, body: bytes, ctype="application/json"):
+    def _headers(self, tenant: str, ctype: str = "") -> dict:
+        h = {}
+        if ctype:
+            h["Content-Type"] = ctype
+        if tenant:
+            h["X-Scope-OrgID"] = tenant
+        return h
+
+    def _post(self, path: str, body: bytes, ctype="application/json",
+              tenant: str = ""):
         req = urllib.request.Request(self.target + path, data=body,
-                                     headers={"Content-Type": ctype})
+                                     headers=self._headers(tenant, ctype))
         with urllib.request.urlopen(req, timeout=15) as r:
             return r.read()
 
-    def _get(self, path: str):
-        with urllib.request.urlopen(self.target + path, timeout=15) as r:
+    def _get(self, path: str, tenant: str = ""):
+        req = urllib.request.Request(self.target + path,
+                                     headers=self._headers(tenant))
+        with urllib.request.urlopen(req, timeout=15) as r:
             return r.read()
+
+    def _pick_tenant(self, rng: random.Random) -> str:
+        if len(self.tenants) == 1:
+            return self.tenants[0]
+        return rng.choices(self.tenants, weights=self.zipf_weights)[0]
 
     def _trace_json(self, tid_hex: str, svc: str) -> dict:
         now = time.time_ns()
@@ -82,6 +125,7 @@ class Soak:
 
     def _writer(self, stop: threading.Event, wid: int):
         svc = f"soak-svc-{wid % 4}"
+        tenant = self.tenants[wid % len(self.tenants)]
         # alternate transports: even writers push OTLP-proto (the raw
         # native-scan fast path, the production OTel transport), odd
         # writers push OTLP-JSON (the model path) -- the soak hammers
@@ -110,42 +154,79 @@ class Soak:
                     else:
                         bodies.append((j, "application/json"))
                 t0 = time.perf_counter()
-                for body, ctype in bodies:
-                    self._post("/v1/traces", body, ctype=ctype)
+                posted, shed = [], 0
+                for tid, (body, ctype) in zip(ids, bodies):
+                    try:
+                        self._post("/v1/traces", body, ctype=ctype,
+                                   tenant=tenant)
+                        posted.append(tid)
+                    except urllib.error.HTTPError as e:
+                        # an ingest-side 429 (rate limit from the same
+                        # overrides file) is a shed doing its job, not a
+                        # soak failure -- and its fast-fail must not
+                        # enter the write percentiles
+                        if e.code != 429:
+                            raise
+                        shed += 1
                 dt = (time.perf_counter() - t0) / self.batch
                 with self.lock:
-                    self.write_lat.append(dt)
-                    self.written.extend(ids)
+                    if not shed:
+                        self.write_lat[tenant].append(dt)
+                    self.sheds[tenant] += shed
+                    self.written[tenant].extend(posted)
             except Exception as e:
                 with self.lock:
-                    self.errors.append(f"write: {type(e).__name__}: {e}")
+                    self.errors.append(f"write[{tenant}]: {type(e).__name__}: {e}")
                 return
 
-    def _reader(self, stop: threading.Event):
+    def _reader(self, stop: threading.Event, rid: int):
+        rng = random.Random(0x50AC + rid)
         while not stop.is_set():
+            tenant = self._pick_tenant(rng)
             with self.lock:
-                tid = random.choice(self.written) if self.written else None
+                ids = self.written[tenant]
+                tid = rng.choice(ids) if ids else None
             try:
+                # a 429 shed is counted but its (fast-fail) latency is
+                # NOT: percentiles must measure served reads, or a
+                # mostly-shed tenant would report flattering numbers
                 if tid is not None:
                     t0 = time.perf_counter()
+                    shed = False
                     try:
-                        self._get(f"/api/traces/{tid}")
+                        self._get(f"/api/traces/{tid}", tenant=tenant)
                         with self.lock:
                             self.found += 1
                     except urllib.error.HTTPError as e:
-                        if e.code != 404:
+                        if e.code == 429:  # QoS shed-load: counted, not fatal
+                            shed = True
+                            with self.lock:
+                                self.sheds[tenant] += 1
+                        elif e.code != 404:
                             raise
-                        with self.lock:  # raced ingest; re-checked at the end
-                            self.not_yet += 1
-                    with self.lock:
-                        self.find_lat.append(time.perf_counter() - t0)
+                        else:
+                            with self.lock:  # raced ingest; re-checked at the end
+                                self.not_yet += 1
+                    if not shed:
+                        with self.lock:
+                            self.find_lat[tenant].append(time.perf_counter() - t0)
                 t0 = time.perf_counter()
-                self._get("/api/search?tags=service.name%3Dsoak-svc-1&limit=20")
-                with self.lock:
-                    self.search_lat.append(time.perf_counter() - t0)
+                shed = False
+                try:
+                    self._get("/api/search?tags=service.name%3Dsoak-svc-1&limit=20",
+                              tenant=tenant)
+                except urllib.error.HTTPError as e:
+                    if e.code != 429:
+                        raise
+                    shed = True
+                    with self.lock:
+                        self.sheds[tenant] += 1
+                if not shed:
+                    with self.lock:
+                        self.search_lat[tenant].append(time.perf_counter() - t0)
             except Exception as e:
                 with self.lock:
-                    self.errors.append(f"read: {type(e).__name__}: {e}")
+                    self.errors.append(f"read[{tenant}]: {type(e).__name__}: {e}")
                 return
             time.sleep(0.01)
 
@@ -155,8 +236,8 @@ class Soak:
         stop = threading.Event()
         threads = [threading.Thread(target=self._writer, args=(stop, i), daemon=True)
                    for i in range(self.writers)]
-        threads += [threading.Thread(target=self._reader, args=(stop,), daemon=True)
-                    for _ in range(self.readers)]
+        threads += [threading.Thread(target=self._reader, args=(stop, i), daemon=True)
+                    for i in range(self.readers)]
         for t in threads:
             t.start()
         time.sleep(duration_s)
@@ -166,33 +247,56 @@ class Soak:
 
         time.sleep(settle_s)  # let live traces become queryable
         missing = []
-        sample = random.sample(self.written, min(sample_verify, len(self.written)))
-        for tid in sample:
-            try:
-                self._get(f"/api/traces/{tid}")
-            except Exception:
-                missing.append(tid)
+        verified = 0
+        per_tenant_verify = max(1, sample_verify // len(self.tenants))
+        for tenant in self.tenants:
+            sample = random.sample(self.written[tenant],
+                                   min(per_tenant_verify, len(self.written[tenant])))
+            verified += len(sample)
+            for tid in sample:
+                try:
+                    self._get(f"/api/traces/{tid}", tenant=tenant)
+                except Exception:
+                    missing.append(tid)
 
+        all_writes = [x for xs in self.write_lat.values() for x in xs]
+        all_search = [x for xs in self.search_lat.values() for x in xs]
+        all_find = [x for xs in self.find_lat.values() for x in xs]
         report = {
-            "written": len(self.written),
+            "written": sum(len(v) for v in self.written.values()),
             "found_live": self.found,
             "raced_reads": self.not_yet,
             "errors": self.errors[:5],
             "error_count": len(self.errors),
-            "write_p50_ms": round(_pct(self.write_lat, 0.5) * 1e3, 2),
-            "write_p95_ms": round(_pct(self.write_lat, 0.95) * 1e3, 2),
-            "search_p50_ms": round(_pct(self.search_lat, 0.5) * 1e3, 2),
-            "search_p95_ms": round(_pct(self.search_lat, 0.95) * 1e3, 2),
-            "find_p50_ms": round(_pct(self.find_lat, 0.5) * 1e3, 2),
-            "verified_sample": len(sample),
+            "write_p50_ms": round(_pct(all_writes, 0.5) * 1e3, 2),
+            "write_p95_ms": round(_pct(all_writes, 0.95) * 1e3, 2),
+            "search_p50_ms": round(_pct(all_search, 0.5) * 1e3, 2),
+            "search_p95_ms": round(_pct(all_search, 0.95) * 1e3, 2),
+            "search_p99_ms": round(_pct(all_search, 0.99) * 1e3, 2),
+            "find_p50_ms": round(_pct(all_find, 0.5) * 1e3, 2),
+            "sheds_429": sum(self.sheds.values()),
+            "verified_sample": verified,
             "missing_after_settle": missing,
         }
+        if len(self.tenants) > 1:
+            # per-tenant QoS/affinity view: rank order == Zipf weight
+            # order, so tenants[0] is the heavy tenant by construction
+            report["tenants"] = {
+                t or "single-tenant": {
+                    "written": len(self.written[t]),
+                    "sheds_429": self.sheds[t],
+                    "search": _lat_summary(self.search_lat[t]),
+                    "find": _lat_summary(self.find_lat[t]),
+                    "write": _lat_summary(self.write_lat[t]),
+                }
+                for t in self.tenants
+            }
         report["ok"] = (
             not self.errors
             and not missing
-            and len(self.written) > 0
-            and _pct(self.write_lat, 0.95) <= max_write_p95_s
-            and _pct(self.search_lat, 0.95) <= max_search_p95_s
+            and report["written"] > 0
+            and _pct(all_writes, 0.95) <= max_write_p95_s
+            and _pct(all_search, 0.95) <= max_search_p95_s
         )
         return report
 
@@ -205,9 +309,19 @@ def main(argv=None) -> int:
     ap.add_argument("--duration", type=float, default=30.0)
     ap.add_argument("--writers", type=int, default=4)
     ap.add_argument("--readers", type=int, default=2)
+    ap.add_argument("--tenants", type=int, default=1,
+                    help="mixed-tenant mode: N tenants, Zipf-skewed reads")
+    ap.add_argument("--zipf", type=float, default=1.2,
+                    help="Zipf skew exponent for mixed-tenant read traffic")
+    ap.add_argument("--overrides", default="",
+                    help="per-tenant overrides YAML for the self-hosted app "
+                         "(QoS budgets, limits)")
     ap.add_argument("--write-p95", type=float, default=1.0)
     ap.add_argument("--search-p95", type=float, default=3.0)
     args = ap.parse_args(argv)
+
+    tenants = ([f"soak-tenant-{i}" for i in range(args.tenants)]
+               if args.tenants > 1 else None)
 
     proc = None
     target = args.target
@@ -217,9 +331,14 @@ def main(argv=None) -> int:
 
         port = random.randint(20000, 40000)
         d = tempfile.mkdtemp(prefix="soak-")
+        cmd = [sys.executable, "-m", "tempo_tpu.services.app", "--target=all",
+               f"--storage.path={d}", f"--http.port={port}"]
+        if tenants:
+            cmd.append("--multitenancy")
+        if args.overrides:
+            cmd.append(f"--overrides.path={args.overrides}")
         proc = subprocess.Popen(
-            [sys.executable, "-m", "tempo_tpu.services.app", "--target=all",
-             f"--storage.path={d}", f"--http.port={port}"],
+            cmd,
             env={**os.environ, "JAX_PLATFORMS": os.environ.get("JAX_PLATFORMS", "cpu")},
         )
         target = f"http://127.0.0.1:{port}"
@@ -231,7 +350,8 @@ def main(argv=None) -> int:
                 time.sleep(0.2)
 
     try:
-        soak = Soak(target, args.writers, args.readers)
+        soak = Soak(target, args.writers, args.readers, tenants=tenants,
+                    zipf=args.zipf)
         report = soak.run(args.duration, max_write_p95_s=args.write_p95,
                           max_search_p95_s=args.search_p95)
         print(json.dumps(report, indent=2))

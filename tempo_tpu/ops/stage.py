@@ -242,10 +242,15 @@ def stage_block(
         hit = store.get(key)
         if hit is not None:
             TEL.staged_cache_hits.inc()
+            # attribute the hit to the dequeue placement of the job
+            # asking (own/steal/unowned): the affinity scheduler's
+            # whole point is moving this ratio
+            TEL.record_staged_lookup(True)
             _lru_touch(blk, key, sum(a.nbytes for a in hit.cols.values()))
             return hit
     if cache:
         TEL.staged_cache_misses.inc()
+        TEL.record_staged_lookup(False)
     plan = plan_stage(needed)
     span_ax = blk.pack.axes[S.AX_SPAN]
     if groups is None:

@@ -25,6 +25,17 @@ NUM_TOKENS = 128
 HEARTBEAT_TIMEOUT_S = 60.0
 
 
+def deterministic_tokens(ring_key: str, instance_id: str,
+                         num_tokens: int = NUM_TOKENS) -> list[int]:
+    """The token set an instance owns in a ring, as a pure function of
+    (ring_key, instance_id): lifecyclers and transient read-plane rings
+    (the frontend's block->querier affinity ring) must agree on
+    placement without any coordination beyond knowing the member's
+    name, so tokens cannot depend on join order or wall time."""
+    rng = random.Random(fnv1a_32(f"{ring_key}/{instance_id}".encode()))
+    return sorted(rng.randrange(0, 2**32) for _ in range(num_tokens))
+
+
 class InstanceState(str, Enum):
     JOINING = "JOINING"
     ACTIVE = "ACTIVE"
@@ -82,10 +93,12 @@ class Ring:
         self.ring_key = ring_key
         self.rf = replication_factor
         self.heartbeat_timeout = heartbeat_timeout
-        # token-map cache keyed on the healthy-instance id set (the hot
-        # ingest path calls get() once per trace)
-        self._cache_key: tuple | None = None
-        self._cache: tuple[list[int], list[InstanceDesc]] | None = None
+        # token-map cache keyed on the instance id set (the hot ingest
+        # path calls get() once per trace; frontend affinity claims call
+        # it per tenant-shard subset, so one slot would thrash). A dict
+        # with immutable values is safe under concurrent readers --
+        # per-key get/set are atomic, never a torn key/map pair
+        self._cache: dict[tuple, tuple[list[int], list[InstanceDesc]]] = {}
 
     # ------------------------------------------------------------ views
     def instances(self) -> list[InstanceDesc]:
@@ -111,11 +124,13 @@ class Ring:
         if not descs:
             return ReplicationSet([], 0)
         key = tuple(d.instance_id for d in descs)
-        if key == self._cache_key and self._cache is not None:
-            tokens, owners = self._cache
-        else:
-            tokens, owners = self._token_map(descs)
-            self._cache_key, self._cache = key, (tokens, owners)
+        hit = self._cache.get(key)
+        if hit is None:
+            hit = self._token_map(descs)
+            if len(self._cache) >= 64:  # membership/shard churn bound
+                self._cache.clear()
+            self._cache[key] = hit
+        tokens, owners = hit
         out: list[InstanceDesc] = []
         seen: set[str] = set()
         i = bisect.bisect_right(tokens, token) % len(tokens)
@@ -154,11 +169,20 @@ class Ring:
         rng = random.Random(fnv1a_32(tenant.encode()))
         return rng.sample(descs, size)
 
+    def owner_of(self, job_hash: str,
+                 instances: list[InstanceDesc] | None = None) -> str | None:
+        """First owner clockwise of a key's token -- the consistent-hash
+        placement question both job ownership (compactor) and read-plane
+        affinity (which querier owns this block's staged cache) ask.
+        `instances` overrides the healthy-instance view for callers that
+        maintain their own membership (frontend worker registry)."""
+        rs = self.get(fnv1a_32(job_hash.encode()), instances=instances)
+        return rs.instances[0].instance_id if rs.instances else None
+
     def owns(self, instance_id: str, job_hash: str) -> bool:
         """Ring-sharded job ownership: the instance owning the token of
         fnv32(job_hash) owns the job (modules/compactor/compactor.go:187)."""
-        rs = self.get(fnv1a_32(job_hash.encode()))
-        return bool(rs.instances) and rs.instances[0].instance_id == instance_id
+        return self.owner_of(job_hash) == instance_id
 
 
 class Lifecycler:
@@ -168,11 +192,10 @@ class Lifecycler:
                  num_tokens: int = NUM_TOKENS, heartbeat_period: float = 5.0):
         self.kv = kv
         self.ring_key = ring_key
-        rng = random.Random(fnv1a_32(f"{ring_key}/{instance_id}".encode()))
         self.desc = InstanceDesc(
             instance_id=instance_id,
             addr=addr or instance_id,
-            tokens=sorted(rng.randrange(0, 2**32) for _ in range(num_tokens)),
+            tokens=deterministic_tokens(ring_key, instance_id, num_tokens),
         )
         self.heartbeat_period = heartbeat_period
         self._stop = threading.Event()

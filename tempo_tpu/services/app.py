@@ -494,7 +494,21 @@ def _make_handler(app: App):
                         "application/openmetrics-text; version=1.0.0; charset=utf-8",
                     )
                 if u.path == "/status/config":
-                    return self._send(200, json.dumps(_config_dict(app.cfg), indent=2))
+                    # ?mode=defaults -> the built-in config; ?mode=diff
+                    # -> only fields differing from it (the reference's
+                    # /status/config?mode= variants)
+                    mode = q.get("mode", "")
+                    if mode not in ("", "diff", "defaults"):
+                        return self._err(
+                            400, f"unknown mode {mode!r}; one of diff, defaults")
+                    cfg_d = _config_dict(app.cfg)
+                    if mode == "defaults":
+                        cfg_d = _config_dict(AppConfig())
+                    elif mode == "diff":
+                        defaults = _config_dict(AppConfig())
+                        cfg_d = {k: v for k, v in cfg_d.items()
+                                 if v != defaults.get(k)}
+                    return self._send(200, json.dumps(cfg_d, indent=2))
                 if u.path == "/status/kernels":
                     # kernel telemetry: compile/cache-hit table, staged-
                     # cache contents, routing reasons, slow-query log

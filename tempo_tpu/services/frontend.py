@@ -17,10 +17,23 @@ engine answers a whole batch of blocks in one fused program + one
 device sync (db/search.search_blocks_fused), so the unit of dispatch
 is sized to amortize the sync, not to bound a Go worker's scan time.
 Oversized single blocks still shard by row-group range.
+
+Cache-affinity scheduling: block-carrying jobs hash their lead block ID
+onto a consistent-hash ring over the live cache domains (this process's
+local worker pool + every attached remote querier), and the dequeue
+prefers handing a job to its affinity owner so a block staged in one
+querier's HBM (ops/stage staged cache) stays staged there instead of
+being re-fetched, re-padded and re-uploaded by whichever worker happens
+to poll first. A bounded anti-starvation steal timeout
+(TEMPO_AFFINITY_STEAL_MS) lets any worker take a job its owner hasn't
+claimed in time, so a slow or dead owner never strands work; with
+affinity off (TEMPO_AFFINITY=0) or a single cache domain the dequeue
+path is exactly the legacy head-of-queue behavior.
 """
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 import uuid
@@ -33,7 +46,9 @@ from ..db.search import (
     request_to_dict,
     response_from_dict,
 )
+from ..ring.ring import InMemoryKV, InstanceDesc, InstanceState, Ring, deterministic_tokens
 from ..wire.combine import combine_traces, sort_trace
+from .overrides import QueryAdmission
 from .querier import Querier
 
 TARGET_BATCH_BYTES = 256 << 20  # block-batch job size (device engine unit)
@@ -41,6 +56,10 @@ DEFAULT_CONCURRENT_JOBS = 50
 MAX_RETRIES = 3
 MAX_BLOCKS_PER_BATCH = 64
 FIND_SHARD_BLOCKS = 16  # candidate blocks per ID-shard find job
+
+AFFINITY_RING_KEY = "querier-affinity"
+AFFINITY_STEAL_MS = 75.0  # default anti-starvation steal timeout
+AFFINITY_SCAN_WINDOW = 64  # queued jobs per tenant an affinity scan inspects
 
 
 class TooManyRequests(Exception):
@@ -63,6 +82,8 @@ class RequestQueue:
     from the rotation (a churning tenant population used to grow
     self.order without bound, and every dequeue scanned the corpses)."""
 
+    CLAIM_RECHECK_S = 0.02  # re-scan cadence while steal clocks run
+
     def __init__(self, max_per_tenant: int = 2000):
         self.lock = threading.Lock()
         self.cv = threading.Condition(self.lock)
@@ -80,7 +101,27 @@ class RequestQueue:
             if len(q) >= self.max_per_tenant:
                 raise TooManyRequests(f"tenant {tenant} queue full")  # 429
             q.append(job)
-            self.cv.notify()
+            try:
+                # a re-dispatched job must not carry its previous
+                # dequeue's placement: the next dequeue stamps its own
+                # (or none, on the legacy path) -- stale "own" would
+                # double-count affinity telemetry and misattribute
+                # staged-cache lookups on the retry worker
+                job.placement = ""
+            except AttributeError:
+                pass
+            if getattr(job, "queued_at", None) == 0.0:
+                # steal clock starts at FIRST enqueue only: a hedged
+                # twin keeps its original stamp (long past the steal
+                # window by hedge time) and a retried job is demoted to
+                # placement-free by the retry paths -- re-dispatch
+                # exists precisely to dodge the owner that failed it
+                job.queued_at = time.monotonic()
+            # notify_all, not notify: under affinity a single wakeup can
+            # land on a non-owner that defers the job and goes back to
+            # waiting -- the sleeping owner would never hear about its
+            # own job and every dequeue would pay the steal timeout
+            self.cv.notify_all()
 
     def _prune_locked(self, tenant: str, q) -> None:
         """Drop a drained tenant from both maps (invariant: a tenant is
@@ -92,51 +133,123 @@ class RequestQueue:
             except ValueError:
                 pass
 
-    def dequeue(self, timeout: float = 0.5, allowed=None):
+    def dequeue(self, timeout: float = 0.5, allowed=None, claim=None):
         """Next (tenant, job), fair across tenants; allowed(tenant) False
         skips a tenant for THIS caller (per-tenant querier shuffle-shard,
-        pkg/scheduler/queue/user_queues.go)."""
+        pkg/scheduler/queue/user_queues.go). claim(tenant, job, now) ->
+        placement string | None gates WHICH job this caller may take (block->
+        querier affinity): the first claimable job within
+        AFFINITY_SCAN_WINDOW of each tenant's FIFO is taken and stamped
+        with its placement; jobs deferred to their owner are re-checked
+        every CLAIM_RECHECK_S so steal timeouts fire without a notify.
+        claim=None (affinity off / single cache domain) is exactly the
+        legacy head-of-queue path."""
         with self.cv:
+            if claim is None:
+                while True:
+                    item = self._take_head_locked(allowed)
+                    if item is not None:
+                        return item
+                    if self.closed:
+                        return None
+                    if not self.cv.wait(timeout):
+                        return None
+            deadline = time.monotonic() + timeout
             while True:
-                n = len(self.order)
-                scanned = 0
-                while scanned < n:
-                    tenant = self.order[0]
-                    q = self.queues.get(tenant)
-                    if not q:
-                        # drained (or orphaned) rotation slot: prune it
-                        self.order.popleft()
-                        self.queues.pop(tenant, None)
-                        n -= 1
-                        continue
-                    self.order.rotate(-1)
-                    scanned += 1
-                    if allowed is None or allowed(tenant):
-                        job = q.popleft()
-                        self._prune_locked(tenant, q)
-                        return tenant, job
+                item, deferred = self._take_claimed_locked(allowed, claim)
+                if item is not None:
+                    return item
                 if self.closed:
                     return None
-                if not self.cv.wait(timeout):
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
                     return None
+                self.cv.wait(min(remaining, self.CLAIM_RECHECK_S)
+                             if deferred else remaining)
+
+    def _take_head_locked(self, allowed):
+        """One fair pass taking the head job of the first allowed
+        tenant -- the pre-affinity dequeue, byte for byte."""
+        n = len(self.order)
+        scanned = 0
+        while scanned < n:
+            tenant = self.order[0]
+            q = self.queues.get(tenant)
+            if not q:
+                # drained (or orphaned) rotation slot: prune it
+                self.order.popleft()
+                self.queues.pop(tenant, None)
+                n -= 1
+                continue
+            self.order.rotate(-1)
+            scanned += 1
+            if allowed is None or allowed(tenant):
+                job = q.popleft()
+                self._prune_locked(tenant, q)
+                return tenant, job
+        return None
+
+    def _take_claimed_locked(self, allowed, claim):
+        """One fair pass under affinity: per tenant, take the first job
+        (within the scan window) the claimer may have. claim(tenant,
+        job, now) sees the tenant because ownership is resolved within
+        the tenant's reachable worker subset (querier shuffle-shard).
+        Returns ((tenant, job), False) or (None, deferred) where
+        deferred means jobs exist that only their owner (or the steal
+        clock) can release."""
+        now = time.monotonic()
+        deferred = False
+        n = len(self.order)
+        scanned = 0
+        while scanned < n:
+            tenant = self.order[0]
+            q = self.queues.get(tenant)
+            if not q:
+                self.order.popleft()
+                self.queues.pop(tenant, None)
+                n -= 1
+                continue
+            self.order.rotate(-1)
+            scanned += 1
+            if allowed is not None and not allowed(tenant):
+                continue
+            for i, job in enumerate(q):
+                if i >= AFFINITY_SCAN_WINDOW:
+                    break
+                placement = claim(tenant, job, now)
+                if placement:
+                    del q[i]
+                    try:
+                        job.placement = placement
+                    except AttributeError:
+                        pass
+                    self._prune_locked(tenant, q)
+                    return (tenant, job), False
+            deferred = True
+        return None, deferred
 
     def dequeue_batch(self, timeout: float = 0.5, allowed=None,
-                      max_batch: int = 1, key_fn=None):
+                      max_batch: int = 1, key_fn=None, claim=None):
         """Fair dequeue of one job plus up to max_batch-1 ALREADY-QUEUED
         jobs sharing its coalesce key (key_fn(job), None = unbatchable),
         collected in one pass over the tenant rotation -- fairness within
         the window means every tenant's matching head jobs join the same
         fused launch rather than queueing behind it. Never waits for
         more jobs, so a lone query is never delayed here (the admission
-        window lives in db/batchexec). Returns (tenant, job, extras)
-        where extras is a list of (tenant, job)."""
-        item = self.dequeue(timeout, allowed)
+        window lives in db/batchexec). Under affinity (claim) the
+        same-key extras ride the lead's claim wherever they sit in the
+        scan window: same blocks means same owner, so a coalesced
+        multi-query launch lands whole on the warm staged cache.
+        Returns (tenant, job, extras) where extras is a list of
+        (tenant, job)."""
+        item = self.dequeue(timeout, allowed, claim=claim)
         if item is None:
             return None
         tenant, job = item
         extras: list = []
         key = key_fn(job) if key_fn is not None else None
         if key is not None and max_batch > 1:
+            lead_placement = getattr(job, "placement", "")
             with self.cv:
                 for _ in range(len(self.order)):
                     if len(extras) >= max_batch - 1 or not self.order:
@@ -146,9 +259,24 @@ class RequestQueue:
                     q = self.queues.get(t2)
                     if not q or (allowed is not None and not allowed(t2)):
                         continue
-                    while (q and len(extras) < max_batch - 1
-                           and key_fn(q[0]) == key):
-                        extras.append((t2, q.popleft()))
+                    if claim is None:
+                        while (q and len(extras) < max_batch - 1
+                               and key_fn(q[0]) == key):
+                            extras.append((t2, q.popleft()))
+                    else:
+                        i = 0
+                        while (i < min(len(q), AFFINITY_SCAN_WINDOW)
+                               and len(extras) < max_batch - 1):
+                            if key_fn(q[i]) == key:
+                                j2 = q[i]
+                                del q[i]
+                                try:
+                                    j2.placement = lead_placement
+                                except AttributeError:
+                                    pass
+                                extras.append((t2, j2))
+                            else:
+                                i += 1
                     self._prune_locked(t2, q)
         return tenant, job, extras
 
@@ -183,6 +311,13 @@ class _Job:
     # may execute together via batch_fn(group) -> list of results
     batch_key: tuple | None = None
     batch_fn: object = None
+    # cache-affinity scheduling: the block ID this job's placement
+    # hashes on (None = placement-free, claimable by anyone), the
+    # monotonic stamp its steal clock runs from (set at first enqueue),
+    # and the dequeue outcome ("own"/"steal"/"unowned") it executed under
+    affinity_key: str | None = None
+    queued_at: float = 0.0
+    placement: str = ""
 
     def finish(self) -> None:
         if not self.done.is_set():  # a late hedge twin must not clobber
@@ -220,7 +355,9 @@ class Frontend:
                  hedge_after_s: float = 2.0,
                  lease_s: float = 30.0,
                  overrides=None,
-                 worker_expiry_s: float = 60.0):
+                 worker_expiry_s: float = 60.0,
+                 affinity: bool | None = None,
+                 affinity_steal_ms: float | None = None):
         self.querier = querier
         self.queue = RequestQueue()
         self.concurrent_jobs = concurrent_jobs
@@ -229,6 +366,24 @@ class Frontend:
         self.lease_s = lease_s
         self.overrides = overrides
         self.worker_expiry_s = worker_expiry_s
+        # block->querier affinity routing (None = TEMPO_AFFINITY env,
+        # default on; it is a no-op until a second cache domain appears)
+        if affinity is None:
+            affinity = os.environ.get("TEMPO_AFFINITY", "") != "0"
+        self.affinity_enabled = affinity
+        if affinity_steal_ms is None:
+            try:
+                affinity_steal_ms = float(
+                    os.environ.get("TEMPO_AFFINITY_STEAL_MS", AFFINITY_STEAL_MS))
+            except ValueError:
+                affinity_steal_ms = AFFINITY_STEAL_MS
+        self.affinity_steal_ms = affinity_steal_ms
+        self._aff_ring = Ring(InMemoryKV(), AFFINITY_RING_KEY)
+        self._aff_descs: dict[str, InstanceDesc] = {}  # member -> tokens
+        self._local_member = "local" if n_workers > 0 else None
+        # per-tenant read QoS (concurrency / inflight-byte budgets):
+        # overrides-driven, so without overrides there is no gate
+        self.qos = QueryAdmission(overrides) if overrides is not None else None
         self._remote_workers: dict[str, float] = {}  # worker id -> last poll
         # lease id -> ([(tenant, job), ...], expiry); a `multi` wire job
         # leases its whole merged batch under one id
@@ -255,6 +410,113 @@ class Frontend:
                         {"cancelled": j.cancelled, "hedged": j.hedged,
                          "error": j.error is not None})
 
+    # --------------------------------------------------- affinity routing
+    def _affinity_members(self) -> list[InstanceDesc]:
+        """The live cache domains jobs can be placed on: this process
+        (when it runs local workers -- its threads share one staged
+        cache) plus every remote querier that polled within
+        worker_expiry_s. Token sets are deterministic per member id, so
+        every frontend replica computes the same placement."""
+        now = time.monotonic()
+        members = [self._local_member] if self._local_member else []
+        out = []
+        with self._lease_lock:
+            remote = [w for w, t in self._remote_workers.items()
+                      if now - t < self.worker_expiry_s]
+            members += sorted(remote)
+            live = set(members)
+            for m in list(self._aff_descs):
+                if m not in live:  # churned worker ids must not accumulate
+                    del self._aff_descs[m]
+            for m in members:
+                d = self._aff_descs.get(m)
+                if d is None:
+                    d = self._aff_descs[m] = InstanceDesc(
+                        instance_id=m, state=InstanceState.ACTIVE,
+                        tokens=deterministic_tokens(AFFINITY_RING_KEY, m))
+                out.append(d)
+        return out
+
+    def _claimer(self, member: str):
+        """Build claim(tenant, job, now) for one dequeue pass by
+        `member`, or None when affinity is off or there is at most one
+        cache domain (the legacy dequeue, preserved exactly). Ownership
+        is resolved within the tenant's REACHABLE domains: with querier
+        shuffle-shard on, a block is placed on the shard-member subset
+        (plus the local pool, which shuffle-shard never filters), never
+        on a worker the tenant's jobs can't be handed to -- otherwise
+        every such job would pay the full steal timeout for an owner
+        that can never claim it. Lookups memoize per pass -- one ring
+        walk per distinct (tenant, block) per dequeue."""
+        if not self.affinity_enabled or not member:
+            return None
+        members = self._affinity_members()
+        if len(members) <= 1:
+            return None
+        ring = self._aff_ring
+        steal_s = self.affinity_steal_ms / 1000.0
+        owners: dict[tuple[str, str], str | None] = {}
+        shards: dict[str, list[InstanceDesc]] = {}
+
+        def shard_members(tenant: str) -> list[InstanceDesc]:
+            ms = shards.get(tenant)
+            if ms is None:
+                ms = shards[tenant] = [
+                    d for d in members
+                    if d.instance_id == self._local_member
+                    or self._tenant_allowed(tenant, d.instance_id)]
+            return ms
+
+        def claim(tenant: str, job, now: float) -> str | None:
+            key = getattr(job, "affinity_key", None)
+            if not key:
+                return "unowned"
+            ck = (tenant, key)
+            if ck in owners:
+                owner = owners[ck]
+            else:
+                owner = owners[ck] = ring.owner_of(
+                    key, instances=shard_members(tenant))
+            if owner == member:
+                return "own"
+            if owner is None:
+                return "unowned"
+            queued = getattr(job, "queued_at", 0.0)
+            if queued and now - queued < steal_s:
+                return None  # owner's job; steal clock still running
+            return "steal"
+
+        return claim
+
+    def _note_placements(self, jobs: list) -> None:
+        """Count dequeue placements (kerneltel affinity counters)."""
+        from ..util.kerneltel import TEL
+
+        for j in jobs:
+            p = getattr(j, "placement", "")
+            if p:
+                TEL.record_affinity(p)
+
+    # ------------------------------------------------------ per-tenant QoS
+    def _qos_admit(self, tenant: str, est_bytes: int) -> int:
+        """Admit one query against the tenant's QoS budgets; returns the
+        byte charge release() must return (0 when no gate is wired).
+        Sheds with TooManyRequests (the HTTP layer's 429)."""
+        if self.qos is None:
+            return 0
+        refused = self.qos.try_admit(tenant, est_bytes)
+        if refused is not None:
+            from ..util.kerneltel import TEL
+
+            TEL.record_shed(tenant, refused)
+            raise TooManyRequests(
+                f"tenant {tenant} over per-tenant {refused} budget")
+        return est_bytes
+
+    def _qos_release(self, tenant: str, est_bytes: int) -> None:
+        if self.qos is not None:
+            self.qos.release(tenant, est_bytes)
+
     # ------------------------------------------------------- local workers
     WORKER_DEQUEUE_BATCH = 16  # same-key jobs one worker drains per pull
 
@@ -262,12 +524,14 @@ class Frontend:
         while True:
             item = self.queue.dequeue_batch(
                 timeout=1.0, max_batch=self.WORKER_DEQUEUE_BATCH,
-                key_fn=lambda j: j.batch_key)
+                key_fn=lambda j: j.batch_key,
+                claim=self._claimer(self._local_member or ""))
             if item is None:
                 if self.queue.closed:
                     return
                 continue
             tenant, job, extras = item
+            self._note_placements([job] + [j for _, j in extras])
             if extras and job.batch_fn is not None:
                 self._execute_batch([(tenant, job)] + extras)
                 continue
@@ -293,12 +557,14 @@ class Frontend:
         lead = live[0][1]
         token = (TEL.set_active_trace(lead.trace)
                  if lead.trace is not None else None)
+        ptoken = TEL.set_affinity_placement(lead.placement)
         results = None
         try:
             results = lead.batch_fn(live)
         except Exception:
             results = None
         finally:
+            TEL.reset_affinity_placement(ptoken)
             if token is not None:
                 TEL.reset_active_trace(token)
         if isinstance(results, list) and len(results) == len(live):
@@ -324,6 +590,7 @@ class Frontend:
         job.tries += 1
         if _retryable(e) and job.tries < MAX_RETRIES:
             try:
+                job.affinity_key = None  # retry dodges the failing owner
                 self.queue.enqueue(tenant, job)
                 return
             except TooManyRequests:
@@ -343,6 +610,7 @@ class Frontend:
 
         token = (TEL.set_active_trace(job.trace)
                  if job.trace is not None else None)
+        ptoken = TEL.set_affinity_placement(getattr(job, "placement", ""))
         try:
             res = job.fn(*job.args)
             if not job.done.is_set():
@@ -356,6 +624,7 @@ class Frontend:
             self._fail_job(tenant, job, e)
             return
         finally:
+            TEL.reset_affinity_placement(ptoken)
             if token is not None:
                 TEL.reset_active_trace(token)
         job.finish()
@@ -411,7 +680,11 @@ class Frontend:
         or None on timeout. Same-key jobs queued at poll time merge into
         ONE `multi` wire job (the remote face of the batch-aware
         dequeue), leased together. Expired leases re-enter the queue
-        first."""
+        first. Affinity: this worker prefers jobs whose block hashes to
+        it on the cache-domain ring; a peer's jobs become claimable only
+        past the steal timeout. The wire job carries the dequeue
+        placement so the remote process attributes its staged-cache
+        hits."""
         if worker_id:
             with self._lease_lock:
                 self._remote_workers[worker_id] = time.monotonic()
@@ -425,7 +698,8 @@ class Frontend:
             item = self.queue.dequeue_batch(
                 timeout=min(remaining, 1.0), allowed=allowed,
                 max_batch=self.REMOTE_BATCH_MAX,
-                key_fn=lambda j: j.batch_key)
+                key_fn=lambda j: j.batch_key,
+                claim=self._claimer(worker_id))
             if item is None:
                 if self.queue.closed:
                     return None
@@ -439,14 +713,17 @@ class Frontend:
                     pairs.append((t, j))
             if not pairs:
                 continue
+            self._note_placements([j for _, j in pairs])
             jid = uuid.uuid4().hex
             with self._lease_lock:
                 self._leases[jid] = (pairs, time.monotonic() + self.lease_s)
+            placement = pairs[0][1].placement
             if len(pairs) == 1:
                 t0, j0 = pairs[0]
                 return {"id": jid, "tenant": t0, "kind": j0.kind,
-                        "payload": j0.payload}
+                        "payload": j0.payload, "placement": placement}
             return {"id": jid, "tenant": pairs[0][0], "kind": "multi",
+                    "placement": placement,
                     "payload": {"kind": pairs[0][1].kind,
                                 "tenants": [t for t, _ in pairs],
                                 "jobs": [j.payload for _, j in pairs]}}
@@ -499,6 +776,11 @@ class Frontend:
                 job.tries += 1
                 if job_retryable and job.tries < MAX_RETRIES:
                     try:
+                        # demote to placement-free: a sick-but-alive
+                        # owner polls fastest right after failing and
+                        # would win its own job back every retry inside
+                        # the steal window
+                        job.affinity_key = None
                         self.queue.enqueue(tenant, job)
                         continue
                     except TooManyRequests:
@@ -600,27 +882,33 @@ class Frontend:
                           time_start: int = 0, time_end: int = 0, trace=None):
         db = self.querier.db
         candidates = db.find_candidates(tenant, trace_id, time_start, time_end)
-        jobs = [_Job(
-            kind="find_recent",
-            payload={"trace_id": trace_id.hex()},
-            fn=self.querier.find_trace_by_id,
-            args=(tenant, trace_id, time_start, time_end, True, False),
-        )]
-        for i in range(0, len(candidates), FIND_SHARD_BLOCKS):
-            part = candidates[i : i + FIND_SHARD_BLOCKS]
-            jobs.append(_Job(
-                kind="find_blocks",
-                payload={"trace_id": trace_id.hex(),
-                         "block_ids": [m.block_id for m in part]},
-                fn=self.querier.find_in_blocks,
-                args=(tenant, trace_id, part),
-                batch_key=("find_blocks", tenant,
-                           tuple(m.block_id for m in part)),
-                batch_fn=self._batch_find_blocks,
-            ))
-        for j in jobs:
-            j.trace = trace
-        self._run_jobs(tenant, jobs)
+        charge = self._qos_admit(
+            tenant, sum(m.size_bytes or 0 for m in candidates))
+        try:
+            jobs = [_Job(
+                kind="find_recent",
+                payload={"trace_id": trace_id.hex()},
+                fn=self.querier.find_trace_by_id,
+                args=(tenant, trace_id, time_start, time_end, True, False),
+            )]
+            for i in range(0, len(candidates), FIND_SHARD_BLOCKS):
+                part = candidates[i : i + FIND_SHARD_BLOCKS]
+                jobs.append(_Job(
+                    kind="find_blocks",
+                    payload={"trace_id": trace_id.hex(),
+                             "block_ids": [m.block_id for m in part]},
+                    fn=self.querier.find_in_blocks,
+                    args=(tenant, trace_id, part),
+                    batch_key=("find_blocks", tenant,
+                               tuple(m.block_id for m in part)),
+                    batch_fn=self._batch_find_blocks,
+                    affinity_key=part[0].block_id,
+                ))
+            for j in jobs:
+                j.trace = trace
+            self._run_jobs(tenant, jobs)
+        finally:
+            self._qos_release(tenant, charge)
         if trace is not None:
             self._emit_self_trace(jobs, trace)
         partials = []
@@ -669,69 +957,75 @@ class Frontend:
             m for m in self.querier.db.blocklist.metas(tenant)
             if m.overlaps_time(req.start, req.end)
         ]
-        jobs: list[_Job] = [_Job(
-            kind="search_recent", payload={"req": req_d},
-            fn=self.querier.search_recent, args=(tenant, req),
-        )]
-        batch: list = []
-        batch_bytes = 0
+        charge = self._qos_admit(tenant, sum(m.size_bytes or 0 for m in metas))
+        try:
+            jobs: list[_Job] = [_Job(
+                kind="search_recent", payload={"req": req_d},
+                fn=self.querier.search_recent, args=(tenant, req),
+            )]
+            batch: list = []
+            batch_bytes = 0
 
-        def flush_batch():
-            nonlocal batch, batch_bytes
-            if batch:
-                part = batch
-                jobs.append(_Job(
-                    kind="search_blocks",
-                    payload={"req": req_d, "block_ids": [m.block_id for m in part]},
-                    fn=self.querier.search_blocks, args=(tenant, part, req),
-                    batch_key=("search_blocks", tenant,
-                               tuple(m.block_id for m in part)),
-                    batch_fn=self._batch_search_blocks,
-                ))
-                batch, batch_bytes = [], 0
-
-        for m in metas:
-            size = m.size_bytes or 0
-            if size > self.batch_bytes:
-                # a single oversized block: shard it by row-group range
-                for groups in self._group_chunks(m):
+            def flush_batch():
+                nonlocal batch, batch_bytes
+                if batch:
+                    part = batch
                     jobs.append(_Job(
-                        kind="search_block_shard",
-                        payload={"req": req_d, "block_id": m.block_id, "groups": groups},
-                        fn=self.querier.search_block_shard, args=(tenant, m, req, groups),
-                        batch_key=("search_block_shard", tenant, m.block_id,
-                                   tuple(groups)),
-                        batch_fn=self._batch_search_shards,
+                        kind="search_blocks",
+                        payload={"req": req_d, "block_ids": [m.block_id for m in part]},
+                        fn=self.querier.search_blocks, args=(tenant, part, req),
+                        batch_key=("search_blocks", tenant,
+                                   tuple(m.block_id for m in part)),
+                        batch_fn=self._batch_search_blocks,
+                        affinity_key=part[0].block_id,
                     ))
-                continue
-            if batch_bytes + size > self.batch_bytes or len(batch) >= MAX_BLOCKS_PER_BATCH:
-                flush_batch()
-            batch.append(m)
-            batch_bytes += size
-        flush_batch()
+                    batch, batch_bytes = [], 0
 
-        for j in jobs:
-            j.trace = trace
+            for m in metas:
+                size = m.size_bytes or 0
+                if size > self.batch_bytes:
+                    # a single oversized block: shard it by row-group range
+                    for groups in self._group_chunks(m):
+                        jobs.append(_Job(
+                            kind="search_block_shard",
+                            payload={"req": req_d, "block_id": m.block_id, "groups": groups},
+                            fn=self.querier.search_block_shard, args=(tenant, m, req, groups),
+                            batch_key=("search_block_shard", tenant, m.block_id,
+                                       tuple(groups)),
+                            batch_fn=self._batch_search_shards,
+                            affinity_key=m.block_id,
+                        ))
+                    continue
+                if batch_bytes + size > self.batch_bytes or len(batch) >= MAX_BLOCKS_PER_BATCH:
+                    flush_batch()
+                batch.append(m)
+                batch_bytes += size
+            flush_batch()
 
-        def early():
-            with lock:
-                return len(resp.traces) >= limit
-
-        # collect results as jobs complete, merging under the limit
-        collector_done = threading.Event()
-
-        def collect():
             for j in jobs:
-                j.done.wait()
-                if j.error is None and j.result is not None:
-                    with lock:
-                        resp.merge(j.result, limit)
-            collector_done.set()
+                j.trace = trace
 
-        t = threading.Thread(target=collect, daemon=True)
-        t.start()
-        self._run_jobs(tenant, jobs, early_exit=early)
-        collector_done.wait(timeout=60.0)
+            def early():
+                with lock:
+                    return len(resp.traces) >= limit
+
+            # collect results as jobs complete, merging under the limit
+            collector_done = threading.Event()
+
+            def collect():
+                for j in jobs:
+                    j.done.wait()
+                    if j.error is None and j.result is not None:
+                        with lock:
+                            resp.merge(j.result, limit)
+                collector_done.set()
+
+            t = threading.Thread(target=collect, daemon=True)
+            t.start()
+            self._run_jobs(tenant, jobs, early_exit=early)
+            collector_done.wait(timeout=60.0)
+        finally:
+            self._qos_release(tenant, charge)
         if trace is not None:
             self._emit_self_trace(jobs, trace)
         resp.traces.sort(key=lambda r: -r.start_time_unix_nano)
@@ -776,28 +1070,32 @@ class Frontend:
         )
 
         q = parse_metrics_query(req.query)  # ParseError -> 400 at the API
-        nb = req.n_buckets
-        n_jobs = max(1, -(-nb // self.METRICS_BUCKETS_PER_JOB))
-        if nb >= 2 and n_jobs < 2:
-            n_jobs = 2  # the shard/merge path is the production path: keep it hot
-        per_job = -(-nb // n_jobs)
-        jobs: list[_Job] = []
-        for lo in range(0, nb, per_job):
-            hi = min(lo + per_job, nb)
-            sub = MetricsRequest(
-                query=req.query,
-                start_ms=req.start_ms + lo * req.step_ms,
-                end_ms=req.start_ms + hi * req.step_ms,
-                step_ms=req.step_ms,
-            )
-            jobs.append(_Job(
-                kind="metrics_query_range",
-                payload={"req": metrics_request_to_dict(sub)},
-                fn=self.querier.metrics_query_range, args=(tenant, sub),
-            ))
-        for j in jobs:
-            j.trace = trace
-        self._run_jobs(tenant, jobs)
+        charge = self._qos_admit(tenant, 0)  # concurrency budget only
+        try:
+            nb = req.n_buckets
+            n_jobs = max(1, -(-nb // self.METRICS_BUCKETS_PER_JOB))
+            if nb >= 2 and n_jobs < 2:
+                n_jobs = 2  # the shard/merge path is the production path: keep it hot
+            per_job = -(-nb // n_jobs)
+            jobs: list[_Job] = []
+            for lo in range(0, nb, per_job):
+                hi = min(lo + per_job, nb)
+                sub = MetricsRequest(
+                    query=req.query,
+                    start_ms=req.start_ms + lo * req.step_ms,
+                    end_ms=req.start_ms + hi * req.step_ms,
+                    step_ms=req.step_ms,
+                )
+                jobs.append(_Job(
+                    kind="metrics_query_range",
+                    payload={"req": metrics_request_to_dict(sub)},
+                    fn=self.querier.metrics_query_range, args=(tenant, sub),
+                ))
+            for j in jobs:
+                j.trace = trace
+            self._run_jobs(tenant, jobs)
+        finally:
+            self._qos_release(tenant, charge)
         if trace is not None:
             self._emit_self_trace(jobs, trace)
         resp = MetricsResponse(

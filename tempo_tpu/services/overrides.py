@@ -22,6 +22,11 @@ class Limits:
     max_bytes_per_tag_values_query: int = 5 * 1024 * 1024
     max_search_duration_s: int = 0  # 0 = unlimited
     max_queriers_per_tenant: int = 0  # queue shuffle-shard size; 0 = all
+    # read-plane QoS (frontend admission): concurrent queries a tenant
+    # may run and block bytes it may reference in flight; over budget =
+    # 429 shed-load. 0 = unlimited.
+    max_concurrent_queries: int = 0
+    max_inflight_query_bytes: int = 0
     # storage
     block_retention_s: int = 0  # 0 = use compactor default
     # generator
@@ -93,6 +98,61 @@ class Overrides:
         with self._lock:
             self.per_tenant = per_tenant
             self._mtime = mtime
+
+
+class QueryAdmission:
+    """Per-tenant read-plane QoS gate (used by the query frontend):
+    bounds how many queries a tenant runs concurrently and how many
+    block bytes it may reference in flight, so one heavy tenant cannot
+    monopolize the queue or churn every other tenant's staged device
+    columns out of HBM. Overrides-driven like the ingest limits;
+    try_admit never blocks -- an over-budget query sheds with 429
+    (frontend.TooManyRequests), the reference's queue-full response
+    applied per tenant instead of per process."""
+
+    def __init__(self, overrides: Overrides):
+        self.overrides = overrides
+        self._lock = threading.Lock()
+        self._queries: dict[str, int] = {}  # tenant -> queries in flight
+        self._bytes: dict[str, int] = {}  # tenant -> referenced block bytes
+
+    def try_admit(self, tenant: str, est_bytes: int = 0) -> str | None:
+        """Admit one query referencing est_bytes of block data. Returns
+        None on admission, else the name of the refusing budget
+        ("concurrency" | "bytes"). A tenant with nothing in flight
+        always admits: a single query larger than its own byte budget
+        is the budget's unit of progress, not a livelock."""
+        lim = self.overrides.for_tenant(tenant)
+        with self._lock:
+            q = self._queries.get(tenant, 0)
+            b = self._bytes.get(tenant, 0)
+            if q > 0:
+                if 0 < lim.max_concurrent_queries <= q:
+                    return "concurrency"
+                if (lim.max_inflight_query_bytes > 0
+                        and b + est_bytes > lim.max_inflight_query_bytes):
+                    return "bytes"
+            self._queries[tenant] = q + 1
+            self._bytes[tenant] = b + est_bytes
+            return None
+
+    def release(self, tenant: str, est_bytes: int = 0) -> None:
+        """Return one admitted query's budget. Must be called exactly
+        once per successful try_admit (callers pair them try/finally)."""
+        with self._lock:
+            q = self._queries.get(tenant, 0) - 1
+            if q <= 0:
+                self._queries.pop(tenant, None)
+                self._bytes.pop(tenant, None)
+            else:
+                self._queries[tenant] = q
+                self._bytes[tenant] = max(
+                    0, self._bytes.get(tenant, 0) - est_bytes)
+
+    def inflight(self, tenant: str) -> tuple[int, int]:
+        """(queries, bytes) a tenant currently holds (status surfaces)."""
+        with self._lock:
+            return self._queries.get(tenant, 0), self._bytes.get(tenant, 0)
 
 
 class RateLimiter:

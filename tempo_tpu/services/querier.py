@@ -8,6 +8,8 @@ The ingester boundary is the same client registry the distributor uses.
 
 from __future__ import annotations
 
+import contextvars
+
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass
 
@@ -52,6 +54,14 @@ class Querier:
         self.external_breaker_fails = 3
         self.external_breaker_cooldown_s = 30.0
 
+    def _submit(self, fn, *args):
+        """pool.submit carrying the caller's contextvars: kerneltel's
+        ambient attribution (affinity dequeue placement, active
+        self-trace) must follow a query's legs into the pool threads,
+        or pooled staged-cache probes would all attribute to "none"."""
+        ctx = contextvars.copy_context()
+        return self.pool.submit(ctx.run, fn, *args)
+
     def _ingester_clients(self):
         if self.ring is None:
             return []
@@ -74,9 +84,9 @@ class Querier:
         futures = []
         if query_ingesters:
             for c in self._ingester_clients():
-                futures.append(self.pool.submit(c.find_trace_by_id, tenant, trace_id))
+                futures.append(self._submit(c.find_trace_by_id, tenant, trace_id))
         if query_backend:
-            futures.append(self.pool.submit(
+            futures.append(self._submit(
                 self.db.find_trace_by_id, tenant, trace_id, time_start, time_end
             ))
         partials = []
@@ -96,7 +106,7 @@ class Querier:
     def search_recent(self, tenant: str, req: SearchRequest) -> SearchResponse:
         """Recent (unflushed) data: all ingesters (querier.go:295)."""
         resp = SearchResponse()
-        futs = [self.pool.submit(c.search, tenant, req) for c in self._ingester_clients()]
+        futs = [self._submit(c.search, tenant, req) for c in self._ingester_clients()]
         for f in futs:
             try:
                 resp.merge(f.result(), req.limit or 20)
@@ -166,7 +176,7 @@ class Querier:
         eps = self._external_candidates()
         first = eps[self._external_rr % len(eps)]
         self._external_rr += 1
-        futs = {self.pool.submit(self._post_external, first, event): first}
+        futs = {self._submit(self._post_external, first, event): first}
         try:
             out = next(iter(futs)).result(timeout=self.external_hedge_after_s)
             self._note_external(first, out is not None)
@@ -177,7 +187,7 @@ class Querier:
             if len(eps) > 1:  # hedge on a different endpoint
                 second = eps[self._external_rr % len(eps)]
                 self._external_rr += 1
-                futs[self.pool.submit(self._post_external, second, event)] = second
+                futs[self._submit(self._post_external, second, event)] = second
             # await ALL legs up to one more hedge window: a slow first
             # leg failing must not discard a still-pending hedge winner
             from concurrent.futures import as_completed

@@ -16,6 +16,7 @@ import urllib.error
 import urllib.request
 
 from ..db.search import request_from_dict, response_to_dict
+from ..util.kerneltel import TEL
 from ..wire import otlp_json
 from .querier import Querier
 
@@ -180,6 +181,10 @@ class QuerierWorker:
             if not job or not job.get("id"):
                 continue
             out = {"id": job["id"]}
+            # the frontend's dequeue placement (own/steal/unowned) rides
+            # the wire job so THIS process's staged-cache hits attribute
+            # to owner-vs-stolen routing in its own kerneltel
+            ptoken = TEL.set_affinity_placement(job.get("placement", ""))
             try:
                 result = execute_job(
                     self.querier, job.get("tenant", ""), job["kind"], job["payload"]
@@ -192,6 +197,8 @@ class QuerierWorker:
                 out.update(ok=False, error=f"{type(e).__name__}: {e}",
                            retryable=_retryable(e))
                 self.jobs_failed += 1
+            finally:
+                TEL.reset_affinity_placement(ptoken)
             try:
                 self._post(addr, "/internal/jobs/result", out, timeout=10.0)
             except (urllib.error.URLError, ConnectionError, OSError):

@@ -65,6 +65,23 @@ SEEN_SIGNATURES_MAX = 4096
 _active_trace: contextvars.ContextVar = contextvars.ContextVar(
     "tempo_selftrace", default=None)
 
+# placement the current job was dequeued under (own/steal/unowned, "" =
+# no affinity context): the frontend/worker parks it around execution so
+# ops/stage can attribute staged-cache hits to owner-vs-stolen routing
+_affinity_placement: contextvars.ContextVar = contextvars.ContextVar(
+    "tempo_affinity_placement", default="")
+
+QOS_SHED_TENANTS_MAX = 128  # per-tenant shed rows kept before _overflow
+
+
+def _esc_label(v: str) -> str:
+    """Prometheus label-value escaping (backslash, quote, newline):
+    tenant names come straight off the X-Scope-OrgID header -- the one
+    caller-controlled string that reaches a label -- and an unescaped
+    quote would corrupt every subsequent /metrics scrape."""
+    return (v.replace("\\", "\\\\").replace('"', '\\"')
+             .replace("\n", "\\n"))
+
 
 class KernelTelemetry:
     def __init__(self):
@@ -169,6 +186,23 @@ class KernelTelemetry:
             "runs": 0, "wall_seconds": 0.0, "stage_seconds": {},
             "units": 0, "errors": 0, "cancelled": 0,
         }
+        # cache-affinity scheduling (services/frontend): dequeue
+        # placement outcomes, per-tenant QoS sheds, and staged-cache
+        # lookups attributed by the dequeue placement of the job that
+        # made them (owner-vs-stolen hit-rate attribution)
+        self.affinity_jobs = Counter(
+            "tempo_affinity_jobs_total",
+            help="frontend dequeue placement outcomes (own/steal/unowned)")
+        self.qos_shed = Counter(
+            "tempo_qos_shed_total",
+            help="queries shed with 429 by per-tenant read QoS budgets")
+        self.staged_placement = Counter(
+            "tempo_stage_cache_placement_total",
+            help="staged-cache lookups by job placement (own/steal/"
+                 "unowned/none) and result")
+        self._affinity: dict[str, int] = {}
+        self._qos_sheds: dict[str, dict[str, int]] = {}
+        self._staged_by_placement: dict[str, list[int]] = {}
         # every instrument exported through /metrics -- ONE list shared
         # by metrics_lines() and help_entries() so an instrument can't
         # ship samples without its HELP (or vice versa)
@@ -185,6 +219,7 @@ class KernelTelemetry:
             self.compact_bytes_inflight, self.compact_queue_depth,
             self.compact_passthrough_bytes, self.stream_stage_time,
             self.stream_units, self.stream_bytes_inflight,
+            self.affinity_jobs, self.qos_shed, self.staged_placement,
         )
         # full compile-key signatures, LRU-bounded (SEEN_SIGNATURES_MAX)
         self._seen: OrderedDict = OrderedDict()
@@ -492,6 +527,77 @@ class KernelTelemetry:
         c["bytes_inflight"] = int(self.stream_bytes_inflight.get())
         return c
 
+    # ------------------------------------------------- affinity scheduling
+    def record_affinity(self, outcome: str, n: int = 1) -> None:
+        """One frontend dequeue under affinity routing: the job went to
+        its owner ("own"), was taken past the steal timeout ("steal"),
+        or carried no block affinity at all ("unowned")."""
+        try:
+            self.affinity_jobs.inc(n, labels=f'outcome="{outcome}"')
+            with self._lock:
+                self._affinity[outcome] = self._affinity.get(outcome, 0) + n
+        except Exception:
+            pass
+
+    def record_shed(self, tenant: str, budget: str) -> None:
+        """One query refused with 429 by a per-tenant QoS budget
+        ("concurrency" or "bytes")."""
+        try:
+            tenant = tenant[:128]  # header-sourced: bound label size
+            with self._lock:
+                key = (tenant if (tenant in self._qos_sheds
+                                  or len(self._qos_sheds) < QOS_SHED_TENANTS_MAX)
+                       else "_overflow")
+                t = self._qos_sheds.setdefault(key, {})
+                t[budget] = t.get(budget, 0) + 1
+            self.qos_shed.inc(
+                labels=f'tenant="{_esc_label(key)}",budget="{budget}"')
+        except Exception:
+            pass
+
+    def set_affinity_placement(self, placement: str):
+        """Park the current job's dequeue placement for this execution
+        context; returns a token for reset_affinity_placement."""
+        return _affinity_placement.set(placement or "")
+
+    def reset_affinity_placement(self, token) -> None:
+        try:
+            _affinity_placement.reset(token)
+        except Exception:
+            pass
+
+    def affinity_placement(self) -> str:
+        return _affinity_placement.get()
+
+    def record_staged_lookup(self, hit: bool) -> None:
+        """One staged-cache probe, attributed to the ambient dequeue
+        placement -- the owner-vs-stolen hit-rate split that says
+        whether affinity routing is actually landing jobs on warm
+        caches."""
+        try:
+            p = _affinity_placement.get() or "none"
+            self.staged_placement.inc(
+                labels=f'placement="{p}",result="{"hit" if hit else "miss"}"')
+            with self._lock:
+                row = self._staged_by_placement.setdefault(p, [0, 0])
+                row[0 if hit else 1] += 1
+        except Exception:
+            pass
+
+    def affinity_stats(self) -> dict:
+        """Affinity + QoS aggregates for /status/kernels and the bench
+        differential row."""
+        with self._lock:
+            staged = {
+                p: {"hits": h, "misses": m,
+                    "hit_rate": round(h / (h + m), 4) if h + m else 0.0}
+                for p, (h, m) in sorted(self._staged_by_placement.items())
+            }
+            return {"jobs": dict(self._affinity),
+                    "staged_by_placement": staged,
+                    "qos_sheds": {t: dict(v)
+                                  for t, v in sorted(self._qos_sheds.items())}}
+
     def record_passthrough(self, nbytes: int) -> None:
         """Compressed bytes a compaction output inherited verbatim."""
         try:
@@ -591,6 +697,7 @@ class KernelTelemetry:
                 "cache_misses": int(self.staged_cache_misses.get()),
             },
             "routing": routing,
+            "affinity": self.affinity_stats(),
             "batching": self.batch_stats(),
             "compaction": self.compaction_stats(),
             "stream": self.stream_stats(),

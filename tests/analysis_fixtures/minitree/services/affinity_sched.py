@@ -1,0 +1,56 @@
+"""Seeded violations in the cache-affinity scheduler's lock shapes: a
+worker-membership registry refreshed on every poll, the request queue's
+condition variable, and the per-tenant QoS admission lock -- the lock
+pairs services/frontend.py and services/overrides.py use, so the
+concurrency rules provably cover the affinity scheduling module shape."""
+
+import threading
+
+_members: dict[str, float] = {}  # worker id -> last poll (monotonic)
+_queue_cv = threading.Condition()
+_qos_lock = threading.Lock()
+_inflight: dict[str, int] = {}  # tenant -> queries in flight
+
+
+def register(worker, now):
+    # sanctioned: membership refresh under the queue condition
+    with _queue_cv:
+        _members[worker] = now
+        _queue_cv.notify_all()
+
+
+def register_racy(worker, now):
+    _members[worker] = now  # EXPECT: global-mutation-unlocked
+
+
+def admit(tenant):
+    with _qos_lock:
+        _inflight[tenant] = _inflight.get(tenant, 0) + 1
+
+
+def claim_then_admit(tenant):
+    # sanctioned order: queue cv outer, QoS lock inner
+    with _queue_cv:
+        with _qos_lock:
+            _inflight[tenant] = _inflight.get(tenant, 0) + 1
+
+
+def admit_then_claim_racy(tenant):
+    with _qos_lock:
+        with _queue_cv:  # EXPECT: lock-order
+            _members.pop(tenant, None)
+
+
+def steal_scan_unsafe():
+    _queue_cv.acquire()  # EXPECT: lock-bare-acquire
+    n = len(_members)
+    _queue_cv.release()
+    return n
+
+
+def steal_scan_safe():
+    _queue_cv.acquire()
+    try:
+        _members.clear()
+    finally:
+        _queue_cv.release()

@@ -480,6 +480,25 @@ def test_self_tracing(tmp_path):
         app.stop()
 
 
+def test_status_config_modes(server):
+    """/status/config?mode=defaults serves the built-in config,
+    mode=diff only the fields this instance changed (the reference's
+    runtime-config mode variants); an unknown mode is a 400."""
+    app, base = server
+    st, body = _get(base, "/status/config")
+    full = json.loads(body)
+    st, body = _get(base, "/status/config?mode=defaults")
+    defaults = json.loads(body)
+    assert set(defaults) == set(full)
+    assert defaults["http_port"] != full["http_port"]  # fixture port
+    st, body = _get(base, "/status/config?mode=diff")
+    diff = json.loads(body)
+    assert 0 < len(diff) < len(full)
+    assert diff["storage_path"] == full["storage_path"]
+    assert all(full[k] == v and defaults.get(k) != v for k, v in diff.items())
+    assert _get(base, "/status/config?mode=bogus", expect=400)[0] == 400
+
+
 def test_debug_endpoints(server):
     """/debug/threads (the pprof goroutine-dump analog) and
     /debug/profile (sampling CPU profile across all threads)."""
