@@ -779,6 +779,88 @@ def bench_search_concurrent(tmp: str) -> None:
     db.close()
 
 
+def bench_search_live(tmp: str) -> None:
+    """Live-head device engine (db/live_engine): N live traces in one
+    ingester instance, C concurrent searches -- device engine vs the
+    host index walk (the differential oracle), plus the staging-lag
+    stat (push -> device-visible ms) from kernel telemetry."""
+    import os
+    import random as _random
+    from concurrent.futures import ThreadPoolExecutor
+
+    from tempo_tpu.backend import MemBackend
+    from tempo_tpu.db import TempoDB, TempoDBConfig
+    from tempo_tpu.db.search import SearchRequest
+    from tempo_tpu.db.wal import WAL
+    from tempo_tpu.services.ingester import Ingester, IngesterConfig
+    from tempo_tpu.services.overrides import Overrides
+    from tempo_tpu.util.kerneltel import TEL
+    from tempo_tpu.util.testdata import make_trace, make_trace_id
+    from tempo_tpu.wire.segment import segment_for_write
+
+    db = TempoDB(TempoDBConfig(wal_path=tmp + "/wal-live-db"),
+                 backend=MemBackend())
+    ing = Ingester(WAL(tmp + "/wal-live"), db, Overrides(), IngesterConfig())
+    inst = ing.instance("bench")
+    rng = _random.Random(17)
+    n_traces, C, iters = 2000, 8, 3
+    lag0 = TEL.livestage_stats()
+    for i in range(n_traces):
+        tid = make_trace_id(rng)
+        tr = make_trace(rng, trace_id=tid, n_spans=4,
+                        base_time_ns=1_700_000_000_000_000_000 + i * 10**9)
+        lo, hi = tr.time_range_nanos()
+        s, e = lo // 10**9, hi // 10**9 + 1
+        inst.push_segments([(tid, s, e, segment_for_write(tr, s, e))])
+    reqs = [SearchRequest(tags={"service.name": "db"}, limit=20),
+            SearchRequest(tags={"name": "GET /api"}, limit=20),
+            SearchRequest(min_duration_ms=200, limit=20)]
+
+    def run_engine(engine: str) -> list[float]:
+        prev = os.environ.get("TEMPO_LIVE_ENGINE")
+        os.environ["TEMPO_LIVE_ENGINE"] = engine
+        try:
+            inst.search_live(reqs[0])  # warm: staging upload + compiles
+
+            def one(i):
+                t0 = time.perf_counter()
+                r = inst.search_live(reqs[i % len(reqs)])
+                assert r.traces
+                return time.perf_counter() - t0
+
+            lats: list[float] = []
+            for _ in range(iters):
+                with ThreadPoolExecutor(C) as ex:
+                    lats.extend(ex.map(one, range(C)))
+            return lats
+        finally:
+            if prev is None:  # restore whatever the operator forced
+                del os.environ["TEMPO_LIVE_ENGINE"]
+            else:
+                os.environ["TEMPO_LIVE_ENGINE"] = prev
+
+    mark = _tel_mark()
+    dev = run_engine("device")
+    host = run_engine("index")
+    lag1 = TEL.livestage_stats()
+    lag_ms = 0.0
+    if lag1["lag_count"] > lag0["lag_count"]:
+        lag_ms = ((lag1["lag_avg_s"] * lag1["lag_count"]
+                   - lag0["lag_avg_s"] * lag0["lag_count"])
+                  / (lag1["lag_count"] - lag0["lag_count"]) * 1e3)
+    tel = _tel_close(mark)
+    tel.update({
+        "host_index_p50_ms": round(float(np.median(host)) * 1e3, 3),
+        "p95_ms": round(float(np.percentile(dev, 95)) * 1e3, 3),
+        "staging_lag_ms": round(lag_ms, 2),
+        "live_traces": n_traces,
+        "crossover_rows": inst.live_engine.stats()["crossover_rows"],
+    })
+    _emit("search_live_p50_ms", float(np.median(dev)) * 1e3, "ms", 0.0,
+          tel=tel)
+    db.close()
+
+
 def bench_search_affinity(tmp: str) -> None:
     """Cache-affinity scheduling differential (services/frontend): a
     dispatcher-only frontend + 3 simulated remote querier workers, each
@@ -956,6 +1038,7 @@ def main() -> None:
         bench_ingest(tmp)
         bench_spanmetrics()
         bench_search_concurrent(tmp)
+        bench_search_live(tmp)
         bench_search_affinity(tmp)
         _emit("search_block_e2e_cold_spans_per_sec", cold, "spans/s",
               cold / BASELINE_SPANS_PER_SEC, tel=cold_tel)

@@ -57,12 +57,17 @@ def _lat_summary(xs) -> dict:
 class Soak:
     def __init__(self, target: str, writers: int, readers: int,
                  spans_per_trace: int = 8, batch: int = 5,
-                 tenants: list[str] | None = None, zipf: float = 1.2):
+                 tenants: list[str] | None = None, zipf: float = 1.2,
+                 live_tail: bool = False):
         self.target = target.rstrip("/")
         self.writers = writers
         self.readers = readers
         self.spans_per_trace = spans_per_trace
         self.batch = batch
+        # live-tail mode: searches ask for the most recent window only
+        # (start=now-60s), the recent-data shape the live-head device
+        # engine serves from the ingester's staged columns
+        self.live_tail = live_tail
         # "" = single-tenant (no X-Scope-OrgID header), today's default
         self.tenants: list[str] = list(tenants) if tenants else [""]
         # Zipf read skew over tenant rank: weight 1/(rank+1)^s
@@ -212,9 +217,12 @@ class Soak:
                             self.find_lat[tenant].append(time.perf_counter() - t0)
                 t0 = time.perf_counter()
                 shed = False
+                path = "/api/search?tags=service.name%3Dsoak-svc-1&limit=20"
+                if self.live_tail:
+                    now = int(time.time())
+                    path += f"&start={now - 60}&end={now + 5}"
                 try:
-                    self._get("/api/search?tags=service.name%3Dsoak-svc-1&limit=20",
-                              tenant=tenant)
+                    self._get(path, tenant=tenant)
                 except urllib.error.HTTPError as e:
                     if e.code != 429:
                         raise
@@ -316,6 +324,9 @@ def main(argv=None) -> int:
     ap.add_argument("--overrides", default="",
                     help="per-tenant overrides YAML for the self-hosted app "
                          "(QoS budgets, limits)")
+    ap.add_argument("--live-tail", action="store_true",
+                    help="searches query only the most recent 60s window "
+                         "(exercises the live-head device engine)")
     ap.add_argument("--write-p95", type=float, default=1.0)
     ap.add_argument("--search-p95", type=float, default=3.0)
     args = ap.parse_args(argv)
@@ -351,7 +362,7 @@ def main(argv=None) -> int:
 
     try:
         soak = Soak(target, args.writers, args.readers, tenants=tenants,
-                    zipf=args.zipf)
+                    zipf=args.zipf, live_tail=args.live_tail)
         report = soak.run(args.duration, max_write_p95_s=args.write_p95,
                           max_search_p95_s=args.search_p95)
         print(json.dumps(report, indent=2))

@@ -38,7 +38,7 @@ R_UNRESOLVABLE = register_rule(
 
 # the executors whose device dispatches the registry must cover
 DB_EXECUTORS = ("db/search.py", "db/metrics_exec.py", "db/metrics_mesh.py",
-                "db/batchexec.py")
+                "db/batchexec.py", "db/live_engine.py")
 KERNEL_PKGS = ("ops", "parallel")
 
 

@@ -113,6 +113,47 @@ def cmd_search(args):
         _print_kernel_stats()
 
 
+def cmd_stream_search(args):
+    """Progressive search against a RUNNING instance: consume
+    /api/search?stream=true (NDJSON) and print each partial the moment
+    its shard completes -- the operator's live tail. Partials go to
+    stderr as they arrive; the final (done=true) body goes to stdout,
+    so piping to jq sees exactly the blocking-response shape."""
+    import urllib.parse
+    import urllib.request
+
+    params = {"limit": str(args.limit), "stream": "true"}
+    if args.q:
+        params["q"] = args.q
+    if args.tags:
+        params["tags"] = " ".join(args.tags)
+    if args.recent:
+        import time
+
+        now = int(time.time())
+        params["start"], params["end"] = str(now - args.recent), str(now + 5)
+    url = args.target.rstrip("/") + "/api/search?" + urllib.parse.urlencode(params)
+    req = urllib.request.Request(
+        url, headers={"X-Scope-OrgID": args.tenant} if args.tenant else {})
+    last = None
+    with urllib.request.urlopen(req, timeout=args.timeout) as r:
+        for line in r:
+            line = line.strip()
+            if not line:
+                continue
+            ev = json.loads(line)
+            last = ev
+            if not ev.get("done"):
+                print(json.dumps({
+                    "partial": True,
+                    "jobs": f"{ev['jobsCompleted']}/{ev['jobsTotal']}",
+                    "traces": len(ev["traces"]),
+                }), file=sys.stderr)
+    if last is not None:
+        print(json.dumps({"traces": last["traces"],
+                          "metrics": last.get("metrics", {})}, indent=2))
+
+
 def cmd_query_range(args):
     """Offline TraceQL metrics over a backend path: the CLI face of
     /api/metrics/query_range (db/metrics_exec), Prometheus matrix JSON
@@ -278,6 +319,20 @@ def main(argv=None):
     p.add_argument("--kernel-stats", dest="kernel_stats", action="store_true",
                    help="print kernel telemetry (compiles, routing) to stderr")
     p.set_defaults(fn=cmd_search)
+
+    p = sub.add_parser("stream-search",
+                       help="progressive search against a running instance "
+                            "(/api/search?stream=true): partials on stderr "
+                            "as shards land, final body on stdout")
+    p.add_argument("target", help="base URL, e.g. http://localhost:3200")
+    p.add_argument("--tenant", default="", help="X-Scope-OrgID header")
+    p.add_argument("--tags", nargs="*", help="k=v pairs")
+    p.add_argument("-q", help="TraceQL query")
+    p.add_argument("--limit", type=int, default=20)
+    p.add_argument("--recent", type=int, default=0, metavar="SECONDS",
+                   help="query only the last N seconds (the live-head shape)")
+    p.add_argument("--timeout", type=float, default=60.0)
+    p.set_defaults(fn=cmd_stream_search)
 
     p = sub.add_parser("query-range",
                        help="TraceQL metrics range query against the backend")

@@ -39,6 +39,10 @@ DEVICE_HOST_TWINS: dict[str, str] = {
     "parallel.search.sharded_search": "ops.hostfilter.eval_block_host",
     # span-metrics segmented reduce routes to its host fold internally
     "ops.reduce.span_metrics_reduce": "ops.reduce._reduce_host",
+    # live-head engine: staged slot filter + id lookup, numpy twins run
+    # the tiny-head path and the differential harness
+    "ops.livestage.eval_live_device": "ops.livestage.eval_live_host",
+    "ops.livestage.find_slot_device": "ops.livestage.find_slot_host",
 }
 
 # Device entry points with no host twin BY DESIGN; each carries the
@@ -56,4 +60,8 @@ DEVICE_ONLY: dict[str, str] = {
     "ops.bloom_ops.union_blooms": "ingest-side aggregation of filter "
                                   "words; nothing to verify",
     "parallel.bloom.sharded_bloom_union": "mesh variant of union_blooms",
+    # live-head delta append is transport (dynamic_update_slice into the
+    # resident column); the host tails ARE the source of truth it copies
+    "ops.livestage._append_rows_device": "transport only; host tails are "
+                                         "the authoritative copy",
 }

@@ -466,6 +466,58 @@ def _make_handler(app: App):
         def _err(self, code: int, msg: str):
             self._send(code, json.dumps({"error": msg}))
 
+        def _stream_json(self, events, sse: bool) -> None:
+            """Write an event iterator as a chunked HTTP/1.1 response:
+            SSE `data:` frames or NDJSON lines, one flush per event so
+            the client sees each partial the moment its shard lands.
+            The first event is pulled BEFORE the headers go out, so
+            admission errors (QoS 429) still surface as real statuses."""
+            import itertools
+
+            close = getattr(events, "close", None)  # BEFORE chain rebinds
+            try:
+                first = next(events)
+            except StopIteration:
+                first = None
+            else:
+                events = itertools.chain([first], events)
+            self.send_response(200)
+            self.send_header(
+                "Content-Type",
+                "text/event-stream" if sse else "application/x-ndjson")
+            self.send_header("Cache-Control", "no-cache")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+
+            def chunk(payload: bytes) -> bytes:
+                return b"%X\r\n%s\r\n" % (len(payload), payload)
+
+            try:
+                if first is not None:
+                    for obj in events:
+                        data = json.dumps(obj)
+                        payload = (f"data: {data}\n\n"
+                                   if sse else data + "\n").encode()
+                        self.wfile.write(chunk(payload))
+                        self.wfile.flush()
+                self.wfile.write(b"0\r\n\r\n")
+            except (BrokenPipeError, ConnectionResetError):
+                # client went away mid-stream: close the generator so it
+                # cancels its jobs and releases its QoS charge
+                if close is not None:
+                    close()
+            except Exception:
+                # headers are already out: propagating would let do_GET
+                # write a SECOND status line into the chunked body. Close
+                # the generator (cancels jobs, releases QoS) and end the
+                # chunked stream so the client sees clean termination.
+                if close is not None:
+                    close()
+                try:
+                    self.wfile.write(b"0\r\n\r\n")
+                except OSError:
+                    pass
+
         def _authorized_internal(self) -> bool:
             """Operational + internal endpoints: loopback peers are always
             trusted; remote peers must present the shared token."""
@@ -704,6 +756,18 @@ def _make_handler(app: App):
                 )
             except (ValueError, OverflowError) as e:
                 return self._err(400, f"bad search parameter: {e}")
+            stream = q.get("stream", "").lower()
+            if stream in ("true", "1", "sse"):
+                # progressive delivery: newest-first partial result
+                # snapshots flush as ingester/backend shards complete
+                # (the reference's streaming search direction). SSE when
+                # asked (stream=sse or an event-stream Accept header),
+                # newline-delimited JSON otherwise; the final event is
+                # the exact blocking-response body plus done=true.
+                sse = (stream == "sse"
+                       or "text/event-stream" in self.headers.get("Accept", ""))
+                return self._stream_json(
+                    app.frontend.search_stream(tenant, req), sse)
             resp = app.frontend.search(tenant, req)
             return self._send(
                 200,

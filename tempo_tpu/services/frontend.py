@@ -311,6 +311,10 @@ class _Job:
     # may execute together via batch_fn(group) -> list of results
     batch_key: tuple | None = None
     batch_fn: object = None
+    # progressive delivery: a streaming collector's condition, notified
+    # (in addition to batch_cv) whenever this job finishes so partial
+    # results flush to the client as each shard completes
+    stream_cv: threading.Condition | None = None
     # cache-affinity scheduling: the block ID this job's placement
     # hashes on (None = placement-free, claimable by anyone), the
     # monotonic stamp its steal clock runs from (set at first enqueue),
@@ -327,6 +331,10 @@ class _Job:
         if cv is not None:
             with cv:
                 cv.notify_all()
+        scv = self.stream_cv
+        if scv is not None:
+            with scv:
+                scv.notify_all()
 
 
 def decode_job_result(kind: str, out: dict):
@@ -947,6 +955,56 @@ class Frontend:
                              req.query or " ".join(
                                  f"{k}={v}" for k, v in req.tags.items()))
 
+    def _build_search_jobs(self, tenant: str, req: SearchRequest,
+                           req_d: dict, metas: list) -> list[_Job]:
+        """The search shard plan: one ingester-leg job FIRST (the
+        newest data -- streaming delivery leans on this ordering), then
+        block-batch jobs (+ row-group shard jobs for oversized
+        blocks)."""
+        jobs: list[_Job] = [_Job(
+            kind="search_recent", payload={"req": req_d},
+            fn=self.querier.search_recent, args=(tenant, req),
+        )]
+        batch: list = []
+        batch_bytes = 0
+
+        def flush_batch():
+            nonlocal batch, batch_bytes
+            if batch:
+                part = batch
+                jobs.append(_Job(
+                    kind="search_blocks",
+                    payload={"req": req_d, "block_ids": [m.block_id for m in part]},
+                    fn=self.querier.search_blocks, args=(tenant, part, req),
+                    batch_key=("search_blocks", tenant,
+                               tuple(m.block_id for m in part)),
+                    batch_fn=self._batch_search_blocks,
+                    affinity_key=part[0].block_id,
+                ))
+                batch, batch_bytes = [], 0
+
+        for m in metas:
+            size = m.size_bytes or 0
+            if size > self.batch_bytes:
+                # a single oversized block: shard it by row-group range
+                for groups in self._group_chunks(m):
+                    jobs.append(_Job(
+                        kind="search_block_shard",
+                        payload={"req": req_d, "block_id": m.block_id, "groups": groups},
+                        fn=self.querier.search_block_shard, args=(tenant, m, req, groups),
+                        batch_key=("search_block_shard", tenant, m.block_id,
+                                   tuple(groups)),
+                        batch_fn=self._batch_search_shards,
+                        affinity_key=m.block_id,
+                    ))
+                continue
+            if batch_bytes + size > self.batch_bytes or len(batch) >= MAX_BLOCKS_PER_BATCH:
+                flush_batch()
+            batch.append(m)
+            batch_bytes += size
+        flush_batch()
+        return jobs
+
     def _search(self, tenant: str, req: SearchRequest, trace=None) -> SearchResponse:
         limit = req.limit or 20
         resp = SearchResponse()
@@ -959,49 +1017,7 @@ class Frontend:
         ]
         charge = self._qos_admit(tenant, sum(m.size_bytes or 0 for m in metas))
         try:
-            jobs: list[_Job] = [_Job(
-                kind="search_recent", payload={"req": req_d},
-                fn=self.querier.search_recent, args=(tenant, req),
-            )]
-            batch: list = []
-            batch_bytes = 0
-
-            def flush_batch():
-                nonlocal batch, batch_bytes
-                if batch:
-                    part = batch
-                    jobs.append(_Job(
-                        kind="search_blocks",
-                        payload={"req": req_d, "block_ids": [m.block_id for m in part]},
-                        fn=self.querier.search_blocks, args=(tenant, part, req),
-                        batch_key=("search_blocks", tenant,
-                                   tuple(m.block_id for m in part)),
-                        batch_fn=self._batch_search_blocks,
-                        affinity_key=part[0].block_id,
-                    ))
-                    batch, batch_bytes = [], 0
-
-            for m in metas:
-                size = m.size_bytes or 0
-                if size > self.batch_bytes:
-                    # a single oversized block: shard it by row-group range
-                    for groups in self._group_chunks(m):
-                        jobs.append(_Job(
-                            kind="search_block_shard",
-                            payload={"req": req_d, "block_id": m.block_id, "groups": groups},
-                            fn=self.querier.search_block_shard, args=(tenant, m, req, groups),
-                            batch_key=("search_block_shard", tenant, m.block_id,
-                                       tuple(groups)),
-                            batch_fn=self._batch_search_shards,
-                            affinity_key=m.block_id,
-                        ))
-                    continue
-                if batch_bytes + size > self.batch_bytes or len(batch) >= MAX_BLOCKS_PER_BATCH:
-                    flush_batch()
-                batch.append(m)
-                batch_bytes += size
-            flush_batch()
-
+            jobs = self._build_search_jobs(tenant, req, req_d, metas)
             for j in jobs:
                 j.trace = trace
 
@@ -1031,6 +1047,112 @@ class Frontend:
         resp.traces.sort(key=lambda r: -r.start_time_unix_nano)
         resp.traces = resp.traces[:limit]
         return resp
+
+    # ------------------------------------------------- progressive search
+    def search_stream(self, tenant: str, req: SearchRequest):
+        """Progressive search: a generator of result snapshots, one per
+        completed shard wave, newest-first. Each yield is a dict
+        {"traces": [...], "metrics": {...}, "done": bool,
+        "jobsCompleted": n, "jobsTotal": m}; the final item has
+        done=True and is the exact /api/search response body. Jobs ride
+        the SAME queue/lease plane as blocking search -- local workers
+        and remote querier polls both complete them -- the frontend just
+        flushes the merged snapshot to the client as each completes
+        instead of holding everything until the slowest shard."""
+        from ..util.kerneltel import TEL
+        from ..util.metrics import timed
+
+        t0 = time.perf_counter()
+        try:
+            with timed(self.query_latency, 'op="search"'):
+                yield from self._search_stream(tenant, req)
+        finally:
+            TEL.record_query("search", time.perf_counter() - t0, "",
+                             req.query or " ".join(
+                                 f"{k}={v}" for k, v in req.tags.items()))
+
+    def _search_stream(self, tenant: str, req: SearchRequest):
+        limit = req.limit or 20
+        req_d = request_to_dict(req)
+        metas = [
+            m for m in self.querier.db.blocklist.metas(tenant)
+            if m.overlaps_time(req.start, req.end)
+        ]
+        charge = self._qos_admit(tenant, sum(m.size_bytes or 0 for m in metas))
+        runner = None
+        jobs: list[_Job] = []
+        try:
+            jobs = self._build_search_jobs(tenant, req, req_d, metas)
+            cv = threading.Condition()
+            for j in jobs:
+                j.stream_cv = cv
+            resp = SearchResponse()
+            lock = threading.Lock()
+
+            def early():
+                with lock:
+                    return len(resp.traces) >= limit
+
+            runner = threading.Thread(
+                target=self._run_jobs, args=(tenant, jobs),
+                kwargs={"early_exit": early}, daemon=True,
+                name="search-stream-dispatch")
+            runner.start()
+
+            def body(done: bool) -> dict:
+                with lock:
+                    traces = sorted(resp.traces,
+                                    key=lambda r: -r.start_time_unix_nano)
+                    return {
+                        "traces": [t.to_dict() for t in traces[:limit]],
+                        "metrics": {
+                            "inspectedBytes": str(resp.inspected_bytes),
+                            "inspectedSpans": str(resp.inspected_spans),
+                        },
+                        "done": done,
+                        "jobsCompleted": len(reaped),
+                        "jobsTotal": len(jobs),
+                    }
+
+            reaped: set[int] = set()
+            while len(reaped) < len(jobs):
+                with cv:
+                    if not any(j.done.is_set() and id(j) not in reaped
+                               for j in jobs):
+                        cv.wait(0.25)
+                fresh = False
+                for j in jobs:
+                    if id(j) in reaped or not j.done.is_set():
+                        continue
+                    reaped.add(id(j))
+                    # same tolerance as blocking search: a failed shard
+                    # degrades coverage, it doesn't fail the stream
+                    if j.error is None and j.result is not None:
+                        with lock:
+                            n0 = len(resp.traces)
+                            resp.merge(j.result, limit)
+                            fresh = fresh or len(resp.traces) > n0
+                if fresh and len(reaped) < len(jobs):
+                    yield body(False)
+            runner.join(timeout=5.0)
+            runner = None
+            with lock:
+                resp.traces.sort(key=lambda r: -r.start_time_unix_nano)
+                resp.traces = resp.traces[:limit]
+            yield body(True)
+        finally:
+            if runner is not None:
+                # client went away mid-stream: cancel the orphaned jobs
+                # FIRST (workers skip cancelled jobs, finish() unblocks
+                # the dispatcher), then settle the dispatcher, and only
+                # then return the byte charge -- releasing while shard
+                # jobs still run would let the tenant exceed its budget
+                for j in jobs:
+                    if not j.done.is_set():
+                        j.cancelled = True
+                        j.finish()
+                runner.join(timeout=5.0)
+            self._qos_release(tenant, charge)
 
     # ------------------------------------------------------------ metrics
     METRICS_BUCKETS_PER_JOB = 64  # time-shard unit of /api/metrics/query_range

@@ -44,8 +44,12 @@ class LiveTrace:
     start_s: int = 0
     end_s: int = 0
     # lazy search index (see _SearchEntry): built on first search touch,
-    # reused until a new segment arrives
+    # reused until a new segment arrives. The decoded trace it was built
+    # from is cached alongside (same invalidation via indexed_segments):
+    # TraceQL evaluation on an unchanged trace must never re-run
+    # combine_traces over every segment per request.
     search_index: object = None
+    decoded: object = None
     indexed_segments: int = 0
 
 
@@ -134,6 +138,29 @@ class Instance:
         # modules/ingester/instance.go:428-476)
         self.flushing: dict[bytes, LiveTrace] = {}
         self.blocks_flushed = 0
+        # live-head device engine (db/live_engine): staged columnar
+        # tails so live searches run the fused filter->top-k kernels;
+        # None = device runtime unavailable, the index path serves alone
+        try:
+            from ..db.live_engine import LiveEngine
+
+            self.live_engine = LiveEngine(self)
+        except Exception as e:  # pragma: no cover - jax-less fallback
+            # degrade loudly: every live search will take the slow index
+            # walk, and the routing counter must say WHY, or an import
+            # regression ships as an unexplained latency cliff
+            self.live_engine = None
+            import sys
+
+            print(f"tempo: live-head engine unavailable for tenant "
+                  f"{tenant!r}, falling back to index search: "
+                  f"{type(e).__name__}: {e}", file=sys.stderr)
+            try:
+                from ..util.kerneltel import TEL
+
+                TEL.record_routing("search_live", "index", "engine_init_failed")
+            except Exception:
+                pass
 
     # ---------------------------------------------------------------- push
     def push_segments(self, batch: list[tuple[bytes, int, int, bytes]]) -> None:
@@ -167,6 +194,10 @@ class Instance:
                 lt.end_s = max(lt.end_s, e)
                 self.head.append(tid, s, e, seg)
             self.head.flush()
+        if self.live_engine is not None:
+            # staging-lag clock only -- the delta decode itself happens
+            # at the next refresh, OFF this push path
+            self.live_engine.note_push([tid for tid, *_ in batch], now)
 
     # ------------------------------------------------------------ lifecycle
     def cut_complete_traces(self, force: bool = False, now: float | None = None) -> int:
@@ -262,6 +293,14 @@ class Instance:
 
     # ---------------------------------------------------------------- read
     def find_trace_by_id(self, trace_id: bytes) -> Trace | None:
+        if self.live_engine is not None:
+            return self.live_engine.find(trace_id)
+        return self._find_live_map(trace_id)
+
+    def _find_live_map(self, trace_id: bytes) -> Trace | None:
+        """Hash-map find: segments combined in live/cut/flushing order
+        (both the legacy path and the device engine materialize through
+        here, so the two routes are bit-identical by construction)."""
         with self.lock:
             segs = []
             for src in (self.live.get(trace_id), self.cut.get(trace_id),
@@ -272,68 +311,113 @@ class Instance:
             return None
         return sort_trace(combine_traces([segment_to_trace(s) for s in segs]))
 
-    def _index_of(self, lt: LiveTrace) -> tuple[_SearchEntry, Trace | None]:
+    def _index_of(self, lt: LiveTrace) -> tuple[_SearchEntry, Trace]:
         """The trace's search index, (re)built only when segments arrived
-        since the last build. Returns (entry, decoded trace when this
-        call had to decode, else None) so callers needing the full trace
-        (TraceQL) never decode twice. The segment snapshot is taken
+        since the last build; the decoded trace is cached alongside so
+        repeated TraceQL queries on an unchanged trace never re-run
+        combine_traces over every segment. The segment snapshot is taken
         under the instance lock: a segment appended mid-build must not
         be counted as indexed."""
         with self.lock:
             segs = list(lt.segments)
             idx = lt.search_index
             if idx is not None and lt.indexed_segments == len(segs):
-                return idx, None
+                return idx, lt.decoded
         tr = sort_trace(combine_traces([segment_to_trace(s) for s in segs]))
         idx = _SearchEntry.build(tr)
         with self.lock:
             lt.search_index = idx
+            lt.decoded = tr
             lt.indexed_segments = len(segs)
         return idx, tr
 
+    def _live_groups(self) -> dict:
+        """Consistent snapshot of the live head MERGED BY TRACE ID:
+        {tid: [segments, state, start_s, end_s, [LiveTrace, ...]]} with
+        segments concatenated in flushing->cut->live order (the order
+        the cut/flush lifecycle keeps prefix-stable, so the staging
+        layer's delta detection works by identity). A trace straddling
+        lifecycle states evaluates over its FULL segment set -- the same
+        contract find_trace_by_id always had."""
+        groups: dict[bytes, list] = {}
+        with self.lock:
+            for state, src in (("flushing", self.flushing), ("cut", self.cut),
+                               ("live", self.live)):
+                for tid, lt in src.items():
+                    g = groups.get(tid)
+                    if g is None:
+                        groups[tid] = [list(lt.segments), state,
+                                       lt.start_s, lt.end_s, [lt]]
+                    else:
+                        g[0].extend(lt.segments)
+                        g[1] = state  # latest lifecycle state wins
+                        g[2] = min(g[2], lt.start_s)
+                        g[3] = max(g[3], lt.end_s)
+                        g[4].append(lt)
+        return groups
+
+    def _live_entry(self, tid: bytes, lts: list, segs: list):
+        """(entry, decoded trace) for one merged live trace: the cached
+        per-LiveTrace index when the tid lives in a single lifecycle
+        dict (the overwhelmingly common case), a transient merged build
+        otherwise. BOTH the host oracle and the device engine's verify
+        step come through here -- sharing it is what makes the two
+        engines bit-identical."""
+        if len(lts) == 1:
+            return self._index_of(lts[0])
+        tr = sort_trace(combine_traces([segment_to_trace(s) for s in segs]))
+        return _SearchEntry.build(tr), tr
+
     def search_live(self, req: SearchRequest) -> SearchResponse:
-        """Live + cut traces answered from the incremental per-trace
-        search index (the reference's tempodb/search data role): tag,
-        duration and time predicates never re-decode segments; only
-        TraceQL queries evaluate on the decoded trace, and only for
-        traces that survive the time filter."""
+        """Live + cut + flushing traces through the live-head device
+        engine (db/live_engine): fused filter->top-k over staged
+        columnar tails, candidates exactly re-verified against the same
+        per-trace index the host oracle uses. Falls back to the index
+        walk when the engine is unavailable or killed."""
+        if self.live_engine is not None:
+            return self.live_engine.search(req)
+        return self.search_live_index(req)
+
+    def search_live_index(self, req: SearchRequest) -> SearchResponse:
+        """Host index walk over the merged live head -- the differential
+        oracle for the device engine and the kill-switch fallback: tag,
+        duration and time predicates come from the cached per-trace
+        search index; TraceQL evaluates on the cached decoded trace.
+        Results are newest-first (exact start_ns, trace id tiebreak),
+        truncated to the limit AFTER the sort -- the same ordering the
+        device engine's top-k produces."""
         from ..traceql.hosteval import trace_matches
         from ..traceql.parser import parse
 
         q = parse(req.query) if req.query else None
         resp = SearchResponse()
-        with self.lock:
-            items = (list(self.live.values()) + list(self.cut.values())
-                     + list(self.flushing.values()))
-        for lt in items:
-            if req.start and lt.end_s < req.start:
+        matches: list[tuple[int, str, _SearchEntry]] = []
+        for tid, (segs, _state, start_s, end_s, lts) in self._live_groups().items():
+            if req.start and end_s < req.start:
                 continue
-            if req.end and lt.start_s > req.end:
+            if req.end and start_s > req.end:
                 continue
-            idx, decoded = self._index_of(lt)
+            idx, decoded = self._live_entry(tid, lts, segs)
             if req.tags and not idx.matches_tags(req.tags):
                 continue
             if req.min_duration_ms and idx.dur_ms < req.min_duration_ms:
                 continue
             if req.max_duration_ms and idx.dur_ms > req.max_duration_ms:
                 continue
-            if q is not None:
-                tr = decoded if decoded is not None else sort_trace(
-                    combine_traces([segment_to_trace(s) for s in lt.segments])
-                )
-                if not trace_matches(q, tr):
-                    continue
+            if q is not None and not trace_matches(q, decoded):
+                continue
+            matches.append((idx.start_ns, tid.hex(), idx))
+        matches.sort(key=lambda m: (-m[0], m[1]))
+        for start_ns, tid_hex, idx in matches[: (req.limit or 20)]:
             resp.traces.append(
                 SearchResult(
-                    trace_id=lt.trace_id.hex(),
+                    trace_id=tid_hex,
                     root_service_name=idx.root_service,
                     root_trace_name=idx.root_name,
                     start_time_unix_nano=idx.start_ns,
                     duration_ms=idx.dur_ms,
                 )
             )
-            if len(resp.traces) >= (req.limit or 20):
-                break
         return resp
 
 
@@ -417,6 +501,13 @@ class Ingester:
         now = time.time()
         for inst in insts:
             inst.cut_complete_traces(force=force)
+            if inst.live_engine is not None:
+                try:
+                    # bound push->device-visible staging lag to the sweep
+                    # cadence even when no query arrives
+                    inst.live_engine.maybe_refresh()
+                except Exception:  # staging must never block cuts
+                    pass
             # per-tenant exponential backoff after a failed flush
             # (reference: flushqueues retry-with-backoff, flush.go:62-67)
             # -- a broken backend must not be hammered every sweep, and

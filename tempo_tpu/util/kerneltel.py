@@ -203,6 +203,26 @@ class KernelTelemetry:
         self._affinity: dict[str, int] = {}
         self._qos_sheds: dict[str, dict[str, int]] = {}
         self._staged_by_placement: dict[str, list[int]] = {}
+        # live-head staging (ops/livestage): slot/row occupancy by
+        # lifecycle state, delta-upload volume, push->device-visible lag
+        self.livestage_rows = Gauge(
+            "tempo_livestage_rows",
+            help="live-head staged slots by lifecycle state "
+                 "(live/cut/flushing/dead) and membership rows (rows)")
+        self.livestage_delta_bytes = Counter(
+            "tempo_livestage_delta_bytes_total",
+            help="host->device bytes uploaded by live-head staging "
+                 "refreshes (delta appends + slot columns)")
+        self.livestage_lag = Histogram(
+            "tempo_livestage_lag_seconds",
+            buckets=(0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0),
+            help="staging lag: push acknowledged -> segment visible to "
+                 "the device live engine")
+        self._livestage: dict = {
+            "slots": {}, "rows": 0, "generation": 0,
+            "uploads": 0, "full_uploads": 0, "delta_bytes": 0,
+            "delta_rows": 0, "lag_count": 0, "lag_sum": 0.0, "lag_max": 0.0,
+        }
         # every instrument exported through /metrics -- ONE list shared
         # by metrics_lines() and help_entries() so an instrument can't
         # ship samples without its HELP (or vice versa)
@@ -220,6 +240,8 @@ class KernelTelemetry:
             self.compact_passthrough_bytes, self.stream_stage_time,
             self.stream_units, self.stream_bytes_inflight,
             self.affinity_jobs, self.qos_shed, self.staged_placement,
+            self.livestage_rows, self.livestage_delta_bytes,
+            self.livestage_lag,
         )
         # full compile-key signatures, LRU-bounded (SEEN_SIGNATURES_MAX)
         self._seen: OrderedDict = OrderedDict()
@@ -598,6 +620,70 @@ class KernelTelemetry:
                     "qos_sheds": {t: dict(v)
                                   for t, v in sorted(self._qos_sheds.items())}}
 
+    # ------------------------------------------------- live-head staging
+    def set_livestage_rows(self, states: dict[str, int], rows: int,
+                           generation: int) -> None:
+        """Point-in-time occupancy after one staging refresh: slots by
+        lifecycle state plus total membership rows."""
+        try:
+            with self._lock:
+                gone = set(self._livestage["slots"]) - set(states)
+                self._livestage["slots"] = dict(states)
+                self._livestage["rows"] = int(rows)
+                self._livestage["generation"] = int(generation)
+            for state, n in states.items():
+                self.livestage_rows.set(n, labels=f'state="{state}"')
+            for state in gone:  # a drained state must read 0, not stale
+                self.livestage_rows.set(0, labels=f'state="{state}"')
+            self.livestage_rows.set(rows, labels='state="rows"')
+        except Exception:
+            pass
+
+    def record_livestage_upload(self, nbytes: int, rows: int,
+                                full: bool) -> None:
+        """One refresh moved bytes over the host->device link (a delta
+        append, or a full re-upload on bucket growth/compaction)."""
+        try:
+            self.livestage_delta_bytes.inc(nbytes)
+            with self._lock:
+                self._livestage["uploads"] += 1
+                if full:
+                    self._livestage["full_uploads"] += 1
+                self._livestage["delta_bytes"] += int(nbytes)
+                self._livestage["delta_rows"] += int(rows)
+        except Exception:
+            pass
+
+    def record_staging_lag(self, seconds: float) -> None:
+        """Push acknowledged -> segment staged (device-visible)."""
+        try:
+            self.livestage_lag.observe(float(seconds))
+            with self._lock:
+                ls = self._livestage
+                ls["lag_count"] += 1
+                ls["lag_sum"] += float(seconds)
+                ls["lag_max"] = max(ls["lag_max"], float(seconds))
+        except Exception:
+            pass
+
+    def livestage_stats(self) -> dict:
+        """Live-head staging aggregates for /status/kernels, including
+        the live-vs-host engine routing split."""
+        with self._lock:
+            out = dict(self._livestage)
+            out["slots"] = dict(self._livestage["slots"])
+            routing = {
+                f"{layer}:{engine}:{reason}": n
+                for (layer, engine, reason), n in sorted(self._routing.items())
+                if layer in ("search_live", "find_live")
+            }
+        out["lag_avg_s"] = round(
+            out["lag_sum"] / out["lag_count"], 6) if out["lag_count"] else 0.0
+        out["lag_max_s"] = round(out.pop("lag_max"), 6)
+        out.pop("lag_sum", None)
+        out["routing"] = routing
+        return out
+
     def record_passthrough(self, nbytes: int) -> None:
         """Compressed bytes a compaction output inherited verbatim."""
         try:
@@ -701,6 +787,7 @@ class KernelTelemetry:
             "batching": self.batch_stats(),
             "compaction": self.compaction_stats(),
             "stream": self.stream_stats(),
+            "livestage": self.livestage_stats(),
             "slow_queries": self.slow_queries(slow_k),
         }
 

@@ -1,0 +1,65 @@
+"""Seeded violations in the live-head staging lock/epoch shape: the
+stager's tail lock guarding slot/row mutation and the generation
+(epoch) counter, plus the staging-lag pending-push stamp lock -- the
+lock pairs ops/livestage.py and db/live_engine.py use, so the
+concurrency rules provably cover the live-stage module shape."""
+
+import threading
+
+_tails: dict[bytes, int] = {}  # trace id -> slot
+_tail_lock = threading.RLock()
+_pending_lock = threading.Lock()
+_pending_push: dict[bytes, float] = {}
+_generation = 0
+
+
+def refresh(tid, slot):
+    # sanctioned: slot assignment and the epoch bump share the tail lock,
+    # so a snapshot can never observe a half-applied generation
+    global _generation
+    with _tail_lock:
+        _tails[tid] = slot
+        _generation += 1
+        return _generation
+
+
+def refresh_racy(tid, slot):
+    global _generation
+    _tails[tid] = slot  # EXPECT: global-mutation-unlocked
+    _generation += 1  # EXPECT: global-mutation-unlocked
+    return _generation
+
+
+def note_push(tid, now):
+    with _pending_lock:
+        _pending_push.setdefault(tid, now)
+
+
+def retire_tail_then_pending(tid):
+    # sanctioned order: tail lock outer, pending-stamp lock inner
+    with _tail_lock:
+        with _pending_lock:
+            _pending_push.pop(tid, None)
+            _tails.pop(tid, None)
+
+
+def stamp_pending_then_tail(tid, now):
+    with _pending_lock:
+        with _tail_lock:  # EXPECT: lock-order
+            _tails.setdefault(tid, len(_tails))
+            _pending_push[tid] = now
+
+
+def generation_peek_unsafe():
+    _tail_lock.acquire()  # EXPECT: lock-bare-acquire
+    g = _generation
+    _tail_lock.release()
+    return g
+
+
+def generation_peek_safe():
+    _tail_lock.acquire()
+    try:
+        return _generation
+    finally:
+        _tail_lock.release()

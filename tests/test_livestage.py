@@ -1,0 +1,403 @@
+"""Live-head device engine (ops/livestage + db/live_engine) and the
+progressive streaming search plane.
+
+The load-bearing test is the randomized-interleaving differential: the
+device live engine, its numpy twin, and the host index oracle must
+return BIT-IDENTICAL results across arbitrary push/cut/flush/rotate
+interleavings -- the oracle is the legacy per-trace index walk, so any
+divergence is a staging bug, not a test artifact. The streaming test
+pins the acceptance contract: the first partial arrives before the
+slowest shard completes."""
+
+import random
+import threading
+import time
+
+import pytest
+
+from tempo_tpu.backend import MemBackend
+from tempo_tpu.db.search import SearchRequest
+from tempo_tpu.db.tempodb import TempoDB, TempoDBConfig
+from tempo_tpu.db.wal import WAL
+from tempo_tpu.services.ingester import Ingester, IngesterConfig
+from tempo_tpu.services.overrides import Overrides
+from tempo_tpu.util.kerneltel import TEL
+from tempo_tpu.util.testdata import make_trace, make_trace_id, make_traces
+from tempo_tpu.wire.segment import segment_for_write
+
+TENANT = "live-t"
+
+
+@pytest.fixture()
+def ingester(tmp_path):
+    db = TempoDB(TempoDBConfig(wal_path=str(tmp_path / "dw")), backend=MemBackend())
+    ing = Ingester(WAL(str(tmp_path / "w")), db, Overrides(), IngesterConfig())
+    yield ing
+    db.close()
+
+
+def _push_trace(inst, tid, tr):
+    lo, hi = tr.time_range_nanos()
+    s, e = lo // 10**9, hi // 10**9 + 1
+    inst.push_segments([(tid, s, e, segment_for_write(tr, s, e))])
+
+
+def _dump(resp):
+    """Full wire-relevant tuple per result: bit-identity means THESE
+    are equal, ordering included."""
+    return [(t.trace_id, t.start_time_unix_nano, t.root_service_name,
+             t.root_trace_name, t.duration_ms) for t in resp.traces]
+
+
+QUERIES = [
+    SearchRequest(limit=200),
+    SearchRequest(limit=3),
+    SearchRequest(tags={"service.name": "db"}, limit=200),
+    SearchRequest(tags={"name": "GET /api"}, limit=200),
+    SearchRequest(tags={"service.name": "db", "name": "db.query"}, limit=200),
+    SearchRequest(tags={"component": "sql"}, limit=200),
+    SearchRequest(tags={"http.method": "get"}, limit=200),  # value lowering
+    SearchRequest(tags={"no.such.key": "x"}, limit=200),
+    SearchRequest(min_duration_ms=500, limit=200),
+    SearchRequest(max_duration_ms=500, limit=200),
+    SearchRequest(min_duration_ms=100, max_duration_ms=1500, limit=5),
+    SearchRequest(start=1_700_000_000 - 50, end=1_700_000_000 + 50, limit=200),
+    SearchRequest(start=1_900_000_000, limit=200),  # nothing that new
+    SearchRequest(query='{ resource.service.name = "db" }', limit=200),
+    SearchRequest(query='{ span.http.status_code = 500 }', limit=200),
+    SearchRequest(tags={"service.name": "auth"}, min_duration_ms=200, limit=4),
+]
+
+
+def _assert_engines_identical(inst, monkeypatch, queries=QUERIES):
+    for i, req in enumerate(queries):
+        oracle = inst.search_live_index(req)
+        monkeypatch.setenv("TEMPO_LIVE_ENGINE", "device")
+        dev = inst.search_live(req)
+        monkeypatch.setenv("TEMPO_LIVE_ENGINE", "host")
+        host = inst.search_live(req)
+        monkeypatch.delenv("TEMPO_LIVE_ENGINE")
+        assert _dump(dev) == _dump(oracle), f"device != oracle on query {i}"
+        assert _dump(host) == _dump(oracle), f"host twin != oracle on query {i}"
+
+
+def test_differential_randomized_interleavings(ingester, monkeypatch):
+    """Device live search ≡ host search_live across randomized
+    push / late-segment / cut / flush / rotate interleavings."""
+    inst = ingester.instance(TENANT)
+    rng = random.Random(421)
+    known_tids = []
+    for step in range(60):
+        op = rng.random()
+        if op < 0.55 or not known_tids:
+            # push a fresh trace; spread base times so the top-k key
+            # covers both distinct-second and tied-second regimes
+            tid = make_trace_id(rng)
+            base = 1_700_000_000_000_000_000 + rng.randrange(0, 4) * 10**9 * 60
+            tr = make_trace(rng, trace_id=tid, n_spans=rng.randrange(1, 6),
+                            base_time_ns=base)
+            _push_trace(inst, tid, tr)
+            known_tids.append(tid)
+        elif op < 0.72:
+            # late segment for an existing trace (possibly already cut)
+            tid = rng.choice(known_tids)
+            tr = make_trace(rng, trace_id=tid, n_spans=rng.randrange(1, 4))
+            _push_trace(inst, tid, tr)
+        elif op < 0.85:
+            inst.cut_complete_traces(force=rng.random() < 0.5)
+        else:
+            # flush cut traces into a backend block (retires their rows)
+            # or rotate an aged head
+            inst.cut_block_if_ready(force=True)
+            known_tids = [t for t in known_tids
+                          if t in inst.live or t in inst.cut or t in inst.flushing]
+        if step % 6 == 5:
+            _assert_engines_identical(inst, monkeypatch)
+    # drain completely: the staged head must empty out too
+    inst.cut_complete_traces(force=True)
+    inst.cut_block_if_ready(force=True)
+    _assert_engines_identical(inst, monkeypatch)
+    monkeypatch.setenv("TEMPO_LIVE_ENGINE", "device")
+    assert inst.search_live(SearchRequest(limit=200)).traces == []
+
+
+def test_differential_flush_failure_restore(ingester, monkeypatch):
+    """A failed block flush restores the cut set; the staged head must
+    keep answering identically through the failure and the retry."""
+    inst = ingester.instance(TENANT)
+    for tid, tr in make_traces(12, seed=3, n_spans=4):
+        _push_trace(inst, tid, tr)
+    inst.cut_complete_traces(force=True)
+    orig = ingester.db.write_block
+    monkeypatch.setattr(ingester.db, "write_block",
+                        lambda *a, **k: (_ for _ in ()).throw(OSError("backend down")))
+    with pytest.raises(OSError):
+        inst.cut_block_if_ready(force=True)
+    _assert_engines_identical(inst, monkeypatch)
+    monkeypatch.setattr(ingester.db, "write_block", orig)
+    inst.cut_block_if_ready(force=True)
+    _assert_engines_identical(inst, monkeypatch)
+
+
+def test_find_differential(ingester, monkeypatch):
+    inst = ingester.instance(TENANT)
+    traces = make_traces(10, seed=11, n_spans=3)
+    for tid, tr in traces:
+        _push_trace(inst, tid, tr)
+    for tid, tr in traces[:4]:
+        monkeypatch.setenv("TEMPO_LIVE_FIND_DEVICE", "1")
+        dev = inst.find_trace_by_id(tid)
+        monkeypatch.delenv("TEMPO_LIVE_FIND_DEVICE")
+        host = inst.find_trace_by_id(tid)
+        assert dev is not None and host is not None
+        assert dev.span_count() == host.span_count() == tr.span_count()
+    monkeypatch.setenv("TEMPO_LIVE_FIND_DEVICE", "1")
+    assert inst.find_trace_by_id(b"\x01" * 16) is None
+    monkeypatch.delenv("TEMPO_LIVE_FIND_DEVICE")
+
+
+def test_delta_upload_moves_only_new_rows(ingester, monkeypatch):
+    """The second refresh after a small push must move a small delta,
+    not re-upload the whole head (the PCIe amortization the subsystem
+    exists for)."""
+    monkeypatch.setenv("TEMPO_LIVE_ENGINE", "device")
+    inst = ingester.instance(TENANT)
+    for tid, tr in make_traces(80, seed=5, n_spans=6):
+        _push_trace(inst, tid, tr)
+    s0 = TEL.livestage_stats()
+    inst.search_live(SearchRequest(limit=10))
+    s1 = TEL.livestage_stats()
+    full_bytes = s1["delta_bytes"] - s0["delta_bytes"]
+    assert full_bytes > 0 and s1["full_uploads"] > s0["full_uploads"]
+    # two more traces: a delta append, NOT another full upload
+    for tid, tr in make_traces(2, seed=99, n_spans=2):
+        _push_trace(inst, tid, tr)
+    inst.search_live(SearchRequest(limit=10))
+    s2 = TEL.livestage_stats()
+    delta_bytes = s2["delta_bytes"] - s1["delta_bytes"]
+    assert s2["full_uploads"] == s1["full_uploads"]
+    assert 0 < delta_bytes < full_bytes / 4
+    # an unchanged head re-serves the same generation: no upload at all
+    inst.search_live(SearchRequest(limit=10))
+    s3 = TEL.livestage_stats()
+    assert s3["delta_bytes"] == s2["delta_bytes"]
+    assert s3["generation"] == s2["generation"]
+
+
+def test_staging_lag_and_state_telemetry(ingester, monkeypatch):
+    monkeypatch.setenv("TEMPO_LIVE_ENGINE", "device")
+    inst = ingester.instance(TENANT)
+    lag0 = TEL.livestage_stats()["lag_count"]
+    for tid, tr in make_traces(5, seed=8, n_spans=3):
+        _push_trace(inst, tid, tr)
+    inst.search_live(SearchRequest(limit=10))
+    st = TEL.livestage_stats()
+    assert st["lag_count"] >= lag0 + 5
+    assert st["slots"].get("live", 0) == 5
+    routing = TEL.routing_counts()
+    assert any(layer == "search_live" and engine == "device"
+               for (layer, engine, _r) in routing)
+
+
+def test_traceql_decode_cached_when_unchanged(ingester, monkeypatch):
+    """Satellite regression: repeated TraceQL live searches on an
+    unchanged trace must not re-run combine_traces over every segment
+    (the decoded trace is cached alongside the search index)."""
+    import tempo_tpu.services.ingester as ing_mod
+
+    inst = ingester.instance(TENANT)
+    for tid, tr in make_traces(6, seed=21, n_spans=4):
+        _push_trace(inst, tid, tr)
+    req = SearchRequest(query='{ resource.service.name = "db" }', limit=50)
+    monkeypatch.setenv("TEMPO_LIVE_ENGINE", "index")
+    inst.search_live(req)  # builds index + cached decode
+    calls = []
+    orig = ing_mod.segment_to_trace
+    monkeypatch.setattr(ing_mod, "segment_to_trace",
+                        lambda seg: calls.append(1) or orig(seg))
+    inst.search_live(req)
+    assert not calls, "unchanged live traces were re-decoded"
+    # a new segment invalidates exactly that trace
+    tid0 = next(iter(inst.live))
+    _push_trace(inst, tid0, make_trace(3, trace_id=tid0, n_spans=2))
+    inst.search_live(req)
+    assert len(calls) == len(inst.live[tid0].segments)
+
+
+def test_compaction_rebuild_after_churn(ingester, monkeypatch):
+    """Repeated push->flush churn retires most slots; the stager must
+    compact its tails and keep answering identically."""
+    inst = ingester.instance(TENANT)
+    monkeypatch.setenv("TEMPO_LIVE_ENGINE", "device")
+    for round_i in range(4):
+        for tid, tr in make_traces(15, seed=100 + round_i, n_spans=3):
+            _push_trace(inst, tid, tr)
+        inst.search_live(SearchRequest(limit=5))  # stage this round
+        inst.cut_complete_traces(force=True)
+        inst.cut_block_if_ready(force=True)
+        inst.search_live(SearchRequest(limit=5))  # observe retirement
+    eng = inst.live_engine
+    assert eng.stager.dead_slots <= eng.stager.n_slots  # compacted at least once
+    assert eng.stager.n_slots < 60  # 4x15 pushed; dead rounds were reclaimed
+    monkeypatch.delenv("TEMPO_LIVE_ENGINE")
+    for tid, tr in make_traces(10, seed=777, n_spans=3):
+        _push_trace(inst, tid, tr)
+    _assert_engines_identical(inst, monkeypatch)
+
+
+def test_concurrent_push_and_search_no_slot_thrash(ingester, monkeypatch):
+    """Concurrent pushes + searches must never retire-and-restage a
+    live trace: the engine serializes the groups snapshot with the
+    stager reconcile, so a stale snapshot can't reach refresh after a
+    newer one (dead slots only ever come from real cut/flush)."""
+    monkeypatch.setenv("TEMPO_LIVE_ENGINE", "device")
+    inst = ingester.instance(TENANT)
+    stop = threading.Event()
+    errors: list = []
+
+    def pusher(seed):
+        rng = random.Random(seed)
+        try:
+            while not stop.is_set():
+                tid = make_trace_id(rng)
+                _push_trace(inst, tid, make_trace(rng, trace_id=tid, n_spans=2))
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    def searcher():
+        try:
+            while not stop.is_set():
+                inst.search_live(SearchRequest(limit=10))
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = ([threading.Thread(target=pusher, args=(i,)) for i in range(2)]
+               + [threading.Thread(target=searcher) for _ in range(3)])
+    for t in threads:
+        t.start()
+    time.sleep(1.5)
+    stop.set()
+    for t in threads:
+        t.join(10)
+    assert not errors, errors
+    assert inst.live_engine.stager.dead_slots == 0
+    _assert_engines_identical(inst, monkeypatch)
+
+
+# ------------------------------------------------------------ streaming
+
+
+@pytest.fixture()
+def pipeline(tmp_path):
+    from tempo_tpu.ring.ring import InMemoryKV, Lifecycler, Ring
+    from tempo_tpu.services.distributor import Distributor
+    from tempo_tpu.services.frontend import Frontend
+    from tempo_tpu.services.querier import Querier
+
+    db = TempoDB(TempoDBConfig(wal_path=str(tmp_path / "dw")), backend=MemBackend())
+    wal = WAL(str(tmp_path / "w"))
+    ov = Overrides()
+    ing = Ingester(wal, db, ov, IngesterConfig(max_trace_idle_s=0.0,
+                                               max_block_age_s=0.0))
+    kv = InMemoryKV()
+    lc = Lifecycler(kv, "ing", "i0")
+    lc.join()
+    ring = Ring(kv, "ing", replication_factor=1)
+    clients = {lc.desc.addr: ing}
+    dist = Distributor(ring, clients.__getitem__, ov)
+    q = Querier(db, ring, clients.__getitem__)
+    fe = Frontend(q, n_workers=4)
+    yield db, ing, dist, q, fe
+    fe.stop()
+    db.close()
+
+
+def test_stream_first_partial_before_slowest_shard(pipeline):
+    """Acceptance: stream=true delivers a newest-first partial BEFORE
+    full query completion -- the ingester leg lands while a backend
+    shard is still running."""
+    db, ing, dist, q, fe = pipeline
+    traces = make_traces(20, seed=5, n_spans=4)
+    for tid, tr in traces[:10]:
+        dist.push(TENANT, tr.resource_spans)
+    ing.sweep_all(force=True)  # 10 traces into a backend block
+    for tid, tr in traces[10:]:
+        dist.push(TENANT, tr.resource_spans)  # 10 stay live
+
+    slow_done = threading.Event()
+    orig = q.search_blocks
+
+    def slow_search_blocks(tenant, metas, req):
+        time.sleep(0.6)
+        slow_done.set()
+        return orig(tenant, metas, req)
+
+    q.search_blocks = slow_search_blocks
+    events = []
+    for ev in fe.search_stream(TENANT, SearchRequest(limit=100)):
+        events.append((slow_done.is_set(), ev))
+    assert len(events) >= 2
+    first_slow_seen, first = events[0]
+    assert first_slow_seen is False, "first partial waited for the slowest shard"
+    assert first["done"] is False and first["jobsCompleted"] < first["jobsTotal"]
+    assert first["traces"], "the ingester partial carries the newest data"
+    # partials are newest-first
+    starts = [int(t["startTimeUnixNano"]) for t in first["traces"]]
+    assert starts == sorted(starts, reverse=True)
+    done_flag, final = events[-1]
+    assert done_flag and final["done"] is True
+    assert final["jobsCompleted"] == final["jobsTotal"]
+    assert len(final["traces"]) == 20
+    # the final streamed body matches the blocking response exactly
+    blocking = fe.search(TENANT, SearchRequest(limit=100))
+    assert final["traces"] == [t.to_dict() for t in blocking.traces]
+
+
+def test_stream_failed_shard_degrades_not_fails(pipeline):
+    db, ing, dist, q, fe = pipeline
+    traces = make_traces(12, seed=6, n_spans=3)
+    for tid, tr in traces[:6]:
+        dist.push(TENANT, tr.resource_spans)
+    ing.sweep_all(force=True)
+    for tid, tr in traces[6:]:
+        dist.push(TENANT, tr.resource_spans)
+
+    def broken(tenant, metas, req):
+        raise ValueError("shard poisoned")  # non-retryable
+
+    q.search_blocks = broken
+    out = list(fe.search_stream(TENANT, SearchRequest(limit=100)))
+    assert out[-1]["done"] is True
+    assert len(out[-1]["traces"]) == 6  # ingester leg still answered
+
+
+def test_stream_http_chunked_sse(tmp_path):
+    """End to end over HTTP: /api/search?stream=sse emits chunked SSE
+    events, final event identical to the blocking response body."""
+    import http.client
+    import json as _json
+
+    from tempo_tpu.services.app import App, AppConfig
+
+    app = App(AppConfig(target="all", http_port=0,
+                        storage_path=str(tmp_path / "data"),
+                        enable_generator=False))
+    app.start()
+    app.serve_http(background=True)
+    try:
+        port = app.http_server.server_address[1]
+        for tid, tr in make_traces(8, seed=9, n_spans=3):
+            app.distributor.push("single-tenant", tr.resource_spans)
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+        conn.request("GET", "/api/search?limit=50&stream=sse")
+        r = conn.getresponse()
+        assert r.status == 200
+        assert r.headers["Content-Type"] == "text/event-stream"
+        body = r.read().decode()
+        events = [_json.loads(line[len("data: "):])
+                  for line in body.split("\n") if line.startswith("data: ")]
+        assert events and events[-1]["done"] is True
+        assert len(events[-1]["traces"]) == 8
+        conn.close()
+    finally:
+        app.stop()
