@@ -774,6 +774,30 @@ def bench_search_concurrent(tmp: str) -> None:
         "launches_per_query": round(launches / (Q * iters), 3),
         "batch_occupancy": round(queries / groups, 2) if groups else 0.0,
     })
+
+    # tracing-on overhead on the SAME warm batched shape: the timeline
+    # spine's hot-path cost is clock reads + locked appends, so this
+    # ratio must stay ~1.0 (the test suite asserts < 1.05)
+    from tempo_tpu.services.selftrace import SelfTracer
+
+    st = SelfTracer(lambda tenant, rss: None)
+
+    def one_traced(_):
+        with st.trace("bench") as t:
+            token = TEL.set_active_trace(t)
+            t0 = time.perf_counter()
+            try:
+                db.search_blocks("bench", [meta], req)
+            finally:
+                TEL.reset_active_trace(token)
+            return time.perf_counter() - t0
+
+    lats_tr: list[float] = []
+    for _ in range(iters):
+        with ThreadPoolExecutor(Q) as ex:
+            lats_tr.extend(ex.map(one_traced, range(Q)))
+    tel["selftrace_overhead_ratio"] = round(
+        float(np.median(lats_tr)) / max(float(np.median(lats)), 1e-9), 4)
     _emit("search_concurrent_p50_ms", float(np.median(lats)) * 1e3, "ms",
           0.0, tel=tel)
     db.close()

@@ -1,0 +1,42 @@
+#!/usr/bin/env bash
+# Tier-1 pass-count regression guard (verify half).
+#
+# Runs the ROADMAP tier-1 command verbatim and asserts DOTS_PASSED
+# against the committed floor in TIER1_BASELINE.json -- a green suite
+# that quietly passes FEWER tests than the baseline fails here. The
+# static twin (tests/test_baseline_count.py) guards the test-function
+# count from inside the suite itself.
+#
+# Usage: scripts/verify_tier1.sh   (from the repo root)
+set -u
+cd "$(dirname "$0")/.."
+
+FLOOR=$(python -c "import json; print(json.load(open('TIER1_BASELINE.json'))['dots_passed_floor'])")
+
+set -o pipefail
+rm -f /tmp/_t1.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+  --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly \
+  2>&1 | tee /tmp/_t1.log
+rc=${PIPESTATUS[0]}
+DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
+echo "DOTS_PASSED=${DOTS_PASSED} (floor ${FLOOR})"
+# Gate on FAILURES and the pass-count floor, not on pytest's raw exit
+# code: environment-gated suites (e.g. proto interop without protoc on
+# PATH) error at collection on images that can't run them, and the
+# committed floor already prices that in. A REAL collection regression
+# (a test module that stops importing) drops DOTS_PASSED below the
+# floor and fails here.
+if grep -aqE '[0-9]+ failed' /tmp/_t1.log; then
+  echo "tier-1 FAILED (test failures; exit $rc)"
+  exit 1
+fi
+if [ "$DOTS_PASSED" -lt "$FLOOR" ]; then
+  echo "tier-1 regression: DOTS_PASSED ${DOTS_PASSED} < floor ${FLOOR} (TIER1_BASELINE.json)"
+  exit 1
+fi
+if [ "$rc" -ne 0 ]; then
+  echo "tier-1 OK with env-gated collection errors (exit $rc tolerated; floor held)"
+  exit 0
+fi
+echo "tier-1 OK"

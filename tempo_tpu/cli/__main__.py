@@ -154,6 +154,104 @@ def cmd_stream_search(args):
                           "metrics": last.get("metrics", {})}, indent=2))
 
 
+def _render_timeline(tr) -> None:
+    """Render a self-trace as an indented timeline tree: per span its
+    wall time, offset from the root, and attrs -- the flame view of one
+    query's life across frontend, queue, engines and remote legs."""
+    spans = [sp for _, _, sp in tr.all_spans()]
+    if not spans:
+        print("(empty trace)")
+        return
+    by_id = {sp.span_id: sp for sp in spans}
+    children: dict[bytes, list] = {}
+    roots = []
+    for sp in spans:
+        if sp.parent_span_id and sp.parent_span_id in by_id:
+            children.setdefault(sp.parent_span_id, []).append(sp)
+        else:
+            roots.append(sp)
+    roots.sort(key=lambda s: s.start_unix_nano)
+    t0 = roots[0].start_unix_nano
+
+    def fmt_attrs(attrs: dict) -> str:
+        parts = []
+        for k in sorted(attrs):
+            v = attrs[k]
+            parts.append(f"{k}={v}")
+        return ("  [" + " ".join(parts) + "]") if parts else ""
+
+    def walk(sp, prefix: str, last: bool, top: bool) -> None:
+        dur_ms = max(0, sp.end_unix_nano - sp.start_unix_nano) / 1e6
+        off_ms = (sp.start_unix_nano - t0) / 1e6
+        branch = "" if top else ("└─ " if last else "├─ ")
+        print(f"{prefix}{branch}{sp.name}  {dur_ms:.2f}ms @+{off_ms:.2f}ms"
+              f"{fmt_attrs(sp.attrs)}")
+        kids = sorted(children.get(sp.span_id, []),
+                      key=lambda s: s.start_unix_nano)
+        ext = "" if top else ("   " if last else "│  ")
+        for i, k in enumerate(kids):
+            walk(k, prefix + ext, i == len(kids) - 1, False)
+
+    for i, r in enumerate(roots):
+        walk(r, "", i == len(roots) - 1, True)
+
+
+def cmd_self_trace(args):
+    """Dogfood: fetch one of the system's OWN query traces through the
+    system's own find-by-ID path and render the timeline tree. `latest`
+    resolves the most recent self-traced query from /status/kernels'
+    slow-query log. With --target unset, reads flushed self-tenant
+    blocks straight off the backend path (offline mode)."""
+    import urllib.error
+    import urllib.request
+
+    from ..util.traceid import parse_trace_id
+    from ..wire import otlp_json
+
+    trace_id = args.trace_id
+    if args.target:
+        base = args.target.rstrip("/")
+        if trace_id == "latest":
+            with urllib.request.urlopen(base + "/status/kernels",
+                                        timeout=args.timeout) as r:
+                status = json.load(r)
+            logged = sorted(
+                (q for q in status.get("slow_queries", [])
+                 if q.get("self_trace_id")),
+                key=lambda q: -q.get("at_unix", 0))
+            if not logged:
+                print("no self-traced queries in the slow-query log "
+                      "(is --self-tracing.tenant set?)", file=sys.stderr)
+                sys.exit(1)
+            trace_id = logged[0]["self_trace_id"]
+            print(f"latest self-traced {logged[0]['op']} query: {trace_id} "
+                  f"({logged[0]['seconds'] * 1e3:.1f}ms)", file=sys.stderr)
+        req = urllib.request.Request(
+            f"{base}/api/traces/{trace_id}",
+            headers={"X-Scope-OrgID": args.tenant})
+        try:
+            with urllib.request.urlopen(req, timeout=args.timeout) as r:
+                tr = otlp_json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            print(f"trace {trace_id} not found under tenant {args.tenant!r}: "
+                  f"{e.code} (still in the live head? it is searchable "
+                  f"there too)", file=sys.stderr)
+            sys.exit(1)
+    else:
+        if trace_id == "latest":
+            print("self-trace latest needs --target (a running instance)",
+                  file=sys.stderr)
+            sys.exit(1)
+        db = _open_db(args.backend)
+        tr = db.find_trace_by_id(args.tenant, parse_trace_id(trace_id))
+        db.close()
+        if tr is None:
+            print(f"trace {trace_id} not found in backend tenant "
+                  f"{args.tenant!r}", file=sys.stderr)
+            sys.exit(1)
+    _render_timeline(tr)
+
+
 def cmd_query_range(args):
     """Offline TraceQL metrics over a backend path: the CLI face of
     /api/metrics/query_range (db/metrics_exec), Prometheus matrix JSON
@@ -333,6 +431,21 @@ def main(argv=None):
                    help="query only the last N seconds (the live-head shape)")
     p.add_argument("--timeout", type=float, default=60.0)
     p.set_defaults(fn=cmd_stream_search)
+
+    p = sub.add_parser("self-trace",
+                       help="fetch + render one of the system's own query "
+                            "timelines (the self tenant) as a span tree; "
+                            "`latest` picks the most recent self-traced "
+                            "query from /status/kernels")
+    p.add_argument("trace_id", help="self-trace id (hex) or `latest`")
+    p.add_argument("--target", default="",
+                   help="base URL of a running instance (uses the system's "
+                        "own find path incl. the live head); empty = read "
+                        "flushed blocks from --backend.path")
+    p.add_argument("--tenant", default="self",
+                   help="self-tracing tenant (default: self)")
+    p.add_argument("--timeout", type=float, default=30.0)
+    p.set_defaults(fn=cmd_self_trace)
 
     p = sub.add_parser("query-range",
                        help="TraceQL metrics range query against the backend")

@@ -147,6 +147,7 @@ class BatchExecutor:
         from ..util.kerneltel import TEL
 
         t0 = time.monotonic()
+        t0_wall = time.time()
         # lone-query fast path: only hold the window open when another
         # SUBMITTER is inside the executor (each counts once in
         # _inflight no matter how many items it carries; the leader
@@ -164,6 +165,10 @@ class BatchExecutor:
                 del self._groups[key]
             items = list(g.items)
         wait_s = time.monotonic() - t0
+        # timeline: the admission window this leader held open (zero-
+        # length on the lone-query fast path), with its final occupancy
+        TEL.child_span("batch-window", t0_wall, t0_wall + wait_s,
+                       {"executor": self.name, "occupancy": len(items)})
         try:
             results = self.runner(key, items)
             if not isinstance(results, list) or len(results) != len(items):

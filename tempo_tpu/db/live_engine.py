@@ -199,6 +199,7 @@ class LiveEngine:
 
         TEL.record_routing("search_live", engine, reason)
         t0 = time.perf_counter()
+        t0_wall = time.time()
         if engine == "device":
             mask = eval_live_device(snap, tag_codes, name_codes,
                                     req.start, req.end, req.min_duration_ms)
@@ -218,6 +219,9 @@ class LiveEngine:
 
         resp = self._collect(snap, groups, req, q, selector)
         self._observe_engine(engine, rows, time.perf_counter() - t0)
+        # timeline: the ingester live-head leg with its routing verdict
+        TEL.child_span("live:search", t0_wall, time.time(),
+                       {"engine": engine, "reason": reason, "rows": rows})
         return resp
 
     def _collect(self, snap, groups, req: SearchRequest, q, selector) -> SearchResponse:

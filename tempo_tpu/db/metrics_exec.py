@@ -676,6 +676,101 @@ def _label_value(v) -> str | None:
     return None
 
 
+def _fold_params(q: MetricsQuery) -> tuple:
+    """(filt, count_fn, fname, vscale) shared by every exact fold --
+    the block engine and the live-head engine must scale duration-typed
+    fold values identically or their series disagree."""
+    filt = Pipeline(q.filter, q.stages) if q.stages else q.filter
+    agg = q.agg
+    count_fn = agg.fn in ("rate", "count_over_time")
+    fname = {"sum_over_time": "vsum", "avg_over_time": "vsum",
+             "min_over_time": "vmin", "max_over_time": "vmax"}.get(agg.fn)
+    # duration-typed fold values are SECONDS on the wire (the columnar
+    # engines fold span.start/end_ns deltas / 1e9); the exact evaluator
+    # yields nanoseconds, so scale by the argument's static type
+    vscale = 1.0
+    if agg.field is not None:
+        from ..traceql.validate import _expr_type
+
+        try:
+            if _expr_type(agg.field) == "duration":
+                vscale = 1e-9
+        except Exception:
+            pass
+    return filt, count_fn, fname, vscale
+
+
+def _fold_span(local: dict, agg, sp, res, ctx, b: int, nb: int,
+               count_fn: bool, fname, vscale: float) -> None:
+    """Fold ONE matched span into the per-label state dict -- the inner
+    accumulator every exact engine shares."""
+    from ..traceql.hosteval import _is_num, _value
+
+    labels = []
+    for f in agg.by:
+        lv = _label_value(_value(f, sp, res, ctx))
+        if lv is None:
+            return
+        labels.append(lv)
+    key = tuple(labels)
+    state = local.get(key)
+    if state is None:
+        _check_cardinality(len(local) + 1, nb)
+    if count_fn:
+        if state is None:
+            state = local[key] = {"count": np.zeros(nb, np.int64)}
+        state["count"][b] += 1
+        return
+    v = _value(agg.field, sp, res, ctx)
+    if not _is_num(v):
+        return
+    if state is None:
+        varr = (np.zeros(nb, np.float64) if fname == "vsum"
+                else np.full(nb, _FIELD_INIT[fname], np.float64))
+        state = local[key] = {"vcnt": np.zeros(nb, np.int64),
+                              fname: varr}
+    state["vcnt"][b] += 1
+    v = float(v) * vscale
+    if fname == "vsum":
+        state[fname][b] += v
+    elif fname == "vmin":
+        state[fname][b] = min(state[fname][b], v)
+    else:
+        state[fname][b] = max(state[fname][b], v)
+
+
+def metrics_live_traces(traces, q: MetricsQuery, req: MetricsRequest,
+                        resp: MetricsResponse) -> None:
+    """Fold DECODED live traces (the ingester's merged live head) into
+    resp with the exact host evaluator -- the host-twin leg that makes
+    unflushed spans visible to TraceQL metrics (ROADMAP #4 follow-up).
+    Buckets use absolute span-start ms on the request's step grid.
+    The block engines floor through the block base (base_ms + rel_ms,
+    the columnar ms encoding), so a span within 1 ms of a step edge
+    inside a block whose base_ns has a sub-ms remainder can land one
+    bucket differently after flush -- bounded at 1 ms, irreducible
+    without re-encoding blocks, and invisible at realistic steps."""
+    from ..traceql.hosteval import _matched_spans, _TraceCtx
+
+    filt, count_fn, fname, vscale = _fold_params(q)
+    agg = q.agg
+    nb = req.n_buckets
+    local: dict[tuple, dict[str, np.ndarray]] = {}
+    n_spans = 0
+    for tr in traces:
+        ctx = _TraceCtx(tr)
+        for sp, res in _matched_spans(filt, ctx):
+            n_spans += 1
+            b = (sp.start_unix_nano // 1_000_000 - req.start_ms) // req.step_ms
+            if not 0 <= b < nb:
+                continue
+            _fold_span(local, agg, sp, res, ctx, int(b), nb,
+                       count_fn, fname, vscale)
+    for key, state in local.items():
+        resp.add_partial(key, state, 0)
+    resp.inspected_spans += n_spans
+
+
 def _metrics_block_exact(blk: BackendBlock, q: MetricsQuery, req: MetricsRequest,
                          resp: MetricsResponse, planned, b_off: int, nb: int) -> None:
     """Exact engine: the conservative columnar mask narrows the
@@ -684,7 +779,7 @@ def _metrics_block_exact(blk: BackendBlock, q: MetricsQuery, req: MetricsRequest
     lossy leaves). Folds use exact span start times under the SAME
     floored-ms bucket definition as the columnar engines."""
     from ..ops.hostfilter import eval_span_mask_host
-    from ..traceql.hosteval import _is_num, _matched_spans, _TraceCtx, _value
+    from ..traceql.hosteval import _matched_spans, _TraceCtx
 
     n_traces = blk.meta.total_traces
     n_spans = blk.pack.axes["span"].n_rows if "span" in blk.pack.axes else 0
@@ -709,26 +804,11 @@ def _metrics_block_exact(blk: BackendBlock, q: MetricsQuery, req: MetricsRequest
     resp.inspected_spans += n_spans
     if not sids:
         return
-    filt = Pipeline(q.filter, q.stages) if q.stages else q.filter
+    filt, count_fn, fname, vscale = _fold_params(q)
     base_ns = blk.meta.start_time_unix_nano
     base_ms = base_ns // 1_000_000
     t0_abs = req.start_ms + b_off * req.step_ms
     agg = q.agg
-    count_fn = agg.fn in ("rate", "count_over_time")
-    fname = {"sum_over_time": "vsum", "avg_over_time": "vsum",
-             "min_over_time": "vmin", "max_over_time": "vmax"}.get(agg.fn)
-    # duration-typed fold values are SECONDS on the wire (the columnar
-    # engines fold span.start/end_ns deltas / 1e9); the exact evaluator
-    # yields nanoseconds, so scale by the argument's static type
-    vscale = 1.0
-    if agg.field is not None:
-        from ..traceql.validate import _expr_type
-
-        try:
-            if _expr_type(agg.field) == "duration":
-                vscale = 1e-9
-        except Exception:
-            pass
     local: dict[tuple, dict[str, np.ndarray]] = {}
     for lo in range(0, len(sids), 512):  # bounded materialization
         for tr in blk.materialize_traces(sids[lo:lo + 512]):
@@ -738,38 +818,8 @@ def _metrics_block_exact(blk: BackendBlock, q: MetricsQuery, req: MetricsRequest
                 b = (base_ms + rel_ms - t0_abs) // req.step_ms
                 if not 0 <= b < nb:
                     continue
-                labels = []
-                for f in agg.by:
-                    lv = _label_value(_value(f, sp, res, ctx))
-                    if lv is None:
-                        break
-                    labels.append(lv)
-                else:
-                    key = tuple(labels)
-                    state = local.get(key)
-                    if state is None:
-                        _check_cardinality(len(local) + 1, nb)
-                    if count_fn:
-                        if state is None:
-                            state = local[key] = {"count": np.zeros(nb, np.int64)}
-                        state["count"][b] += 1
-                        continue
-                    v = _value(agg.field, sp, res, ctx)
-                    if not _is_num(v):
-                        continue
-                    if state is None:
-                        varr = (np.zeros(nb, np.float64) if fname == "vsum"
-                                else np.full(nb, _FIELD_INIT[fname], np.float64))
-                        state = local[key] = {"vcnt": np.zeros(nb, np.int64),
-                                              fname: varr}
-                    state["vcnt"][b] += 1
-                    v = float(v) * vscale
-                    if fname == "vsum":
-                        state[fname][b] += v
-                    elif fname == "vmin":
-                        state[fname][b] = min(state[fname][b], v)
-                    else:
-                        state[fname][b] = max(state[fname][b], v)
+                _fold_span(local, agg, sp, res, ctx, int(b), nb,
+                           count_fn, fname, vscale)
     for key, state in local.items():
         resp.add_partial(key, state, b_off)
 

@@ -246,15 +246,26 @@ def _verify_candidates(blk: BackendBlock, req: SearchRequest, sids, needs_verify
     was conservative. Bounded: callers pass at most the escalation k."""
     if not (needs_verify and req.query and len(sids)):
         return sids
+    import time as _time
+
     from ..traceql.hosteval import trace_matches
     from ..traceql.parser import parse
+    from ..util.kerneltel import TEL
 
+    t0_wall = _time.time()
     q = parse(req.query)
     traces = blk.materialize_traces([int(s) for s in sids])
-    return np.asarray(
+    out = np.asarray(
         [s for s, tr in zip(sids, traces) if tr is not None and trace_matches(q, tr)],
         dtype=np.int64,
     )
+    # timeline + cost: the exact-verify leg (conservative device mask ->
+    # host re-check) of this block's evaluation
+    TEL.child_span("verify", t0_wall, _time.time(),
+                   {"block": blk.meta.block_id[:8],
+                    "rows": int(len(sids)), "kept": int(out.shape[0])})
+    TEL.add_query_cost("rows_verified", int(len(sids)))
+    return out
 
 
 def _candidates(

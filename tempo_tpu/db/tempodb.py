@@ -12,7 +12,6 @@ from __future__ import annotations
 import os
 import threading
 import time
-from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -64,7 +63,11 @@ class TempoDB:
         self.wal = WAL(os.path.join(cfg.wal_path, "wal"))
         self.blocklist = Blocklist()
         self.poller = Poller(self.backend)
-        self.pool = ThreadPoolExecutor(max_workers=cfg.pool_workers)
+        # context-propagating: pooled engine legs keep the caller's
+        # ambient self-trace + affinity placement (util/ctxpool)
+        from ..util.ctxpool import ContextThreadPool
+
+        self.pool = ContextThreadPool(max_workers=cfg.pool_workers)
         # fan-out pool for the query engines: on a 1-core box with a
         # LOCAL backend the handoffs only add GIL ping-pong (~20% of a
         # cold scan), so every engine gets None and runs serial; remote

@@ -368,18 +368,26 @@ def upload_stage(blk: BackendBlock, plan: StagePlan, staged: StagedBlock,
                  padded: dict, real_rows: dict) -> StagedBlock:
     """The host->device phase: one batched transfer + the query-
     independent res->span materialization."""
+    import time as _time
+
     from ..util.kerneltel import TEL
 
+    t0_wall = _time.time()
     # ONE batched transfer for the whole block: per-array device_puts
     # each pay a full link round trip on a high-latency tunnel
     staged.cols = dict(zip(padded, jax.device_put(list(padded.values()))))
     # telemetry: upload volume + padding waste (padded vs real rows
     # summed per column -- columns live on different axes)
+    nbytes = sum(int(a.nbytes) for a in padded.values())
     TEL.record_transfer(
-        sum(int(a.nbytes) for a in padded.values()),
+        nbytes,
         sum(real_rows.values()),
         sum(int(a.shape[0]) for a in padded.values()),
     )
+    # timeline span for the active self-trace: this is THE host->device
+    # upload, whether a warm staging miss or a stream-pipeline unit
+    TEL.child_span("stream:upload", t0_wall, _time.time(),
+                   {"bytes": nbytes, "block": blk.meta.block_id[:8]})
 
     # materialize requested res columns at SPAN level: the res->span
     # broadcast gather is query-independent, so paying it once here

@@ -95,14 +95,19 @@ _pool_lock = threading.Lock()
 
 
 def _executor() -> ThreadPoolExecutor:
-    """The shared stage executor, sized once (TEMPO_STREAM_WORKERS)."""
+    """The shared stage executor, sized once (TEMPO_STREAM_WORKERS).
+    Context-propagating (util/ctxpool): stage timings/spans recorded on
+    pool threads keep the submitting query's ambient self-trace +
+    affinity placement."""
     global _pool
     with _pool_lock:
         if _pool is None:
+            from ..util.ctxpool import ContextThreadPool
+
             workers = _env_int("TEMPO_STREAM_WORKERS", 0)
             if workers <= 0:
                 workers = max(4, (os.cpu_count() or 8) // 2)
-            _pool = ThreadPoolExecutor(
+            _pool = ContextThreadPool(
                 max_workers=workers, thread_name_prefix="stream-stage")
         return _pool
 

@@ -416,8 +416,17 @@ class App:
                 )
 
     # ------------------------------------------------------------ tenant
-    def tenant_of(self, headers) -> str:
+    def tenant_of(self, headers, read: bool = False) -> str:
         if not self.cfg.multitenancy:
+            t = headers.get(TENANT_HEADER, "")
+            if read and t and t == self.cfg.self_tracing_tenant:
+                # READ-only carve-out: the self-tracing tenant stays
+                # queryable in single-tenant mode so the dogfood loop
+                # (tempo-cli self-trace) works against the plain dev
+                # app. Ingest never honors the header here -- a client
+                # must not be able to push spoofed spans into the
+                # system's own diagnostic tenant.
+                return t
             return DEFAULT_TENANT
         t = headers.get(TENANT_HEADER, "")
         if not t:
@@ -608,7 +617,7 @@ def _make_handler(app: App):
                         app._profile_lock.release()
                 if app.querier is None:
                     return self._err(404, f"target {app.cfg.target} serves no query API")
-                tenant = app.tenant_of(self.headers)
+                tenant = app.tenant_of(self.headers, read=True)
                 m = re.fullmatch(r"/api/traces/([0-9a-fA-F]+)", u.path)
                 if m:
                     return self._trace_by_id(tenant, m.group(1), q)
@@ -1136,6 +1145,10 @@ def main(argv=None):
                     type=int, default=None,
                     help="Jaeger agent UDP compact port; binary opens at +1 "
                          "(0=off, -1=ephemeral)")
+    ap.add_argument("--self-tracing.tenant", dest="self_tracing_tenant",
+                    default=None,
+                    help="tenant the app's own query timelines ship into "
+                         "('' = off); inspect with tempo-cli self-trace")
     ap.add_argument("--querier.search-external-endpoints", dest="search_external",
                     default=None,
                     help="comma-separated serverless search handler URLs")
@@ -1166,6 +1179,7 @@ def main(argv=None):
         "opencensus_grpc_port": args.opencensus_grpc_port,
         "jaeger_grpc_port": args.jaeger_grpc_port,
         "jaeger_agent_port": args.jaeger_agent_port,
+        "self_tracing_tenant": args.self_tracing_tenant,
         "search_external_endpoints": args.search_external,
         "kafka_brokers": args.kafka_brokers,
         "kafka_topic": args.kafka_topic,

@@ -304,8 +304,13 @@ class _Job:
     done_at: float = 0.0  # wall clock
     batch_cv: threading.Condition | None = None
     # active SelfTracer trace, parked in the kerneltel contextvar around
-    # local execution so engine code can attach per-block kernel spans
+    # local execution so engine code can attach per-block kernel spans;
+    # span_id is this job's PRE-ASSIGNED span in that trace (engine
+    # spans nest under it; remote legs parent onto it over the wire),
+    # dequeued_wall closes the queue-wait span
     trace: object = None
+    span_id: bytes = b""
+    dequeued_wall: float = 0.0
     # cross-query coalescing: jobs sharing a non-None batch_key target
     # the same data unit (block batch / shard / candidate partition) and
     # may execute together via batch_fn(group) -> list of results
@@ -335,6 +340,19 @@ class _Job:
         if scv is not None:
             with scv:
                 scv.notify_all()
+
+
+def attach_trace(jobs: list, trace) -> None:
+    """Bind jobs to the active self-trace, pre-assigning each job's
+    span id so the span EXISTS as an address before the job runs:
+    nested engine spans and remote-leg spans parent onto it, and
+    _emit_self_trace materializes it retroactively with the measured
+    times."""
+    if trace is None:
+        return
+    for j in jobs:
+        j.trace = trace
+        j.span_id = os.urandom(8)
 
 
 def decode_job_result(kind: str, out: dict):
@@ -411,12 +429,24 @@ class Frontend:
             w.start()
 
     def _emit_self_trace(self, jobs: list[_Job], t) -> None:
-        """Attach one child span per dispatched job to the active trace."""
+        """Materialize the per-job spans of the active trace: one span
+        per dispatched job at its PRE-ASSIGNED id (engine/remote spans
+        already parent onto it), with the enqueue->dequeue wait as a
+        child -- the queue-wait leg of the timeline."""
         for j in jobs:
-            if j.started_wall and j.done_at:
-                t.child(f"job:{j.kind}", j.started_wall, j.done_at,
-                        {"cancelled": j.cancelled, "hedged": j.hedged,
-                         "error": j.error is not None})
+            if not (j.started_wall and j.done_at):
+                continue
+            attrs = {"cancelled": j.cancelled, "hedged": j.hedged,
+                     "error": j.error is not None}
+            if j.tries:
+                attrs["tries"] = j.tries
+            if j.placement:
+                attrs["placement"] = j.placement
+            sid = t.child(f"job:{j.kind}", j.started_wall, j.done_at, attrs,
+                          parent=t.root_id, span_id=j.span_id or None)
+            if j.dequeued_wall and j.dequeued_wall >= j.started_wall:
+                t.child("queue-wait", j.started_wall, j.dequeued_wall,
+                        {}, parent=sid)
 
     # --------------------------------------------------- affinity routing
     def _affinity_members(self) -> list[InstanceDesc]:
@@ -561,10 +591,17 @@ class Frontend:
         if not live:
             return
         from ..util.kerneltel import TEL
+        from .selftrace import reset_current_span, set_current_span
 
+        now_wall = time.time()
+        for _, j in live:
+            if not j.dequeued_wall:
+                j.dequeued_wall = now_wall
         lead = live[0][1]
         token = (TEL.set_active_trace(lead.trace)
                  if lead.trace is not None else None)
+        stoken = (set_current_span(lead.span_id)
+                  if lead.trace is not None and lead.span_id else None)
         ptoken = TEL.set_affinity_placement(lead.placement)
         results = None
         try:
@@ -573,8 +610,23 @@ class Frontend:
             results = None
         finally:
             TEL.reset_affinity_placement(ptoken)
+            if stoken is not None:
+                reset_current_span(stoken)
             if token is not None:
                 TEL.reset_active_trace(token)
+        # window mates rode the lead's fused launch: stamp each mate's
+        # OWN trace with a span under its job span naming the lead, so
+        # every coalesced query's timeline shows where its device step
+        # actually ran (the batch-window propagation contract)
+        t1_wall = time.time()
+        for _, j in live[1:]:
+            if j.trace is not None and j.trace is not lead.trace:
+                j.trace.child(
+                    "batch:ride", now_wall, t1_wall,
+                    {"lead_trace": (lead.trace.trace_id.hex()
+                                    if lead.trace is not None else ""),
+                     "occupancy": len(live)},
+                    parent=j.span_id or None)
         if isinstance(results, list) and len(results) == len(live):
             for (t, j), r in zip(live, results):
                 if isinstance(r, Exception):
@@ -615,9 +667,14 @@ class Frontend:
             job.finish()
             return
         from ..util.kerneltel import TEL
+        from .selftrace import reset_current_span, set_current_span
 
+        if not job.dequeued_wall:
+            job.dequeued_wall = time.time()
         token = (TEL.set_active_trace(job.trace)
                  if job.trace is not None else None)
+        stoken = (set_current_span(job.span_id)
+                  if job.trace is not None and job.span_id else None)
         ptoken = TEL.set_affinity_placement(getattr(job, "placement", ""))
         try:
             res = job.fn(*job.args)
@@ -633,6 +690,8 @@ class Frontend:
             return
         finally:
             TEL.reset_affinity_placement(ptoken)
+            if stoken is not None:
+                reset_current_span(stoken)
             if token is not None:
                 TEL.reset_active_trace(token)
         job.finish()
@@ -722,30 +781,50 @@ class Frontend:
             if not pairs:
                 continue
             self._note_placements([j for _, j in pairs])
+            now_wall = time.time()
+            for _, j in pairs:
+                if not j.dequeued_wall:
+                    j.dequeued_wall = now_wall
             jid = uuid.uuid4().hex
             with self._lease_lock:
                 self._leases[jid] = (pairs, time.monotonic() + self.lease_s)
             placement = pairs[0][1].placement
+            # self-trace propagation: the remote leg records its spans
+            # against (trace_id, parent=this job's span) and ships them
+            # back with the result -- one timeline tree, wherever the
+            # leg ran. A multi job rides the LEAD's context (the fused
+            # launch is one device step, same as local batch execution).
+            lead = pairs[0][1]
+            trace_ctx = (lead.trace.wire_context(lead.span_id or None)
+                         if lead.trace is not None else None)
             if len(pairs) == 1:
                 t0, j0 = pairs[0]
                 return {"id": jid, "tenant": t0, "kind": j0.kind,
-                        "payload": j0.payload, "placement": placement}
+                        "payload": j0.payload, "placement": placement,
+                        "trace": trace_ctx}
             return {"id": jid, "tenant": pairs[0][0], "kind": "multi",
-                    "placement": placement,
+                    "placement": placement, "trace": trace_ctx,
                     "payload": {"kind": pairs[0][1].kind,
                                 "tenants": [t for t, _ in pairs],
                                 "jobs": [j.payload for _, j in pairs]}}
 
     def complete_job(self, jid: str, ok: bool, result: dict | None = None,
-                     error: str = "", retryable: bool = False) -> None:
+                     error: str = "", retryable: bool = False,
+                     self_spans: list | None = None) -> None:
         """Remote worker posts a job result (or a `multi` result list,
         demuxed per leased job). Unknown/expired lease ids are dropped
-        (the job was re-dispatched or timed out)."""
+        (the job was re-dispatched or timed out). self_spans: the remote
+        leg's recorded timeline spans, grafted into the lead job's
+        trace (they were recorded against its span ids)."""
         with self._lease_lock:
             lease = self._leases.pop(jid, None)
         if lease is None:
             return
         pairs, _ = lease
+        if self_spans:
+            lead = pairs[0][1]
+            if lead.trace is not None:
+                lead.trace.add_remote_spans(self_spans)
         results: list = [result or {}]
         if ok and len(pairs) > 1:
             results = (result or {}).get("results") or []
@@ -868,30 +947,43 @@ class Frontend:
         the candidate block set IS the shardable space, since the device
         engine answers a whole partition in one batched lookup)."""
         from ..util.kerneltel import TEL
-        from ..util.metrics import timed
 
         t0 = time.perf_counter()
         self_tid = ""
         try:
-            with timed(self.query_latency, 'op="traces"'):
-                if self.self_tracer is None or tenant == self.self_tracer.tenant:
-                    return self._find_trace_by_id(tenant, trace_id, time_start, time_end)
-                with self.self_tracer.trace(
-                    "frontend.find_trace_by_id", {"tenant": tenant}
-                ) as t:
-                    self_tid = t.trace_id.hex()
-                    return self._find_trace_by_id(tenant, trace_id, time_start, time_end,
-                                                  trace=t)
+            if self.self_tracer is None or tenant == self.self_tracer.tenant:
+                return self._find_trace_by_id(tenant, trace_id, time_start, time_end)
+            with self.self_tracer.trace(
+                "frontend.find_trace_by_id", {"tenant": tenant}
+            ) as t:
+                self_tid = t.trace_id.hex()
+                return self._find_trace_by_id(tenant, trace_id, time_start, time_end,
+                                              trace=t)
         finally:
-            TEL.record_query("traces", time.perf_counter() - t0, self_tid,
-                             trace_id.hex())
+            dt = time.perf_counter() - t0
+            # exemplar: the latency histogram links to the self-trace
+            self.query_latency.observe(dt, 'op="traces"',
+                                       exemplar=self_tid or None)
+            TEL.record_query("traces", dt, self_tid, trace_id.hex())
+
+    def _qos_admit_traced(self, tenant: str, est_bytes: int, trace) -> int:
+        """_qos_admit with a timeline span when a trace is active (the
+        QoS admission leg; a shed shows as error=true on the root)."""
+        if trace is None or self.qos is None:
+            return self._qos_admit(tenant, est_bytes)
+        t0 = time.time()
+        try:
+            return self._qos_admit(tenant, est_bytes)
+        finally:
+            trace.child("qos-admit", t0, time.time(),
+                        {"est_bytes": int(est_bytes)})
 
     def _find_trace_by_id(self, tenant: str, trace_id: bytes,
                           time_start: int = 0, time_end: int = 0, trace=None):
         db = self.querier.db
         candidates = db.find_candidates(tenant, trace_id, time_start, time_end)
-        charge = self._qos_admit(
-            tenant, sum(m.size_bytes or 0 for m in candidates))
+        charge = self._qos_admit_traced(
+            tenant, sum(m.size_bytes or 0 for m in candidates), trace)
         try:
             jobs = [_Job(
                 kind="find_recent",
@@ -912,8 +1004,7 @@ class Frontend:
                     batch_fn=self._batch_find_blocks,
                     affinity_key=part[0].block_id,
                 ))
-            for j in jobs:
-                j.trace = trace
+            attach_trace(jobs, trace)
             self._run_jobs(tenant, jobs)
         finally:
             self._qos_release(tenant, charge)
@@ -937,21 +1028,22 @@ class Frontend:
         shard jobs for oversized blocks), bounded concurrency, early
         exit at limit."""
         from ..util.kerneltel import TEL
-        from ..util.metrics import timed
 
         t0 = time.perf_counter()
         self_tid = ""
         try:
-            with timed(self.query_latency, 'op="search"'):
-                if self.self_tracer is None or tenant == self.self_tracer.tenant:
-                    return self._search(tenant, req)
-                with self.self_tracer.trace(
-                    "frontend.search", {"tenant": tenant, "q": req.query or ""}
-                ) as t:
-                    self_tid = t.trace_id.hex()
-                    return self._search(tenant, req, trace=t)
+            if self.self_tracer is None or tenant == self.self_tracer.tenant:
+                return self._search(tenant, req)
+            with self.self_tracer.trace(
+                "frontend.search", {"tenant": tenant, "q": req.query or ""}
+            ) as t:
+                self_tid = t.trace_id.hex()
+                return self._search(tenant, req, trace=t)
         finally:
-            TEL.record_query("search", time.perf_counter() - t0, self_tid,
+            dt = time.perf_counter() - t0
+            self.query_latency.observe(dt, 'op="search"',
+                                       exemplar=self_tid or None)
+            TEL.record_query("search", dt, self_tid,
                              req.query or " ".join(
                                  f"{k}={v}" for k, v in req.tags.items()))
 
@@ -1015,11 +1107,11 @@ class Frontend:
             m for m in self.querier.db.blocklist.metas(tenant)
             if m.overlaps_time(req.start, req.end)
         ]
-        charge = self._qos_admit(tenant, sum(m.size_bytes or 0 for m in metas))
+        charge = self._qos_admit_traced(
+            tenant, sum(m.size_bytes or 0 for m in metas), trace)
         try:
             jobs = self._build_search_jobs(tenant, req, req_d, metas)
-            for j in jobs:
-                j.trace = trace
+            attach_trace(jobs, trace)
 
             def early():
                 with lock:
@@ -1029,11 +1121,16 @@ class Frontend:
             collector_done = threading.Event()
 
             def collect():
+                t0_merge = time.time()
                 for j in jobs:
                     j.done.wait()
                     if j.error is None and j.result is not None:
                         with lock:
                             resp.merge(j.result, limit)
+                if trace is not None:
+                    # the cross-shard merge leg of the timeline
+                    trace.child("merge", t0_merge, time.time(),
+                                {"jobs": len(jobs)})
                 collector_done.set()
 
             t = threading.Thread(target=collect, daemon=True)
@@ -1044,6 +1141,9 @@ class Frontend:
             self._qos_release(tenant, charge)
         if trace is not None:
             self._emit_self_trace(jobs, trace)
+            trace.add_cost("bytes_scanned", sum(
+                j.result.inspected_bytes for j in jobs
+                if j.error is None and j.result is not None))
         resp.traces.sort(key=lambda r: -r.start_time_unix_nano)
         resp.traces = resp.traces[:limit]
         return resp
@@ -1165,22 +1265,22 @@ class Frontend:
         label -- alignment to one global grid makes the shard merge
         exact (metrics_exec.align_params)."""
         from ..util.kerneltel import TEL
-        from ..util.metrics import timed
 
         t0 = time.perf_counter()
         self_tid = ""
         try:
-            with timed(self.query_latency, 'op="metrics"'):
-                if self.self_tracer is None or tenant == self.self_tracer.tenant:
-                    return self._metrics_query_range(tenant, req)
-                with self.self_tracer.trace(
-                    "frontend.metrics_query_range", {"tenant": tenant, "q": req.query}
-                ) as t:
-                    self_tid = t.trace_id.hex()
-                    return self._metrics_query_range(tenant, req, trace=t)
+            if self.self_tracer is None or tenant == self.self_tracer.tenant:
+                return self._metrics_query_range(tenant, req)
+            with self.self_tracer.trace(
+                "frontend.metrics_query_range", {"tenant": tenant, "q": req.query}
+            ) as t:
+                self_tid = t.trace_id.hex()
+                return self._metrics_query_range(tenant, req, trace=t)
         finally:
-            TEL.record_query("metrics", time.perf_counter() - t0, self_tid,
-                             req.query)
+            dt = time.perf_counter() - t0
+            self.query_latency.observe(dt, 'op="metrics"',
+                                       exemplar=self_tid or None)
+            TEL.record_query("metrics", dt, self_tid, req.query)
 
     def _metrics_query_range(self, tenant: str, req, trace=None):
         from ..db.metrics_exec import (
@@ -1192,7 +1292,7 @@ class Frontend:
         )
 
         q = parse_metrics_query(req.query)  # ParseError -> 400 at the API
-        charge = self._qos_admit(tenant, 0)  # concurrency budget only
+        charge = self._qos_admit_traced(tenant, 0, trace)  # concurrency only
         try:
             nb = req.n_buckets
             n_jobs = max(1, -(-nb // self.METRICS_BUCKETS_PER_JOB))
@@ -1213,8 +1313,7 @@ class Frontend:
                     payload={"req": metrics_request_to_dict(sub)},
                     fn=self.querier.metrics_query_range, args=(tenant, sub),
                 ))
-            for j in jobs:
-                j.trace = trace
+            attach_trace(jobs, trace)
             self._run_jobs(tenant, jobs)
         finally:
             self._qos_release(tenant, charge)

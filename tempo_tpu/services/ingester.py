@@ -378,6 +378,43 @@ class Instance:
             return self.live_engine.search(req)
         return self.search_live_index(req)
 
+    def metrics_query_range(self, req) -> "object":
+        """TraceQL metrics over the MERGED live head (live/cut/flushing
+        traces) via the exact host-twin fold (metrics_exec
+        .metrics_live_traces): the ingester leg that makes unflushed
+        spans visible to /api/metrics/query_range. Traces are the same
+        cached decodes the search oracle uses. Known transient: a query
+        sampling the instant between a flushed block's blocklist
+        publish and the flushing-snapshot retirement (microseconds,
+        cut_block_if_ready) can count those spans in both legs --
+        search dedups by trace id across the same window; aggregated
+        series cannot, matching the reference's flush semantics."""
+        from ..db.metrics_exec import (
+            MetricsResponse,
+            expr_label,
+            metrics_live_traces,
+            parse_metrics_query,
+        )
+
+        q = parse_metrics_query(req.query)
+        resp = MetricsResponse(
+            fn=q.agg.fn, start_ms=req.start_ms, step_ms=req.step_ms,
+            n_buckets=req.n_buckets,
+            label_names=tuple(expr_label(e, i) for i, e in enumerate(q.agg.by)),
+        )
+        decoded = []
+        for tid, (segs, _state, start_s, end_s, lts) in self._live_groups().items():
+            # push-metadata time prefilter against the request range
+            # (seconds resolution; 0 = unknown, never prunes)
+            if end_s and end_s * 1000 < req.start_ms:
+                continue
+            if start_s and start_s * 1000 >= req.end_ms:
+                continue
+            _, tr = self._live_entry(tid, lts, segs)
+            decoded.append(tr)
+        metrics_live_traces(decoded, q, req, resp)
+        return resp
+
     def search_live_index(self, req: SearchRequest) -> SearchResponse:
         """Host index walk over the merged live head -- the differential
         oracle for the device engine and the kill-switch fallback: tag,
@@ -460,6 +497,13 @@ class Ingester:
         with self.lock:
             inst = self.instances.get(tenant)
         return inst.search_live(req) if inst else SearchResponse()
+
+    def metrics_query_range(self, tenant: str, req):
+        """Live-head TraceQL metrics leg (None when this ingester holds
+        nothing for the tenant -- the querier skips empty legs)."""
+        with self.lock:
+            inst = self.instances.get(tenant)
+        return inst.metrics_query_range(req) if inst else None
 
     # ---------------------------------------------------------- lifecycle
     def replay_wal(self) -> int:

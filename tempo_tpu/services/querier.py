@@ -249,11 +249,32 @@ class Querier:
     def metrics_query_range(self, tenant: str, req):
         """One metrics time-shard job: a step-aligned sub-range of the
         query_range axis, executed over the backend blocklist
-        (db/metrics_exec). Recent unflushed data lives in the ingester
-        WAL and is not yet visible to metrics (same contract as the
-        reference's initial traceql-metrics: blocks only)."""
+        (db/metrics_exec) MERGED with every ingester's live-head leg
+        (exact host-twin fold over live/cut/flushing traces) -- so
+        recent unflushed spans are visible to TraceQL metrics, closing
+        the blocks-only gap. Time-shard jobs cover disjoint sub-ranges,
+        so the per-shard ingester legs never double-count. Failed legs
+        degrade coverage (the search_recent tolerance), never the
+        query."""
+        from ..util.kerneltel import TEL
+
         self.stats.metrics_queries += 1
-        return self.db.metrics_query_range(tenant, req)
+        futs = []
+        for c in self._ingester_clients():
+            fn = getattr(c, "metrics_query_range", None)
+            if fn is not None:  # pre-upgrade remote ingesters: skip
+                futs.append(self._submit(fn, tenant, req))
+        resp = self.db.metrics_query_range(tenant, req)
+        for f in futs:
+            try:
+                part = f.result()
+            except Exception:
+                TEL.record_routing("metrics_live", "ingester", "leg_failed")
+                continue
+            if part is not None and part.series:
+                TEL.record_routing("metrics_live", "ingester", "merged")
+                resp.merge(part)
+        return resp
 
     def find_in_blocks(self, tenant: str, trace_id: bytes, metas: list):
         """One frontend ID-shard job: lookup restricted to a partition

@@ -2,25 +2,71 @@
 
 Reference: the app instruments handlers with its tracing client and
 ships those spans like any tenant's (SURVEY.md 5.1) -- dogfooding that
-makes slow queries debuggable with the product itself. Here a
-SelfTracer records a root span per frontend query plus one child span
-per dispatched job, and pushes the finished trace through the
-distributor under a dedicated tenant. Pushes from the self tenant are
-never traced (no recursion), and failures are swallowed -- observability
-must not fail queries.
+makes slow queries debuggable with the product itself. A SelfTracer
+records one HIERARCHICAL trace per frontend query: a root span, one
+span per dispatched job (queue-wait as a child), and nested engine
+spans (batch window, stream fetch/decompress/upload, kernel launches
+with compile attrs, exact verify) attached by the hot paths through an
+ambient contextvar -- no signature threading. Remote querier legs
+propagate by (trace_id, parent_span_id) riding the wire job: the
+remote process records its spans into a RemoteSpanRecorder and ships
+them back WITH the job result, so the whole query lands as one tree
+under the `self` tenant no matter where its legs ran.
+
+Span capture on the hot path is two wall-clock reads and a list append
+under a small lock; finished traces ship from a background thread (the
+reference's async batch exporter role) through the distributor like any
+tenant's push. The in-flight queue is BOUNDED: a stalled distributor
+drops whole traces (counted, exported via kerneltel) instead of
+growing process memory without limit. Pushes from the self tenant are
+never traced (no recursion), and failures are swallowed --
+observability must not fail queries.
+
+Per-query cost attribution closes the loop: engine hooks accumulate
+device ms / staged bytes / compiles / verified rows onto the active
+trace (kerneltel add_query_cost); at root-span finish the totals become
+`cost.*` root attrs and fold into per-tenant counters in kerneltel
+(/status/kernels "query_costs", tempo_query_cost_total).
 """
 
 from __future__ import annotations
 
+import contextvars
 import os
 import threading
 import time
 
 from ..wire.model import Resource, ResourceSpans, Scope, ScopeSpans, Span, SpanKind
 
+# in-flight trace cap: a stalled shipper must bound memory, not grow it
+DEFAULT_QUEUE_MAX = 256
+
+# ambient parent span id for the CURRENT execution context: set around
+# job execution (frontend/worker) and nested span() bodies so engine
+# child spans parent correctly without threading ids through signatures
+_CURRENT_SPAN: contextvars.ContextVar = contextvars.ContextVar(
+    "tempo_selftrace_span", default=None)
+
+
+def set_current_span(span_id: bytes | None):
+    """Park the ambient parent span id; returns a reset token."""
+    return _CURRENT_SPAN.set(span_id)
+
+
+def reset_current_span(token) -> None:
+    try:
+        _CURRENT_SPAN.reset(token)
+    except Exception:
+        pass
+
+
+def current_span() -> bytes | None:
+    return _CURRENT_SPAN.get()
+
 
 class SelfTracer:
-    def __init__(self, push, tenant: str = "self", service: str = "tempo-tpu"):
+    def __init__(self, push, tenant: str = "self", service: str = "tempo-tpu",
+                 queue_max: int | None = None):
         """push(tenant, [ResourceSpans]) -- the distributor entrypoint.
         Finished traces ship from a background thread (the reference's
         async batch exporter role): the query hot path only enqueues."""
@@ -28,12 +74,21 @@ class SelfTracer:
         self.tenant = tenant
         self.service = service
         self.spans_emitted = 0
+        self.traces_dropped = 0
+        if queue_max is None:
+            try:
+                queue_max = int(os.environ.get("TEMPO_SELFTRACE_QUEUE",
+                                               DEFAULT_QUEUE_MAX))
+            except ValueError:
+                queue_max = DEFAULT_QUEUE_MAX
+        self.queue_max = max(1, queue_max)
         self._lock = threading.Lock()
         # processed-counter ack instead of polling queue emptiness:
         # _q.empty() flips true the instant the shipper DEQUEUES, before
         # its push (and the spans_emitted update) completes, so a flush
         # built on emptiness could return while the last trace was still
-        # in flight
+        # in flight. (_enqueued - _processed) is also the in-flight
+        # depth the bounded-queue drop policy gates on.
         self._done = threading.Condition(self._lock)
         self._enqueued = 0
         self._processed = 0
@@ -48,19 +103,35 @@ class SelfTracer:
         return _ActiveTrace(self, name, attrs or {})
 
     def _enqueue(self, rs, n_spans: int) -> None:
+        from ..util.kerneltel import TEL
+
         with self._lock:
+            if self._enqueued - self._processed >= self.queue_max:
+                # stalled shipper: drop the WHOLE trace with a counter --
+                # self-observability must never grow memory unbounded
+                self.traces_dropped += 1
+                TEL.record_selftrace("dropped", n_spans)
+                return
             self._enqueued += 1
         self._q.put((rs, n_spans))
 
     def _ship_loop(self) -> None:
+        from ..util.kerneltel import TEL
+
         while True:
             rs, n_spans = self._q.get()
             try:
                 self.push(self.tenant, [rs])
                 with self._lock:
                     self.spans_emitted += n_spans
+                TEL.record_selftrace("shipped", n_spans)
             except Exception:
-                pass  # self-observability must never fail anything
+                # self-observability must never fail anything -- but a
+                # failing distributor must still COUNT: without this
+                # outcome the queue drains fast, nothing ever reads as
+                # dropped, and the TempoSelfTraceDropped alert stays
+                # silent while every timeline is lost
+                TEL.record_selftrace("push_failed", n_spans)
             finally:
                 with self._done:
                     self._processed += 1
@@ -80,8 +151,40 @@ class SelfTracer:
                 self._done.wait(remaining)
 
 
+class _SpanCM:
+    """One live nested span: `with trace.span("stage") as s:` parents
+    under the ambient span, becomes the ambient parent for its body."""
+
+    __slots__ = ("trace", "name", "attrs", "span_id", "parent_id", "t0", "_token")
+
+    def __init__(self, trace: "_ActiveTrace", name: str, attrs: dict):
+        self.trace = trace
+        self.name = name
+        self.attrs = attrs
+        self.span_id = os.urandom(8)
+
+    def __enter__(self):
+        self.parent_id = _CURRENT_SPAN.get() or self.trace.root_id
+        self.t0 = time.time()
+        self._token = _CURRENT_SPAN.set(self.span_id)
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        reset_current_span(self._token)
+        if exc_type is not None:
+            self.attrs["error"] = True
+            self.attrs["error.type"] = exc_type.__name__
+        self.trace._record(self.name, self.t0, time.time(), self.attrs,
+                           self.span_id, self.parent_id)
+        return False
+
+
 class _ActiveTrace:
-    """One root span + flat children, finished and pushed on __exit__."""
+    """One root span + a TREE of children, finished and pushed on
+    __exit__. Children attach three ways: span() (nested context
+    manager), child() (retroactive, measured by the caller), and
+    add_remote_spans() (a remote leg's recorder shipped back with its
+    job result). All are safe from any thread."""
 
     def __init__(self, tracer: SelfTracer, name: str, attrs: dict):
         self.tracer = tracer
@@ -90,13 +193,65 @@ class _ActiveTrace:
         self.trace_id = os.urandom(16)
         self.root_id = os.urandom(8)
         self.t0 = 0.0
-        self.children: list[tuple[str, float, float, dict]] = []
+        # finished spans: (name, t0, t1, attrs, span_id, parent_id)
+        self.spans: list[tuple] = []
+        self.cost: dict[str, float] = {}
         self._lock = threading.Lock()
 
-    def child(self, name: str, t_start: float, t_end: float, attrs: dict | None = None):
-        with self._lock:
-            self.children.append((name, t_start, t_end, attrs or {}))
+    # ------------------------------------------------------------- spans
+    def span(self, name: str, attrs: dict | None = None) -> _SpanCM:
+        return _SpanCM(self, name, attrs or {})
 
+    def _record(self, name, t0, t1, attrs, span_id, parent_id) -> None:
+        with self._lock:
+            self.spans.append((name, t0, t1, attrs, span_id, parent_id))
+
+    def child(self, name: str, t_start: float, t_end: float,
+              attrs: dict | None = None, parent: bytes | None = None,
+              span_id: bytes | None = None) -> bytes:
+        """Retroactive child span (caller already measured it). Parent
+        resolution: explicit arg > ambient contextvar > root. Returns
+        the span id so callers can hang further children under it."""
+        sid = span_id or os.urandom(8)
+        pid = parent or _CURRENT_SPAN.get() or self.root_id
+        self._record(name, t_start, t_end, attrs or {}, sid, pid)
+        return sid
+
+    def add_remote_spans(self, spans: list[dict]) -> None:
+        """Graft a remote leg's recorded spans (RemoteSpanRecorder
+        .to_wire() payload): ids/parents were assigned remotely against
+        this trace's id space, so they land already linked."""
+        for s in spans:
+            try:
+                if s.get("name") == "__cost__":
+                    # the remote leg's cost totals fold into this
+                    # trace's root attrs, not a rendered span
+                    for k, v in (s.get("attrs") or {}).items():
+                        self.add_cost(str(k), float(v))
+                    continue
+                self._record(
+                    str(s["name"]), float(s["t0"]), float(s["t1"]),
+                    dict(s.get("attrs") or {}),
+                    bytes.fromhex(s["span_id"]), bytes.fromhex(s["parent_id"]))
+            except Exception:
+                continue  # a malformed remote span must not drop the trace
+
+    def wire_context(self, parent_span_id: bytes | None = None) -> dict:
+        """The (trace_id, parent_span_id) a wire job carries so a remote
+        leg's spans parent into this tree."""
+        return {"trace_id": self.trace_id.hex(),
+                "parent_span_id": (parent_span_id or self.root_id).hex()}
+
+    # -------------------------------------------------------------- cost
+    def add_cost(self, key: str, value: float) -> None:
+        """Accumulate one per-query cost dimension (device_ms,
+        staged_bytes, compiles, rows_verified, ...) -- kerneltel's
+        add_query_cost lands here from any thread the trace is parked
+        in."""
+        with self._lock:
+            self.cost[key] = self.cost.get(key, 0) + value
+
+    # --------------------------------------------------------- lifecycle
     def __enter__(self):
         self.t0 = time.time()
         return self
@@ -106,6 +261,11 @@ class _ActiveTrace:
         if exc_type is not None:
             self.attrs["error"] = True
             self.attrs["error.type"] = exc_type.__name__
+        with self._lock:
+            children = list(self.spans)
+            cost = dict(self.cost)
+        for k, v in sorted(cost.items()):
+            self.attrs[f"cost.{k}"] = round(v, 3) if isinstance(v, float) else v
         spans = [Span(
             trace_id=self.trace_id,
             span_id=self.root_id,
@@ -115,11 +275,11 @@ class _ActiveTrace:
             end_unix_nano=int(t1 * 1e9),
             attrs=self.attrs,
         )]
-        for name, cs, ce, attrs in self.children:
+        for name, cs, ce, attrs, sid, pid in children:
             spans.append(Span(
                 trace_id=self.trace_id,
-                span_id=os.urandom(8),
-                parent_span_id=self.root_id,
+                span_id=sid,
+                parent_span_id=pid,
                 name=name,
                 kind=SpanKind.INTERNAL,
                 start_unix_nano=int(cs * 1e9),
@@ -130,5 +290,67 @@ class _ActiveTrace:
             resource=Resource(attrs={"service.name": self.tracer.service}),
             scope_spans=[ScopeSpans(scope=Scope(name="selftrace"), spans=spans)],
         )
+        if cost:
+            from ..util.kerneltel import TEL
+
+            TEL.record_query_cost(str(self.attrs.get("tenant", "")), cost)
         self.tracer._enqueue(rs, len(spans))
         return False
+
+
+class RemoteSpanRecorder:
+    """The remote face of an _ActiveTrace: a querier worker executing a
+    wire job builds one from the job's (trace_id, parent_span_id),
+    parks it in the kerneltel contextvar, and every engine span hook
+    (child_span / span() / add_cost) lands here exactly as it would on
+    the frontend's trace. The recorded spans ship back WITH the job
+    result (to_wire) and graft into the originating tree -- the query's
+    remote leg joins the same timeline."""
+
+    def __init__(self, trace_id_hex: str, parent_span_id_hex: str,
+                 worker_id: str = ""):
+        self.trace_id = bytes.fromhex(trace_id_hex)
+        self.root_id = bytes.fromhex(parent_span_id_hex)  # remote spans'
+        # default parent is the frontend-side JOB span, not a new root
+        self.worker_id = worker_id
+        self.spans: list[tuple] = []
+        self.cost: dict[str, float] = {}
+        self._lock = threading.Lock()
+
+    def span(self, name: str, attrs: dict | None = None) -> _SpanCM:
+        return _SpanCM(self, name, attrs or {})
+
+    def _record(self, name, t0, t1, attrs, span_id, parent_id) -> None:
+        with self._lock:
+            self.spans.append((name, t0, t1, attrs, span_id, parent_id))
+
+    def child(self, name: str, t_start: float, t_end: float,
+              attrs: dict | None = None, parent: bytes | None = None,
+              span_id: bytes | None = None) -> bytes:
+        sid = span_id or os.urandom(8)
+        pid = parent or _CURRENT_SPAN.get() or self.root_id
+        self._record(name, t_start, t_end, attrs or {}, sid, pid)
+        return sid
+
+    def add_cost(self, key: str, value: float) -> None:
+        with self._lock:
+            self.cost[key] = self.cost.get(key, 0) + value
+
+    def to_wire(self) -> list[dict]:
+        with self._lock:
+            spans = list(self.spans)
+            cost = dict(self.cost)
+        out = []
+        for name, t0, t1, attrs, sid, pid in spans:
+            a = dict(attrs)
+            if self.worker_id:
+                a.setdefault("querier", self.worker_id)
+            out.append({"name": name, "t0": t0, "t1": t1, "attrs": a,
+                        "span_id": sid.hex(), "parent_id": pid.hex()})
+        if cost:
+            # remote leg's cost rides as attrs on a zero-length span so
+            # the frontend can fold it into the root totals
+            out.append({"name": "__cost__", "t0": 0.0, "t1": 0.0,
+                        "attrs": cost, "span_id": os.urandom(8).hex(),
+                        "parent_id": self.root_id.hex()})
+        return out

@@ -185,6 +185,22 @@ class QuerierWorker:
             # the wire job so THIS process's staged-cache hits attribute
             # to owner-vs-stolen routing in its own kerneltel
             ptoken = TEL.set_affinity_placement(job.get("placement", ""))
+            # self-trace propagation: the wire job's (trace_id,
+            # parent_span_id) seed a recorder that catches every engine
+            # span/cost hook this leg fires; the spans ship back WITH
+            # the result and graft into the frontend's tree
+            recorder = None
+            ctx = job.get("trace")
+            if ctx and ctx.get("trace_id") and ctx.get("parent_span_id"):
+                try:
+                    from .selftrace import RemoteSpanRecorder
+
+                    recorder = RemoteSpanRecorder(
+                        ctx["trace_id"], ctx["parent_span_id"],
+                        worker_id=self.worker_id)
+                except Exception:
+                    recorder = None
+            ttoken = TEL.set_active_trace(recorder) if recorder else None
             try:
                 result = execute_job(
                     self.querier, job.get("tenant", ""), job["kind"], job["payload"]
@@ -198,7 +214,13 @@ class QuerierWorker:
                            retryable=_retryable(e))
                 self.jobs_failed += 1
             finally:
+                if ttoken is not None:
+                    TEL.reset_active_trace(ttoken)
                 TEL.reset_affinity_placement(ptoken)
+            if recorder is not None:
+                spans = recorder.to_wire()
+                if spans:
+                    out["self_spans"] = spans
             try:
                 self._post(addr, "/internal/jobs/result", out, timeout=10.0)
             except (urllib.error.URLError, ConnectionError, OSError):

@@ -154,6 +154,20 @@ class HTTPIngesterClient:
         )
         return response_from_dict(out)
 
+    def metrics_query_range(self, tenant: str, req):
+        """Live-head TraceQL metrics leg against a remote ingester
+        (None when it holds nothing for the tenant)."""
+        from ..db.metrics_exec import (
+            request_to_dict as metrics_request_to_dict,
+            response_from_dict as metrics_response_from_dict,
+        )
+
+        out = self._post(
+            "/internal/metrics",
+            {"tenant": tenant, "req": metrics_request_to_dict(req)},
+        )
+        return metrics_response_from_dict(out) if out else None
+
 
 def client_registry(local: dict, token: str = ""):
     """addr -> client resolver: in-process objects first, HTTP for the rest."""
@@ -211,6 +225,7 @@ def handle_internal(app, path: str, payload: dict, raw_body: bytes = b"",
             payload.get("id", ""), bool(payload.get("ok")),
             result=payload.get("result"), error=payload.get("error", ""),
             retryable=bool(payload.get("retryable")),
+            self_spans=payload.get("self_spans"),
         )
         return 200, {}
     if path == "/internal/genpush":
@@ -242,4 +257,14 @@ def handle_internal(app, path: str, payload: dict, raw_body: bytes = b"",
 
         resp = app.ingester.search(tenant, request_from_dict(payload.get("req", {})))
         return 200, response_to_dict(resp)
+    if path == "/internal/metrics":
+        # live-head TraceQL metrics leg (querier merges it with blocks)
+        from ..db.metrics_exec import (
+            request_from_dict as metrics_request_from_dict,
+            response_to_dict as metrics_response_to_dict,
+        )
+
+        resp = app.ingester.metrics_query_range(
+            tenant, metrics_request_from_dict(payload.get("req", {})))
+        return 200, (metrics_response_to_dict(resp) if resp is not None else {})
     return 404, {"error": f"no internal route {path}"}

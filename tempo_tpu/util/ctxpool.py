@@ -1,0 +1,22 @@
+"""Context-propagating thread pool.
+
+ThreadPoolExecutor workers run with the contextvars of whatever thread
+happened to create them, so ambient query attribution -- the active
+self-trace (util/kerneltel set_active_trace) and the affinity dequeue
+placement -- silently vanished on every pooled leg: staged-cache probes
+attributed to "none", engine spans dropped on the floor. This subclass
+captures the SUBMITTING thread's context per task and runs the callable
+under a copy, the same fix services/querier.py applies to its own pool.
+Executor.map routes through submit, so both entry points propagate.
+"""
+
+from __future__ import annotations
+
+import contextvars
+from concurrent.futures import ThreadPoolExecutor
+
+
+class ContextThreadPool(ThreadPoolExecutor):
+    def submit(self, fn, /, *args, **kwargs):
+        ctx = contextvars.copy_context()
+        return super().submit(ctx.run, fn, *args, **kwargs)

@@ -223,6 +223,20 @@ class KernelTelemetry:
             "uploads": 0, "full_uploads": 0, "delta_bytes": 0,
             "delta_rows": 0, "lag_count": 0, "lag_sum": 0.0, "lag_max": 0.0,
         }
+        # self-tracing pipeline health (services/selftrace): spans
+        # shipped vs whole traces dropped at the bounded in-flight queue
+        self.selftrace_spans = Counter(
+            "tempo_selftrace_spans_total",
+            help="self-trace spans by outcome (shipped / dropped with "
+                 "their trace at the bounded in-flight queue)")
+        self._selftrace: dict[str, int] = {}
+        # per-query cost attribution (selftrace root spans): per-tenant
+        # totals of device ms, staged bytes, compiles, verified rows
+        self.query_cost = Counter(
+            "tempo_query_cost_total",
+            help="per-tenant query cost totals by resource (device_ms, "
+                 "staged_bytes, bytes_scanned, compiles, rows_verified)")
+        self._query_costs: dict[str, dict[str, float]] = {}
         # every instrument exported through /metrics -- ONE list shared
         # by metrics_lines() and help_entries() so an instrument can't
         # ship samples without its HELP (or vice versa)
@@ -241,7 +255,7 @@ class KernelTelemetry:
             self.stream_units, self.stream_bytes_inflight,
             self.affinity_jobs, self.qos_shed, self.staged_placement,
             self.livestage_rows, self.livestage_delta_bytes,
-            self.livestage_lag,
+            self.livestage_lag, self.selftrace_spans, self.query_cost,
         )
         # full compile-key signatures, LRU-bounded (SEEN_SIGNATURES_MAX)
         self._seen: OrderedDict = OrderedDict()
@@ -297,6 +311,7 @@ class KernelTelemetry:
             labels = f'op="{op}",bucket="{blab}"'
             (self.compiles if new else self.cache_hits).inc(labels=labels)
             self._tls.last = (op, blab, new)
+            self.add_query_cost("compiles" if new else "cache_hits", 1)
             return new
         except Exception:
             return False
@@ -318,7 +333,9 @@ class KernelTelemetry:
 
                 jax.block_until_ready(out)
             dt = time.perf_counter() - t0
-            self.device_time.observe(dt, f'op="{op}"')
+            self.device_time.observe(dt, f'op="{op}"',
+                                     exemplar=self._exemplar_tid())
+            self.add_query_cost("device_ms", dt * 1e3)
             with self._lock:
                 k = self._kernels.get((op, str(bucket)))
                 if k is not None:
@@ -347,6 +364,7 @@ class KernelTelemetry:
         self.transfer_bytes.inc(nbytes)
         self.staged_rows_real.inc(rows_real)
         self.staged_rows_padded.inc(rows_padded)
+        self.add_query_cost("staged_bytes", nbytes)
 
     # ----------------------------------------------------------- routing
     def record_routing(self, layer: str, engine: str, reason: str, n: int = 1) -> None:
@@ -371,7 +389,8 @@ class KernelTelemetry:
             self.batch_groups.inc(labels=labels)
             self.batch_queries.inc(occupancy, labels=labels)
             self.batch_occupancy.observe(float(occupancy), labels)
-            self.batch_window_wait.observe(float(window_wait_s), labels)
+            self.batch_window_wait.observe(float(window_wait_s), labels,
+                                           exemplar=self._exemplar_tid())
             with self._lock:
                 b = self._batches.setdefault(
                     name, {"groups": 0, "queries": 0, "max_occupancy": 0})
@@ -492,14 +511,26 @@ class KernelTelemetry:
         return c
 
     # ------------------------------------------------- cold-read streaming
+    # stages that emit timeline spans from this chokepoint; "upload"
+    # spans come from ops/stage.upload_stage (which knows the bytes and
+    # also covers warm staging uploads outside the stream pipeline)
+    _STREAM_SPAN_STAGES = ("fetch", "decompress", "assemble")
+
     def record_stream_stage(self, stage: str, seconds: float) -> None:
         """One stream-pipeline stage (fetch/decompress/assemble/upload)
-        finished for one unit: observe its wall time."""
+        finished for one unit: observe its wall time, and attach a
+        timeline span to the active self-trace -- this is the single
+        chokepoint every cold ranged read passes (colio._run_plan and
+        ops/stream._run_stages both land here)."""
         try:
-            self.stream_stage_time.observe(float(seconds), f'stage="{stage}"')
+            self.stream_stage_time.observe(float(seconds), f'stage="{stage}"',
+                                           exemplar=self._exemplar_tid())
             with self._lock:
                 ss = self._stream["stage_seconds"]
                 ss[stage] = ss.get(stage, 0.0) + float(seconds)
+            if stage in self._STREAM_SPAN_STAGES:
+                t1 = time.time()
+                self.child_span(f"stream:{stage}", t1 - float(seconds), t1)
         except Exception:
             pass
 
@@ -708,7 +739,71 @@ class KernelTelemetry:
             recent = list(self._queries)
         return sorted(recent, key=lambda q: -q["seconds"])[:k]
 
+    # --------------------------------------------------- query cost record
+    def add_query_cost(self, key: str, value: float) -> None:
+        """Accumulate one cost dimension onto the ACTIVE self-trace (a
+        no-op when no trace is parked): device ms, staged bytes,
+        compiles, verified rows. Totals become `cost.*` root attrs at
+        trace finish and fold into per-tenant counters here."""
+        try:
+            t = _active_trace.get()
+            if t is not None:
+                t.add_cost(key, value)
+        except Exception:
+            pass
+
+    def record_query_cost(self, tenant: str, cost: dict) -> None:
+        """Fold one finished query's cost record into the per-tenant
+        aggregates (bounded tenant cardinality, like QoS sheds)."""
+        try:
+            tenant = (tenant or "_unknown")[:128]
+            with self._lock:
+                key = (tenant if (tenant in self._query_costs
+                                  or len(self._query_costs) < QOS_SHED_TENANTS_MAX)
+                       else "_overflow")
+                t = self._query_costs.setdefault(key, {"queries": 0})
+                t["queries"] += 1
+                for k, v in cost.items():
+                    t[k] = round(t.get(k, 0) + float(v), 3)
+            esc = _esc_label(key)
+            self.query_cost.inc(1, labels=f'tenant="{esc}",resource="queries"')
+            for k, v in cost.items():
+                self.query_cost.inc(
+                    float(v), labels=f'tenant="{esc}",resource="{k}"')
+        except Exception:
+            pass
+
+    def query_cost_stats(self) -> dict:
+        with self._lock:
+            return {t: dict(v) for t, v in sorted(self._query_costs.items())}
+
     # --------------------------------------------------------- self-trace
+    def record_selftrace(self, outcome: str, n_spans: int) -> None:
+        """Self-trace shipping outcome: `shipped` spans reached the
+        distributor, `dropped` spans died with their trace at the
+        bounded in-flight queue (TempoSelfTraceDropped alert feed)."""
+        try:
+            self.selftrace_spans.inc(n_spans, labels=f'outcome="{outcome}"')
+            with self._lock:
+                self._selftrace[outcome] = (
+                    self._selftrace.get(outcome, 0) + n_spans)
+        except Exception:
+            pass
+
+    def selftrace_stats(self) -> dict:
+        with self._lock:
+            return dict(self._selftrace)
+
+    def _exemplar_tid(self) -> str | None:
+        """The active self-trace's id for OpenMetrics exemplars (None
+        when no trace is parked -- the histogram keeps its last one)."""
+        try:
+            t = _active_trace.get()
+            tid = getattr(t, "trace_id", None)
+            return tid.hex() if tid is not None else None
+        except Exception:
+            return None
+
     def set_active_trace(self, trace):
         """Park the active SelfTracer trace for this execution context;
         returns a token for reset_active_trace."""
@@ -784,6 +879,8 @@ class KernelTelemetry:
             },
             "routing": routing,
             "affinity": self.affinity_stats(),
+            "query_costs": self.query_cost_stats(),
+            "selftrace": self.selftrace_stats(),
             "batching": self.batch_stats(),
             "compaction": self.compaction_stats(),
             "stream": self.stream_stats(),

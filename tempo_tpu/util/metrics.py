@@ -30,7 +30,13 @@ def _fmt(name: str, labels: str) -> str:
 
 
 class Histogram:
-    """Cumulative-bucket latency histogram."""
+    """Cumulative-bucket latency histogram.
+
+    Exemplars (OpenMetrics): observe(..., exemplar="<trace-id>") keeps
+    the most recent exemplar per label set; text() renders it as a
+    `# {trace_id="..."} value` suffix on the bucket line its value
+    falls in -- so a latency histogram on /metrics links straight to a
+    self-trace of a query that landed in that bucket."""
 
     def __init__(self, name: str, buckets: tuple[float, ...] = DEFAULT_BUCKETS,
                  help: str = ""):
@@ -41,8 +47,11 @@ class Histogram:
         self._counts: dict[str, list[int]] = {}
         self._sums: dict[str, float] = {}
         self._totals: dict[str, int] = {}
+        # labels -> (exemplar trace id, observed value): last one wins
+        self._exemplars: dict[str, tuple[str, float]] = {}
 
-    def observe(self, value: float, labels: str = "") -> None:
+    def observe(self, value: float, labels: str = "",
+                exemplar: str | None = None) -> None:
         with self._lock:
             counts = self._counts.get(labels)
             if counts is None:
@@ -57,18 +66,28 @@ class Histogram:
                 counts[-1] += 1
             self._sums[labels] += value
             self._totals[labels] += 1
+            if exemplar:
+                self._exemplars[labels] = (exemplar, float(value))
 
     def text(self) -> list[str]:
         out = []
         with self._lock:
             for labels, counts in self._counts.items():
                 sep = "," if labels else ""
+                ex = self._exemplars.get(labels)
                 cum = 0
                 for i, edge in enumerate(self.buckets):
                     cum += counts[i]
-                    out.append(f'{self.name}_bucket{{{labels}{sep}le="{edge}"}} {cum}')
+                    line = f'{self.name}_bucket{{{labels}{sep}le="{edge}"}} {cum}'
+                    if ex is not None and ex[1] <= edge:
+                        line += f' # {{trace_id="{ex[0]}"}} {ex[1]:.6g}'
+                        ex = None  # one exemplar, on its own bucket
+                    out.append(line)
                 cum += counts[-1]
-                out.append(f'{self.name}_bucket{{{labels}{sep}le="+Inf"}} {cum}')
+                line = f'{self.name}_bucket{{{labels}{sep}le="+Inf"}} {cum}'
+                if ex is not None:
+                    line += f' # {{trace_id="{ex[0]}"}} {ex[1]:.6g}'
+                out.append(line)
                 out.append(f"{_fmt(self.name + '_sum', labels)} {self._sums[labels]:.6f}")
                 out.append(f"{_fmt(self.name + '_count', labels)} {self._totals[labels]}")
         return out

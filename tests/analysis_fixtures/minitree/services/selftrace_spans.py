@@ -1,0 +1,59 @@
+"""Seeded violations in the self-trace timeline spine's lock shapes:
+the tracer's in-flight counter + processed-ack condition variable, a
+trace's span-list lock, and the ambient-span contextvar token
+discipline -- the lock pairs services/selftrace.py uses, so the
+concurrency rules provably cover the span/contextvar module shape."""
+
+import contextvars
+import threading
+
+_ambient_span = contextvars.ContextVar("fixture_span", default=None)
+_done_cv = threading.Condition()
+_span_lock = threading.Lock()
+_spans: list[tuple] = []
+_inflight: dict[str, int] = {}
+
+
+def push_span(span_id):
+    # sanctioned: contextvar token discipline is not a container mutation
+    token = _ambient_span.set(span_id)
+    return token
+
+
+def record(name, t0, t1):
+    # sanctioned: span append under the span lock
+    with _span_lock:
+        _spans.append((name, t0, t1))
+
+
+def record_racy(name, t0, t1):
+    _spans.append((name, t0, t1))  # EXPECT: global-mutation-unlocked
+
+
+def enqueue(trace_id):
+    # sanctioned order: processed-ack cv outer, span lock inner
+    with _done_cv:
+        with _span_lock:
+            _inflight[trace_id] = _inflight.get(trace_id, 0) + 1
+        _done_cv.notify_all()
+
+
+def flush_racy(trace_id):
+    with _span_lock:
+        with _done_cv:  # EXPECT: lock-order
+            _inflight.pop(trace_id, None)
+
+
+def drain_unsafe():
+    _done_cv.acquire()  # EXPECT: lock-bare-acquire
+    n = len(_spans)
+    _done_cv.release()
+    return n
+
+
+def drain_safe():
+    _done_cv.acquire()
+    try:
+        _spans.clear()
+    finally:
+        _done_cv.release()

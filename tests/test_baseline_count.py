@@ -1,0 +1,58 @@
+"""Tier-1 pass-count regression guard (CI half).
+
+Two floors in TIER1_BASELINE.json keep the suite honest:
+
+  * test_defs_floor -- asserted HERE, statically: the number of
+    `def test_*` functions across tests/ must never shrink below the
+    committed floor. A test file accidentally deleted, renamed out of
+    collection, or emptied by a refactor fails THIS test inside the
+    very run that lost the coverage -- a green run can no longer mean
+    "fewer tests ran".
+  * dots_passed_floor -- asserted by scripts/verify_tier1.sh, which
+    runs the ROADMAP tier-1 command and compares its DOTS_PASSED
+    against the floor (a test obviously can't count the passes of the
+    run it is part of).
+
+Raise the floors when adding tests; lowering them is a reviewed act.
+"""
+
+import json
+import re
+from pathlib import Path
+
+TESTS = Path(__file__).resolve().parent
+REPO = TESTS.parent
+
+_DEF_RE = re.compile(r"^\s*def (test_\w+)\(", re.MULTILINE)
+
+
+def _baseline() -> dict:
+    return json.loads((REPO / "TIER1_BASELINE.json").read_text())
+
+
+def test_baseline_file_is_valid():
+    b = _baseline()
+    assert isinstance(b["dots_passed_floor"], int)
+    assert isinstance(b["test_defs_floor"], int)
+    # the dots floor tracks the committed tier-1 state; it only ratchets
+    assert b["dots_passed_floor"] >= 506
+
+
+def test_test_function_count_never_shrinks():
+    defs = []
+    for p in sorted(TESTS.glob("test_*.py")):
+        defs.extend((p.name, name) for name in _DEF_RE.findall(p.read_text()))
+    # distinct (file, name): a duplicated name in one file shadows its
+    # twin at collection time and silently halves that file's coverage
+    assert len(set(defs)) == len(defs), "duplicate test names shadow tests"
+    floor = _baseline()["test_defs_floor"]
+    assert len(defs) >= floor, (
+        f"tests/ defines {len(defs)} test functions, below the committed "
+        f"floor {floor} (TIER1_BASELINE.json): a test file was lost or "
+        f"emptied. If removal is intentional, lower the floor explicitly.")
+
+
+def test_verify_script_exists_and_references_floor():
+    script = (REPO / "scripts" / "verify_tier1.sh").read_text()
+    assert "TIER1_BASELINE.json" in script
+    assert "DOTS_PASSED" in script
