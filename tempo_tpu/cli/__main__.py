@@ -252,6 +252,148 @@ def cmd_self_trace(args):
     _render_timeline(tr)
 
 
+def cmd_calibrate(args):
+    """Measure THIS box's host-vs-device crossovers and commit them to
+    the CostLedger (util/costledger) so `auto` routing stops guessing:
+
+      find        -- the device-vs-host find race (ops/find
+                     calibrate_find) over real backend blocks, or one
+                     synthesized block when the backend is empty;
+      block_scan  -- cold host column-scan rate (bytes/s incl. IO +
+                     decode) + the measured link RTT, the two inputs of
+                     db/search's host-vs-device engine estimate;
+      live_search -- live-head engine rates (host s/row vs device fixed
+                     seconds) from a synthetic ingester instance, the
+                     seed db/live_engine loads at startup.
+
+    The artifact publishes atomically; every entry is stamped with
+    measured_at_unix. Run it once per box (or per topology change)."""
+    import os
+    import time
+
+    import numpy as np
+
+    from ..util import costledger
+
+    path = (args.ledger or os.environ.get(costledger.LEDGER_ENV, "")
+            or os.path.join(args.backend, "cost_ledger.json"))
+    led = costledger.configure(path)
+    db = _open_db(args.backend)
+    scratch = None  # throwaway db when the real backend has no blocks
+    out: dict = {}
+    try:
+        # ---- find race over backend blocks; an empty backend gets a
+        # synthetic block in a THROWAWAY temp store (never a junk
+        # tenant written into the operator's real backend)
+        tenants = [args.tenant] if args.tenant else db.tenants()
+        picked = next(
+            ((t, db.blocklist.metas(t)) for t in tenants if db.blocklist.metas(t)),
+            None)
+        if picked is None:
+            import tempfile
+
+            from ..util.testdata import make_traces
+
+            scratch = _open_db(tempfile.mkdtemp(prefix="tempo-calibrate-store-"))
+            meta = scratch.write_block(
+                "_calibrate", make_traces(512, seed=1, n_spans=8))
+            picked = ("_calibrate", [meta])
+            print("backend empty: calibrating against one synthetic block "
+                  "in a throwaway store", file=sys.stderr)
+        tenant, metas = picked
+        src_db = scratch or db
+        blocks = [src_db.open_block(m) for m in metas[:8]]
+        idx = blocks[0].trace_index["trace.id_codes"]
+        rng = np.random.default_rng(7)
+        q = np.asarray(
+            idx[rng.integers(0, idx.shape[0], size=min(256, idx.shape[0]))],
+            np.int32)
+        from ..ops.find import calibrate_find
+
+        out["find"] = calibrate_find(blocks, q, repeats=args.repeats)
+
+        # ---- cold host scan rate: fresh reader, so the bytes come off
+        # the backend through the ranged-read + decode path the cold
+        # engine actually pays
+        from ..block.versioned import open_block_versioned
+
+        fresh = open_block_versioned(src_db.backend, metas[0])
+        names = [n for n in ("span.trace_sid", "span.dur_us", "span.name_id",
+                             "span.start_ms", "span.res_idx")
+                 if fresh.pack.has(n)]
+        t0 = time.perf_counter()
+        fresh.pack.warm_columns(names)
+        nbytes = sum(fresh.pack.read(n).nbytes for n in names)
+        dt = time.perf_counter() - t0
+        from ..util.linkcost import link_rtt_ms
+
+        out["block_scan"] = led.update(
+            costledger.KEY_BLOCK_SCAN,
+            host_rate_bps=round(nbytes / max(dt, 1e-9), 1),
+            scanned_bytes=int(nbytes),
+            link_rtt_ms=round(link_rtt_ms(), 3))
+        led.publish()
+
+        # ---- live-head engine race (synthetic ingester instance)
+        if not args.skip_live:
+            out["live_search"] = _calibrate_live(args.repeats)
+    finally:
+        if scratch is not None:
+            scratch.close()
+        db.close()
+    print(json.dumps({"ledger": path, "entries": out}, indent=2))
+
+
+def _calibrate_live(repeats: int) -> dict:
+    """Run the live-head device engine and its host twin over a
+    synthetic instance so both EMAs get real measurements, then persist
+    them (LiveEngine.persist_crossover)."""
+    import os
+    import random
+    import tempfile
+
+    from ..backend import MemBackend
+    from ..db.search import SearchRequest
+    from ..db.tempodb import TempoDB, TempoDBConfig
+    from ..db.wal import WAL
+    from ..services.ingester import Ingester, IngesterConfig
+    from ..services.overrides import Overrides
+    from ..util.testdata import make_trace, make_trace_id
+    from ..wire.segment import segment_for_write
+
+    tmp = tempfile.mkdtemp(prefix="tempo-calibrate-")
+    dbl = TempoDB(TempoDBConfig(wal_path=tmp + "/wal-db"), backend=MemBackend())
+    ing = Ingester(WAL(tmp + "/wal"), dbl, Overrides(), IngesterConfig())
+    inst = ing.instance("_calibrate")
+    rng = random.Random(11)
+    for i in range(512):
+        tid = make_trace_id(rng)
+        tr = make_trace(rng, trace_id=tid, n_spans=4,
+                        base_time_ns=1_700_000_000_000_000_000 + i * 10**9)
+        lo, hi = tr.time_range_nanos()
+        s, e = lo // 10**9, hi // 10**9 + 1
+        inst.push_segments([(tid, s, e, segment_for_write(tr, s, e))])
+    req = SearchRequest(tags={"service.name": "db"}, limit=20)
+    prev = os.environ.get("TEMPO_LIVE_ENGINE")
+    try:
+        for engine in ("device", "host"):
+            os.environ["TEMPO_LIVE_ENGINE"] = engine
+            for _ in range(max(2, repeats + 1)):  # first run warms compiles
+                inst.search_live(req)
+    finally:
+        if prev is None:
+            os.environ.pop("TEMPO_LIVE_ENGINE", None)
+        else:
+            os.environ["TEMPO_LIVE_ENGINE"] = prev
+    eng = inst.live_engine
+    eng.persist_crossover()
+    stats = eng.stats()
+    dbl.close()
+    return {"crossover_rows": stats["crossover_rows"],
+            "host_s_per_row": eng._host_s_per_row,
+            "device_fixed_s": eng._dev_fixed_s}
+
+
 def cmd_query_range(args):
     """Offline TraceQL metrics over a backend path: the CLI face of
     /api/metrics/query_range (db/metrics_exec), Prometheus matrix JSON
@@ -446,6 +588,22 @@ def main(argv=None):
                    help="self-tracing tenant (default: self)")
     p.add_argument("--timeout", type=float, default=30.0)
     p.set_defaults(fn=cmd_self_trace)
+
+    p = sub.add_parser("calibrate",
+                       help="measure host-vs-device crossovers (find race, "
+                            "cold scan rate, live-head engines) and commit "
+                            "them to the CostLedger for `auto` routing")
+    p.add_argument("--tenant", default="",
+                   help="tenant whose blocks the find race runs over "
+                        "(default: first tenant with blocks)")
+    p.add_argument("--ledger", default="",
+                   help="ledger artifact path (default: TEMPO_COST_LEDGER "
+                        "env, else <backend.path>/cost_ledger.json)")
+    p.add_argument("--repeats", type=int, default=3,
+                   help="timed repetitions per engine (best-of)")
+    p.add_argument("--skip-live", action="store_true",
+                   help="skip the synthetic live-head engine race")
+    p.set_defaults(fn=cmd_calibrate)
 
     p = sub.add_parser("query-range",
                        help="TraceQL metrics range query against the backend")

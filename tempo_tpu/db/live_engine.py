@@ -75,6 +75,25 @@ class LiveEngine:
         # host twin scans at s/row, the device path pays ~fixed seconds
         self._host_s_per_row: float | None = None
         self._dev_fixed_s: float | None = None
+        self._measured = False  # did THIS process observe an engine run?
+        # seed the EMAs from the persisted CostLedger (a PREVIOUS
+        # process's measurements) so routing starts measured instead of
+        # re-learning from scratch every restart. The env seed still
+        # wins when set -- the operator aimed the crossover on purpose.
+        if not _env_flag("TEMPO_LIVE_CROSSOVER_ROWS"):
+            try:
+                from ..util.costledger import KEY_LIVE_SEARCH, ledger
+
+                entry = ledger().get(KEY_LIVE_SEARCH)
+                if entry:
+                    h = float(entry.get("host_s_per_row", 0.0) or 0.0)
+                    d = float(entry.get("device_fixed_s", 0.0) or 0.0)
+                    if h > 0:
+                        self._host_s_per_row = h
+                    if d > 0:
+                        self._dev_fixed_s = d
+            except Exception:
+                pass  # routing falls back to the seed constant
 
     # ------------------------------------------------------------- push
     def note_push(self, tids, now: float) -> None:
@@ -109,6 +128,7 @@ class LiveEngine:
     def _observe_engine(self, engine: str, rows: int, seconds: float) -> None:
         if seconds <= 0:
             return
+        self._measured = True
         if engine == "host":
             per_row = seconds / max(rows, 1)
             cur = self._host_s_per_row
@@ -322,6 +342,32 @@ class LiveEngine:
         if slot < 0:
             return None
         return inst._find_live_map(trace_id)
+
+    def persist_crossover(self) -> None:
+        """Commit this process's measured engine rates to the
+        CostLedger so the NEXT process starts from them (ingester stop
+        hook). Writes ONLY when this process actually observed an
+        engine run: ledger-seeded values that never updated are not
+        re-written (a restart loop would otherwise keep refreshing
+        measured_at_unix on stale rates forever). Multi-tenant
+        ingesters persist per instance; instances that measured nothing
+        skip, so the last real measurement wins."""
+        if not self._measured:
+            return
+        if self._host_s_per_row is None and self._dev_fixed_s is None:
+            return
+        try:
+            from ..util.costledger import KEY_LIVE_SEARCH, ledger
+
+            fields = {"crossover_rows": round(self.crossover_rows(), 1)}
+            if self._host_s_per_row is not None:
+                fields["host_s_per_row"] = self._host_s_per_row
+            if self._dev_fixed_s is not None:
+                fields["device_fixed_s"] = self._dev_fixed_s
+            ledger().update(KEY_LIVE_SEARCH, **fields)
+            ledger().publish()
+        except Exception:
+            pass  # persistence is advisory; next process re-learns
 
     # --------------------------------------------------------------- ops
     def stats(self) -> dict:

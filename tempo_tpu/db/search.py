@@ -80,6 +80,7 @@ _host_io_pool = ThreadPoolExecutor(max_workers=8, thread_name_prefix="search-io"
 from ..util.linkcost import link_rtt_ms as _link_rtt_ms
 
 _HOST_RATE_BPS: float = 1.5e9  # EMA, seeded at DDR-ish single-core scan rate
+_HOST_RATE_SEEDED = False  # ledger seed applied (once per process)
 
 
 def _note_host_rate(n_bytes: int, seconds: float) -> None:
@@ -89,6 +90,30 @@ def _note_host_rate(n_bytes: int, seconds: float) -> None:
         # on the same steady state and a lock would serialize every scan
         # tempo: ignore[global-mutation-unlocked] intentional lock-free EMA
         _HOST_RATE_BPS = 0.7 * _HOST_RATE_BPS + 0.3 * (n_bytes / seconds)
+
+
+def seed_host_rate_from_ledger() -> None:
+    """Seed the cold-scan host-rate EMA from the CostLedger's measured
+    block_scan entry (tempo-tpu-cli calibrate) instead of the DDR-ish
+    constant -- the first routing decisions of a fresh process then
+    start from THIS box's measured scan rate. Later scans keep updating
+    the EMA as before; called once by TempoDB init (idempotent)."""
+    global _HOST_RATE_BPS, _HOST_RATE_SEEDED
+    if _HOST_RATE_SEEDED:
+        return
+    # racing initializers write the same ledger value
+    # tempo: ignore[global-mutation-unlocked] once-at-init seed
+    _HOST_RATE_SEEDED = True
+    try:
+        from ..util.costledger import KEY_BLOCK_SCAN, ledger
+
+        entry = ledger().get(KEY_BLOCK_SCAN)
+        rate = float(entry.get("host_rate_bps", 0.0)) if entry else 0.0
+        if rate > 0:
+            # tempo: ignore[global-mutation-unlocked] same seed-once write
+            _HOST_RATE_BPS = rate
+    except Exception:
+        pass  # routing falls back to the constant seed
 
 
 @dataclass
@@ -952,6 +977,53 @@ def _collect_topk_multi(blocks, plans, offsets, req: SearchRequest, selector,
 _DEVICE_SEARCH_MAX_BYTES = 512 << 20  # stacked-column budget before falling back
 
 
+def _count_struct_nodes(tree) -> int:
+    """Struct ('>' / '>>' / '~') nodes in a condition tree. Each one
+    costs its own round of span-axis all_gathers on the mesh, so the
+    pre-IO budget estimate must scale with the count, not a boolean.
+    ('struct', op, lhs, rhs): t[1] is the op STRING, never recursed."""
+    if not isinstance(tree, tuple):
+        return 0
+    n = 1 if tree[0] == "struct" else 0
+    children = tree[2:] if tree[0] == "struct" else tree[1:]
+    return n + sum(_count_struct_nodes(ch) for ch in children
+                   if isinstance(ch, tuple))
+
+
+def _stacked_words_est(items, needed: list[str], tree, sp: int,
+                       S_b: int, NT_b: int, attr_b: dict[str, int]) -> int:
+    """Per-block stacked-column words the mesh program will hold on
+    device, estimated BEFORE any column IO (an over-budget group must
+    fall back without paying the cold reads). Per-axis products plus
+    the struct-node all_gather replication -- EACH struct node gathers
+    full span-axis tables onto EVERY chip (lm/pid/valid +
+    pointer-doubling temps), so the term scales with the node COUNT
+    (the costmodel comm walker prices the same gathers on the wire:
+    3 all_gathers per node -- tests cross-check the two counts)."""
+    from ..ops.device import bucket
+
+    span_cols = [n for n in needed if n.startswith("span.")]
+    est = S_b * max(1, len(span_cols))
+    # trace-axis tables (span_off at NT_b+1 plus any trace.* conds) and
+    # res-axis columns ride every block too; their row counts come from
+    # footer metadata (pack.n_rows_of), so trace-heavy groups near the
+    # budget are no longer understated (ADVICE round 5)
+    n_trace_cols = sum(1 for n in needed if n.startswith("trace."))
+    est += NT_b * n_trace_cols
+    res_cols = [n for n in needed if n.startswith("res.")]
+    if res_cols:
+        r_rows = max((blk.pack.n_rows_of(n) for blk, _ in items for n in res_cols),
+                     default=1)
+        est += bucket(max(r_rows, 1)) * len(res_cols)
+    for pre, a_b in attr_b.items():
+        n_val_cols = sum(
+            1 for n in needed if n.startswith(f"{pre}.") and not n.endswith((".span", ".res"))
+        )
+        est += a_b * n_val_cols + (S_b + 1 if pre == "sattr" else 0)  # values + off
+    est += 6 * S_b * sp * _count_struct_nodes(tree)
+    return est
+
+
 def search_blocks_device(
     blocks: list[BackendBlock],
     req: SearchRequest,
@@ -1023,7 +1095,6 @@ def _search_group_device(items, tree, conds, req: SearchRequest, mesh, resp: Sea
     # needs (span.parent_idx for struct nodes).
     needed = [n for n in required_columns(conds) + list(items[0][1].extra_cols)
               if not n.startswith("span@")]
-    span_cols = [n for n in needed if n.startswith("span.")]
     B = len(items)
     Bp = ((B + dp - 1) // dp) * dp
     s_max = max(blk.pack.axes[S.AX_SPAN].n_rows for blk, _ in items)
@@ -1038,31 +1109,7 @@ def _search_group_device(items, tree, conds, req: SearchRequest, mesh, resp: Sea
                 blk.pack.axes[ax].n_rows if ax in blk.pack.axes else 0 for blk, _ in items
             )
             attr_b[pre] = sp * bucket(max(1, -(-max(a_max, 1) // sp)))
-    # stacked-bytes estimate BEFORE any column IO, per-axis products: an
-    # over-budget group must fall back without paying the cold reads
-    est = S_b * max(1, len(span_cols))
-    # trace-axis tables (span_off at NT_b+1 plus any trace.* conds) and
-    # res-axis columns ride every block too; their row counts come from
-    # footer metadata (pack.n_rows_of), so trace-heavy groups near the
-    # budget are no longer understated (ADVICE round 5)
-    n_trace_cols = sum(1 for n in needed if n.startswith("trace."))
-    est += NT_b * n_trace_cols
-    res_cols = [n for n in needed if n.startswith("res.")]
-    if res_cols:
-        r_rows = max((blk.pack.n_rows_of(n) for blk, _ in items for n in res_cols),
-                     default=1)
-        est += bucket(max(r_rows, 1)) * len(res_cols)
-    for pre, a_b in attr_b.items():
-        n_val_cols = sum(
-            1 for n in needed if n.startswith(f"{pre}.") and not n.endswith((".span", ".res"))
-        )
-        est += a_b * n_val_cols + (S_b + 1 if pre == "sattr" else 0)  # values + off
-    if items[0][1].has_struct:
-        # each struct node all_gathers full span-axis tables onto EVERY
-        # chip (lm/pid/valid + pointer-doubling temps): account the
-        # replication so near-budget struct queries fall back instead of
-        # exhausting device memory mid-program
-        est += 6 * S_b * sp
+    est = _stacked_words_est(items, needed, tree, sp, S_b, NT_b, attr_b)
     if Bp * est * 4 > _DEVICE_SEARCH_MAX_BYTES:
         from ..util.kerneltel import TEL
 

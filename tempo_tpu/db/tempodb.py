@@ -96,6 +96,11 @@ class TempoDB:
         self.poll_duration = Histogram("tempo_blocklist_poll_duration_seconds")
         self.poll_errors = Counter("tempo_blocklist_poll_errors_total")
         self.polls = Counter("tempo_blocklist_polls_total")
+        # measured-crossover routing: seed the cold-scan host-rate EMA
+        # from the persisted CostLedger (util/costledger) once
+        from .search import seed_host_rate_from_ledger
+
+        seed_host_rate_from_ledger()
 
     @property
     def mesh(self):

@@ -459,12 +459,16 @@ def eval_block(
         for i in table_idxs
     ]
     fn = _compiled(tree, conds, table_idxs, n_spans_b, n_res_b, n_traces_b, span_out)
+    from ..util import costmodel
     from ..util.kerneltel import TEL
 
+    ns, nt = np.int32(n_spans), np.int32(n_traces)
     TEL.record_launch(
         "filter",
         ("filter", tree, conds, table_idxs, n_spans_b, n_res_b, n_traces_b, span_out),
         n_spans_b,
+        cost=lambda: costmodel.spec(fn, cols, operands.ints, operands.floats,
+                                    table_list, ns, nt),
     )
     import time as _time
 
@@ -474,7 +478,7 @@ def eval_block(
         operands.ints,
         operands.floats,
         table_list,
-        np.int32(n_spans),
-        np.int32(n_traces),
+        ns,
+        nt,
     )
     return TEL.observe_device("filter", n_spans_b, t0, out)

@@ -135,23 +135,12 @@ def lookup_ids_blocks_host(blocks: list, query_codes: np.ndarray) -> np.ndarray:
     return out
 
 
-def lookup_ids_blocks_cached(blocks: list, query_codes: np.ndarray,
-                             mode: str = "auto") -> np.ndarray:
-    """Batched multi-block lookup, engine picked per topology. 'auto'
-    uses the host searchsorted engine on a single chip (each device
-    dispatch+fetch costs a full link RTT; the bisection itself is
-    microseconds either way) and the device kernel path when a mesh of
-    chips is attached (ids stay device-resident and shard over the
-    mesh). Returns (B, Q) int32 row-in-block (-1 miss)."""
-    B = len(blocks)
+def _lookup_blocks_device(blocks: list, query_codes: np.ndarray) -> np.ndarray:
+    """The device engine body: per-block cached device id indexes, one
+    lockstep bisection kernel per id-row bucket, one timing window over
+    the whole batch. Shared by the routed entry below and the
+    calibration race."""
     q = query_codes.shape[0]
-    if B == 0 or q == 0:
-        return np.full((B, q), -1, dtype=np.int32)
-    if mode == "host" or (mode == "auto" and len(jax.devices()) == 1):
-        TEL.record_routing(
-            "find", "host", "forced" if mode == "host" else "single_chip_rtt")
-        return lookup_ids_blocks_host(blocks, query_codes)
-    TEL.record_routing("find", "device", "forced" if mode == "device" else "mesh")
     qb = bucket(q)
     # host arrays ride the dispatch upload; eager jnp conversions here
     # would each pay a blocking host->device round trip
@@ -163,9 +152,13 @@ def lookup_ids_blocks_cached(blocks: list, query_codes: np.ndarray,
         dev_ids, n = _device_ids(blk)
         tb = int(dev_ids.shape[0])  # id-row bucket: the launch key's label
         n_steps = tb.bit_length()
-        TEL.record_launch("find", ("find1", tb, qb), tb)
+        nv = np.int32(n)
+        TEL.record_launch(
+            "find", ("find1", tb, qb), tb,
+            cost=lambda dev_ids=dev_ids, nv=nv, n_steps=n_steps: _costmodel(
+            ).spec(_lookup_kernel, dev_ids, queries, nv, n_steps))
         buckets.append(tb)
-        outs.append(_lookup_kernel(dev_ids, queries, np.int32(n), n_steps))
+        outs.append(_lookup_kernel(dev_ids, queries, nv, n_steps))
     stacked = jnp.stack(outs) if len(outs) > 1 else outs[0][None]
     res = np.asarray(stacked)[:, :q]
     # one timing window covers the whole batch (per-block syncs would
@@ -176,6 +169,120 @@ def lookup_ids_blocks_cached(blocks: list, query_codes: np.ndarray,
     for tb in buckets:
         TEL.credit_device("find", tb, dt / len(buckets))
     return res
+
+
+def _costmodel():
+    from ..util import costmodel
+
+    return costmodel
+
+
+def _n_devices() -> int:
+    """Visible chip count (own function so topology tests can pin it)."""
+    return len(jax.devices())
+
+
+def _find_policy(mode: str, rows: int) -> tuple[str, str]:
+    """Resolve the find engine for a SINGLE-chip topology:
+    (engine, routing reason). TEMPO_FIND_MODE overrides the caller's
+    mode (env always wins); 'auto' consults the CostLedger's measured
+    find race (tempo-tpu-cli calibrate / the find_auto_crossover_rows
+    bench row): host cost is linear in scanned id rows while the device
+    path is ~fixed, so THIS batch's row count is compared against the
+    committed crossover_rows -- a race calibrated on a small block
+    still routes a huge multi-block lookup to the device once it is
+    past the crossover. Entries without crossover_rows fall back to
+    the race's binary winner; no entry at all falls back to the
+    host-on-one-chip assumption."""
+    import os
+
+    env = os.environ.get("TEMPO_FIND_MODE", "")
+    if env in ("host", "device", "auto"):
+        mode = env
+    if mode == "host":
+        return "host", "forced"
+    if mode == "device":
+        return "device", "forced"
+    from ..util.costledger import KEY_FIND, ledger
+
+    entry = ledger().get(KEY_FIND)
+    if entry:
+        cross = entry.get("crossover_rows")
+        if cross and float(cross) > 0:
+            return (("device" if rows >= float(cross) else "host"),
+                    "ledger_crossover")
+        if entry.get("winner") in ("host", "device"):
+            return entry["winner"], "ledger_crossover"
+    return "host", "single_chip_rtt"
+
+
+def lookup_ids_blocks_cached(blocks: list, query_codes: np.ndarray,
+                             mode: str = "auto") -> np.ndarray:
+    """Batched multi-block lookup, engine picked per topology +
+    measured crossover. A mesh of chips always runs the device kernel
+    (ids stay device-resident and shard over the mesh); on a single
+    chip 'auto' routes by the CostLedger's committed host-vs-device
+    race (_find_policy) -- the host searchsorted engine remains the
+    default only until someone actually measures. Both engines return
+    bit-identical (B, Q) int32 row-in-block (-1 miss)."""
+    B = len(blocks)
+    q = query_codes.shape[0]
+    if B == 0 or q == 0:
+        return np.full((B, q), -1, dtype=np.int32)
+    if mode != "host" and _n_devices() > 1:
+        TEL.record_routing("find", "device",
+                           "forced" if mode == "device" else "mesh")
+        return _lookup_blocks_device(blocks, query_codes)
+    # id-index rows of THIS batch, from footer metadata (no IO)
+    rows = sum(int(b.meta.total_traces) for b in blocks)
+    engine, reason = _find_policy(mode, rows)
+    TEL.record_routing("find", engine, reason)
+    if engine == "host":
+        return lookup_ids_blocks_host(blocks, query_codes)
+    return _lookup_blocks_device(blocks, query_codes)
+
+
+def calibrate_find(blocks: list, query_codes: np.ndarray, repeats: int = 3,
+                   record: bool = True) -> dict:
+    """THE find race (ROADMAP item 5): run both engines over the same
+    blocks/queries, take best-of-repeats (noise only ever adds time),
+    and commit the measured crossover to the CostLedger so the `auto`
+    policy stops guessing. Returns the ledger entry.
+
+    crossover_rows models the host engine as linear in scanned id rows
+    and the device engine as a ~fixed dispatch+fetch: the id-row count
+    at which the device path starts winning for this query batch."""
+    rows = int(sum(b.trace_index["trace.id_codes"].shape[0] for b in blocks))
+    q = int(query_codes.shape[0])
+
+    def best(fn) -> float:
+        fn()  # warm: device compiles + id uploads; host void16 caches
+        times = []
+        for _ in range(max(1, repeats)):
+            t0 = _time.perf_counter()
+            fn()
+            times.append(_time.perf_counter() - t0)
+        return min(times)
+
+    host_s = best(lambda: lookup_ids_blocks_host(blocks, query_codes))
+    device_s = best(lambda: _lookup_blocks_device(blocks, query_codes))
+    host_per_row = host_s / max(rows, 1)
+    entry = {
+        "host_s": round(host_s, 6),
+        "device_s": round(device_s, 6),
+        "host_s_per_row": host_per_row,
+        "rows": rows,
+        "queries": q,
+        "repeats": int(repeats),
+        "winner": "host" if host_s <= device_s else "device",
+        "crossover_rows": round(device_s / max(host_per_row, 1e-12), 1),
+    }
+    if record:
+        from ..util.costledger import KEY_FIND, ledger
+
+        ledger().update(KEY_FIND, **entry)
+        ledger().publish()
+    return entry
 
 
 def lookup_ids_blocks(id_code_arrays: list[np.ndarray], query_codes: np.ndarray) -> np.ndarray:
@@ -197,7 +304,10 @@ def lookup_ids_blocks(id_code_arrays: list[np.ndarray], query_codes: np.ndarray)
     qb = bucket(q)
     queries = pad_rows(np.asarray(query_codes, dtype=np.int32), qb, PAD_I32)
     n_steps = int(T).bit_length()
-    TEL.record_launch("find", ("findB", B, T, qb), T)
+    TEL.record_launch(
+        "find", ("findB", B, T, qb), T,
+        cost=lambda: _costmodel().spec(
+            _lookup_blocks_kernel, ids, queries, n_valid, n_steps))
     t0 = _time.perf_counter()
     out = _lookup_blocks_kernel(ids, queries, n_valid, n_steps)
     res = np.asarray(out)[:, :q]
@@ -217,9 +327,12 @@ def lookup_ids(id_codes: np.ndarray, query_codes: np.ndarray) -> np.ndarray:
     ids = pad_rows(np.asarray(id_codes, dtype=np.int32), tb, np.int32(2**31 - 1))
     queries = pad_rows(np.asarray(query_codes, dtype=np.int32), qb, PAD_I32)
     n_steps = int(tb).bit_length()  # ceil(log2(tb)) + 1 covers the range
-    TEL.record_launch("find", ("find1", tb, qb), tb)
+    nv = np.int32(n)
+    TEL.record_launch(
+        "find", ("find1", tb, qb), tb,
+        cost=lambda: _costmodel().spec(_lookup_kernel, ids, queries, nv, n_steps))
     t0 = _time.perf_counter()
-    out = _lookup_kernel(ids, queries, np.int32(n), n_steps)
+    out = _lookup_kernel(ids, queries, nv, n_steps)
     res = np.asarray(out)[:q]
     TEL.observe_device("find", tb, t0)
     return res

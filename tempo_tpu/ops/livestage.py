@@ -42,6 +42,7 @@ of the selected candidates (db/live_engine).
 from __future__ import annotations
 
 import threading
+import weakref
 from dataclasses import dataclass, field
 from functools import lru_cache
 
@@ -55,6 +56,20 @@ from .stage import GKEY_ORIGIN_S
 
 _I32_MIN = -(2**31)
 _I32_MAX = 2**31 - 1
+
+# live stagers (one per ingester instance/tenant), weakly held so the
+# HBM ledger (util/costmodel) can account their resident device tails
+# without keeping drained instances alive
+_registry_lock = threading.Lock()
+_stagers: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def stager_device_bytes() -> tuple[int, int]:
+    """(total device bytes of all live stagers' resident columns,
+    stager count) -- the livestage component of the HBM ledger."""
+    with _registry_lock:
+        stagers = list(_stagers)
+    return sum(s.device_bytes() for s in stagers), len(stagers)
 
 
 def _clip_i32(v: int) -> int:
@@ -236,11 +251,7 @@ def eval_live_device(snap: LiveSnapshot, tag_codes: list[int],
     key = (len(tag_codes), len(name_codes), t0 > 0, t1 > 0, dmin > 0,
            snap.slot_b, snap.kv_b, snap.name_b)
     fn = _compiled_live_filter(*key)
-    TEL.record_launch("live_filter", ("live_filter",) + key, snap.slot_b)
-    import time as _time
-
-    t_start = _time.perf_counter()
-    out = fn(
+    args = (
         d["start_s"], d["end_s"], d["dur_ms"], d["alive"],
         d["kv_owner"], d["kv_code"], d["name_owner"], d["name_code"],
         np.asarray(tag_codes or [0], dtype=np.int32),
@@ -248,6 +259,14 @@ def eval_live_device(snap: LiveSnapshot, tag_codes: list[int],
         np.int32(_clip_i32(t0)), np.int32(_clip_i32(t1)),
         np.int32(_clip_i32(dmin)), np.int32(snap.n_slots),
     )
+    from ..util import costmodel
+
+    TEL.record_launch("live_filter", ("live_filter",) + key, snap.slot_b,
+                      cost=lambda: costmodel.spec(fn, *args))
+    import time as _time
+
+    t_start = _time.perf_counter()
+    out = fn(*args)
     return TEL.observe_device("live_filter", snap.slot_b, t_start, out)
 
 
@@ -300,12 +319,17 @@ def find_slot_device(snap: LiveSnapshot, trace_id: bytes) -> int:
 
     d = snap.dev
     fn = _compiled_find(snap.slot_b)
-    TEL.record_launch("live_find", ("live_find", snap.slot_b), snap.slot_b)
+    q = np.asarray(S.trace_id_to_codes(trace_id.rjust(16, b"\x00")), dtype=np.int32)
+    ns = np.int32(snap.n_slots)
+    from ..util import costmodel
+
+    TEL.record_launch(
+        "live_find", ("live_find", snap.slot_b), snap.slot_b,
+        cost=lambda: costmodel.spec(fn, d["id_codes"], d["alive"], q, ns))
     import time as _time
 
     t0 = _time.perf_counter()
-    q = np.asarray(S.trace_id_to_codes(trace_id.rjust(16, b"\x00")), dtype=np.int32)
-    out = fn(d["id_codes"], d["alive"], q, np.int32(snap.n_slots))
+    out = fn(d["id_codes"], d["alive"], q, ns)
     out = TEL.observe_device("live_find", snap.slot_b, t0, out)
     return int(np.asarray(out))
 
@@ -379,6 +403,14 @@ class LiveStager:
         self._dev_rows: tuple[int, int, int] | None = None  # slots, kv, name
         self._dirty_slots: set[int] = set()  # slots changed since last upload
         self._snap: LiveSnapshot | None = None
+        with _registry_lock:
+            _stagers.add(self)
+
+    def device_bytes(self) -> int:
+        """Resident device bytes of the staged tails (HBM ledger)."""
+        with self.lock:
+            dev = self._dev
+            return sum(int(a.nbytes) for a in dev.values()) if dev else 0
 
     # ------------------------------------------------------ host tails
     def _grow_slots_locked(self, need: int) -> None:

@@ -63,9 +63,13 @@ def select_topk_device(mask, key, counts, k: int):
     from ..util.kerneltel import TEL
 
     k = int(min(k, mask.shape[0]))
-    TEL.record_launch("select", ("sel1", k, int(mask.shape[0])), k)
+    from ..util import costmodel
+
+    sel = _compiled_select(k)
+    TEL.record_launch("select", ("sel1", k, int(mask.shape[0])), k,
+                      cost=lambda: costmodel.spec(sel, mask, key, counts))
     t0 = _time.perf_counter()
-    out = np.asarray(_compiled_select(k)(mask, key, counts))
+    out = np.asarray(sel(mask, key, counts))
     TEL.observe_device("select", k, t0)
     sids, cnts, valid = out[:k], out[k : 2 * k], out[2 * k : 3 * k] > 0
     return sids[valid], cnts[valid], int(out[3 * k])
@@ -107,11 +111,16 @@ def select_topk_device_multi(masks, keys, counts, k: int):
 
     total = int(sum(m.shape[0] for m in masks))
     k = int(min(k, total))
+    from ..util import costmodel
+
+    sel = _compiled_select_multi(k, len(masks))
     TEL.record_launch(
-        "select", ("selN", k, tuple(int(m.shape[0]) for m in masks)), k)
+        "select", ("selN", k, tuple(int(m.shape[0]) for m in masks)), k,
+        cost=lambda: costmodel.spec(
+            sel, tuple(masks), tuple(keys), tuple(counts)))
     t0 = _time.perf_counter()
     out = np.asarray(
-        _compiled_select_multi(k, len(masks))(tuple(masks), tuple(keys), tuple(counts))
+        sel(tuple(masks), tuple(keys), tuple(counts))
     )
     TEL.observe_device("select", k, t0)
     gids, cnts, valid = out[:k], out[k : 2 * k], out[2 * k : 3 * k] > 0

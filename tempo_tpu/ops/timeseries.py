@@ -143,19 +143,25 @@ def eval_timeseries_device(query, staged, operands: Operands,
     t0 = int(np.clip(t0_rel_ms, -(2**31) + 1, 2**31 - 1))
     import time as _time
 
+    from ..util import costmodel
     from ..util.kerneltel import TEL
 
+    t0_i = np.int32(t0)
+    step_i = np.int32(max(1, step_ms))
+    ns_i, nb_i = np.int32(staged.n_spans), np.int32(n_buckets)
     TEL.record_launch(
         "timeseries",
         ("ts", tree, conds, table_idxs, has_val, staged.n_spans_b,
          staged.n_res_b, staged.n_traces_b, G_b, B_b),
         staged.n_spans_b,
+        cost=lambda: costmodel.spec(fn, staged.cols, operands.ints,
+                                    operands.floats, tabs, gid_p, val_p,
+                                    pres_p, t0_i, step_i, ns_i, nb_i),
     )
     tw = _time.perf_counter()
     outs = fn(staged.cols, operands.ints, operands.floats, tabs,
               gid_p, val_p, pres_p,
-              np.int32(t0), np.int32(max(1, step_ms)),
-              np.int32(staged.n_spans), np.int32(n_buckets))
+              t0_i, step_i, ns_i, nb_i)
     res = tuple(np.asarray(o)[:n_groups, :n_buckets] for o in outs)
     TEL.observe_device("timeseries", staged.n_spans_b, tw)
     return res

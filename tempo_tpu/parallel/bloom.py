@@ -50,14 +50,17 @@ def sharded_bloom_union(mesh, blooms: list[ShardedBloom]) -> ShardedBloom:
     fn = make_sharded_union(mesh, K, first.words.shape[0], first.words.shape[1])
     import time as _time
 
+    from ..util import costmodel
     from ..util.kerneltel import TEL
 
-    TEL.record_launch("mesh_bloom", ("union", K, first.words.shape), K)
+    stacked_j = jnp.asarray(stacked)
+    TEL.record_launch("mesh_bloom", ("union", K, first.words.shape), K,
+                      cost=lambda: costmodel.spec(fn, stacked_j, mesh=mesh))
     t0 = _time.perf_counter()
     out = ShardedBloom(first.n_shards, first.shard_bits)
     from .mesh import DISPATCH_LOCK
 
     with DISPATCH_LOCK:  # collective programs must not interleave enqueues
-        out.words = np.asarray(fn(jnp.asarray(stacked)))
+        out.words = np.asarray(fn(stacked_j))
     TEL.observe_device("mesh_bloom", K, t0)
     return out

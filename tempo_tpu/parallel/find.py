@@ -103,14 +103,18 @@ def sharded_find_rows(mesh, id_code_arrays: list[np.ndarray], query_codes: np.nd
     fn = make_sharded_find_rows(mesh, ids.shape[0], T, Qb)
     import time as _time
 
+    from ..util import costmodel
     from ..util.kerneltel import TEL
 
-    TEL.record_launch("mesh_find", ("rows", ids.shape[0], T, Qb), T)
+    ids_j, nv_j, q_j = jnp.asarray(ids), jnp.asarray(n_valid), jnp.asarray(queries)
+    TEL.record_launch(
+        "mesh_find", ("rows", ids.shape[0], T, Qb), T,
+        cost=lambda: costmodel.spec(fn, ids_j, nv_j, q_j, mesh=mesh))
     t0 = _time.perf_counter()
     from .mesh import DISPATCH_LOCK
 
     with DISPATCH_LOCK:  # collective programs must not interleave enqueues
-        out = np.asarray(fn(jnp.asarray(ids), jnp.asarray(n_valid), jnp.asarray(queries)))
+        out = np.asarray(fn(ids_j, nv_j, q_j))
     TEL.observe_device("mesh_find", T, t0)
     return out[: len(id_code_arrays), :q]
 
@@ -143,14 +147,18 @@ def sharded_find(mesh, id_code_arrays: list[np.ndarray], query_codes: np.ndarray
     fn = make_sharded_find(mesh, ids.shape[0], T, Qb)
     import time as _time
 
+    from ..util import costmodel
     from ..util.kerneltel import TEL
 
-    TEL.record_launch("mesh_find", ("elect", ids.shape[0], T, Qb), T)
+    ids_j, nv_j, q_j = jnp.asarray(ids), jnp.asarray(n_valid), jnp.asarray(queries)
+    TEL.record_launch(
+        "mesh_find", ("elect", ids.shape[0], T, Qb), T,
+        cost=lambda: costmodel.spec(fn, ids_j, nv_j, q_j, mesh=mesh))
     t0 = _time.perf_counter()
     from .mesh import DISPATCH_LOCK
 
     with DISPATCH_LOCK:  # collective programs must not interleave enqueues
-        out = np.asarray(fn(jnp.asarray(ids), jnp.asarray(n_valid), jnp.asarray(queries)))[:q]
+        out = np.asarray(fn(ids_j, nv_j, q_j))[:q]
     TEL.observe_device("mesh_find", T, t0)
     out = out.astype(np.int32, copy=True)
     out[out[:, 0] < 0] = -1  # normalize misses to (-1, -1)

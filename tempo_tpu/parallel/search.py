@@ -345,16 +345,29 @@ def sharded_search(mesh, tree, conds, operands, cols: dict[str, np.ndarray],
     arrays = [jnp.asarray(tabs[i]) for i in table_idxs] + [jnp.asarray(cols[n]) for n in names]
     import time as _time
 
+    from ..util import costmodel
     from ..util.kerneltel import TEL
 
+    ints_j = jnp.asarray(ints)
+    floats_j = jnp.asarray(floats)
+    nsp_j = jnp.asarray(n_spans, dtype=np.int32)
     TEL.record_launch(
-        "mesh_search", ("search", tree, conds, names, B, S, R, NT, table_idxs), S)
+        "mesh_search", ("search", tree, conds, names, B, S, R, NT, table_idxs), S,
+        cost=lambda: costmodel.spec(fn, ints_j, floats_j, nsp_j, *arrays,
+                                    mesh=mesh))
     t0 = _time.perf_counter()
+    t0_wall = _time.time()
     from .mesh import DISPATCH_LOCK
 
     with DISPATCH_LOCK:  # collective programs must not interleave enqueues
-        tm, sc = fn(jnp.asarray(ints), jnp.asarray(floats),
-                    jnp.asarray(n_spans, dtype=np.int32), *arrays)
+        tm, sc = fn(ints_j, floats_j, nsp_j, *arrays)
         out = np.asarray(tm), np.asarray(sc)
     TEL.observe_device("mesh_search", S, t0)
+    # timeline: the mesh leg with its statically-priced collective bytes
+    # (costmodel comm walker; zeros until the background capture lands)
+    comm = costmodel.COST.comm_for("mesh_search", str(S))
+    TEL.child_span(
+        "mesh:search", t0_wall, _time.time(),
+        {"blocks": B, "bucket": S, "comm_bytes": int(sum(comm.values())),
+         **{f"comm.{c}": int(b) for c, b in sorted(comm.items())}})
     return out

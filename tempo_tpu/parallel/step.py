@@ -46,4 +46,26 @@ def distributed_query_step(mesh, tree, conds, col_names: tuple[str, ...],
         bu = union_fn(blooms)
         return hits, tm, sc, bu
 
-    return jax.jit(step)
+    fn = jax.jit(step)
+
+    def launcher(ids, n_valid, queries, ops_i, ops_f, n_spans, col_arrays, blooms):
+        """Thin telemetry shim over the jitted step: the driver calls
+        this like the jit fn; the first call per shape also captures the
+        composed program's XLA costs + collective comm bytes
+        (util/costmodel -- find's pmax, search's psum, union's
+        all_gather all in ONE walk)."""
+        from ..util import costmodel
+        from ..util.kerneltel import TEL
+
+        args = (ids, n_valid, queries, ops_i, ops_f, n_spans, col_arrays, blooms)
+        TEL.record_launch(
+            "mesh_step", ("step", B, T, Q, S, R, NT, K, NS, W), S,
+            cost=lambda: costmodel.spec(fn, *args, mesh=mesh))
+        import time as _time
+
+        t0 = _time.perf_counter()
+        out = fn(*args)
+        TEL.observe_device("mesh_step", S, t0)
+        return out
+
+    return launcher

@@ -162,22 +162,26 @@ def sharded_timeseries(mesh, tree, conds, operands, cols: dict[str, np.ndarray],
     arrays += [jnp.asarray(cols[n]) for n in names]
     import time as _time
 
+    from ..util import costmodel
     from ..util.kerneltel import TEL
 
+    call_args = (
+        jnp.asarray(ints), jnp.asarray(floats),
+        jnp.asarray(n_spans, np.int32), jnp.asarray(t0_rel, np.int32),
+        jnp.asarray(np.int32(max(1, step_ms))),
+        jnp.asarray(np.int32(n_buckets)),
+        jnp.asarray(np.asarray(gid, np.int32)),
+        jnp.asarray(np.asarray(val, np.float32)),
+        jnp.asarray(np.asarray(pres, bool)), *arrays)
     TEL.record_launch(
         "mesh_timeseries",
-        ("ts", tree, conds, names, has_val, G_b, NB_b, NT, B, S, table_idxs), S)
+        ("ts", tree, conds, names, has_val, G_b, NB_b, NT, B, S, table_idxs), S,
+        cost=lambda: costmodel.spec(fn, *call_args, mesh=m1))
     tw = _time.perf_counter()
     from .mesh import DISPATCH_LOCK
 
     with DISPATCH_LOCK:  # collective programs must not interleave enqueues
-        outs = fn(jnp.asarray(ints), jnp.asarray(floats),
-                  jnp.asarray(n_spans, np.int32), jnp.asarray(t0_rel, np.int32),
-                  jnp.asarray(np.int32(max(1, step_ms))),
-                  jnp.asarray(np.int32(n_buckets)),
-                  jnp.asarray(np.asarray(gid, np.int32)),
-                  jnp.asarray(np.asarray(val, np.float32)),
-                  jnp.asarray(np.asarray(pres, bool)), *arrays)
+        outs = fn(*call_args)
         res = tuple(np.asarray(o)[:n_groups, :n_buckets] for o in outs)
     TEL.observe_device("mesh_timeseries", S, tw)
     return res

@@ -104,6 +104,14 @@ class AppConfig:
     # querier.search.external_endpoints, querier.go:401-458)
     search_external_endpoints: str = ""
     search_external_hedge_after_s: float = 4.0
+    # persistent XLA compilation cache dir ("" = TEMPO_COMPILE_CACHE_DIR
+    # env, or off): restarts deserialize compiled kernels from disk
+    # instead of re-paying the first-compile storm (util/costmodel)
+    compile_cache_dir: str = ""
+    # measured-crossover CostLedger artifact ("" = TEMPO_COST_LEDGER
+    # env, else <storage_path>/cost_ledger.json): find/live-search/
+    # block-scan routing seeds from it at startup (util/costledger)
+    cost_ledger_path: str = ""
 
 
 class App:
@@ -138,6 +146,21 @@ class App:
                 "must advertise an http(s):// address (--advertise.addr) for "
                 "peers to reach it"
             )
+        # device cost plane wiring BEFORE the first TempoDB (it seeds
+        # routing from the ledger at init): persistent compile cache +
+        # the measured-crossover CostLedger artifact. Explicit env vars
+        # win over the storage-path default -- the operator aimed them.
+        from ..util import costledger, costmodel
+
+        if cfg.compile_cache_dir:
+            costmodel.enable_compile_cache(cfg.compile_cache_dir)
+        else:
+            costmodel.maybe_enable_compile_cache_from_env()
+        if not os.environ.get(costledger.LEDGER_ENV, ""):
+            costledger.configure(
+                cfg.cost_ledger_path
+                or os.path.join(cfg.storage_path, "cost_ledger.json"))
+
         # per-instance WAL dir: ingesters sharing --storage.path must never
         # replay (and delete) each other's live WAL files
         default_wal_layout = not cfg.wal_path
@@ -574,6 +597,15 @@ def _make_handler(app: App):
                     # kernel telemetry: compile/cache-hit table, staged-
                     # cache contents, routing reasons, slow-query log
                     return self._send(200, json.dumps(_kernel_status(app), indent=2))
+                if u.path == "/status/cost":
+                    # device cost plane (util/costmodel): per-(op,bucket)
+                    # FLOPs/bytes/utilization vs roofline, collective
+                    # comm bytes, the HBM ledger, the crossover ledger
+                    # and compile-cache state
+                    from ..util.costmodel import COST
+
+                    return self._send(
+                        200, json.dumps(COST.status_snapshot(), indent=2))
                 if u.path == "/status/usage-stats":
                     return self._send(200, json.dumps(app.usage.report(app), indent=2))
                 if u.path == "/debug/threads":
@@ -1149,6 +1181,13 @@ def main(argv=None):
                     default=None,
                     help="tenant the app's own query timelines ship into "
                          "('' = off); inspect with tempo-cli self-trace")
+    ap.add_argument("--compile-cache.dir", dest="compile_cache_dir", default=None,
+                    help="persistent XLA compilation cache directory "
+                         "(default: TEMPO_COMPILE_CACHE_DIR env, else off)")
+    ap.add_argument("--cost-ledger.path", dest="cost_ledger_path", default=None,
+                    help="measured-crossover CostLedger artifact (default: "
+                         "TEMPO_COST_LEDGER env, else "
+                         "<storage.path>/cost_ledger.json)")
     ap.add_argument("--querier.search-external-endpoints", dest="search_external",
                     default=None,
                     help="comma-separated serverless search handler URLs")
@@ -1180,6 +1219,8 @@ def main(argv=None):
         "jaeger_grpc_port": args.jaeger_grpc_port,
         "jaeger_agent_port": args.jaeger_agent_port,
         "self_tracing_tenant": args.self_tracing_tenant,
+        "compile_cache_dir": args.compile_cache_dir,
+        "cost_ledger_path": args.cost_ledger_path,
         "search_external_endpoints": args.search_external,
         "kafka_brokers": args.kafka_brokers,
         "kafka_topic": args.kafka_topic,

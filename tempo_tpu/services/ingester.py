@@ -588,3 +588,8 @@ class Ingester:
     def stop(self) -> None:
         self._stop.set()
         self.flush_all()
+        # commit this process's measured live-engine crossovers so the
+        # next restart routes from measurements, not the env seed
+        for inst in list(self.instances.values()):
+            if getattr(inst, "live_engine", None) is not None:
+                inst.live_engine.persist_crossover()

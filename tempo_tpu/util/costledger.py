@@ -1,0 +1,161 @@
+"""CostLedger: persisted measured-crossover store for engine routing.
+
+Every engine router in this tree weighs "host arithmetic, zero device
+round trips" against "device kernel, ~fixed dispatch cost". Until now
+each router learned that trade from scratch every process start
+(live_engine's EMA), assumed it (ops/find's single-chip-means-host
+rule), or seeded it from a hardcoded constant (db/search's host-rate
+EMA). The ledger makes those measurements durable: a small JSON
+artifact, atomically published (tmp file + os.replace) so readers never
+see a torn write, loaded once at startup and consulted by:
+
+  * ops/find -- the `auto` find policy routes host-vs-device from the
+    measured race `tempo-tpu-cli calibrate` (or the bench's
+    find_auto_crossover_rows row) committed under key "find";
+  * db/live_engine -- seeds its host-s/row and device-fixed-s EMAs from
+    key "live_search" instead of the TEMPO_LIVE_CROSSOVER_ROWS guess
+    (the env var still wins when set);
+  * db/search -- seeds the cold-scan host-rate EMA from key
+    "block_scan" instead of the DDR-ish constant.
+
+Resolution order for the artifact path: explicit configure() (the app
+wires <storage.path>/cost_ledger.json), else the TEMPO_COST_LEDGER env
+var, else no persistence (an in-memory ledger: updates work, publish is
+a no-op -- bench/CLI runs against throwaway stores stay self-contained).
+
+A corrupt or unreadable artifact must never take routing down: load
+falls back to an empty ledger, remembers the error (surfaced in
+/status/cost), and the next publish rewrites the artifact whole.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+
+LEDGER_ENV = "TEMPO_COST_LEDGER"
+SCHEMA_VERSION = 1
+
+# routing keys with committed meaning (callers may add more; these are
+# the ones the shipped routers consult)
+KEY_FIND = "find"
+KEY_LIVE_SEARCH = "live_search"
+KEY_BLOCK_SCAN = "block_scan"
+
+
+class CostLedger:
+    """One JSON artifact of measured crossovers. Thread-safe; reads
+    return copies so callers can't mutate shared state."""
+
+    def __init__(self, path: str = ""):
+        self.path = path or ""
+        self._lock = threading.Lock()
+        self._entries: dict[str, dict] = {}
+        self.load_error = ""
+        if self.path:
+            self._load()
+
+    # -------------------------------------------------------------- load
+    def _load(self) -> None:
+        try:
+            with open(self.path, "rb") as f:
+                data = json.loads(f.read())
+            if not isinstance(data, dict) or not isinstance(
+                    data.get("entries"), dict):
+                raise ValueError("ledger root must be "
+                                 '{"version": int, "entries": {...}}')
+            self._entries = {
+                str(k): dict(v) for k, v in data["entries"].items()
+                if isinstance(v, dict)
+            }
+        except FileNotFoundError:
+            pass  # first run: publish() creates it
+        except Exception as e:  # corrupt artifact: degrade loudly, keep serving
+            self.load_error = f"{type(e).__name__}: {e}"
+            self._entries = {}
+            print(f"tempo-tpu: cost ledger {self.path} unreadable "
+                  f"({self.load_error}); starting from an empty ledger",
+                  file=sys.stderr)
+
+    # ------------------------------------------------------------- access
+    def get(self, key: str) -> dict | None:
+        with self._lock:
+            e = self._entries.get(key)
+            return dict(e) if e is not None else None
+
+    def entries(self) -> dict[str, dict]:
+        with self._lock:
+            return {k: dict(v) for k, v in self._entries.items()}
+
+    def update(self, key: str, **fields) -> dict:
+        """Merge fields into an entry (stamping measured_at_unix) and
+        return the merged copy. Call publish() to persist."""
+        with self._lock:
+            e = self._entries.setdefault(key, {})
+            e.update(fields)
+            e["measured_at_unix"] = round(time.time(), 3)
+            return dict(e)
+
+    # ------------------------------------------------------------ publish
+    def publish(self) -> bool:
+        """Atomically write the artifact (tmp + rename). Returns True on
+        a durable write, False when pathless or the write failed --
+        routing never depends on persistence succeeding."""
+        if not self.path:
+            return False
+        with self._lock:
+            doc = {"version": SCHEMA_VERSION,
+                   "entries": {k: dict(v) for k, v in self._entries.items()}}
+        tmp = f"{self.path}.tmp.{os.getpid()}"
+        try:
+            os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+            with open(tmp, "w") as f:
+                json.dump(doc, f, indent=1, sort_keys=True)
+            os.replace(tmp, self.path)  # atomic publish: readers see old or new
+            return True
+        except OSError as e:
+            print(f"tempo-tpu: cost ledger publish to {self.path} failed: {e}",
+                  file=sys.stderr)
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            return False
+
+    def to_dict(self) -> dict:
+        return {"path": self.path, "load_error": self.load_error,
+                "entries": self.entries()}
+
+
+# process-wide singleton: routers consult ledger() at decision time; the
+# app (or a test) points it somewhere with configure()
+_singleton_lock = threading.Lock()
+_singleton: CostLedger | None = None
+
+
+def ledger() -> CostLedger:
+    global _singleton
+    with _singleton_lock:
+        if _singleton is None:
+            _singleton = CostLedger(os.environ.get(LEDGER_ENV, ""))
+        return _singleton
+
+
+def configure(path: str) -> CostLedger:
+    """(Re)point the process ledger at an artifact path and load it.
+    The app calls this with <storage.path>/cost_ledger.json; tests call
+    it with tmp paths. An explicit TEMPO_COST_LEDGER env var wins over
+    the app default (the operator aimed it somewhere on purpose)."""
+    global _singleton
+    with _singleton_lock:
+        _singleton = CostLedger(path)
+        return _singleton
+
+
+def reset_for_tests() -> None:
+    global _singleton
+    with _singleton_lock:
+        _singleton = None

@@ -1,0 +1,653 @@
+"""Device cost observability: program cost analysis, collective comm
+accounting, and the HBM ledger.
+
+kerneltel (PR 2) says how long each kernel RAN; this module says what
+each kernel COSTS and whether the time was well spent:
+
+  * **Program cost analysis** -- on every new compile (the
+    TEL.record_launch chokepoint passes a capture thunk), a background
+    worker lowers the same program against abstract avals and records
+    XLA's own `cost_analysis()` (FLOPs, bytes accessed) and
+    `memory_analysis()` (argument/output/temp/code bytes) per
+    (op, shape-bucket). Paired with kerneltel's measured wall-time
+    histograms this yields achieved-vs-roofline utilization per kernel
+    in /status/cost. Capture happens OFF the query path: the hot path
+    only enqueues ShapeDtypeStructs (never live device arrays).
+
+  * **Collective comm accounting** -- for mesh programs the capture
+    also traces a jaxpr and statically walks it for collectives
+    (all_gather / psum / pmax / pmin / psum_scatter / reduce_scatter /
+    all_to_all / ppermute), pricing each with the standard ring-
+    algorithm model (see collective_comm_bytes) times the number of
+    independent device groups. Per-launch bytes x launch counts feed
+    `tempo_mesh_comm_bytes_total{collective,op}` -- ROADMAP item 2(a)'s
+    "how big IS the struct-op all_gather" made a first-class series.
+
+  * **HBM ledger** -- one device-memory view unifying the staged
+    block-column cache (ops/stage), live-head staging tails
+    (ops/livestage) and the compiled-program footprint, cross-checked
+    against device.memory_stats() where the backend provides it, with
+    watermark gauges feeding the TempoHBMPressure alert.
+
+  * **Persistent compilation cache** -- TEMPO_COMPILE_CACHE_DIR (env or
+    --compile-cache.dir) turns on jax's disk compilation cache so
+    restarts stop paying the first-compile storm;
+    `tempo_kernel_compile_disk_total{outcome}` (fed by jax.monitoring
+    events) splits disk-cache hits from fresh XLA compiles, the
+    complement of kerneltel's in-process jit-cache-hit counter.
+
+Kill switches: TEMPO_COSTMODEL=0 disables capture entirely (launch
+counting stays, it is two dict increments); TEMPO_COSTMODEL_MEMORY=0
+skips the background `compile()` that memory_analysis needs, keeping
+capture to trace+lower. Everything here is advisory: no method may
+raise into the query path.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+
+# peak HBM bandwidth per chip for the roofline denominator (v5e: 819
+# GB/s; axon is the tunneled TPU platform the dev boxes expose).
+# Unknown platforms (cpu) report utilization 0.0 = "no roofline".
+HBM_PEAK_BPS = {"tpu": 819e9, "axon": 819e9}
+
+# collectives the comm walker prices (jaxpr primitive names)
+_COLLECTIVES = ("all_gather", "psum", "pmax", "pmin", "psum_scatter",
+                "reduce_scatter", "all_to_all", "ppermute")
+
+
+def _aval_bytes(aval) -> int:
+    try:
+        return int(aval.size) * int(aval.dtype.itemsize)
+    except Exception:
+        return 0
+
+
+def _axis_group_size(params, mesh_axis_sizes: dict[str, int]) -> int:
+    """Number of devices participating in one collective group: the
+    product of the collective's named axes' sizes."""
+    axes = params.get("axis_name", params.get("axes", ()))
+    if isinstance(axes, str):
+        axes = (axes,)
+    k = 1
+    for a in axes or ():
+        k *= int(mesh_axis_sizes.get(a, 1))
+    return max(k, 1)
+
+
+def _sub_jaxprs(params):
+    for v in params.values():
+        if hasattr(v, "eqns"):
+            yield v
+        elif hasattr(v, "jaxpr") and hasattr(v.jaxpr, "eqns"):
+            yield v.jaxpr
+        elif isinstance(v, (list, tuple)):
+            for b in v:
+                if hasattr(b, "eqns"):
+                    yield b
+                elif hasattr(b, "jaxpr") and hasattr(b.jaxpr, "eqns"):
+                    yield b.jaxpr
+
+
+def collective_comm_bytes(jaxpr, mesh_axis_sizes: dict[str, int],
+                          total_devices: int) -> dict[str, int]:
+    """Statically price every collective in a jaxpr: fleet-wide wire
+    bytes per program execution, by collective name.
+
+    Model (ring algorithms, k = devices in one collective group,
+    g = total_devices / k independent groups running the collective):
+      all_gather      out_bytes x (k-1)         x g   (each of k receives
+                                                       the (k-1)/k it lacks)
+      psum/pmax/pmin  2 x in_bytes x (k-1)      x g   (ring all-reduce)
+      psum_scatter /
+      reduce_scatter  in_bytes x (k-1)          x g
+      all_to_all      in_bytes x (k-1)          x g
+      ppermute        in_bytes x k              x g   (every shard moves)
+    Shapes inside shard_map are PER-SHARD; in/out bytes above are the
+    eqn's own aval bytes, so the model needs no sharding inference.
+    Recursion: sub-jaxprs (pjit/shard_map/custom calls) count once,
+    `scan` bodies multiply by the trip count, `cond` branches take the
+    max (conservative for routing, never an undercount of the worst
+    branch)."""
+    out: dict[str, int] = {}
+
+    def add(dst: dict[str, int], src: dict[str, int], mul: int = 1) -> None:
+        for kk, vv in src.items():
+            dst[kk] = dst.get(kk, 0) + vv * mul
+
+    def walk(jx) -> dict[str, int]:
+        acc: dict[str, int] = {}
+        for eqn in jx.eqns:
+            name = eqn.primitive.name
+            if name in _COLLECTIVES:
+                k = _axis_group_size(eqn.params, mesh_axis_sizes)
+                groups = max(1, total_devices // k)
+                in_b = sum(_aval_bytes(v.aval) for v in eqn.invars
+                           if hasattr(v, "aval"))
+                out_b = sum(_aval_bytes(v.aval) for v in eqn.outvars)
+                if name == "all_gather":
+                    wire = out_b * (k - 1)
+                elif name in ("psum", "pmax", "pmin"):
+                    wire = 2 * in_b * (k - 1)
+                elif name == "ppermute":
+                    wire = in_b * k
+                else:  # psum_scatter / reduce_scatter / all_to_all
+                    wire = in_b * (k - 1)
+                acc[name] = acc.get(name, 0) + wire * groups
+            if name == "cond":
+                branches = [walk(b.jaxpr if hasattr(b, "jaxpr") else b)
+                            for b in eqn.params.get("branches", ())]
+                if branches:
+                    worst: dict[str, int] = {}
+                    for b in branches:
+                        for kk in set(worst) | set(b):
+                            worst[kk] = max(worst.get(kk, 0), b.get(kk, 0))
+                    add(acc, worst)
+                continue
+            mul = int(eqn.params.get("length", 1)) if name == "scan" else 1
+            for sub in _sub_jaxprs(eqn.params):
+                add(acc, walk(sub), mul)
+        return acc
+
+    add(out, walk(jaxpr.jaxpr if hasattr(jaxpr, "jaxpr") else jaxpr))
+    return out
+
+
+# --------------------------------------------------------- program specs
+
+
+class ProgramSpec:
+    """Everything the background worker needs to re-derive one compiled
+    program's costs: the jitted callable plus ABSTRACT argument avals
+    (built eagerly at the call site, so the spec never pins live device
+    arrays), and the mesh shape for comm pricing (None = single-device
+    program, no jaxpr walk)."""
+
+    __slots__ = ("fn", "args", "kwargs", "mesh_axis_sizes", "mesh_devices")
+
+    def __init__(self, fn, args, kwargs, mesh_axis_sizes, mesh_devices):
+        self.fn = fn
+        self.args = args
+        self.kwargs = kwargs
+        self.mesh_axis_sizes = mesh_axis_sizes
+        self.mesh_devices = mesh_devices
+
+
+def spec(fn, *args, mesh=None, **kwargs) -> ProgramSpec:
+    """Build a capture spec at a launch site. Array-likes (anything with
+    a dtype) become ShapeDtypeStructs; python ints/bools/strings pass
+    through untouched so static args still key the lowering."""
+    import jax
+
+    def absify(x):
+        if hasattr(x, "dtype") and hasattr(x, "shape"):
+            import numpy as np
+
+            return jax.ShapeDtypeStruct(tuple(x.shape), np.dtype(x.dtype))
+        return x
+
+    a_args = jax.tree_util.tree_map(absify, args)
+    a_kwargs = jax.tree_util.tree_map(absify, kwargs)
+    axis_sizes = dict(mesh.shape) if mesh is not None else None
+    n_dev = int(mesh.devices.size) if mesh is not None else 1
+    return ProgramSpec(fn, a_args, a_kwargs, axis_sizes, n_dev)
+
+
+# ------------------------------------------------------------ cost model
+
+
+class CostModel:
+    """Process-wide capture store + background analysis worker."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._queue: list[tuple[str, str, ProgramSpec]] = []
+        self._pending = 0
+        self._worker: threading.Thread | None = None
+        # (op, bucket) -> analysis row (last capture wins; one row per
+        # shape bucket is the granularity the kernel table also uses)
+        self._programs: dict[tuple[str, str], dict] = {}
+        self._launches: dict[tuple[str, str], int] = {}
+        self._captures = 0
+        self._capture_errors = 0
+        self._hbm_peak = 0
+
+    # ------------------------------------------------------------ config
+    @staticmethod
+    def enabled() -> bool:
+        return os.environ.get("TEMPO_COSTMODEL", "1") != "0"
+
+    @staticmethod
+    def _memory_enabled() -> bool:
+        return os.environ.get("TEMPO_COSTMODEL_MEMORY", "1") != "0"
+
+    # ----------------------------------------------------------- capture
+    def note_launch(self, op: str, bucket_label: str) -> None:
+        """Every kernel launch (compile or cache hit) lands here from
+        record_launch: launch counts turn per-program comm bytes into
+        the tempo_mesh_comm_bytes_total counter."""
+        with self._lock:
+            key = (op, bucket_label)
+            self._launches[key] = self._launches.get(key, 0) + 1
+
+    def enqueue(self, op: str, bucket_label: str, program: ProgramSpec) -> None:
+        """Queue one new program for background analysis."""
+        if not self.enabled():
+            return
+        with self._cv:
+            self._queue.append((op, bucket_label, program))
+            self._pending += 1
+            if self._worker is None or not self._worker.is_alive():
+                self._worker = threading.Thread(
+                    target=self._run, daemon=True, name="costmodel")
+                self._worker.start()
+            self._cv.notify_all()
+
+    def drain(self, timeout: float = 5.0) -> bool:
+        """Wait for queued captures to finish (tests, /status/cost)."""
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            while self._pending > 0:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return False
+                self._cv.wait(left)
+        return True
+
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                while not self._queue:
+                    self._cv.wait()
+                op, blab, program = self._queue.pop(0)
+            try:
+                entry = self._analyze(program)
+            except Exception as e:  # the worker must outlive any one capture
+                entry = {"flops": 0.0, "bytes_accessed": 0.0,
+                         "argument_bytes": 0, "output_bytes": 0,
+                         "peak_temp_bytes": 0, "generated_code_bytes": 0,
+                         "mesh_devices": 1, "comm": {},
+                         "error": f"{type(e).__name__}: {e}",
+                         "captured_at_unix": round(time.time(), 3)}
+            with self._cv:
+                self._programs[(op, blab)] = entry
+                self._captures += 1
+                if entry.get("error"):
+                    self._capture_errors += 1
+                self._pending -= 1
+                self._cv.notify_all()
+
+    def _analyze(self, program: ProgramSpec) -> dict:
+        entry: dict = {
+            "flops": 0.0, "bytes_accessed": 0.0,
+            "argument_bytes": 0, "output_bytes": 0,
+            "peak_temp_bytes": 0, "generated_code_bytes": 0,
+            "mesh_devices": getattr(program, "mesh_devices", 1),
+            "comm": {}, "error": "",
+            "captured_at_unix": round(time.time(), 3),
+        }
+        try:
+            import jax
+
+            lowered = program.fn.lower(*program.args, **program.kwargs)
+            ca = lowered.cost_analysis()
+            if isinstance(ca, (list, tuple)):
+                ca = ca[0] if ca else {}
+            if ca:
+                entry["flops"] = float(ca.get("flops", 0.0))
+                entry["bytes_accessed"] = float(ca.get("bytes accessed", 0.0))
+            if self._memory_enabled():
+                mem = lowered.compile().memory_analysis()
+                if mem is not None:
+                    entry["argument_bytes"] = int(
+                        getattr(mem, "argument_size_in_bytes", 0))
+                    entry["output_bytes"] = int(
+                        getattr(mem, "output_size_in_bytes", 0))
+                    entry["peak_temp_bytes"] = int(
+                        getattr(mem, "temp_size_in_bytes", 0))
+                    entry["generated_code_bytes"] = int(
+                        getattr(mem, "generated_code_size_in_bytes", 0))
+            if program.mesh_axis_sizes:
+                jaxpr = jax.make_jaxpr(program.fn)(
+                    *program.args, **program.kwargs)
+                entry["comm"] = collective_comm_bytes(
+                    jaxpr, program.mesh_axis_sizes, program.mesh_devices)
+        except Exception as e:  # capture is advisory; record why it failed
+            entry["error"] = f"{type(e).__name__}: {e}"
+        return entry
+
+    # ------------------------------------------------------------ readout
+    def program_table(self) -> dict[tuple[str, str], dict]:
+        with self._lock:
+            return {k: {**dict(v), "launches": self._launches.get(k, 0),
+                        "comm": dict(v["comm"])}
+                    for k, v in self._programs.items()}
+
+    def comm_for(self, op: str, bucket_label: str) -> dict[str, int]:
+        with self._lock:
+            e = self._programs.get((op, bucket_label))
+            return dict(e["comm"]) if e else {}
+
+    def comm_totals(self) -> dict[tuple[str, str], int]:
+        """(op, collective) -> fleet wire bytes = per-launch bytes x
+        launches of that program's bucket."""
+        out: dict[tuple[str, str], int] = {}
+        with self._lock:
+            for (op, blab), e in self._programs.items():
+                n = self._launches.get((op, blab), 0)
+                for coll, b in e["comm"].items():
+                    k = (op, coll)
+                    out[k] = out.get(k, 0) + b * n
+        return out
+
+    # --------------------------------------------------------- HBM ledger
+    def hbm_snapshot(self) -> dict:
+        """One device-memory accounting view. Components are the
+        accountable residents this process manages; `device` carries the
+        backend's own memory_stats() where it exposes one (TPU runtimes
+        do, CPU does not) as the cross-check -- device.bytes_in_use
+        should be >= the accounted total, the gap being XLA runtime
+        overhead plus anything staged outside these caches."""
+        comps: dict[str, dict] = {}
+        staged_bytes = live_bytes = code_bytes = 0
+        try:
+            from ..ops.stage import staged_cache_stats
+
+            st = staged_cache_stats(max_entries=1)
+            staged_bytes = int(st["bytes"])
+            comps["staged_cache"] = {
+                "bytes": staged_bytes, "entries": int(st["entries"]),
+                "budget_bytes": int(st["budget_bytes"]),
+            }
+        except Exception:
+            comps["staged_cache"] = {"bytes": 0, "error": "unavailable"}
+        try:
+            from ..ops.livestage import stager_device_bytes
+
+            live_bytes, n_stagers = stager_device_bytes()
+            comps["livestage"] = {"bytes": int(live_bytes),
+                                  "stagers": int(n_stagers)}
+        except Exception:
+            comps["livestage"] = {"bytes": 0, "error": "unavailable"}
+        with self._lock:
+            code_bytes = sum(e["generated_code_bytes"]
+                             for e in self._programs.values())
+            peak_temp = max(
+                (e["peak_temp_bytes"] for e in self._programs.values()),
+                default=0)
+            n_prog = len(self._programs)
+        comps["compiled_programs"] = {
+            "bytes": int(code_bytes), "programs": n_prog,
+            "max_peak_temp_bytes": int(peak_temp),
+        }
+        total = staged_bytes + live_bytes + code_bytes
+        with self._lock:
+            if total > self._hbm_peak:
+                self._hbm_peak = total
+            peak = self._hbm_peak
+        device = None
+        try:
+            import jax
+
+            device = jax.devices()[0].memory_stats()
+        except Exception:
+            device = None
+        snap = {
+            "components": comps,
+            "accounted_bytes": int(total),
+            "accounted_peak_bytes": int(peak),
+            "device_memory_stats": device,
+        }
+        if isinstance(device, dict) and "bytes_in_use" in device:
+            snap["unaccounted_bytes"] = max(
+                0, int(device["bytes_in_use"]) - int(total))
+        return snap
+
+    # ----------------------------------------------------------- metrics
+    def metrics_lines(self) -> list[str]:
+        """Exposition samples for /metrics (rendered through the app's
+        strict-OpenMetrics pass like every kerneltel instrument)."""
+        out: list[str] = []
+        try:
+            table = self.program_table()
+            for (op, blab) in sorted(table):
+                e = table[(op, blab)]
+                lbl = f'op="{op}",bucket="{blab}"'
+                out.append(f"tempo_program_flops{{{lbl}}} {e['flops']:g}")
+                out.append(
+                    f"tempo_program_bytes_accessed{{{lbl}}} "
+                    f"{e['bytes_accessed']:g}")
+                out.append(
+                    f"tempo_program_peak_temp_bytes{{{lbl}}} "
+                    f"{e['peak_temp_bytes']:g}")
+            for (op, coll), b in sorted(self.comm_totals().items()):
+                out.append(
+                    f'tempo_mesh_comm_bytes_total{{collective="{coll}",'
+                    f'op="{op}"}} {b:g}')
+            hbm = self.hbm_snapshot()
+            for comp, row in sorted(hbm["components"].items()):
+                out.append(
+                    f'tempo_hbm_bytes{{component="{comp}"}} '
+                    f"{row.get('bytes', 0):g}")
+            out.append(f"tempo_hbm_peak_bytes {hbm['accounted_peak_bytes']:g}")
+            budget = hbm["components"].get("staged_cache", {}).get(
+                "budget_bytes")
+            if budget is not None:
+                out.append(f"tempo_hbm_staged_budget_bytes {budget:g}")
+            out += _DISK_CACHE_EVENTS.text()
+        except Exception:
+            pass  # observability must never take /metrics down
+        return out
+
+    @staticmethod
+    def help_entries() -> dict[str, str]:
+        return {
+            "tempo_program_flops":
+                "XLA cost-analysis FLOPs per execution by op and shape bucket",
+            "tempo_program_bytes_accessed":
+                "XLA cost-analysis bytes accessed per execution by op/bucket",
+            "tempo_program_peak_temp_bytes":
+                "XLA peak temp allocation per execution by op/bucket",
+            "tempo_mesh_comm_bytes":
+                "static collective wire bytes x launches by collective and op",
+            "tempo_hbm_bytes":
+                "accounted device memory by component (staged_cache/"
+                "livestage/compiled_programs)",
+            "tempo_hbm_peak_bytes":
+                "high-water mark of accounted device memory",
+            "tempo_hbm_staged_budget_bytes":
+                "device budget for the staged block-column cache",
+            "tempo_kernel_compile_disk":
+                "persistent compilation cache outcomes (hit = executable "
+                "deserialized from disk, miss = fresh XLA compile)",
+        }
+
+    # ------------------------------------------------------------- status
+    def status_snapshot(self, drain_timeout: float = 1.0) -> dict:
+        """The /status/cost payload: per-(op,bucket) static costs joined
+        with kerneltel's measured wall times into achieved-vs-roofline
+        utilization, per-collective comm bytes, the HBM ledger, the
+        crossover ledger, and compile-cache state."""
+        self.drain(drain_timeout)
+        from .kerneltel import TEL
+
+        kern = {(k["op"], k["bucket"]): k for k in TEL.snapshot(slow_k=0)["kernels"]}
+        peak_bps = 0.0
+        platform = ""
+        try:
+            import jax
+
+            platform = jax.devices()[0].platform
+            peak_bps = HBM_PEAK_BPS.get(platform, 0.0)
+        except Exception:
+            pass
+        programs = []
+        table = self.program_table()
+        for (op, blab) in sorted(table):
+            e = table[(op, blab)]
+            row = {"op": op, "bucket": blab, **{k: v for k, v in e.items()
+                                               if k != "comm"}}
+            krow = kern.get((op, blab))
+            calls = krow["calls"] if krow else 0
+            dev_s = krow["device_seconds"] if krow else 0.0
+            if calls and dev_s > 0:
+                per_call = dev_s / calls
+                row["measured_calls"] = calls
+                row["measured_s_per_call"] = round(per_call, 9)
+                row["achieved_flops_per_s"] = round(e["flops"] / per_call, 1)
+                row["achieved_bytes_per_s"] = round(
+                    e["bytes_accessed"] / per_call, 1)
+                row["hbm_utilization"] = (
+                    round(e["bytes_accessed"] / per_call / peak_bps, 6)
+                    if peak_bps else 0.0)
+            comm = e["comm"]
+            if comm:
+                row["comm_bytes_per_launch"] = dict(sorted(comm.items()))
+            programs.append(row)
+        comm_rows = [
+            {"op": op, "collective": coll, "bytes_total": b}
+            for (op, coll), b in sorted(self.comm_totals().items())
+        ]
+        from .costledger import ledger
+
+        with self._lock:
+            meta = {"captures": self._captures,
+                    "capture_errors": self._capture_errors,
+                    "pending": self._pending,
+                    "enabled": self.enabled()}
+        return {
+            "platform": platform,
+            "roofline_hbm_bytes_per_s": peak_bps,
+            "programs": programs,
+            "comm": comm_rows,
+            "hbm": self.hbm_snapshot(),
+            "ledger": ledger().to_dict(),
+            "compile_cache": compile_cache_stats(),
+            "capture": meta,
+        }
+
+    def reset(self) -> None:
+        """Fresh state (tests). The worker thread survives; in-flight
+        captures may still land rows after a reset -- tests drain first."""
+        with self._cv:
+            # discarded queue items will never reach the worker's
+            # decrement: release their pending counts here or drain()
+            # waits its full timeout forever after
+            self._pending -= len(self._queue)
+            self._queue.clear()
+            self._programs.clear()
+            self._launches.clear()
+            self._captures = 0
+            self._capture_errors = 0
+            self._hbm_peak = 0
+            self._cv.notify_all()
+
+
+COST = CostModel()
+
+
+# ------------------------------------------------ persistent compile cache
+
+COMPILE_CACHE_ENV = "TEMPO_COMPILE_CACHE_DIR"
+
+from .metrics import Counter as _Counter  # noqa: E402
+
+_DISK_CACHE_EVENTS = _Counter(
+    "tempo_kernel_compile_disk_total",
+    help="persistent compilation cache outcomes by event")
+
+_cc_lock = threading.Lock()
+_cc_state = {"enabled": False, "dir": "", "listener": False}
+
+
+def _on_jax_event(name: str, **kw) -> None:
+    if name.endswith("/compilation_cache/cache_hits"):
+        _DISK_CACHE_EVENTS.inc(labels='outcome="hit"')
+    elif name.endswith("/compilation_cache/cache_misses"):
+        _DISK_CACHE_EVENTS.inc(labels='outcome="miss"')
+
+
+def enable_compile_cache(cache_dir: str) -> bool:
+    """Turn on jax's persistent (disk) compilation cache so a restarted
+    process deserializes yesterday's executables instead of re-paying
+    the first-compile storm (ROADMAP item 5). Registers a
+    jax.monitoring listener so disk hits vs fresh compiles are counted
+    (tempo_kernel_compile_disk_total) -- kerneltel's compile counter
+    cannot tell them apart (both look like a new program key). Must run
+    before the first compile to cover it; later calls still cover every
+    compile after them. Returns True when the cache is active."""
+    if not cache_dir:
+        return False
+    try:
+        import jax
+
+        os.makedirs(cache_dir, exist_ok=True)
+        with _cc_lock:
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+            # cache everything: the padded-bucket discipline keeps the
+            # program population small, so entry-size/compile-time floors
+            # would only punch holes in warm restarts
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+            try:
+                jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+            except Exception:
+                pass  # knob not present on this jax version
+            try:
+                # the cache object latches its (possibly empty) dir on
+                # first compile: a process that compiled anything before
+                # this call must rebuild it or the new dir is ignored
+                from jax._src import compilation_cache as _jcc
+
+                _jcc.reset_cache()
+            except Exception:
+                pass  # private API drift: pre-first-compile enables still work
+            if not _cc_state["listener"]:
+                jax.monitoring.register_event_listener(_on_jax_event)
+                _cc_state["listener"] = True
+            _cc_state["enabled"] = True
+            _cc_state["dir"] = cache_dir
+        return True
+    except Exception as e:
+        print(f"tempo-tpu: persistent compile cache at {cache_dir!r} "
+              f"unavailable: {e}", file=sys.stderr)
+        return False
+
+
+def disable_compile_cache() -> None:
+    """Turn the persistent cache back off (tests that enabled it at a
+    throwaway dir must not leave the process reading a deleted path)."""
+    try:
+        import jax
+
+        with _cc_lock:
+            jax.config.update("jax_compilation_cache_dir", None)
+            _cc_state["enabled"] = False
+            _cc_state["dir"] = ""
+        from jax._src import compilation_cache as _jcc
+
+        _jcc.reset_cache()
+    except Exception:
+        pass
+
+
+def maybe_enable_compile_cache_from_env() -> bool:
+    """The env hook every jax-touching entry point runs once at import
+    (ops/device.py): TEMPO_COMPILE_CACHE_DIR set => cache on."""
+    with _cc_lock:
+        if _cc_state["enabled"]:
+            return True
+    return enable_compile_cache(os.environ.get(COMPILE_CACHE_ENV, ""))
+
+
+def compile_cache_stats() -> dict:
+    with _cc_lock:
+        st = dict(_cc_state)
+    st.pop("listener", None)
+    st["disk_hits"] = int(_DISK_CACHE_EVENTS.get(labels='outcome="hit"'))
+    st["disk_misses"] = int(_DISK_CACHE_EVENTS.get(labels='outcome="miss"'))
+    return st

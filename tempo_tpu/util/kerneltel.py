@@ -282,11 +282,19 @@ class KernelTelemetry:
         return self._sync
 
     # ----------------------------------------------------------- kernels
-    def record_launch(self, op: str, key, bucket) -> bool:
+    def record_launch(self, op: str, key, bucket, cost=None) -> bool:
         """Note one kernel launch. `key` is the full compile signature
         (everything that keys the jitted program: tree/cond structure +
         every padded axis bucket); `bucket` is the primary shape bucket
-        used as the metric label. Returns True on a new compile."""
+        used as the metric label. Returns True on a new compile.
+
+        `cost`: zero-arg callable returning a costmodel.ProgramSpec --
+        invoked only on a NEW compile, so the program's XLA cost
+        analysis (and, for mesh programs, its collective comm bytes)
+        is captured once in the costmodel's background worker. Every
+        launch (new or cached) also ticks the costmodel's launch
+        counter, which turns static per-program comm bytes into the
+        tempo_mesh_comm_bytes_total series."""
         blab = str(bucket)
         try:
             with self._lock:
@@ -312,6 +320,14 @@ class KernelTelemetry:
             (self.compiles if new else self.cache_hits).inc(labels=labels)
             self._tls.last = (op, blab, new)
             self.add_query_cost("compiles" if new else "cache_hits", 1)
+            try:
+                from .costmodel import COST
+
+                COST.note_launch(op, blab)
+                if new and cost is not None:
+                    COST.enqueue(op, blab, cost())
+            except Exception:
+                pass  # cost capture must not flip the compile verdict
             return new
         except Exception:
             return False
@@ -889,10 +905,19 @@ class KernelTelemetry:
         }
 
     def metrics_lines(self) -> list[str]:
-        """Exposition sample lines for /metrics."""
+        """Exposition sample lines for /metrics (kerneltel instruments
+        plus the costmodel's program/comm/HBM families -- one
+        chokepoint so /metrics can't ship one plane without the
+        other)."""
         out: list[str] = []
         for inst in self._instruments:
             out += inst.text()
+        try:
+            from .costmodel import COST
+
+            out += COST.metrics_lines()
+        except Exception:
+            pass
         return out
 
     def help_entries(self) -> dict[str, str]:
@@ -901,12 +926,25 @@ class KernelTelemetry:
         for inst in self._instruments:
             fam = inst.name[:-6] if inst.name.endswith("_total") else inst.name
             out[fam] = inst.help
+        try:
+            from .costmodel import COST
+
+            out.update(COST.help_entries())
+        except Exception:
+            pass
         return out
 
     def reset(self) -> None:
         """Fresh state (tests). Callers must reference instruments via
-        TEL attributes, never cache them across a reset."""
+        TEL attributes, never cache them across a reset. The costmodel's
+        launch/program tables reset with the kernel table they mirror."""
         self.__init__()
+        try:
+            from .costmodel import COST
+
+            COST.reset()
+        except Exception:
+            pass
 
 
 TEL = KernelTelemetry()

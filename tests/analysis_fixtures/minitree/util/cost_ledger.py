@@ -1,0 +1,65 @@
+"""Seeded violations in the cost-plane lock shapes: the CostLedger's
+module singleton (configure/ledger rebind under a dedicated lock), the
+cost model's capture condition variable, and the HBM watermark update --
+the lock pairs util/costledger.py and util/costmodel.py use, so the
+concurrency rules provably cover the measured-crossover store and the
+device-memory ledger."""
+
+import threading
+
+_singleton_lock = threading.Lock()
+_singleton = None
+_capture_cv = threading.Condition()
+_programs: dict[str, dict] = {}  # (op,bucket) -> analysis row
+_hbm_peak = 0
+
+
+def configure(path):
+    # sanctioned: singleton repoint under its lock
+    global _singleton
+    with _singleton_lock:
+        _singleton = {"path": path}
+        return _singleton
+
+
+def configure_racy(path):
+    global _singleton
+    _singleton = {"path": path}  # EXPECT: global-mutation-unlocked
+
+
+def record_capture(key, row):
+    # sanctioned order: capture cv outer, singleton lock inner (the
+    # worker publishes a row, then touches the ledger artifact)
+    with _capture_cv:
+        _programs[key] = row
+        with _singleton_lock:
+            _capture_cv.notify_all()
+
+
+def publish_then_capture_racy(key):
+    with _singleton_lock:
+        with _capture_cv:  # EXPECT: lock-order
+            _programs.pop(key, None)
+
+
+def watermark_scan_unsafe():
+    _capture_cv.acquire()  # EXPECT: lock-bare-acquire
+    n = len(_programs)
+    _capture_cv.release()
+    return n
+
+
+def watermark_scan_safe():
+    _capture_cv.acquire()
+    try:
+        _programs.clear()
+    finally:
+        _capture_cv.release()
+
+
+def note_peak(total):
+    global _hbm_peak
+    with _capture_cv:
+        if total > _hbm_peak:
+            _hbm_peak = total
+    return _hbm_peak
