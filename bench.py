@@ -7,6 +7,11 @@ full-block search that *includes* local-SSD IO
 Lines, in order:
   1. traceql_filter_kernel_spans_per_sec_per_chip -- device-resident
      filter kernel only (ceiling metric; no IO/staging).
+  1b. search_mesh_1x1_overhead -- the stacked shard_map search program
+     vs the plain kernel on a 1x1 mesh, both legs on device-resident
+     columns (ROADMAP 2a): the fixed smap/stacking price mesh routing
+     must amortize, with the costmodel walker's per-collective comm
+     bytes attached (all zero on 1x1 by the ring model).
   2. find_trace_by_id_p50_ms -- BASELINE config #1: trace-ID lookup on a
      local-disk block via the production device Find path (bloom read +
      batched bisection kernel + row materialization).
@@ -417,6 +422,88 @@ def bench_kernel() -> None:
     peak = _HBM_PEAK_BPS.get(jax.devices()[0].platform, 0.0)
     _emit("traceql_filter_kernel_bytes_per_sec", bps, "B/s",
           bps / peak if peak else 0.0, tel=tel)
+
+
+def bench_mesh_1x1_overhead() -> None:
+    """ROADMAP item 2a: what the stacked shard_map search program COSTS
+    over the plain single-block kernel when the mesh buys nothing (a
+    1x1 mesh = one device, no collectives). The value is the wall-time
+    ratio mesh/plain (>1 = overhead; the fixed price of smap dispatch,
+    operand stacking and the block axis), so mesh routing below this
+    block count is pure loss. The row carries the per-collective comm
+    bytes the PR-10 jaxpr walker priced for the mesh program -- on a
+    1x1 mesh every ring term is x(k-1)=0, and the row PROVES that:
+    nonzero bytes here would mean the walker is charging collectives
+    that cannot move wire data."""
+    import jax
+    import jax.numpy as jnp
+
+    from tempo_tpu.ops.filter import Cond, Operands, T_RES, T_SPAN, eval_block
+    from tempo_tpu.parallel.mesh import make_mesh
+    from tempo_tpu.parallel.search import sharded_search
+    from tempo_tpu.util import costmodel
+
+    rng = np.random.default_rng(21)
+    N, NT, R = 1 << 20, 1 << 15, 1 << 10
+    flat = {
+        "span.trace_sid": rng.integers(0, NT, size=N).astype(np.int32),
+        "span.dur_us": rng.integers(0, 1_000_000, size=N).astype(np.int32),
+        "span.res_idx": rng.integers(0, R, size=N).astype(np.int32),
+        "res.service_id": rng.integers(0, 64, size=R).astype(np.int32),
+    }
+    conds = (
+        Cond(target=T_RES, col="res.service_id", op="eq"),
+        Cond(target=T_SPAN, col="span.dur_us", op="ge"),
+    )
+    tree = ("and", ("cond", 0), ("cond", 1))
+    operands = Operands.build([(0, 3, 0, 0.0, 0.0),
+                               (0, 500_000, 0, 0.0, 0.0)])
+
+    # plain kernel: the single-block device path (trace mask + counts)
+    dcols = {k: jax.device_put(jnp.asarray(v)) for k, v in flat.items()}
+    mark = _tel_mark()
+    run_plain = lambda: eval_block(  # noqa: E731
+        (tree, conds), dcols, operands, N, NT, N, R, NT, span_out=False)
+    jax.block_until_ready(run_plain())
+    iters = 8
+    plain_s = best_window(
+        lambda: jax.block_until_ready([run_plain() for _ in range(iters)]),
+        windows=4) / iters
+
+    # stacked mesh program on a 1x1 mesh: same rows as one (B=1) block.
+    # Columns are device-put ONCE (sharded_search's jnp.asarray is a
+    # no-op on resident arrays), matching the plain leg's staged dcols
+    # -- the ratio must price the smap/stacking program overhead, not a
+    # per-call host->device transfer the production staged-column path
+    # never pays.
+    mesh = make_mesh(1)
+    stacked = {k: jax.device_put(jnp.asarray(v[None]))
+               for k, v in flat.items()}
+    n_spans = np.asarray([N], dtype=np.int32)
+    tm, sc = sharded_search(mesh, tree, conds, operands, stacked, n_spans,
+                            nt=NT)
+    # correctness anchor: both engines agree on the trace verdicts
+    ptm, psc = (np.asarray(x) for x in run_plain())
+    assert (tm[0] == ptm).all() and (sc[0] == psc).all(), \
+        "mesh and plain kernels disagree on a 1x1 mesh"
+    mesh_s = best_window(
+        lambda: [sharded_search(mesh, tree, conds, operands, stacked,
+                                n_spans, nt=NT) for _ in range(iters)],
+        windows=4) / iters
+    tel = _tel_close(mark)
+
+    # per-collective comm bytes from the costmodel's static jaxpr
+    # walker (captured in the background on the program's first
+    # compile); a 1x1 mesh must price every ring collective at 0 bytes
+    costmodel.COST.drain(timeout=10.0)
+    comm = costmodel.COST.comm_for("mesh_search", str(N))
+    tel.update({
+        "plain_ms": round(plain_s * 1e3, 3),
+        "mesh_ms": round(mesh_s * 1e3, 3),
+        "comm_bytes_per_launch": {c: int(b) for c, b in sorted(comm.items())},
+        "comm_bytes_total": int(sum(comm.values())),
+    })
+    _emit("search_mesh_1x1_overhead", mesh_s / plain_s, "ratio", tel=tel)
 
 
 def bench_find_and_search(tmp: str) -> tuple[float, float, dict, dict]:
@@ -1199,6 +1286,7 @@ def bench_spanmetrics() -> None:
 def main() -> None:
     bench_analysis()
     bench_kernel()
+    bench_mesh_1x1_overhead()
     tmp = tempfile.mkdtemp(prefix="tempo-tpu-bench-")
     try:
         cold, warm, cold_tel, warm_tel = bench_find_and_search(tmp)

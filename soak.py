@@ -327,6 +327,12 @@ def main(argv=None) -> int:
     ap.add_argument("--live-tail", action="store_true",
                     help="searches query only the most recent 60s window "
                          "(exercises the live-head device engine)")
+    ap.add_argument("--vulture", action="store_true",
+                    help="run the continuous-verification prober beside "
+                         "the soak; its SLO verdicts + freshness "
+                         "percentiles fold into the summary (probe "
+                         "failures fail the run)")
+    ap.add_argument("--vulture-interval", type=float, default=2.0)
     ap.add_argument("--write-p95", type=float, default=1.0)
     ap.add_argument("--search-p95", type=float, default=3.0)
     args = ap.parse_args(argv)
@@ -360,14 +366,64 @@ def main(argv=None) -> int:
             except Exception:
                 time.sleep(0.2)
 
+    # vulture sidecar: black-box probes of every read path WHILE the
+    # soak hammers the instance -- the combination the prober exists
+    # for (correctness under load, not at rest). Runs in its own
+    # thread against the same target/tenant.
+    vult = vstop = vthread = None
+    if args.vulture:
+        from tempo_tpu.vulture import Vulture, VultureConfig
+
+        # Vulture itself disables cold-read /flush probes for remote
+        # tokenless targets (loopback-trust guard), so a remote soak
+        # still runs every other family
+        vult = Vulture(VultureConfig(
+            push_url=target, query_url=target,
+            tenant=tenants[0] if tenants else "",
+            visibility_timeout_s=10.0, flush_every=2, seed=1))
+        vstop = threading.Event()
+
+        def vloop():
+            while not vstop.is_set():
+                try:
+                    vult.cycle()
+                except Exception:  # a dying sidecar must not kill the soak
+                    pass
+                vstop.wait(args.vulture_interval)
+
+        vthread = threading.Thread(target=vloop, daemon=True,
+                                   name="soak-vulture")
+        vthread.start()
+
     try:
         soak = Soak(target, args.writers, args.readers, tenants=tenants,
                     zipf=args.zipf, live_tail=args.live_tail)
         report = soak.run(args.duration, max_write_p95_s=args.write_p95,
                           max_search_p95_s=args.search_p95)
+        if vult is not None:
+            vstop.set()
+            vthread.join(timeout=30)
+            vs = vult.status()
+            bad = sum(n for fam in vs["outcomes"].values()
+                      for out, n in fam.items()
+                      if out not in ("ok", "shed"))
+            report["vulture"] = {
+                "cycles": vs["cycles"],
+                "probe_failures": bad,
+                "outcomes": vs["outcomes"],
+                "freshness": vs["freshness"],
+                "slo_verdict": vs["slo"].get("verdict", "ok"),
+                "slo": {name: {"verdict": o.get("verdict"),
+                               "burn_rates": o.get("burn_rates")}
+                        for name, o in vs["slo"].get("objectives", {}).items()},
+                "failures": vs["failures"][:5],
+            }
+            report["ok"] = bool(report["ok"]) and bad == 0
         print(json.dumps(report, indent=2))
         return 0 if report["ok"] else 1
     finally:
+        if vstop is not None:
+            vstop.set()
         if proc is not None:
             proc.terminate()
 

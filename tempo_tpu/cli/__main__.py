@@ -394,6 +394,72 @@ def _calibrate_live(repeats: int) -> dict:
             "device_fixed_s": eng._dev_fixed_s}
 
 
+def cmd_vulture(args):
+    """Run the continuous-verification prober (tempo_tpu/vulture)
+    against a running instance for N cycles: every probe family (by-id,
+    batched find, blocking/streaming search, query_range, live-head,
+    cold reads, durability ledger), freshness measured, summary with
+    SLO verdicts on stdout. Exit 1 if any probe failed."""
+    from ..vulture import Vulture, VultureConfig
+
+    cfg = VultureConfig(
+        push_url=args.target, query_url=args.target, tenant=args.tenant,
+        visibility_timeout_s=args.visibility_timeout,
+        flush_every=args.flush_every, internal_token=args.internal_token,
+        backend_path=args.backend_path, seed=args.seed)
+    v = Vulture(cfg)
+    all_ok = True
+    try:
+        for n in range(args.cycles):
+            results = v.cycle()
+            all_ok = all_ok and Vulture.ok(results)
+            print(json.dumps({
+                "cycle": v.cycles, "ok": Vulture.ok(results),
+                "results": [{"family": r.family, "outcome": r.outcome,
+                             **({"detail": r.detail}
+                                if r.outcome != "ok" else {})}
+                            for r in results]}), file=sys.stderr, flush=True)
+            if n + 1 < args.cycles:
+                import time
+
+                time.sleep(args.interval)
+        print(json.dumps(v.status(), indent=2))
+    finally:
+        v.close()  # drops the fresh-reader scratch WAL dir
+    if not all_ok:
+        sys.exit(1)
+
+
+def cmd_slo(args):
+    """Fetch /status/slo from a running instance and render the
+    objective table: per-window burn rates and verdicts -- the
+    operator's one-look answer to "are we meeting our targets right
+    now"."""
+    import urllib.request
+
+    with urllib.request.urlopen(args.target.rstrip("/") + "/status/slo",
+                                timeout=args.timeout) as r:
+        st = json.load(r)
+    if args.json:
+        print(json.dumps(st, indent=2))
+        return
+    windows = list(st.get("windows", {}))
+    hdr = f"{'objective':24} {'kind':13} {'target':>7} " + " ".join(
+        f"{'burn ' + w:>10}" for w in windows) + "  verdict"
+    print(hdr)
+    for name, obj in st.get("objectives", {}).items():
+        if "error" in obj:
+            print(f"{name:24} SLI error: {obj['error']}")
+            continue
+        burns = obj.get("burn_rates", {})
+        print(f"{name:24} {obj['kind']:13} {obj['target']:>7} "
+              + " ".join(f"{burns.get(w, 0):>10.2f}" for w in windows)
+              + f"  {obj['verdict']}")
+    print(f"overall: {st.get('verdict')}")
+    if st.get("verdict") != "ok":
+        sys.exit(1)
+
+
 def cmd_query_range(args):
     """Offline TraceQL metrics over a backend path: the CLI face of
     /api/metrics/query_range (db/metrics_exec), Prometheus matrix JSON
@@ -604,6 +670,33 @@ def main(argv=None):
     p.add_argument("--skip-live", action="store_true",
                    help="skip the synthetic live-head engine race")
     p.set_defaults(fn=cmd_calibrate)
+
+    p = sub.add_parser("vulture",
+                       help="run the continuous-verification prober "
+                            "against a running instance (all probe "
+                            "families, freshness, SLO verdicts)")
+    p.add_argument("target", help="base URL, e.g. http://localhost:3200")
+    p.add_argument("--tenant", default="", help="X-Scope-OrgID header")
+    p.add_argument("--cycles", type=int, default=3)
+    p.add_argument("--interval", type=float, default=2.0)
+    p.add_argument("--visibility-timeout", type=float, default=15.0)
+    p.add_argument("--flush-every", type=int, default=1,
+                   help="cold-read probe cadence in cycles (0 = never)")
+    p.add_argument("--internal-token", default="",
+                   help="shared token for /flush on non-loopback targets")
+    p.add_argument("--backend-path", default="",
+                   help="storage path for fresh-reader cold probes")
+    p.add_argument("--seed", type=int, default=None)
+    p.set_defaults(fn=cmd_vulture)
+
+    p = sub.add_parser("slo",
+                       help="fetch /status/slo and render burn rates + "
+                            "verdicts per objective (exit 1 unless ok)")
+    p.add_argument("target", help="base URL, e.g. http://localhost:3200")
+    p.add_argument("--json", action="store_true",
+                   help="raw /status/slo JSON instead of the table")
+    p.add_argument("--timeout", type=float, default=15.0)
+    p.set_defaults(fn=cmd_slo)
 
     p = sub.add_parser("query-range",
                        help="TraceQL metrics range query against the backend")

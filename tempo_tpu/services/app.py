@@ -285,6 +285,12 @@ class App:
                 self.distributor.push, tenant=cfg.self_tracing_tenant
             )
 
+        # SLO plane (util/slo): declarative objectives over the metrics
+        # this process already collects, evaluated as multi-window burn
+        # rates on /status/slo + tempo_slo_burn_rate gauges. Query-
+        # serving roles only -- a standalone compactor has no read SLIs.
+        self.slo = build_default_slo(self.frontend) if self.frontend else None
+
         from .usagestats import UsageReporter
 
         self.usage = UsageReporter(self.db.backend, cfg.target)
@@ -372,6 +378,13 @@ class App:
                 tenant=self.cfg.kafka_tenant or DEFAULT_TENANT,
             )
             self.kafka.start()
+        if self.slo is not None:
+            try:
+                slo_interval = float(os.environ.get("TEMPO_SLO_EVAL_S", "")
+                                     or 15)
+            except ValueError:
+                slo_interval = 15.0  # a typo'd env must not abort startup
+            self.slo.start(interval_s=slo_interval)
         self.db.enable_polling()
         self._started = True
 
@@ -391,6 +404,8 @@ class App:
             self.jaeger_agent.stop()
         if self.kafka is not None:
             self.kafka.stop()
+        if self.slo is not None:
+            self.slo.stop()
         if self.querier_worker:
             self.querier_worker.stop()
         if self.compactor:
@@ -606,6 +621,17 @@ def _make_handler(app: App):
 
                     return self._send(
                         200, json.dumps(COST.status_snapshot(), indent=2))
+                if u.path == "/status/slo":
+                    # the SLO plane's verdict surface: every objective
+                    # with its multi-window burn rates (util/slo),
+                    # re-evaluated at request time so the payload is
+                    # never staler than the ask
+                    if app.slo is None:
+                        return self._err(
+                            404, f"target {app.cfg.target} serves no "
+                                 "query SLOs")
+                    return self._send(
+                        200, json.dumps(app.slo.evaluate(), indent=2))
                 if u.path == "/status/usage-stats":
                     return self._send(200, json.dumps(app.usage.report(app), indent=2))
                 if u.path == "/debug/threads":
@@ -937,6 +963,65 @@ def _sample_profile(seconds: float, hz: float = 200.0) -> str:
     return "".join(lines)
 
 
+def build_default_slo(frontend):
+    """The serving objectives every query-capable target ships with
+    (util/slo): availability over the frontend's per-class outcome
+    counters (QoS sheds excluded -- admission refusing work is the
+    budget system functioning), p99-under-threshold latency per query
+    class from the frontend latency histogram, and live-head freshness
+    from the push->device-visible staging-lag histogram. Thresholds
+    sit on bucket edges; TEMPO_SLO_<CLASS>_P99_S env overrides let an
+    operator retune without code."""
+    from ..util import slo as slomod
+    from ..util.kerneltel import TEL
+
+    def _thr(env: str, default: float) -> float:
+        try:
+            return float(os.environ.get(env, "") or default)
+        except ValueError:
+            return default
+
+    engine = slomod.SLOEngine()
+
+    def outcomes_sli():
+        # resolve the instrument through TEL at call time: TEL.reset()
+        # (tests) swaps the counter object under us
+        return slomod.counter_sli(
+            TEL.query_outcomes,
+            good=lambda l: 'outcome="ok"' in l,
+            bad=lambda l: 'outcome="error"' in l)()
+
+    engine.register(slomod.Objective(
+        name="read-availability", kind="availability", target=0.999,
+        sli=outcomes_sli,
+        description="queries served without error across every query "
+                    "class (429 QoS sheds excluded)"))
+
+    for op, env, default in (("traces", "TEMPO_SLO_TRACES_P99_S", 1.0),
+                             ("search", "TEMPO_SLO_SEARCH_P99_S", 2.5),
+                             ("search_stream", "TEMPO_SLO_STREAM_P99_S", 5.0),
+                             ("metrics", "TEMPO_SLO_METRICS_P99_S", 10.0)):
+        thr = _thr(env, default)
+        engine.register(slomod.Objective(
+            name=f"latency-{op}", kind="latency", target=0.99,
+            sli=slomod.histogram_sli(
+                frontend.query_latency, thr,
+                labels_pred=lambda l, _op=op: f'op="{_op}"' in l),
+            description=f"{op} queries completing within {thr:g}s"))
+
+    fresh_thr = _thr("TEMPO_SLO_FRESHNESS_P99_S", 2.5)
+
+    def freshness_sli():
+        return slomod.histogram_sli(TEL.livestage_lag, fresh_thr)()
+
+    engine.register(slomod.Objective(
+        name="live-freshness", kind="freshness", target=0.99,
+        sli=freshness_sli,
+        description=f"pushes device-visible to live search within "
+                    f"{fresh_thr:g}s (livestage staging lag)"))
+    return engine
+
+
 def _kernel_status(app: App) -> dict:
     """The /status/kernels payload: everything an operator needs to
     answer "why was that query slow" one layer below HTTP -- per-op
@@ -1063,6 +1148,14 @@ def _metrics_text(app: App) -> str:
     lines += TEL.metrics_lines()
     _JIT_CACHE_GAUGE.set(TEL.jit_cache_size())
     lines += _JIT_CACHE_GAUGE.text()
+    if app.slo is not None:
+        # burn-rate + verdict gauges refresh at scrape time: alert
+        # rules must never fire on an evaluator that stalled
+        try:
+            app.slo.evaluate()
+        except Exception:
+            pass  # scrape keeps the last published gauges
+        lines += app.slo.metrics_lines()
     if app.ingester:
         try:
             _WAL_DEPTH_GAUGE.set(sum(
@@ -1073,6 +1166,8 @@ def _metrics_text(app: App) -> str:
         lines += _WAL_DEPTH_GAUGE.text()
     helps = dict(_METRIC_HELP)
     helps.update(TEL.help_entries())
+    if app.slo is not None:
+        helps.update(app.slo.help_entries())
     return render_openmetrics(lines, helps=helps)
 
 

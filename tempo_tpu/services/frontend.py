@@ -950,6 +950,7 @@ class Frontend:
 
         t0 = time.perf_counter()
         self_tid = ""
+        outcome = "ok"
         try:
             if self.self_tracer is None or tenant == self.self_tracer.tenant:
                 return self._find_trace_by_id(tenant, trace_id, time_start, time_end)
@@ -959,12 +960,19 @@ class Frontend:
                 self_tid = t.trace_id.hex()
                 return self._find_trace_by_id(tenant, trace_id, time_start, time_end,
                                               trace=t)
+        except TooManyRequests:
+            outcome = "shed"  # QoS budget refusal, not a serving failure
+            raise
+        except Exception:
+            outcome = "error"
+            raise
         finally:
             dt = time.perf_counter() - t0
             # exemplar: the latency histogram links to the self-trace
             self.query_latency.observe(dt, 'op="traces"',
                                        exemplar=self_tid or None)
-            TEL.record_query("traces", dt, self_tid, trace_id.hex())
+            TEL.record_query("traces", dt, self_tid, trace_id.hex(),
+                             outcome=outcome)
 
     def _qos_admit_traced(self, tenant: str, est_bytes: int, trace) -> int:
         """_qos_admit with a timeline span when a trace is active (the
@@ -1031,6 +1039,7 @@ class Frontend:
 
         t0 = time.perf_counter()
         self_tid = ""
+        outcome = "ok"
         try:
             if self.self_tracer is None or tenant == self.self_tracer.tenant:
                 return self._search(tenant, req)
@@ -1039,13 +1048,20 @@ class Frontend:
             ) as t:
                 self_tid = t.trace_id.hex()
                 return self._search(tenant, req, trace=t)
+        except TooManyRequests:
+            outcome = "shed"
+            raise
+        except Exception:
+            outcome = "error"
+            raise
         finally:
             dt = time.perf_counter() - t0
             self.query_latency.observe(dt, 'op="search"',
                                        exemplar=self_tid or None)
             TEL.record_query("search", dt, self_tid,
                              req.query or " ".join(
-                                 f"{k}={v}" for k, v in req.tags.items()))
+                                 f"{k}={v}" for k, v in req.tags.items()),
+                             outcome=outcome)
 
     def _build_search_jobs(self, tenant: str, req: SearchRequest,
                            req_d: dict, metas: list) -> list[_Job]:
@@ -1163,13 +1179,28 @@ class Frontend:
         from ..util.metrics import timed
 
         t0 = time.perf_counter()
+        outcome = "ok"
         try:
-            with timed(self.query_latency, 'op="search"'):
+            # its OWN query class: progressive delivery has a different
+            # latency contract (time-to-final spans the slowest shard
+            # by design), so the SLO layer must not fold it into the
+            # blocking-search p99
+            with timed(self.query_latency, 'op="search_stream"'):
                 yield from self._search_stream(tenant, req)
+        except TooManyRequests:
+            outcome = "shed"
+            raise
+        except GeneratorExit:
+            outcome = "cancelled"  # client went away, not a failure
+            raise
+        except Exception:
+            outcome = "error"
+            raise
         finally:
-            TEL.record_query("search", time.perf_counter() - t0, "",
+            TEL.record_query("search_stream", time.perf_counter() - t0, "",
                              req.query or " ".join(
-                                 f"{k}={v}" for k, v in req.tags.items()))
+                                 f"{k}={v}" for k, v in req.tags.items()),
+                             outcome=outcome)
 
     def _search_stream(self, tenant: str, req: SearchRequest):
         limit = req.limit or 20
@@ -1268,6 +1299,7 @@ class Frontend:
 
         t0 = time.perf_counter()
         self_tid = ""
+        outcome = "ok"
         try:
             if self.self_tracer is None or tenant == self.self_tracer.tenant:
                 return self._metrics_query_range(tenant, req)
@@ -1276,11 +1308,18 @@ class Frontend:
             ) as t:
                 self_tid = t.trace_id.hex()
                 return self._metrics_query_range(tenant, req, trace=t)
+        except TooManyRequests:
+            outcome = "shed"
+            raise
+        except Exception:
+            outcome = "error"
+            raise
         finally:
             dt = time.perf_counter() - t0
             self.query_latency.observe(dt, 'op="metrics"',
                                        exemplar=self_tid or None)
-            TEL.record_query("metrics", dt, self_tid, req.query)
+            TEL.record_query("metrics", dt, self_tid, req.query,
+                             outcome=outcome)
 
     def _metrics_query_range(self, tenant: str, req, trace=None):
         from ..db.metrics_exec import (

@@ -237,6 +237,15 @@ class KernelTelemetry:
             help="per-tenant query cost totals by resource (device_ms, "
                  "staged_bytes, bytes_scanned, compiles, rows_verified)")
         self._query_costs: dict[str, dict[str, float]] = {}
+        # per-query-class outcomes (ok / error / shed) recorded by the
+        # frontend at every query exit: the availability SLI the SLO
+        # engine (util/slo) evaluates. Sheds are a separate outcome --
+        # a per-tenant QoS budget refusing work is the admission system
+        # functioning, not the serving path failing, so the
+        # availability objective excludes them.
+        self.query_outcomes = Counter(
+            "tempo_query_outcomes_total",
+            help="frontend queries by op and outcome (ok/error/shed)")
         # every instrument exported through /metrics -- ONE list shared
         # by metrics_lines() and help_entries() so an instrument can't
         # ship samples without its HELP (or vice versa)
@@ -256,6 +265,7 @@ class KernelTelemetry:
             self.affinity_jobs, self.qos_shed, self.staged_placement,
             self.livestage_rows, self.livestage_delta_bytes,
             self.livestage_lag, self.selftrace_spans, self.query_cost,
+            self.query_outcomes,
         )
         # full compile-key signatures, LRU-bounded (SEEN_SIGNATURES_MAX)
         self._seen: OrderedDict = OrderedDict()
@@ -740,13 +750,19 @@ class KernelTelemetry:
 
     # --------------------------------------------------------- query log
     def record_query(self, op: str, seconds: float, trace_id: str = "",
-                     detail: str = "") -> None:
+                     detail: str = "", outcome: str = "ok") -> None:
+        try:
+            self.query_outcomes.inc(
+                labels=f'op="{op}",outcome="{outcome}"')
+        except Exception:
+            pass
         with self._lock:
             self._queries.append({
                 "op": op,
                 "seconds": round(float(seconds), 6),
                 "self_trace_id": trace_id,
                 "detail": detail[:200],
+                "outcome": outcome,
                 "at_unix": round(time.time(), 3),
             })
 

@@ -69,6 +69,16 @@ class Histogram:
             if exemplar:
                 self._exemplars[labels] = (exemplar, float(value))
 
+    def snapshot(self) -> dict[str, tuple[list[int], float, int]]:
+        """labels -> (per-bucket counts incl. overflow, sum, total).
+        Counts are NON-cumulative (one entry per bucket edge plus the
+        +Inf overflow) -- the SLI readers in util/slo threshold on
+        them without re-deriving from the cumulative exposition."""
+        with self._lock:
+            return {labels: (list(counts), self._sums[labels],
+                             self._totals[labels])
+                    for labels, counts in self._counts.items()}
+
     def text(self) -> list[str]:
         out = []
         with self._lock:
@@ -107,6 +117,11 @@ class Counter:
     def get(self, labels: str = "") -> float:
         with self._lock:
             return self._vals.get(labels, 0)
+
+    def snapshot(self) -> dict[str, float]:
+        """labels -> cumulative value, every label set."""
+        with self._lock:
+            return dict(self._vals)
 
     def text(self) -> list[str]:
         with self._lock:
@@ -170,6 +185,53 @@ def timed(hist: Histogram, labels: str = ""):
 
 _NAME_RE = re.compile(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)")
 _EMPTY_BRACES_RE = re.compile(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)\{\}")
+
+
+class Registry:
+    """Instrument registry: one object owning a set of instruments and
+    their exposition (the role promauto's default registerer plays).
+    Subsystems with their own /metrics endpoint (vulture) register
+    every instrument here so samples can't ship without HELP/TYPE --
+    the same one-list discipline kerneltel keeps by hand."""
+
+    def __init__(self):
+        self._instruments: list = []
+
+    def register(self, inst):
+        self._instruments.append(inst)
+        return inst
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self.register(Counter(name, help=help))
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self.register(Gauge(name, help=help))
+
+    def histogram(self, name: str, buckets: tuple[float, ...] = DEFAULT_BUCKETS,
+                  help: str = "") -> Histogram:
+        return self.register(Histogram(name, buckets=buckets, help=help))
+
+    def lines(self) -> list[str]:
+        out: list[str] = []
+        for inst in self._instruments:
+            out += inst.text()
+        return out
+
+    def helps(self) -> dict[str, str]:
+        out = {}
+        for inst in self._instruments:
+            fam = (inst.name[:-6] if inst.name.endswith("_total")
+                   else inst.name)
+            out[fam] = inst.help
+        return out
+
+    def render(self, extra_lines: list[str] | None = None,
+               extra_helps: dict[str, str] | None = None) -> str:
+        helps = self.helps()
+        if extra_helps:
+            helps.update(extra_helps)
+        return render_openmetrics(self.lines() + (extra_lines or []),
+                                  helps=helps)
 
 
 def _family_of(name: str, hist_bases: set[str]) -> tuple[str, str]:

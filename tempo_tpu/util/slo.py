@@ -1,0 +1,264 @@
+"""Declarative SLOs evaluated as multi-window burn rates.
+
+The role the reference's operations/tempo-mixin SLO recording rules
+play, in-process: an Objective names a target ("99.9% of reads
+succeed", "99% of searches under 2.5 s", "99% of pushes live-visible
+within 2.5 s") over a cumulative SLI source -- an existing
+util/metrics Counter or Histogram -- and the engine turns the
+cumulative totals into windowed error rates by snapshotting them over
+time and differencing against the window start.
+
+Burn rate (Google SRE Workbook ch. 5): the ratio of the observed error
+rate to the rate that would exactly exhaust the error budget over the
+SLO period. burn == 1 means "spending budget exactly on schedule";
+14.4 over both a short and a long window is the classic page-now pair
+(2% of a 30-day budget gone in one hour). Multi-window evaluation
+(5m/1h/6h here) keeps the signal fast AND debounced: the short window
+detects, the long window confirms, and recovery resets the short
+window first.
+
+Windows shorter than the collected history evaluate against the oldest
+sample (a partial window): a freshly-started process reports honest
+burn from its first two samples instead of silence, which is exactly
+what the injected-regression matrix in tests/test_vulture.py relies
+on -- a red probe drives every window critical within one cycle.
+
+No traffic is not an outage: a window whose good+bad delta is zero
+reports burn 0.0.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Callable
+
+from .metrics import Counter, Gauge, Histogram
+
+# (label, seconds) evaluation windows, short to long
+DEFAULT_WINDOWS: tuple[tuple[str, int], ...] = (
+    ("5m", 300), ("1h", 3600), ("6h", 21600))
+
+# page when BOTH the fast pair burns above this (SRE workbook: 14.4 =
+# 2% of a 30-day budget in 1h)
+FAST_BURN = 14.4
+# warn when the slow pair burns above this (6 = 5% of the budget in 6h)
+SLOW_BURN = 6.0
+
+VERDICTS = ("ok", "warning", "critical")
+
+
+@dataclass
+class Objective:
+    """One declarative objective. `sli` returns CUMULATIVE (good, bad)
+    event totals; the engine does the windowing. `target` is the good
+    fraction promised (0.999 leaves a 0.1% error budget)."""
+
+    name: str
+    kind: str  # availability | freshness | latency
+    target: float
+    sli: Callable[[], tuple[float, float]]
+    description: str = ""
+
+
+def counter_sli(counter: Counter,
+                good: Callable[[str], bool],
+                bad: Callable[[str], bool]) -> Callable[[], tuple[float, float]]:
+    """SLI over a labeled Counter: classify each label set as good,
+    bad, or neither (excluded -- e.g. QoS sheds, which are the budget
+    system working, not the serving path failing)."""
+
+    def read() -> tuple[float, float]:
+        g = b = 0.0
+        for labels, v in counter.snapshot().items():
+            if good(labels):
+                g += v
+            elif bad(labels):
+                b += v
+        return g, b
+
+    return read
+
+
+def histogram_sli(hist: Histogram, threshold: float,
+                  labels_pred: Callable[[str], bool] | None = None
+                  ) -> Callable[[], tuple[float, float]]:
+    """Latency/freshness SLI over a Histogram: observations in buckets
+    whose upper edge is <= threshold are good, the rest (including the
+    +Inf overflow) are bad. The threshold should sit on a bucket edge;
+    anything between edges rounds down to the nearest edge, so the SLI
+    never claims credit the histogram can't prove."""
+
+    def read() -> tuple[float, float]:
+        g = total = 0.0
+        for labels, (counts, _s, n) in hist.snapshot().items():
+            if labels_pred is not None and not labels_pred(labels):
+                continue
+            total += n
+            g += sum(c for c, edge in zip(counts, hist.buckets)
+                     if edge <= threshold)
+        return g, total - g
+
+    return read
+
+
+class SLOEngine:
+    """Evaluates registered objectives into per-window burn rates,
+    verdicts, and exposition gauges.
+
+    `name_prefix` namespaces the gauge families so the app's engine
+    (tempo_slo_*) and vulture's own engine (tempo_vulture_slo_*) can
+    coexist on different /metrics endpoints of one process."""
+
+    def __init__(self, windows: tuple[tuple[str, int], ...] = DEFAULT_WINDOWS,
+                 name_prefix: str = "tempo_slo"):
+        self.windows = tuple(windows)
+        self._objectives: dict[str, Objective] = {}
+        # name -> deque[(unix, good, bad)]; bounded to the longest
+        # window plus slack at the minimum sane sample cadence
+        self._history: dict[str, deque] = {}
+        self._lock = threading.Lock()
+        self._max_age = max(w for _, w in self.windows) * 1.25
+        self._history_max = 4096
+        # minimum spacing between RETAINED samples: evaluate() fires
+        # per scrape + per /status/slo request + from the background
+        # loop, and without thinning a busy scrape cadence would
+        # rotate the bounded deque below the longest window -- the
+        # "6h" burn would silently difference against a younger ref.
+        # Burn math reads the CURRENT cumulative totals fresh each
+        # evaluation, so skipping an append loses no accuracy.
+        self._min_sample_gap = self._max_age / (self._history_max / 2)
+        self.burn_gauge = Gauge(
+            f"{name_prefix}_burn_rate",
+            help="error-budget burn rate by objective and window "
+                 "(1.0 = spending the budget exactly on schedule)")
+        self.verdict_gauge = Gauge(
+            f"{name_prefix}_verdict",
+            help="objective verdict (0 ok, 1 warning, 2 critical)")
+        self._last_status: dict = {"objectives": {}, "verdict": "ok"}
+        self._thread: threading.Thread | None = None
+        self._stop = threading.Event()
+
+    # ------------------------------------------------------------ config
+    def register(self, obj: Objective) -> Objective:
+        with self._lock:
+            self._objectives[obj.name] = obj
+            self._history[obj.name] = deque(maxlen=self._history_max)
+        return obj
+
+    def objectives(self) -> list[Objective]:
+        with self._lock:
+            return list(self._objectives.values())
+
+    # ---------------------------------------------------------- evaluate
+    @staticmethod
+    def _verdict(burns: dict[str, float]) -> str:
+        """Multi-window verdict: fast pair (shortest two windows) both
+        over FAST_BURN pages; slow pair (longest two) both over
+        SLOW_BURN warns. Partial windows fall back to the oldest
+        sample, so early in a process's life the pairs agree and a
+        hard failure still pages immediately."""
+        vals = list(burns.values())
+        if len(vals) >= 2 and vals[0] > FAST_BURN and vals[1] > FAST_BURN:
+            return "critical"
+        if len(vals) >= 2 and vals[-2] > SLOW_BURN and vals[-1] > SLOW_BURN:
+            return "warning"
+        return "ok"
+
+    def evaluate(self, now: float | None = None) -> dict:
+        """Snapshot every objective's SLI, difference against each
+        window, publish gauges, and return the /status/slo payload.
+        `now` is injectable for tests."""
+        now = time.time() if now is None else float(now)
+        with self._lock:
+            objs = list(self._objectives.values())
+        out: dict[str, dict] = {}
+        worst = "ok"
+        for obj in objs:
+            try:
+                good, bad = obj.sli()
+            except Exception as e:  # an SLI source must not kill the plane
+                out[obj.name] = {"error": f"{type(e).__name__}: {e}"}
+                continue
+            with self._lock:
+                hist = self._history[obj.name]
+                if not hist or now - hist[-1][0] >= self._min_sample_gap:
+                    hist.append((now, float(good), float(bad)))
+                while hist and hist[0][0] < now - self._max_age:
+                    hist.popleft()
+                samples = list(hist)
+            burns: dict[str, float] = {}
+            for wname, wsec in self.windows:
+                ref = samples[0]
+                for s in samples:
+                    if s[0] <= now - wsec:
+                        ref = s
+                    else:
+                        break
+                dg, db = good - ref[1], bad - ref[2]
+                total = dg + db
+                err_rate = (db / total) if total > 0 else 0.0
+                burn = err_rate / max(1e-9, 1.0 - obj.target)
+                burns[wname] = round(burn, 4)
+                self.burn_gauge.set(
+                    burn, labels=f'objective="{obj.name}",window="{wname}"')
+            verdict = self._verdict(burns)
+            self.verdict_gauge.set(VERDICTS.index(verdict),
+                                   labels=f'objective="{obj.name}"')
+            if VERDICTS.index(verdict) > VERDICTS.index(worst):
+                worst = verdict
+            out[obj.name] = {
+                "kind": obj.kind,
+                "target": obj.target,
+                "description": obj.description,
+                "good_total": round(float(good), 3),
+                "bad_total": round(float(bad), 3),
+                "burn_rates": burns,
+                "verdict": verdict,
+            }
+        status = {"objectives": out, "verdict": worst,
+                  "windows": dict(self.windows),
+                  "evaluated_at_unix": round(now, 3)}
+        with self._lock:
+            self._last_status = status
+        return status
+
+    def status(self) -> dict:
+        """Most recent evaluation (without re-evaluating)."""
+        with self._lock:
+            return self._last_status
+
+    # -------------------------------------------------------- exposition
+    def metrics_lines(self) -> list[str]:
+        return self.burn_gauge.text() + self.verdict_gauge.text()
+
+    def help_entries(self) -> dict[str, str]:
+        return {self.burn_gauge.name: self.burn_gauge.help,
+                self.verdict_gauge.name: self.verdict_gauge.help}
+
+    # --------------------------------------------------------- lifecycle
+    def start(self, interval_s: float = 15.0) -> None:
+        """Background evaluator so gauges stay fresh for scrapes even
+        when nobody hits /status/slo."""
+        if self._thread is not None:
+            return
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.wait(interval_s):
+                try:
+                    self.evaluate()
+                except Exception:  # noqa: BLE001 - evaluator must survive
+                    pass
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="slo-evaluator")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None

@@ -1,153 +1,945 @@
-"""tempo-vulture equivalent: black-box write/read consistency checker.
+"""tempo-vulture equivalent: a continuous verification plane.
 
-The reference's vulture (cmd/tempo-vulture) runs beside a cluster,
-pushes known traces, reads them back by id and via search, and emits
-404 / missing-span metrics that alerting watches (SURVEY.md 2.1, 4.7).
+The reference ships a black-box prober (cmd/tempo-vulture) that pushes
+known traces and reads them back; alerting watches its metrics
+(SURVEY.md 2.1, 4.7). This port grows that into a long-running
+continuous-verification service whose probe families cover every read
+path the system has:
 
-Run: python -m tempo_tpu.vulture --push-url http://host:3200 \
-        --query-url http://host:3200 --cycles 10 --interval 5
+  push          OTLP ingest of a known trace set (unique service name
+                per cycle, deterministic span content).
+  find_by_id    retry-until-visible GET /api/traces/{id} BEFORE any
+                flush -- the live-head by-id path -- with bit-level
+                span comparison against what was pushed; the retry lag
+                is the write->live-visible freshness histogram.
+  find_batched  K concurrent by-id reads of the cycle's trace set: the
+                cross-query batching executor's find path (PR 3) must
+                demux every trace bit-identically.
+  search        retry-until-visible blocking /api/search by the unique
+                service tag; the retry lag is the write->searchable
+                freshness histogram.
+  live_head     time-windowed recent search (start=now-60s) before
+                cut/flush: the shape the live-head device engine
+                serves from staged columnar tails.
+  search_stream /api/search?stream=true: partial events must be
+                well-ordered (done=false, jobsCompleted monotone) and
+                the final event must equal the blocking response.
+  query_range   TraceQL metrics count_over_time over the cycle's
+                service: the expected per-bucket series is computed
+                from the pushed spans' timestamps and compared
+                exactly.
+  cold_read     POST /flush, then read the trace back cold -- through
+                a FRESH TempoDB reader over the backend path when one
+                is configured (self-hosted / sidecar mode: every byte
+                off disk), over HTTP otherwise; the lag is the
+                flush->cold-readable freshness histogram. Flushed ids
+                enter the durability ledger.
+  durability    a sample of previously-flushed trace ids re-probed by
+                id each cycle, across compactions, against their
+                recorded content digest -- data loss detection long
+                after the write.
 
-Alert thresholds (what the reference's vulture dashboards page on):
-  - notfound_byid > 0 over 10m     -> CRITICAL: written traces are not
-    readable by id (ingest loss or find-path regression).
-  - missing_spans > 0 over 10m     -> CRITICAL: partial traces returned
-    (combiner/replication bug, not just a slow leg).
-  - notfound_search / requests > 0.01 over 30m -> WARNING: fresh traces
-    absent from search results (blocklist poll lag or search-path bug;
-    tolerate brief ingest->searchable delay).
-  - error rate (HTTP failures / requests) > 0.05 over 5m -> WARNING:
-    availability, usually ring/frontend health rather than data loss.
+Outcomes per probe: ok | miss (data absent) | corrupt (content
+mismatch) | timeout (never became visible) | error (transport/HTTP) |
+shed (HTTP 429 -- the per-tenant QoS budget refusing work; counted
+separately and EXCLUDED from the availability SLI). Every failed probe
+captures the self-trace timeline id of the query that served it (the
+/status/kernels slow-query log, PR 9) so a red probe links straight to
+its query timeline.
+
+Freshness is MEASURED as retry-until-visible lag, never assumed as a
+sleep. On top sits a util/slo engine (probe availability + per-kind
+freshness objectives) whose multi-window burn rates and verdicts ship
+in vulture's own strict-OpenMetrics /metrics and in the summary.
+
+Run against a live instance:
+    python -m tempo_tpu.vulture --push-url http://host:3200 \
+        --query-url http://host:3200 --cycles 0 --interval 5 \
+        --metrics-port 8090
+or fully self-hosted (spawns an in-process single binary and probes
+it over HTTP -- the zero-config smoke mode tier-1 runs):
+    python -m tempo_tpu.vulture --self-hosted --cycles 3
 """
 
 from __future__ import annotations
 
 import argparse
+import hashlib
 import json
 import random
+import threading
 import time
 import urllib.error
 import urllib.parse
 import urllib.request
-from dataclasses import dataclass, field
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass
 
-from .util.testdata import make_trace, make_trace_id
+from .util import slo as slomod
+from .util.metrics import Registry
+from .util.testdata import make_trace_id
 from .wire import otlp_json
+from .wire.model import Resource, ResourceSpans, Scope, ScopeSpans, Span, Trace
+
+OUTCOMES = ("ok", "miss", "corrupt", "timeout", "error", "shed")
+BAD_OUTCOMES = ("miss", "corrupt", "timeout", "error")  # shed excluded
+
+# retry-until-visible lag histograms want a fine low end (in-process
+# visibility is sub-ms) and a top at the visibility timeout
+FRESHNESS_BUCKETS = (0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+                     5.0, 10.0, 30.0)
+
+
+class Shed(Exception):
+    """HTTP 429: the per-tenant QoS budget refused the probe."""
 
 
 @dataclass
-class VultureMetrics:
-    requests: int = 0
-    notfound_byid: int = 0
-    missing_spans: int = 0
-    notfound_search: int = 0
-    errors: int = 0
+class VultureConfig:
+    push_url: str = "http://127.0.0.1:3200"
+    query_url: str = "http://127.0.0.1:3200"
+    tenant: str = ""
+    timeout_s: float = 10.0
+    # retry-until-visible budget; a probe family that never sees its
+    # data within this window records outcome=timeout for the cycle
+    visibility_timeout_s: float = 15.0
+    retry_interval_s: float = 0.1
+    spans_per_trace: int = 4
+    batch_ids: int = 4  # traces pushed per cycle (find_batched width)
+    # cold probe cadence: flush + cold read every Nth cycle (0 = never;
+    # needs loopback or the internal token to reach /flush)
+    flush_every: int = 1
+    internal_token: str = ""
+    durability_sample: int = 4
+    ledger_max: int = 512
+    # backend storage path for TRUE fresh-reader cold probes (every
+    # byte off disk through a new TempoDB); "" = cold reads over HTTP
+    backend_path: str = ""
+    seed: int | None = None
 
-    def lines(self) -> list[str]:
-        return [
-            f"tempo_vulture_trace_total {self.requests}",
-            f"tempo_vulture_notfound_byid_total {self.notfound_byid}",
-            f"tempo_vulture_missing_spans_total {self.missing_spans}",
-            f"tempo_vulture_notfound_search_total {self.notfound_search}",
-            f"tempo_vulture_error_total {self.errors}",
-        ]
+
+@dataclass
+class ProbeResult:
+    family: str
+    outcome: str
+    lag_s: float = 0.0
+    detail: str = ""
+    self_trace_id: str = ""
+
+
+@dataclass
+class _LedgerEntry:
+    tid_hex: str
+    digest: str
+    svc: str
+    written_at: float
+
+
+def canonical_spans(tr: Trace) -> frozenset:
+    """Bit-level comparable form of a trace: every span with its
+    resource identity, ids, kind, timestamps, status and attrs (values
+    tagged with their type so 200 != "200" != 200.0). Set-shaped:
+    span ORDER may legally differ across read paths; span CONTENT may
+    not."""
+
+    def val(v):
+        return (type(v).__name__, repr(v))
+
+    rows = []
+    for res, _scope, sp in tr.all_spans():
+        rows.append((
+            res.service_name,
+            tuple(sorted((k, val(v)) for k, v in res.attrs.items())),
+            sp.span_id.hex(),
+            sp.parent_span_id.hex(),
+            sp.name,
+            int(sp.kind),
+            int(sp.start_unix_nano),
+            int(sp.end_unix_nano),
+            int(getattr(sp, "status_code", 0)),
+            tuple(sorted((k, val(v)) for k, v in sp.attrs.items())),
+        ))
+    return frozenset(rows)
+
+
+def content_digest(tr: Trace) -> str:
+    return hashlib.sha256(
+        repr(sorted(canonical_spans(tr))).encode()).hexdigest()
+
+
+def _make_probe_trace(rng: random.Random, tid: bytes, svc: str,
+                      n_spans: int, base_ns: int) -> Trace:
+    """Deterministic probe content: attr values chosen to round-trip
+    OTLP JSON exactly (ints, strings, bools, binary-exact floats), a
+    parent chain for structure, timestamps inside the current minute
+    so time-windowed probes and query_range buckets see them."""
+    rs = ResourceSpans(resource=Resource(attrs={
+        "service.name": svc, "vulture.probe": True}))
+    ss = ScopeSpans(scope=Scope(name="tempo-vulture", version="2"))
+    prev = b""
+    for i in range(n_spans):
+        sid = rng.getrandbits(64).to_bytes(8, "big")
+        start = base_ns + i * 1_000_000
+        sp = Span(
+            trace_id=tid, span_id=sid, parent_span_id=prev,
+            name=f"probe-op-{i}", kind=1 + (i % 5),
+            start_unix_nano=start, end_unix_nano=start + 2_000_000,
+            status_code=0,
+            attrs={"probe.seq": i, "probe.note": f"v-{i:04d}",
+                   "probe.flag": i % 2 == 0, "probe.weight": 0.25 * i},
+        )
+        ss.spans.append(sp)
+        prev = sid
+    rs.scope_spans.append(ss)
+    t = Trace()
+    t.resource_spans.append(rs)
+    return t
 
 
 class Vulture:
-    def __init__(self, push_url: str, query_url: str, tenant_header: str | None = None,
-                 read_back_delay_s: float = 1.0, seed: int | None = None):
-        self.push_url = push_url.rstrip("/")
-        self.query_url = query_url.rstrip("/")
-        self.tenant_header = tenant_header
-        self.read_back_delay_s = read_back_delay_s
-        self.rng = random.Random(seed)
-        self.metrics = VultureMetrics()
+    """The continuous-verification prober. One instance owns the probe
+    loop, the metric registry, the durability ledger and the SLO
+    engine; `cycle()` runs every probe family once."""
 
-    def _headers(self):
-        h = {"Content-Type": "application/json"}
-        if self.tenant_header:
-            h["X-Scope-OrgID"] = self.tenant_header
+    def __init__(self, cfg: VultureConfig, app=None):
+        self.cfg = cfg
+        self.app = app  # in-process App in --self-hosted mode (or None)
+        self.push_url = cfg.push_url.rstrip("/")
+        self.query_url = cfg.query_url.rstrip("/")
+        # /flush is loopback-trusted only (or token-gated): against a
+        # remote target without a token the cold-read probe would 401
+        # every flush cycle and page on a healthy cluster -- disable it
+        # here so every caller (CLI, soak sidecar) gets the guard
+        if cfg.flush_every and not cfg.internal_token:
+            host = urllib.parse.urlparse(self.push_url).hostname or ""
+            if host not in ("127.0.0.1", "::1", "localhost"):
+                import sys
+
+                print("vulture: cold-read probes disabled (remote target, "
+                      "no --internal-token for /flush)", file=sys.stderr)
+                cfg.flush_every = 0
+        self.rng = random.Random(cfg.seed)
+        self.run_id = f"{self.rng.getrandbits(32):08x}"
+        self.seq = 0
+        self.cycles = 0
+        self._lock = threading.Lock()
+        self.ledger: deque[_LedgerEntry] = deque(maxlen=cfg.ledger_max)
+        self.failures: deque[dict] = deque(maxlen=64)
+        # raw lag samples (bounded) for summary percentiles
+        self._lags: dict[str, deque] = {
+            k: deque(maxlen=2048)
+            for k in ("live_visible", "searchable", "cold_readable")}
+
+        # ------------------------------ metrics (util/metrics Registry)
+        self.registry = Registry()
+        self.probes = self.registry.counter(
+            "tempo_vulture_probes_total",
+            help="verification probes by family and outcome")
+        self.freshness = self.registry.histogram(
+            "tempo_vulture_freshness_seconds", buckets=FRESHNESS_BUCKETS,
+            help="measured retry-until-visible lag by kind "
+                 "(live_visible / searchable / cold_readable)")
+        self.probe_duration = self.registry.histogram(
+            "tempo_vulture_probe_duration_seconds",
+            help="wall time of one probe family run")
+        self.cycles_total = self.registry.counter(
+            "tempo_vulture_cycles_total",
+            help="completed verification cycles")
+        self.last_cycle_gauge = self.registry.gauge(
+            "tempo_vulture_last_cycle_unix",
+            help="wall-clock time the last cycle finished")
+        self.ledger_gauge = self.registry.gauge(
+            "tempo_vulture_ledger_entries",
+            help="trace ids tracked by the durability ledger")
+
+        # ------------------------------------------- SLO engine on top
+        self.slo = slomod.SLOEngine(name_prefix="tempo_vulture_slo")
+        self.slo.register(slomod.Objective(
+            name="probe-availability", kind="availability", target=0.999,
+            sli=slomod.counter_sli(
+                self.probes,
+                good=lambda l: 'outcome="ok"' in l,
+                bad=lambda l: any(f'outcome="{o}"' in l
+                                  for o in BAD_OUTCOMES)),
+            description="probes succeeding across every family "
+                        "(QoS sheds excluded)"))
+        for kind, thr, tgt in (("live_visible", 2.5, 0.99),
+                               ("searchable", 5.0, 0.99),
+                               ("cold_readable", 10.0, 0.99)):
+            self.slo.register(slomod.Objective(
+                name=f"freshness-{kind}", kind="freshness", target=tgt,
+                sli=slomod.histogram_sli(
+                    self.freshness, thr,
+                    labels_pred=lambda l, _k=kind: f'kind="{_k}"' in l),
+                description=f"writes {kind.replace('_', '-')} within "
+                            f"{thr:g}s"))
+        self._http_server = None
+        self._cold_wal: str | None = None  # shared fresh-reader WAL dir
+
+    # ------------------------------------------------------------- http
+    def _headers(self, ctype: str = "") -> dict:
+        h = {}
+        if ctype:
+            h["Content-Type"] = ctype
+        if self.cfg.tenant:
+            h["X-Scope-OrgID"] = self.cfg.tenant
         return h
 
-    def cycle(self) -> bool:
-        """One write->read->search round. True if fully consistent."""
-        self.metrics.requests += 1
-        tid = make_trace_id(self.rng)
-        tr = make_trace(self.rng, trace_id=tid, n_spans=4,
-                        base_time_ns=time.time_ns())
-        ok = True
+    def _request(self, url: str, data: bytes | None = None,
+                 ctype: str = "", extra: dict | None = None) -> bytes:
+        """One HTTP round trip. Raises Shed on 429 (the QoS budget
+        refusing the probe -- a distinct outcome, not an error),
+        re-raises HTTPError otherwise."""
+        h = self._headers(ctype)
+        if extra:
+            h.update(extra)
+        req = urllib.request.Request(url, data=data, headers=h)
         try:
-            req = urllib.request.Request(
-                self.push_url + "/v1/traces",
-                data=otlp_json.dumps(tr).encode(), headers=self._headers(),
-            )
-            urllib.request.urlopen(req, timeout=10)
-        except (urllib.error.URLError, OSError):
-            self.metrics.errors += 1
-            return False
+            with urllib.request.urlopen(req, timeout=self.cfg.timeout_s) as r:
+                return r.read()
+        except urllib.error.HTTPError as e:
+            if e.code == 429:
+                raise Shed(str(e)) from e
+            raise
 
-        time.sleep(self.read_back_delay_s)
+    def _push(self, tr: Trace) -> None:
+        self._request(self.push_url + "/v1/traces",
+                      data=otlp_json.dumps(tr).encode(),
+                      ctype="application/json")
 
+    def _get_trace(self, tid_hex: str) -> Trace | None:
         try:
-            with urllib.request.urlopen(
-                urllib.request.Request(
-                    f"{self.query_url}/api/traces/{tid.hex()}", headers=self._headers()
-                ),
-                timeout=10,
-            ) as r:
-                got = otlp_json.loads(r.read())
-            if got.span_count() < tr.span_count():
-                self.metrics.missing_spans += tr.span_count() - got.span_count()
-                ok = False
+            return otlp_json.loads(
+                self._request(f"{self.query_url}/api/traces/{tid_hex}"))
         except urllib.error.HTTPError as e:
             if e.code == 404:
-                self.metrics.notfound_byid += 1
-                ok = False
-            else:
-                self.metrics.errors += 1
-                return False
-        except (urllib.error.URLError, OSError):
-            self.metrics.errors += 1
-            return False
+                return None
+            raise
 
-        # search leg: the trace must be findable by its root service name
-        svc = next(iter(tr.all_spans()))[0].service_name
+    def _search_body(self, params: dict) -> dict:
+        qs = urllib.parse.urlencode(params)
+        return json.loads(self._request(f"{self.query_url}/api/search?{qs}"))
+
+    # ------------------------------------------------------- accounting
+    def _record(self, res: ProbeResult) -> None:
+        self.probes.inc(
+            labels=f'family="{res.family}",outcome="{res.outcome}"')
+        # only REAL failures enter the bounded failure log: sheds are
+        # the QoS budget working, and letting them rotate out a
+        # durability miss would sever the probe->self-trace link
+        # exactly when an operator needs it
+        if res.outcome in BAD_OUTCOMES:
+            res.self_trace_id = self._self_trace_id(res.detail)
+            with self._lock:
+                self.failures.append({
+                    "family": res.family, "outcome": res.outcome,
+                    "detail": res.detail[:300],
+                    "self_trace_id": res.self_trace_id,
+                    "at_unix": round(time.time(), 3)})
+
+    def _freshness(self, kind: str, lag_s: float) -> None:
+        self.freshness.observe(lag_s, labels=f'kind="{kind}"')
+        with self._lock:
+            self._lags[kind].append(lag_s)
+
+    def _self_trace_id(self, marker: str) -> str:
+        """Best-effort: the self-trace timeline id of the query that
+        served (or failed) this probe, from the slow-query log -- a red
+        probe links straight to `tempo-tpu-cli self-trace <id>`."""
         try:
-            q = urllib.parse.quote(f"service.name={svc}")
             with urllib.request.urlopen(
-                urllib.request.Request(
-                    f"{self.query_url}/api/search?tags={q}&limit=200", headers=self._headers()
-                ),
-                timeout=10,
-            ) as r:
-                hits = {t["traceID"] for t in json.loads(r.read())["traces"]}
-            if tid.hex() not in hits:
-                self.metrics.notfound_search += 1
-                ok = False
-        except (urllib.error.URLError, OSError):
-            self.metrics.errors += 1
-            return False
-        return ok
+                    self.query_url + "/status/kernels",
+                    timeout=self.cfg.timeout_s) as r:
+                status = json.load(r)
+            probe_key = marker.split(" ", 1)[0] if marker else ""
+            best = ("", -1.0)
+            for q in status.get("slow_queries", []):
+                if not q.get("self_trace_id"):
+                    continue
+                if probe_key and probe_key not in q.get("detail", ""):
+                    continue
+                if q.get("at_unix", 0) > best[1]:
+                    best = (q["self_trace_id"], q.get("at_unix", 0))
+            return best[0]
+        except Exception:
+            return ""
+
+    def _await(self, check, timeout_s: float | None = None):
+        """Retry-until-visible: poll `check` (None/False = not yet)
+        until it returns truthy or the visibility budget runs out.
+        Returns (value_or_None, lag_seconds). Shed aborts immediately
+        (retrying into a closed budget just burns it further)."""
+        deadline = time.perf_counter() + (timeout_s
+                                          or self.cfg.visibility_timeout_s)
+        t0 = time.perf_counter()
+        while True:
+            v = check()
+            if v:
+                return v, time.perf_counter() - t0
+            if time.perf_counter() >= deadline:
+                return None, time.perf_counter() - t0
+            time.sleep(self.cfg.retry_interval_s)
+
+    def _run_family(self, family: str, fn, detail: str) -> ProbeResult:
+        """Execute one probe family with outcome classification and
+        duration accounting. `fn` returns a ProbeResult (or raises)."""
+        t0 = time.perf_counter()
+        try:
+            res = fn()
+        except Shed as e:
+            res = ProbeResult(family, "shed", detail=f"{detail}: {e}")
+        except urllib.error.HTTPError as e:
+            res = ProbeResult(family, "error",
+                              detail=f"{detail}: HTTP {e.code}")
+        except Exception as e:  # transport errors + probe logic bugs alike
+            res = ProbeResult(family, "error",
+                              detail=f"{detail}: {type(e).__name__}: {e}")
+        self.probe_duration.observe(time.perf_counter() - t0,
+                                    labels=f'family="{family}"')
+        self._record(res)
+        return res
+
+    # ---------------------------------------------------------- probes
+    def cycle(self) -> list[ProbeResult]:
+        """One full verification round across every probe family.
+        Returns the per-family results (self.ok(results) says whether
+        the serving path held)."""
+        self.seq += 1
+        svc = f"vulture-{self.run_id}-{self.seq}"
+        base_ns = time.time_ns()
+        traces: list[tuple[bytes, Trace]] = []
+        for i in range(max(1, self.cfg.batch_ids)):
+            tid = make_trace_id(self.rng)
+            traces.append((tid, _make_probe_trace(
+                self.rng, tid, svc, self.cfg.spans_per_trace,
+                base_ns + i * 10_000_000)))
+        want = {tid.hex(): canonical_spans(tr) for tid, tr in traces}
+        results: list[ProbeResult] = []
+
+        def run(family, fn, detail):
+            results.append(self._run_family(family, fn, detail))
+            return results[-1]
+
+        # -- push: all of the cycle's traces in (a push failure makes
+        # every read family below meaningless -- stop the cycle)
+        def push_fn():
+            for _tid, tr in traces:
+                self._push(tr)
+            return ProbeResult("push", "ok")
+
+        if run("push", push_fn, svc).outcome != "ok":
+            self._close_cycle()
+            return results
+
+        lead_hex = traces[0][0].hex()
+
+        # -- find_by_id: retry-until-visible + bit-level comparison;
+        # the lag IS the write->live-visible freshness sample
+        def byid_fn():
+            got, lag = self._await(lambda: self._get_trace(lead_hex))
+            if got is None:
+                return ProbeResult("find_by_id", "timeout", lag,
+                                   f"{svc} id={lead_hex} never visible")
+            self._freshness("live_visible", lag)
+            if canonical_spans(got) != want[lead_hex]:
+                return ProbeResult("find_by_id", "corrupt", lag,
+                                   f"{svc} id={lead_hex} span mismatch")
+            return ProbeResult("find_by_id", "ok", lag)
+
+        run("find_by_id", byid_fn, svc)
+
+        # -- find_batched: K concurrent by-id reads (the PR-3 batched
+        # find path) -- every demuxed result must be bit-identical
+        def batched_fn():
+            with ThreadPoolExecutor(len(traces)) as ex:
+                got = list(ex.map(
+                    lambda th: (th, self._get_trace(th)), list(want)))
+            missing = [th for th, tr in got if tr is None]
+            if missing:
+                return ProbeResult(
+                    "find_batched", "miss",
+                    detail=f"{svc} {len(missing)}/{len(got)} ids absent "
+                           f"(first {missing[0]})")
+            bad = [th for th, tr in got if canonical_spans(tr) != want[th]]
+            if bad:
+                return ProbeResult(
+                    "find_batched", "corrupt",
+                    detail=f"{svc} {len(bad)} ids mismatched "
+                           f"(first {bad[0]})")
+            return ProbeResult("find_batched", "ok")
+
+        run("find_batched", batched_fn, svc)
+
+        # -- search: retry-until-visible by the unique service tag; the
+        # lag is the write->searchable freshness sample
+        tags = f"service.name={svc}"
+
+        def search_hits() -> dict | None:
+            body = self._search_body({"tags": tags, "limit": 50})
+            hits = {t["traceID"]: t for t in body.get("traces", [])}
+            return hits if lead_hex in hits else None
+
+        def search_fn():
+            hits, lag = self._await(search_hits)
+            if hits is None:
+                return ProbeResult("search", "timeout", lag,
+                                   f"{svc} not searchable")
+            self._freshness("searchable", lag)
+            hit = hits[lead_hex]
+            if hit.get("rootServiceName") not in ("", svc):
+                return ProbeResult(
+                    "search", "corrupt", lag,
+                    f"{svc} summary rootServiceName="
+                    f"{hit.get('rootServiceName')!r}")
+            return ProbeResult("search", "ok", lag)
+
+        run("search", search_fn, svc)
+
+        # -- live_head: the recent-window shape (start=now-60s) the
+        # live-head device engine serves from staged columnar tails --
+        # queried BEFORE any cut/flush of this cycle's traces
+        def live_head_fn():
+            now = int(time.time())
+            got, lag = self._await(lambda: self._search_body({
+                "tags": tags, "limit": 50,
+                "start": str(now - 60), "end": str(now + 5),
+            }).get("traces") or None)
+            if got is None:
+                return ProbeResult("live_head", "timeout", lag,
+                                   f"{svc} absent from recent window")
+            if lead_hex not in {t["traceID"] for t in got}:
+                return ProbeResult("live_head", "miss", lag,
+                                   f"{svc} lead id absent from window hits")
+            return ProbeResult("live_head", "ok", lag)
+
+        run("live_head", live_head_fn, svc)
+
+        # -- search_stream: progressive delivery ordering + final ==
+        # blocking invariants
+        run("search_stream", lambda: self._stream_probe(svc, tags), svc)
+
+        # -- query_range: expected per-bucket series computed from the
+        # pushed spans' timestamps
+        run("query_range",
+            lambda: self._query_range_probe(svc, traces, base_ns), svc)
+
+        # -- cold_read + durability ledger maintenance
+        if self.cfg.flush_every and self.seq % self.cfg.flush_every == 0:
+            run("cold_read",
+                lambda: self._cold_probe(svc, traces, want), svc)
+        if self.ledger:
+            run("durability", self._durability_probe, "ledger")
+
+        self._close_cycle()
+        return results
+
+    def _close_cycle(self) -> None:
+        self.cycles += 1
+        self.cycles_total.inc()
+        self.last_cycle_gauge.set(time.time())
+        self.ledger_gauge.set(len(self.ledger))
+        try:
+            self.slo.evaluate()
+        except Exception:
+            pass
+
+    @staticmethod
+    def ok(results: list[ProbeResult]) -> bool:
+        return all(r.outcome in ("ok", "shed") for r in results)
+
+    # ------------------------------------------------- stream probe
+    def _stream_probe(self, svc: str, tags: str) -> ProbeResult:
+        """stream=true invariants: every partial has done=false with
+        monotone jobsCompleted <= jobsTotal, exactly one final with
+        done=true, and the final body equals the blocking response for
+        the same request (PR 8's final==blocking contract)."""
+        qs = urllib.parse.urlencode(
+            {"tags": tags, "limit": 50, "stream": "true"})
+        req = urllib.request.Request(
+            f"{self.query_url}/api/search?{qs}", headers=self._headers())
+        events = []
+        try:
+            with urllib.request.urlopen(req, timeout=self.cfg.timeout_s) as r:
+                for line in r:
+                    line = line.strip()
+                    if line:
+                        events.append(json.loads(line))
+        except urllib.error.HTTPError as e:
+            if e.code == 429:
+                raise Shed(str(e)) from e
+            raise
+        if not events:
+            return ProbeResult("search_stream", "miss",
+                               detail=f"{svc} stream yielded no events")
+        last_jobs = -1
+        for ev in events[:-1]:
+            if ev.get("done"):
+                return ProbeResult(
+                    "search_stream", "corrupt",
+                    detail=f"{svc} done=true before the final event")
+            jc = ev.get("jobsCompleted", 0)
+            if jc < last_jobs or jc > ev.get("jobsTotal", 0):
+                return ProbeResult(
+                    "search_stream", "corrupt",
+                    detail=f"{svc} jobsCompleted not monotone "
+                           f"({last_jobs} -> {jc})")
+            last_jobs = jc
+        final = events[-1]
+        if not final.get("done"):
+            return ProbeResult("search_stream", "corrupt",
+                               detail=f"{svc} final event missing done=true")
+        blocking = self._search_body({"tags": tags, "limit": 50})
+        if final.get("traces") != blocking.get("traces"):
+            return ProbeResult(
+                "search_stream", "corrupt",
+                detail=f"{svc} final stream body != blocking body "
+                       f"({len(final.get('traces', []))} vs "
+                       f"{len(blocking.get('traces', []))} traces)")
+        return ProbeResult("search_stream", "ok")
+
+    # -------------------------------------------- query_range probe
+    def _query_range_probe(self, svc: str, traces, base_ns: int) -> ProbeResult:
+        """count_over_time over the probe service: expected series
+        computed client-side from the pushed spans (the server aligns
+        start/end onto the step grid exactly like align_params, so the
+        bucket map is reproducible)."""
+        step = 5
+        start_s = base_ns // 1_000_000_000 - step
+        end_s = time.time() + step
+        expect: dict[float, int] = {}
+        for _tid, tr in traces:
+            for _res, _sc, sp in tr.all_spans():
+                b = (sp.start_unix_nano // 1_000_000
+                     // (step * 1000)) * step
+                expect[float(b)] = expect.get(float(b), 0) + 1
+        q = urllib.parse.quote(
+            f'{{ resource.service.name = "{svc}" }} | count_over_time()')
+
+        def sample() -> dict | None:
+            body = json.loads(self._request(
+                f"{self.query_url}/api/metrics/query_range?q={q}"
+                f"&start={start_s}&end={end_s}&step={step}"))
+            got: dict[float, int] = {}
+            for series in body.get("data", {}).get("result", []):
+                for ts, v in series.get("values", []):
+                    if float(v):
+                        got[float(ts)] = got.get(float(ts), 0) + int(float(v))
+            return got if got == expect else None
+
+        got, _lag = self._await(sample)
+        if got is None:
+            # distinguish "never arrived" from "arrived wrong": one
+            # last unconditional read for the detail line
+            try:
+                body = json.loads(self._request(
+                    f"{self.query_url}/api/metrics/query_range?q={q}"
+                    f"&start={start_s}&end={end_s}&step={step}"))
+                n = sum(
+                    int(float(v)) for series in
+                    body.get("data", {}).get("result", [])
+                    for _ts, v in series.get("values", []))
+            except Exception:
+                n = -1
+            want_n = sum(expect.values())
+            # n==0: series never arrived (freshness); n<0: the
+            # confirming read itself failed (transport, NOT content);
+            # n>0: arrived with the wrong shape (real corruption)
+            outcome = ("timeout" if n == 0
+                       else "error" if n < 0 else "corrupt")
+            return ProbeResult(
+                "query_range", outcome,
+                detail=f"{svc} expected {want_n} spans across "
+                       f"{len(expect)} buckets, got {n}")
+        return ProbeResult("query_range", "ok")
+
+    # ------------------------------------------------- cold probe
+    def _cold_probe(self, svc: str, traces, want) -> ProbeResult:
+        """Flush the live head, then prove the cycle's traces are
+        readable COLD: through a fresh TempoDB reader over the backend
+        path when configured (fresh readers pay every byte from disk),
+        over HTTP otherwise. The lag from flush to first successful
+        cold read is the flush->cold-readable freshness sample.
+        Flushed ids enter the durability ledger."""
+        t_flush = time.perf_counter()
+        self._request(self.push_url + "/flush", data=b"",
+                      extra={"X-Tempo-Internal-Token":
+                             self.cfg.internal_token}
+                      if self.cfg.internal_token else None)
+        lead_tid, lead_tr = traces[0]
+        lead_hex = lead_tid.hex()
+
+        if self.cfg.backend_path:
+            got, _ = self._await(
+                lambda: self._cold_read_fresh(lead_tid))
+        else:
+            got, _ = self._await(lambda: self._get_trace(lead_hex))
+        lag = time.perf_counter() - t_flush
+        if got is None:
+            return ProbeResult("cold_read", "timeout", lag,
+                               f"{svc} id={lead_hex} not cold-readable")
+        self._freshness("cold_readable", lag)
+        if canonical_spans(got) != want[lead_hex]:
+            return ProbeResult("cold_read", "corrupt", lag,
+                               f"{svc} id={lead_hex} cold span mismatch")
+        now = time.time()
+        with self._lock:
+            for tid, tr in traces:
+                self.ledger.append(_LedgerEntry(
+                    tid.hex(), content_digest(tr), svc, now))
+        return ProbeResult("cold_read", "ok", lag)
+
+    def _cold_read_fresh(self, tid: bytes):
+        """A brand-new TempoDB over the backend path: fresh blocklist
+        poll, fresh readers, zero shared caches -- the strongest form
+        of "the flushed block is durable and complete". The scratch
+        WAL dir is allocated ONCE per prober and reused: this path
+        retries sub-second inside a long-running service, and a
+        per-attempt mkdtemp would leak a directory per poll forever."""
+        from .db.tempodb import TempoDB, TempoDBConfig
+
+        if self._cold_wal is None:
+            import tempfile
+
+            self._cold_wal = tempfile.mkdtemp(prefix="vulture-cold-wal-")
+        db = TempoDB(TempoDBConfig(
+            backend={"backend": "local", "path": self.cfg.backend_path},
+            wal_path=self._cold_wal))
+        try:
+            db.poll_now()
+            return db.find_trace_by_id(
+                self.cfg.tenant or "single-tenant", tid)
+        finally:
+            db.close()
+
+    # --------------------------------------------- durability probe
+    def _durability_probe(self) -> ProbeResult:
+        """Re-probe a sample of previously-flushed trace ids against
+        their recorded content digests -- the check that survives
+        compactions, retention bugs and backend bit rot."""
+        with self._lock:
+            entries = list(self.ledger)
+        sample = self.rng.sample(
+            entries, min(self.cfg.durability_sample, len(entries)))
+        gone: list[_LedgerEntry] = []
+        changed: list[_LedgerEntry] = []
+        for ent in sample:
+            # verify the WHOLE sample (no early return): partial loss
+            # must burn proportionally, not read as one bad probe. An
+            # HTTP 5xx on one id means THAT id is unreadable (a deleted
+            # block object 500s the find path) -- count it lost and
+            # keep scanning; transport failures abort the family.
+            try:
+                got = self._get_trace(ent.tid_hex)
+            except Shed:
+                raise
+            except urllib.error.HTTPError:
+                got = None
+            if got is None:
+                gone.append(ent)
+            elif content_digest(got) != ent.digest:
+                changed.append(ent)
+        if gone:
+            ent = gone[0]
+            return ProbeResult(
+                "durability", "miss",
+                detail=f"{len(gone)}/{len(sample)} ledger ids unreadable "
+                       f"(first: {ent.svc} id={ent.tid_hex}, written "
+                       f"{time.time() - ent.written_at:.0f}s ago)")
+        if changed:
+            ent = changed[0]
+            return ProbeResult(
+                "durability", "corrupt",
+                detail=f"{len(changed)}/{len(sample)} ledger ids changed "
+                       f"content (first: {ent.svc} id={ent.tid_hex})")
+        return ProbeResult("durability", "ok",
+                           detail=f"{len(sample)} ids re-verified")
+
+    # ------------------------------------------------------ exposition
+    def exposition(self) -> str:
+        """Vulture's own /metrics: registry instruments + SLO gauges
+        rendered as strict OpenMetrics (with EOF marker)."""
+        helps = dict(self.slo.help_entries())
+        return self.registry.render(
+            extra_lines=self.slo.metrics_lines(),
+            extra_helps=helps) + "# EOF\n"
+
+    def _pct(self, xs, p: float) -> float:
+        if not xs:
+            return 0.0
+        xs = sorted(xs)
+        return xs[min(len(xs) - 1, int(len(xs) * p))]
+
+    def status(self) -> dict:
+        with self._lock:
+            lags = {k: list(v) for k, v in self._lags.items()}
+            failures = list(self.failures)
+        outcomes: dict[str, dict[str, int]] = {}
+        for labels, v in self.probes.snapshot().items():
+            fam = labels.split('family="', 1)[1].split('"', 1)[0]
+            out = labels.split('outcome="', 1)[1].split('"', 1)[0]
+            outcomes.setdefault(fam, {})[out] = int(v)
+        return {
+            "cycles": self.cycles,
+            "outcomes": outcomes,
+            "freshness": {
+                k: {"p50_ms": round(self._pct(v, 0.5) * 1e3, 2),
+                    "p99_ms": round(self._pct(v, 0.99) * 1e3, 2),
+                    "n": len(v)}
+                for k, v in lags.items()},
+            "ledger_entries": len(self.ledger),
+            "failures": failures,
+            "slo": self.slo.status(),
+        }
+
+    def serve_metrics(self, port: int, host: str = "127.0.0.1"):
+        """Expose /metrics (strict OpenMetrics) + /status (JSON) --
+        vulture is itself a scrape target whose verdicts alerting
+        watches."""
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        vulture = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def _send(self, code, body: bytes, ctype: str):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/metrics":
+                    return self._send(
+                        200, vulture.exposition().encode(),
+                        "application/openmetrics-text; version=1.0.0; "
+                        "charset=utf-8")
+                if self.path == "/status":
+                    return self._send(
+                        200, json.dumps(vulture.status(), indent=2).encode(),
+                        "application/json")
+                return self._send(404, b'{"error":"no route"}',
+                                  "application/json")
+
+        self._http_server = ThreadingHTTPServer((host, port), Handler)
+        t = threading.Thread(target=self._http_server.serve_forever,
+                             daemon=True, name="vulture-metrics")
+        t.start()
+        return self._http_server
+
+    def close(self) -> None:
+        if self._http_server is not None:
+            self._http_server.shutdown()
+            self._http_server = None
+        if self._cold_wal is not None:
+            import shutil
+
+            shutil.rmtree(self._cold_wal, ignore_errors=True)
+            self._cold_wal = None
 
 
-def main(argv=None):
+def _self_hosted_app(storage: str, compaction_cycle_s: float = 5.0):
+    """An in-process single binary on an ephemeral port for
+    --self-hosted mode: short compaction cycle so the durability
+    ledger actually crosses compactions within a short run."""
+    import socket
+
+    from .services.app import App, AppConfig
+    from .services.ingester import IngesterConfig
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    cfg = AppConfig(
+        storage_path=storage, http_port=port,
+        compaction_cycle_s=compaction_cycle_s,
+        ingester=IngesterConfig(flush_check_period_s=1.0),
+    )
+    app = App(cfg)
+    app.start()
+    app.serve_http(background=True)
+    return app, f"http://127.0.0.1:{port}"
+
+
+def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="tempo-tpu-vulture")
     ap.add_argument("--push-url", default="http://127.0.0.1:3200")
-    ap.add_argument("--query-url", default="http://127.0.0.1:3200")
+    ap.add_argument("--query-url", default="")
     ap.add_argument("--tenant", default="")
     ap.add_argument("--cycles", type=int, default=0, help="0 = forever")
     ap.add_argument("--interval", type=float, default=5.0)
-    ap.add_argument("--read-back-delay", type=float, default=1.0)
+    ap.add_argument("--visibility-timeout", type=float, default=15.0)
+    ap.add_argument("--flush-every", type=int, default=1,
+                    help="cold-read probe cadence in cycles (0 = never "
+                         "flush; needs loopback or --internal-token)")
+    ap.add_argument("--internal-token", default="")
+    ap.add_argument("--backend-path", default="",
+                    help="backend storage path for fresh-reader cold "
+                         "probes (every byte off disk)")
+    ap.add_argument("--metrics-port", type=int, default=0,
+                    help="serve vulture's own /metrics + /status here")
+    ap.add_argument("--seed", type=int, default=None)
+    ap.add_argument("--self-hosted", action="store_true",
+                    help="spawn an in-process single binary and probe it")
     args = ap.parse_args(argv)
-    v = Vulture(args.push_url, args.query_url, args.tenant or None,
-                read_back_delay_s=args.read_back_delay)
-    n = 0
-    while args.cycles == 0 or n < args.cycles:
-        v.cycle()
-        n += 1
-        print("\n".join(v.metrics.lines()), flush=True)
-        if args.cycles == 0 or n < args.cycles:
-            time.sleep(args.interval)
+
+    app = None
+    push_url, query_url = args.push_url, args.query_url or args.push_url
+    backend_path = args.backend_path
+    if args.self_hosted:
+        import tempfile
+
+        storage = tempfile.mkdtemp(prefix="vulture-store-")
+        app, base = _self_hosted_app(storage)
+        push_url = query_url = base
+        backend_path = backend_path or storage
+
+    cfg = VultureConfig(
+        push_url=push_url, query_url=query_url, tenant=args.tenant,
+        visibility_timeout_s=args.visibility_timeout,
+        flush_every=args.flush_every, internal_token=args.internal_token,
+        backend_path=backend_path, seed=args.seed,
+    )
+    v = Vulture(cfg, app=app)
+    if args.metrics_port:
+        v.serve_metrics(args.metrics_port)
+        print(f"vulture metrics on :{args.metrics_port}", flush=True)
+
+    all_ok = True
+    try:
+        n = 0
+        while args.cycles == 0 or n < args.cycles:
+            results = v.cycle()
+            all_ok = all_ok and Vulture.ok(results)
+            print(json.dumps({
+                "cycle": v.cycles,
+                "ok": Vulture.ok(results),
+                "results": [{"family": r.family, "outcome": r.outcome,
+                             "lag_ms": round(r.lag_s * 1e3, 1),
+                             **({"detail": r.detail} if r.outcome != "ok"
+                                else {}),
+                             **({"self_trace_id": r.self_trace_id}
+                                if r.self_trace_id else {})}
+                            for r in results],
+            }), flush=True)
+            n += 1
+            if args.cycles == 0 or n < args.cycles:
+                time.sleep(args.interval)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        print(json.dumps({"summary": v.status()}, indent=2), flush=True)
+        v.close()
+        if app is not None:
+            app.stop()
+    return 0 if all_ok else 1
 
 
 if __name__ == "__main__":
-    main()
+    raise SystemExit(main())

@@ -1,0 +1,215 @@
+"""util/slo: multi-window burn-rate engine + the app's /status/slo."""
+
+import json
+import socket
+import urllib.parse
+import urllib.request
+
+from tempo_tpu.util.metrics import Counter, Histogram
+from tempo_tpu.util.slo import (
+    FAST_BURN,
+    Objective,
+    SLOEngine,
+    counter_sli,
+    histogram_sli,
+)
+
+
+def _avail(counter: Counter):
+    return counter_sli(counter,
+                       good=lambda l: 'outcome="ok"' in l,
+                       bad=lambda l: 'outcome="error"' in l)
+
+
+def test_burn_rate_window_differencing():
+    """Burn = windowed error rate / budget, differenced against the
+    newest sample at-or-before the window start; partial windows fall
+    back to the oldest sample."""
+    c = Counter("t_total")
+    eng = SLOEngine(windows=(("5m", 300), ("1h", 3600)))
+    eng.register(Objective("o", "availability", target=0.99, sli=_avail(c)))
+
+    c.inc(100, labels='outcome="ok"')
+    eng.evaluate(now=1000.0)  # baseline: 100 good, 0 bad
+
+    # 50 good + 50 bad land before t=1200
+    c.inc(50, labels='outcome="ok"')
+    c.inc(50, labels='outcome="error"')
+    st = eng.evaluate(now=1200.0)
+    b = st["objectives"]["o"]["burn_rates"]
+    # both windows are partial -> ref is the baseline: err 50/100 = 0.5,
+    # budget 0.01 -> burn 50
+    assert b["5m"] == 50.0 and b["1h"] == 50.0
+
+    # much later, nothing new: the 5m window ref is now the t=1200
+    # sample (delta 0 -> burn 0); the 1h window still sees the burn
+    st = eng.evaluate(now=1600.0)
+    b = st["objectives"]["o"]["burn_rates"]
+    assert b["5m"] == 0.0
+    assert b["1h"] == 50.0
+
+
+def test_no_traffic_is_not_an_outage():
+    c = Counter("t_total")
+    eng = SLOEngine()
+    eng.register(Objective("o", "availability", target=0.999, sli=_avail(c)))
+    st = eng.evaluate(now=10.0)
+    st = eng.evaluate(now=400.0)
+    assert st["objectives"]["o"]["burn_rates"]["5m"] == 0.0
+    assert st["verdict"] == "ok"
+
+
+def test_counter_sli_excludes_shed():
+    """429 sheds are neither good nor bad: the availability SLI must
+    not move when the QoS budget refuses work."""
+    c = Counter("t_total")
+    sli = _avail(c)
+    c.inc(10, labels='outcome="ok"')
+    c.inc(999, labels='outcome="shed"')
+    assert sli() == (10.0, 0.0)
+    c.inc(2, labels='outcome="error"')
+    assert sli() == (10.0, 2.0)
+
+
+def test_histogram_sli_threshold_on_bucket_edges():
+    h = Histogram("lat_seconds", buckets=(0.1, 0.5, 1.0))
+    sli = histogram_sli(h, 0.5)
+    h.observe(0.05, 'op="a"')   # <= 0.1 bucket: good
+    h.observe(0.4, 'op="a"')    # <= 0.5 bucket: good
+    h.observe(0.9, 'op="a"')    # <= 1.0 bucket: bad (over threshold)
+    h.observe(7.0, 'op="a"')    # overflow: bad
+    assert sli() == (2.0, 2.0)
+    # label filtering
+    h.observe(0.05, 'op="b"')
+    only_b = histogram_sli(h, 0.5, labels_pred=lambda l: 'op="b"' in l)
+    assert only_b() == (1.0, 0.0)
+
+
+def test_verdict_multiwindow_pairs():
+    v = SLOEngine._verdict
+    hot = FAST_BURN + 1
+    assert v({"5m": hot, "1h": hot, "6h": 0.0}) == "critical"
+    # fast window spiking alone (recovered burst) does NOT page
+    assert v({"5m": hot, "1h": 0.5, "6h": 0.5}) == "ok"
+    assert v({"5m": 0.0, "1h": 7.0, "6h": 7.0}) == "warning"
+    assert v({"5m": 0.1, "1h": 0.1, "6h": 0.1}) == "ok"
+
+
+def test_sli_error_does_not_kill_the_plane():
+    eng = SLOEngine()
+    eng.register(Objective("broken", "availability", 0.99,
+                           sli=lambda: 1 / 0))
+    c = Counter("t_total")
+    c.inc(5, labels='outcome="ok"')
+    eng.register(Objective("fine", "availability", 0.99, sli=_avail(c)))
+    st = eng.evaluate(now=1.0)
+    assert "error" in st["objectives"]["broken"]
+    assert st["objectives"]["fine"]["verdict"] == "ok"
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+def test_app_status_slo_and_metrics(tmp_path):
+    """/status/slo serves every default objective, goes critical when
+    the availability SLI burns, and the burn gauges ship on /metrics
+    (strict OpenMetrics)."""
+    from test_observability import parse_openmetrics_strict
+
+    from tempo_tpu.services.app import App, AppConfig
+    from tempo_tpu.services.ingester import IngesterConfig
+    from tempo_tpu.util.kerneltel import TEL
+
+    cfg = AppConfig(storage_path=str(tmp_path / "store"),
+                    http_port=_free_port(), compaction_cycle_s=9999,
+                    ingester=IngesterConfig(flush_check_period_s=9999))
+    app = App(cfg)
+    app.start()
+    app.serve_http(background=True)
+    base = f"http://127.0.0.1:{cfg.http_port}"
+    try:
+        urllib.request.urlopen(
+            base + "/api/search?tags=service.name%3Dnope&limit=5",
+            timeout=30).read()
+        st = json.load(urllib.request.urlopen(base + "/status/slo",
+                                              timeout=10))
+        assert st["verdict"] == "ok"
+        assert {"read-availability", "latency-traces", "latency-search",
+                "latency-search_stream", "latency-metrics",
+                "live-freshness"} <= set(st["objectives"])
+        av = st["objectives"]["read-availability"]
+        # totals are process-cumulative (other tests may have recorded
+        # outcomes); the verdict is delta-based over THIS app's life
+        assert av["good_total"] >= 1
+
+        # burn the availability budget: errors recorded at the same
+        # chokepoint the frontend uses
+        for _ in range(40):
+            TEL.record_query("search", 0.01, outcome="error")
+        st = json.load(urllib.request.urlopen(base + "/status/slo",
+                                              timeout=10))
+        assert st["objectives"]["read-availability"]["verdict"] == "critical"
+        assert st["verdict"] == "critical"
+        for w in ("5m", "1h", "6h"):
+            assert (st["objectives"]["read-availability"]["burn_rates"][w]
+                    > FAST_BURN)
+
+        text = urllib.request.urlopen(base + "/metrics",
+                                      timeout=10).read().decode()
+        fams = parse_openmetrics_strict(text)
+        assert fams.get("tempo_slo_burn_rate") == "gauge"
+        assert fams.get("tempo_slo_verdict") == "gauge"
+        assert fams.get("tempo_query_outcomes") == "counter"
+        assert 'objective="read-availability"' in text
+    finally:
+        app.stop()
+
+
+def test_frontend_query_class_attribution(tmp_path):
+    """Each query class lands under its own op label, sheds under
+    outcome=shed: the attribution the SLO objectives read."""
+    import urllib.error
+
+    from tempo_tpu.services.app import App, AppConfig
+    from tempo_tpu.services.ingester import IngesterConfig
+    from tempo_tpu.util.kerneltel import TEL
+
+    cfg = AppConfig(storage_path=str(tmp_path / "store"),
+                    http_port=_free_port(), compaction_cycle_s=9999,
+                    ingester=IngesterConfig(flush_check_period_s=9999))
+    app = App(cfg)
+    app.start()
+    app.serve_http(background=True)
+    base = f"http://127.0.0.1:{cfg.http_port}"
+    try:
+        before = TEL.query_outcomes.snapshot()
+        urllib.request.urlopen(
+            base + "/api/search?tags=service.name%3Dx&limit=2",
+            timeout=30).read()
+        with urllib.request.urlopen(
+                base + "/api/search?tags=service.name%3Dx&stream=true",
+                timeout=30) as r:
+            r.read()
+        try:
+            urllib.request.urlopen(base + f"/api/traces/{'ab' * 16}",
+                                   timeout=30)
+        except urllib.error.HTTPError as e:
+            assert e.code == 404  # not-found is a SERVED query
+        urllib.request.urlopen(
+            base + "/api/metrics/query_range?q="
+            + urllib.parse.quote("{ true } | rate()")
+            + "&start=1&end=600&step=60", timeout=30).read()
+        after = TEL.query_outcomes.snapshot()
+
+        def delta(labels):
+            return after.get(labels, 0) - before.get(labels, 0)
+
+        for op in ("search", "search_stream", "traces", "metrics"):
+            assert delta(f'op="{op}",outcome="ok"') >= 1, (op, after)
+    finally:
+        app.stop()

@@ -1,12 +1,26 @@
-"""Vulture consistency checker against an in-process single binary."""
+"""Vulture continuous-verification plane against an in-process single
+binary: clean-run coverage, the 429-shed outcome contract, the
+injected-regression matrix (the plane's proof of value), the one-shot
+self-hosted CLI mode, and the soak sidecar."""
 
+import glob
+import json
+import os
 import socket
+import subprocess
+import sys
+import threading
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import pytest
 
 from tempo_tpu.services.app import App, AppConfig
 from tempo_tpu.services.ingester import IngesterConfig
-from tempo_tpu.vulture import Vulture
+from tempo_tpu.vulture import Vulture, VultureConfig
+
+from test_observability import parse_openmetrics_strict
 
 
 def _free_port():
@@ -17,27 +31,274 @@ def _free_port():
     return p
 
 
-def test_vulture_cycles(tmp_path):
-    cfg = AppConfig(storage_path=str(tmp_path / "data"), http_port=_free_port(),
+def _mk_app(tmp_path):
+    cfg = AppConfig(storage_path=str(tmp_path / "store"),
+                    http_port=_free_port(),
                     compaction_cycle_s=9999,
                     ingester=IngesterConfig(flush_check_period_s=9999))
     app = App(cfg)
     app.start()
     app.serve_http(background=True)
+    return app, f"http://127.0.0.1:{cfg.http_port}", str(tmp_path / "store")
+
+
+def _mk_vulture(base, storage, **kw):
+    cfg = VultureConfig(
+        push_url=base, query_url=base, backend_path=storage,
+        visibility_timeout_s=kw.pop("visibility_timeout_s", 10.0),
+        retry_interval_s=0.05, spans_per_trace=3, batch_ids=3,
+        flush_every=1, seed=kw.pop("seed", 11), **kw)
+    return Vulture(cfg)
+
+
+def _outcomes(v: Vulture) -> dict:
+    return v.status()["outcomes"]
+
+
+def test_vulture_clean_cycles(tmp_path):
+    """Two clean cycles: every probe family ok, freshness histograms
+    populated for all three kinds, SLO objectives green, and vulture's
+    own /metrics passes the strict OpenMetrics parse."""
+    app, base, storage = _mk_app(tmp_path)
     try:
-        v = Vulture(f"http://127.0.0.1:{cfg.http_port}",
-                    f"http://127.0.0.1:{cfg.http_port}",
-                    read_back_delay_s=0.05, seed=1)
-        for _ in range(3):
-            assert v.cycle()
-        assert v.metrics.requests == 3
-        assert v.metrics.notfound_byid == 0
-        assert v.metrics.missing_spans == 0
-        assert v.metrics.notfound_search == 0
-        # an unknown trace id IS reported missing
-        import urllib.request, urllib.error
+        v = _mk_vulture(base, storage)
+        for _ in range(2):
+            results = v.cycle()
+            assert Vulture.ok(results), [
+                (r.family, r.outcome, r.detail) for r in results
+                if r.outcome != "ok"]
+        st = v.status()
+        assert st["cycles"] == 2
+        for fam in ("push", "find_by_id", "find_batched", "search",
+                    "live_head", "search_stream", "query_range",
+                    "cold_read", "durability"):
+            assert st["outcomes"].get(fam, {}).get("ok", 0) >= 1, (
+                fam, st["outcomes"])
+        for kind in ("live_visible", "searchable", "cold_readable"):
+            assert st["freshness"][kind]["n"] >= 1, st["freshness"]
+        assert st["ledger_entries"] >= 3  # cold probes feed durability
+        assert st["slo"]["verdict"] == "ok"
+        for name, obj in st["slo"]["objectives"].items():
+            assert obj["verdict"] == "ok", (name, obj)
+        fams = parse_openmetrics_strict(v.exposition())
+        assert fams.get("tempo_vulture_probes") == "counter"
+        assert fams.get("tempo_vulture_freshness_seconds") == "histogram"
+        assert fams.get("tempo_vulture_slo_burn_rate") == "gauge"
+        assert fams.get("tempo_vulture_slo_verdict") == "gauge"
+
+        # vulture's own /metrics + /status endpoints serve the same
+        port = _free_port()
+        v.serve_metrics(port)
+        text = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10).read().decode()
+        parse_openmetrics_strict(text)
+        js = json.load(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/status", timeout=10))
+        assert js["cycles"] == 2
+        v.close()
+
+        # an unknown trace id IS still a 404 through the app
         with pytest.raises(urllib.error.HTTPError):
-            urllib.request.urlopen(
-                f"http://127.0.0.1:{cfg.http_port}/api/traces/{'ab' * 16}")
+            urllib.request.urlopen(f"{base}/api/traces/{'ab' * 16}")
+    finally:
+        app.stop()
+
+
+def test_vulture_429_is_shed_not_error():
+    """Regression (QoS interplay, PR 7): an HTTP 429 shed is its own
+    outcome, excluded from the availability SLI -- a tenant at its
+    budget must not page the on-call for data loss."""
+
+    class Deny429(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *a):
+            pass
+
+        def _deny(self):
+            body = b'{"error":"TooManyRequests"}'
+            self.send_response(429)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        do_GET = do_POST = _deny
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), Deny429)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{srv.server_address[1]}"
+    try:
+        v = _mk_vulture(base, "", visibility_timeout_s=1.0)
+        results = v.cycle()
+        # the push was shed -> the cycle stops there, nothing is an error
+        assert [r.outcome for r in results] == ["shed"]
+        assert Vulture.ok(results)  # sheds do not fail the prober
+        out = _outcomes(v)
+        assert out["push"]["shed"] == 1
+        assert all(o in ("ok", "shed")
+                   for fam in out.values() for o in fam), out
+        # availability SLI: sheds are neither good nor bad
+        st = v.slo.evaluate()
+        av = st["objectives"]["probe-availability"]
+        assert av["good_total"] == 0 and av["bad_total"] == 0
+        assert av["verdict"] == "ok"
+    finally:
+        srv.shutdown()
+
+
+def test_vulture_injected_regression_matrix(tmp_path):
+    """The plane's acceptance gate: three injected faults, each caught
+    by its matching probe family within ONE probe cycle, the SLO
+    verdict going critical; plus the app-side /status/slo burn for the
+    fault that breaks the serving path itself."""
+    from tempo_tpu.db.blocklist import Poller
+
+    app, base, storage = _mk_app(tmp_path)
+    try:
+        v = _mk_vulture(base, storage, visibility_timeout_s=3.0)
+
+        # ---- clean baseline: everything green
+        results = v.cycle()
+        assert Vulture.ok(results), [
+            (r.family, r.outcome, r.detail) for r in results]
+        assert v.status()["slo"]["verdict"] == "ok"
+        app_slo = json.load(urllib.request.urlopen(base + "/status/slo",
+                                                   timeout=10))
+        assert app_slo["verdict"] == "ok"
+
+        # ---- fault C: SKIP LIVE-STAGE REFRESH -- new pushes never
+        # reach the live engine's staged tails. The search + live_head
+        # families (the staged read paths) time out within the cycle;
+        # by-id (hash map) and query_range (direct live fold) still
+        # pass, localizing the fault.
+        inst = app.ingester.instance("single-tenant")
+        stager = inst.live_engine.stager
+        orig_refresh = stager.refresh
+
+        def skip_new(items, stage_device=True):
+            return orig_refresh(
+                {t: g for t, g in items.items() if t in stager.tails},
+                stage_device=stage_device)
+
+        stager.refresh = skip_new
+        try:
+            results = v.cycle()
+        finally:
+            stager.refresh = orig_refresh
+        by_fam = {r.family: r for r in results}
+        assert by_fam["search"].outcome == "timeout", (
+            by_fam["search"].outcome, by_fam["search"].detail)
+        assert by_fam["live_head"].outcome in ("timeout", "miss")
+        assert by_fam["find_by_id"].outcome == "ok"  # fault localized
+        assert by_fam["query_range"].outcome == "ok"
+        assert v.status()["slo"]["verdict"] == "critical"
+        assert (v.status()["slo"]["objectives"]["probe-availability"]
+                ["burn_rates"]["5m"] > 14.4)
+
+        # ---- fault B: STALL THE BLOCKLIST POLL -- pollers keep
+        # serving a frozen snapshot, so the block this cycle flushes
+        # never becomes visible to fresh readers. The cold_read family
+        # (fresh TempoDB per attempt) times out within the cycle.
+        frozen = Poller.poll(app.db.poller)
+        orig_poll = Poller.poll
+        Poller.poll = lambda self: frozen
+        try:
+            results = v.cycle()
+        finally:
+            Poller.poll = orig_poll
+        by_fam = {r.family: r for r in results}
+        assert by_fam["cold_read"].outcome == "timeout", (
+            by_fam["cold_read"].outcome, by_fam["cold_read"].detail)
+        assert by_fam["durability"].outcome == "ok"  # old blocks fine
+        assert by_fam["search"].outcome == "ok"      # fault C cleared
+        app.db.poll_now()  # resync after the stall
+
+        # ---- fault A: DELETE A FLUSHED BLOCK OBJECT -- the durability
+        # ledger's re-probe catches the loss within one cycle. Reader
+        # caches are dropped to simulate the reader churn that makes
+        # the deletion visible in production.
+        removed = 0
+        for path in glob.glob(os.path.join(storage, "single-tenant",
+                                           "*", "data.vtpu")):
+            os.remove(path)
+            removed += 1
+        assert removed >= 1
+        with app.db._cache_lock:
+            app.db._block_cache.clear()
+        results = v.cycle()
+        by_fam = {r.family: r for r in results}
+        assert by_fam["durability"].outcome in ("miss", "corrupt"), (
+            by_fam["durability"].outcome, by_fam["durability"].detail)
+        # the failure report names the lost id (and best-effort links
+        # the self-trace timeline of the query that failed)
+        fail = [f for f in v.status()["failures"]
+                if f["family"] == "durability"][-1]
+        assert "id=" in fail["detail"]
+        assert v.status()["slo"]["verdict"] == "critical"
+
+        # the app's own SLO plane sees this one too (its find path is
+        # serving 500s): drive a little client traffic at a lost id
+        # and /status/slo goes critical on read availability
+        lost = fail["detail"].split("id=", 1)[1].split(",", 1)[0]
+        for _ in range(10):
+            try:
+                urllib.request.urlopen(f"{base}/api/traces/{lost}",
+                                       timeout=15)
+            except urllib.error.HTTPError:
+                pass
+        app_slo = json.load(urllib.request.urlopen(base + "/status/slo",
+                                                   timeout=10))
+        av = app_slo["objectives"]["read-availability"]
+        assert av["verdict"] == "critical", av
+        assert app_slo["verdict"] == "critical"
+    finally:
+        app.stop()
+
+
+def test_vulture_self_hosted_one_shot():
+    """The tier-1 CI wiring: `python -m tempo_tpu.vulture --self-hosted
+    --cycles 3` runs the full probe surface against an in-process
+    single binary and exits 0 with every cycle ok."""
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    out = subprocess.run(
+        [sys.executable, "-m", "tempo_tpu.vulture", "--self-hosted",
+         "--cycles", "3", "--interval", "0.1",
+         "--visibility-timeout", "10", "--seed", "5"],
+        capture_output=True, text=True, timeout=420, env=env)
+    assert out.returncode == 0, out.stdout[-2000:] + out.stderr[-2000:]
+    cycles = [json.loads(ln) for ln in out.stdout.splitlines()
+              if ln.startswith('{"cycle"')]
+    assert len(cycles) == 3
+    assert all(c["ok"] for c in cycles), cycles
+    summary = json.loads(
+        out.stdout[out.stdout.index('{\n  "summary"'):])["summary"]
+    assert summary["slo"]["verdict"] == "ok"
+    assert summary["freshness"]["cold_readable"]["n"] >= 1
+
+
+def test_soak_vulture_sidecar(tmp_path):
+    """soak --vulture runs the prober beside the mixed read/write load
+    and folds SLO verdicts + freshness percentiles into the summary."""
+    import soak
+
+    app, base, _storage = _mk_app(tmp_path)
+    try:
+        rc = None
+        import io
+        from contextlib import redirect_stdout
+
+        buf = io.StringIO()
+        with redirect_stdout(buf):
+            rc = soak.main(["--target", base, "--duration", "3",
+                            "--writers", "1", "--readers", "1",
+                            "--vulture", "--vulture-interval", "0.5"])
+        report = json.loads(buf.getvalue())
+        assert rc == 0, report
+        assert report["ok"]
+        vs = report["vulture"]
+        assert vs["cycles"] >= 1
+        assert vs["probe_failures"] == 0
+        assert vs["slo_verdict"] == "ok"
+        assert "searchable" in vs["freshness"]
     finally:
         app.stop()
