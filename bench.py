@@ -32,6 +32,13 @@ Lines, in order:
   5b. search_concurrent_p50_ms -- Q parallel identical-shape queries on
      one hot block through the cross-query batching executor
      (db/batchexec): p50/p95 latency, launches-per-query, occupancy.
+  5b2. search_mesh_batched -- one admission window's 16 queries as ONE
+     Q-programs x sharded-rows mesh launch (parallel/multiquery) vs 16
+     sequential mesh launches (wall ratio; launches/query, occupancy
+     and walker comm bytes/query attached), in an 8-virtual-device
+     subprocess; search_struct_comm_shrink rides along -- the
+     walker-priced per-struct-node collective before/after the
+     bit-packed + hoisted gathers (>= 5x is the acceptance gate).
   5c. search_affinity_p99_ms -- the cache-affinity differential: 3
      simulated querier workers (each its own TempoDB = its own staged-
      cache domain), 4 tenants, 50 concurrent Zipf-mixed searches, HBM
@@ -1203,6 +1210,156 @@ def bench_search_affinity(tmp: str) -> None:
     _emit("search_affinity_p99_ms", on["p99_ms"], "ms", tel=tel)
 
 
+# mesh-batched probe: runs in a FRESH interpreter with 8 virtual CPU
+# devices (the dev box has one chip; mesh rows need a mesh). Measures
+# (1) one admission window's 16 queries as ONE Q-programs x
+# sharded-rows mesh launch (parallel/multiquery) vs 16 sequential mesh
+# launches of the same programs -- launches/query, occupancy and the
+# walker's comm bytes/query attached -- and (2) the struct-op
+# collective shrink: the walker-priced per-node comm bytes of the
+# packed '>' struct program vs the legacy triple-gather program.
+_MESH_BATCH_PROBE = r"""
+import json, os, time
+import numpy as np
+import tempfile
+from bench import synth_block, best_window
+from tempo_tpu.backend.mem import MemBackend
+from tempo_tpu.db.tempodb import TempoDB, TempoDBConfig
+from tempo_tpu.db.search import SearchRequest, search_block, _plan_for_block
+from tempo_tpu.db.batchexec import batched_search_block_many
+from tempo_tpu.ops.filter import Cond, Operands, T_SPAN, required_columns
+from tempo_tpu.ops.multiquery import _p2, lower_plan, pack_queries
+from tempo_tpu.ops.stage import stage_block
+from tempo_tpu.parallel import make_mesh
+from tempo_tpu.parallel.multiquery import mesh_eval_multiquery
+from tempo_tpu.parallel.search import sharded_search
+from tempo_tpu.util import costmodel
+from tempo_tpu.util.kerneltel import TEL
+
+rng = np.random.default_rng(37)
+backend = MemBackend()
+meta, _ = synth_block(backend, "bench", rng, 1 << 14, 16)  # 256Ki spans
+db = TempoDB(TempoDBConfig(wal_path=tempfile.mkdtemp(),
+                           device_promote_touches=1), backend=backend)
+db.poll_now()
+blk = db.open_block(meta)
+mesh = make_mesh()
+assert mesh.devices.size > 1, "probe needs the virtual-device mesh"
+Q = 16
+reqs = [SearchRequest(query="{ duration > %dms }" % (100 + i), limit=20)
+        for i in range(Q)]
+
+# end-to-end identity + occupancy through the REAL admission window
+warm = batched_search_block_many(db.batchers.search, [(blk, reqs[0], None)],
+                                 promote_touches=1)
+outs = batched_search_block_many(db.batchers.search,
+                                 [(blk, r, None) for r in reqs],
+                                 promote_touches=1)
+d = lambda r: [{**t.to_dict(), "matchedSpans": t.matched_spans}
+               for t in r.traces]
+for r, o in zip(reqs, outs):
+    assert d(o) == d(search_block(blk, r)), "mesh-batched != sequential"
+occupancy = TEL.mesh_batch_stats()["occupancy"]
+
+# kernel-level legs: the SAME 16 programs as one batched launch vs 16
+# sequential mesh launches (the pre-batching mesh comparable)
+lowered = [lower_plan(_plan_for_block(blk, r)) for r in reqs]
+assert all(lq is not None for lq in lowered)
+p0 = _plan_for_block(blk, reqs[0])
+needed = required_columns(p0.conds) + list(p0.extra_cols)
+staged = stage_block(blk, needed + ["trace.start_ms"])
+q_b = _p2(Q, lo=1)
+progs = pack_queries(lowered, q_b)
+progs1 = [pack_queries([lq], 1) for lq in lowered]
+mesh_eval_multiquery(mesh, lowered, staged, progs)          # warm both
+mesh_eval_multiquery(mesh, [lowered[0]], staged, progs1[0])
+l0 = TEL.launch_count()
+batched_s = best_window(
+    lambda: mesh_eval_multiquery(mesh, lowered, staged, progs), windows=4)
+batched_launches = TEL.launch_count() - l0
+seq_s = best_window(
+    lambda: [mesh_eval_multiquery(mesh, [lq], staged, p1)
+             for lq, p1 in zip(lowered, progs1)], windows=4)
+costmodel.COST.drain(30.0)
+comm = costmodel.COST.comm_for("mesh_multiquery", str(staged.n_spans_b))
+
+# struct-op collective shrink: '>' node, packed vs legacy walker bytes
+B, S, NT = 2, 1 << 15, 1 << 10
+scols = {
+    "span.trace_sid": np.sort(
+        rng.integers(0, NT, size=(B, S)).astype(np.int32), axis=1),
+    "span.dur_us": rng.integers(0, 1000, size=(B, S)).astype(np.int32),
+    "span.parent_idx": np.where(
+        np.arange(S)[None, :] % 8 == 0, -1,
+        np.arange(S, dtype=np.int32)[None, :] - 1) * np.ones((B, 1), np.int32),
+}
+n_spans = np.asarray([S, S - 1000], np.int32)
+sconds = (Cond(target=T_SPAN, col="span.dur_us", op="lt"),
+          Cond(target=T_SPAN, col="span.dur_us", op="ge"))
+sops = Operands.build([(0, 900, 0, 0.0, 0.0), (0, 50, 0, 0.0, 0.0)])
+stree = ("struct", ">", ("cond", 0), ("cond", 1))
+os.environ["TEMPO_STRUCT_PACK"] = "1"
+tm1, sc1 = sharded_search(mesh, stree, sconds, sops, scols, n_spans, nt=NT)
+os.environ["TEMPO_STRUCT_PACK"] = "0"
+tm0, sc0 = sharded_search(mesh, stree, sconds, sops, scols, n_spans, nt=NT)
+assert (tm1 == tm0).all() and (sc1 == sc0).all(), "struct shrink changed results"
+del os.environ["TEMPO_STRUCT_PACK"]
+drained = costmodel.COST.drain(30.0)
+packed = costmodel.COST.comm_for("mesh_search", str(S))
+legacy = costmodel.COST.comm_for("mesh_search_nopack", str(S))
+db.close()
+# comm rows may be absent (TEMPO_COSTMODEL=0 kill switch, or a drain
+# timeout on a loaded box): report 0.0 rather than aborting the bench
+shrink = (legacy["all_gather"] / packed["all_gather"]
+          if drained and packed.get("all_gather") and legacy.get("all_gather")
+          else 0.0)
+print(json.dumps({
+    "devices": int(mesh.devices.size),
+    "batched_ms": batched_s * 1e3, "sequential_ms": seq_s * 1e3,
+    "ratio": seq_s / batched_s,
+    "launches_per_query": batched_launches / Q,
+    "occupancy": occupancy,
+    "comm_bytes_per_query": sum(comm.values()) / Q,
+    "comm_bytes_per_launch": {c: int(b) for c, b in sorted(comm.items())},
+    "struct_before": {c: int(b) for c, b in sorted(legacy.items())},
+    "struct_after": {c: int(b) for c, b in sorted(packed.items())},
+    "struct_node_shrink": shrink,
+}))
+"""
+
+
+def bench_mesh_batched(tmp: str) -> None:
+    """search_mesh_batched (ROADMAP 2c): the value is the wall-time
+    ratio of 16 sequential mesh launches to the ONE batched mesh launch
+    carrying the same window (>1 = batching and chip-parallelism
+    multiply). search_struct_comm_shrink: walker-priced per-struct-node
+    comm bytes before/after the bit-packed + hoisted gathers (the
+    acceptance gate is >= 5x). Both legs run in a subprocess with 8
+    virtual CPU devices -- this box has one chip, and the mesh rows
+    must measure a real multi-device program."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    flags = " ".join(f for f in env.get("XLA_FLAGS", "").split()
+                     if not f.startswith("--xla_force_host_platform_device_count"))
+    env["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+    proc = subprocess.run(
+        [sys.executable, "-c", _MESH_BATCH_PROBE],
+        capture_output=True, text=True, timeout=1200, env=env,
+        cwd=os.path.dirname(os.path.abspath(__file__)))
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    row = json.loads(proc.stdout.strip().splitlines()[-1])
+    _emit("search_mesh_batched", row["ratio"], "ratio",
+          tel={"devices": row["devices"],
+               "batched_ms": round(row["batched_ms"], 3),
+               "sequential_ms": round(row["sequential_ms"], 3),
+               "launches_per_query": round(row["launches_per_query"], 3),
+               "occupancy": row["occupancy"],
+               "comm_bytes_per_query": round(row["comm_bytes_per_query"], 1),
+               "comm_bytes_per_launch": row["comm_bytes_per_launch"]})
+    _emit("search_struct_comm_shrink", row["struct_node_shrink"], "ratio",
+          tel={"comm_before": row["struct_before"],
+               "comm_after": row["struct_after"]})
+
+
 # the first-query probe a cold subprocess runs: import the kernel layer,
 # evaluate ONE tiny filter program, report the first-call wall ms (jit
 # trace + XLA compile + execute). The parent varies TEMPO_COMPILE_CACHE_DIR
@@ -1295,6 +1452,7 @@ def main() -> None:
         bench_ingest(tmp)
         bench_spanmetrics()
         bench_search_concurrent(tmp)
+        bench_mesh_batched(tmp)
         bench_search_live(tmp)
         bench_search_affinity(tmp)
         _emit("search_block_e2e_cold_spans_per_sec", cold, "spans/s",

@@ -229,7 +229,7 @@ def _sequential_search(it: _SearchItem):
     return search_block(it.blk, req, groups_range=it.groups_range)
 
 
-def _run_search_group(key, items: list) -> list:
+def _run_search_group(key, items: list, mesh_fn=None) -> list:
     """Execute one coalesced search group: stage once, ONE fused
     multi-query filter launch, ONE batched top-k launch, per-query
     verify + materialize. Any fused-path failure degrades to per-item
@@ -240,7 +240,7 @@ def _run_search_group(key, items: list) -> list:
     if len(items) == 1:
         return [_seq_or_exc(items[0])]
     try:
-        return _run_search_group_fused(items)
+        return _run_search_group_fused(items, mesh_fn)
     except Exception:
         TEL.record_routing("search_batch", "fallback", "fused_error",
                            n=len(items))
@@ -254,7 +254,14 @@ def _seq_or_exc(it: _SearchItem):
         return e
 
 
-def _run_search_group_fused(items: list) -> list:
+def _mesh_batch_enabled() -> bool:
+    """TEMPO_MESH_BATCH=0 pins window leaders to the single-chip fused
+    launch even on a multi-device mesh (the legacy-path escape hatch the
+    differential suite also uses)."""
+    return os.environ.get("TEMPO_MESH_BATCH", "1") not in ("0", "false")
+
+
+def _run_search_group_fused(items: list, mesh_fn=None) -> list:
     import time as _time
 
     from ..ops.multiquery import (
@@ -281,13 +288,32 @@ def _run_search_group_fused(items: list) -> list:
                            n=len(items))
         return [_seq_or_exc(it) for it in items]
     progs = pack_queries([it.lowered for it in items], q_b)
-    tm, counts = eval_multiquery([it.lowered for it in items], staged, progs)
+    lowered = [it.lowered for it in items]
+    # >1 chip attached: the window leader lowers the whole group to ONE
+    # Q-programs x sharded-rows mesh launch (parallel/multiquery), so
+    # the admission window amortizes across every chip instead of
+    # competing with sp-sharding for the executor. Shape-ineligible
+    # buckets and TEMPO_MESH_BATCH=0 keep the single-chip fused launch.
+    mesh = mesh_fn() if mesh_fn is not None else None
+    engine = "device"
+    if mesh is not None and _mesh_batch_enabled():
+        from ..parallel.multiquery import mesh_batch_eligible, mesh_eval_multiquery
+
+        if mesh_batch_eligible(mesh, staged):
+            tm, counts = mesh_eval_multiquery(mesh, lowered, staged, progs)
+            engine = "mesh"
+        else:
+            tm, counts = eval_multiquery(lowered, staged, progs)
+    else:
+        tm, counts = eval_multiquery(lowered, staged, progs)
     key_dev = staged.cols["trace.start_ms"]
     nt = blk.meta.total_traces
-    TEL.record_routing("search_batch", "device", "coalesced", n=len(items))
+    TEL.record_routing("search_batch", engine,
+                       "mesh_batched" if engine == "mesh" else "coalesced",
+                       n=len(items))
     TEL.child_span(
         f"batch:{blk.meta.block_id[:8]}", t0w, _time.time(),
-        {"engine": "device", "bucket": staged.n_spans_b,
+        {"engine": engine, "bucket": staged.n_spans_b,
          "occupancy": len(items)})
 
     responses: list = []
@@ -459,12 +485,19 @@ def batched_find(batcher: BatchExecutor, db, metas: list, trace_id: bytes):
 
 class QueryBatchers:
     """The per-TempoDB pair of batching executors (search + find) under
-    one resolved config."""
+    one resolved config. `mesh_fn` (lazy: the mesh is built on first
+    use) lets window leaders lower a whole group onto the device mesh
+    when more than one chip is attached."""
 
-    def __init__(self, enabled=None, window_ms=None, max_batch=None):
+    def __init__(self, enabled=None, window_ms=None, max_batch=None,
+                 mesh_fn=None):
         on, window_s, max_b = resolve_batch_config(enabled, window_ms, max_batch)
         self.enabled = on
-        self.search = BatchExecutor("search", _run_search_group,
+
+        def search_runner(key, items):
+            return _run_search_group(key, items, mesh_fn)
+
+        self.search = BatchExecutor("search", search_runner,
                                     window_s=window_s, max_batch=max_b,
                                     enabled=on)
         self.find = BatchExecutor("find", _run_find_group,

@@ -979,7 +979,7 @@ _DEVICE_SEARCH_MAX_BYTES = 512 << 20  # stacked-column budget before falling bac
 
 def _count_struct_nodes(tree) -> int:
     """Struct ('>' / '>>' / '~') nodes in a condition tree. Each one
-    costs its own round of span-axis all_gathers on the mesh, so the
+    costs its own span-axis lhs-mask all_gather on the mesh, so the
     pre-IO budget estimate must scale with the count, not a boolean.
     ('struct', op, lhs, rhs): t[1] is the op STRING, never recursed."""
     if not isinstance(tree, tuple):
@@ -990,16 +990,32 @@ def _count_struct_nodes(tree) -> int:
                    if isinstance(ch, tuple))
 
 
+def _has_deep_struct(tree) -> bool:
+    """True when any '>>' or '~' node is present: those relations walk
+    the REPLICATED parent table, so the mesh program hoists one
+    parent/validity gather per launch on top of the per-node masks
+    ('>' runs off the local parent column and needs neither)."""
+    if not isinstance(tree, tuple):
+        return False
+    if tree[0] == "struct" and tree[1] in (">>", "~"):
+        return True
+    children = tree[2:] if tree[0] == "struct" else tree[1:]
+    return any(_has_deep_struct(ch) for ch in children
+               if isinstance(ch, tuple))
+
+
 def _stacked_words_est(items, needed: list[str], tree, sp: int,
                        S_b: int, NT_b: int, attr_b: dict[str, int]) -> int:
     """Per-block stacked-column words the mesh program will hold on
     device, estimated BEFORE any column IO (an over-budget group must
     fall back without paying the cold reads). Per-axis products plus
-    the struct-node all_gather replication -- EACH struct node gathers
-    full span-axis tables onto EVERY chip (lm/pid/valid +
-    pointer-doubling temps), so the term scales with the node COUNT
-    (the costmodel comm walker prices the same gathers on the wire:
-    3 all_gathers per node -- tests cross-check the two counts)."""
+    the struct-node replication, priced to the SHRUNK mesh program
+    (parallel/search): each node replicates its (bit-packed on the
+    wire, unpacked bool on device) lhs mask onto every chip, and a
+    tree with any '>>' / '~' node additionally hoists ONE
+    parent/validity gather (+ pointer-doubling temps) per launch --
+    the costmodel comm walker prices the same collectives on the wire
+    and tests cross-check the two counts."""
     from ..ops.device import bucket
 
     span_cols = [n for n in needed if n.startswith("span.")]
@@ -1020,7 +1036,16 @@ def _stacked_words_est(items, needed: list[str], tree, sp: int,
             1 for n in needed if n.startswith(f"{pre}.") and not n.endswith((".span", ".res"))
         )
         est += a_b * n_val_cols + (S_b + 1 if pre == "sattr" else 0)  # values + off
-    est += 6 * S_b * sp * _count_struct_nodes(tree)
+    from ..parallel.search import struct_pack_enabled
+
+    if struct_pack_enabled():
+        est += S_b * sp * _count_struct_nodes(tree)  # per-node replicated mask
+        if _has_deep_struct(tree):
+            est += 4 * S_b * sp  # hoisted pid/valid + closure temps, once
+    else:
+        # legacy escape hatch (TEMPO_STRUCT_PACK=0): every node gathers
+        # lm/pid/valid + temps -- the budget must price what will run
+        est += 6 * S_b * sp * _count_struct_nodes(tree)
     return est
 
 

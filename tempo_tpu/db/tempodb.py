@@ -88,7 +88,7 @@ class TempoDB:
 
         self.batchers = QueryBatchers(
             enabled=cfg.batch_enabled, window_ms=cfg.batch_window_ms,
-            max_batch=cfg.batch_max)
+            max_batch=cfg.batch_max, mesh_fn=self._batch_mesh)
         # compaction ownership + dedupe hooks, overridden by the service layer
         self.owns_job = lambda job_hash: True
         from ..util.metrics import Counter, Histogram
@@ -101,6 +101,16 @@ class TempoDB:
         from .search import seed_host_rate_from_ledger
 
         seed_host_rate_from_ledger()
+
+    def _batch_mesh(self):
+        """Mesh handed to the batching executors' window leaders
+        (db/batchexec -> parallel/multiquery): all visible chips, or
+        None on a single chip / with device search off -- the
+        single-chip fused launch is already optimal there."""
+        if not self.cfg.device_search:
+            return None
+        mesh = self.mesh
+        return mesh if mesh.devices.size > 1 else None
 
     @property
     def mesh(self):

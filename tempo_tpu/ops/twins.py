@@ -30,6 +30,9 @@ DEVICE_HOST_TWINS: dict[str, str] = {
     # exact-verify replays through the single-block host evaluator
     "ops.multiquery.eval_multiquery": "ops.hostfilter.eval_block_host",
     "ops.multiquery.select_multiquery": "ops.select.select_topk_host",
+    # mesh-batched window launch (Q programs x sharded rows): demuxes
+    # to the same per-query verify as the single-chip fused launch
+    "parallel.multiquery.mesh_eval_multiquery": "ops.hostfilter.eval_block_host",
     # trace-id bisection (single-chip, batched, and mesh-sharded forms)
     "ops.find.lookup_ids": "ops.find.lookup_ids_blocks_host",
     "ops.find.lookup_ids_blocks": "ops.find.lookup_ids_blocks_host",

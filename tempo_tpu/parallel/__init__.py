@@ -18,6 +18,7 @@ from .find import sharded_find, sharded_find_rows, stack_block_ids
 from .search import sharded_search
 from .bloom import sharded_bloom_union
 from .step import distributed_query_step
+from .multiquery import mesh_eval_multiquery
 
 __all__ = [
     "make_mesh",
@@ -27,4 +28,5 @@ __all__ = [
     "sharded_search",
     "sharded_bloom_union",
     "distributed_query_step",
+    "mesh_eval_multiquery",
 ]

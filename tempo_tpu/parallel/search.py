@@ -93,7 +93,8 @@ def _cmp_b(op: str, x, v0, v1, f0, f1, is_float: bool, table):
 
 @lru_cache(maxsize=128)
 def make_sharded_search(mesh, tree, conds: tuple[Cond, ...], col_names: tuple[str, ...],
-                        B: int, S: int, R: int, NT: int, table_idxs: tuple[int, ...] = ()):
+                        B: int, S: int, R: int, NT: int, table_idxs: tuple[int, ...] = (),
+                        pack: bool = True):
     """Jitted mesh program over stacked blocks.
 
     ops_i: (B, C, 3) int32, ops_f: (B, C, 2) f32, tables: (B, L) u8 --
@@ -171,18 +172,59 @@ def make_sharded_search(mesh, tree, conds: tuple[Cond, ...], col_names: tuple[st
                 return attr_mask(i)
             raise ValueError(f"sharded search: unsupported target {c.target}")
 
+        def gather_mask(m):
+            """all_gather a boolean row mask along 'sp', bit-packed into
+            uint8 lanes before the collective and unpacked after: x8
+            fewer wire bytes than gathering the bool array, with an
+            exact pack/unpack round trip (Sl is a power-of-two bucket,
+            always 8-aligned). pack=False keeps the legacy unpacked
+            gather (the before/after comm bench and the differential
+            suite's byte-identity anchor)."""
+            if not pack or m.shape[1] % 8:
+                return jax.lax.all_gather(m, "sp", axis=1, tiled=True)
+            pk = jnp.packbits(m, axis=1)  # (Bl, Sl/8) uint8
+            pk_g = jax.lax.all_gather(pk, "sp", axis=1, tiled=True)
+            return jnp.unpackbits(pk_g, axis=1).astype(bool)  # (Bl, S)
+
+        hoisted: dict = {}
+
+        def parent_tables():
+            """The predicate-independent struct operands -- the parent
+            index table and row validity, replicated along 'sp' --
+            gathered ONCE per launch (lazily, at the first '>>' or '~'
+            node) and shared by every struct node of the query: only
+            the per-node lhs mask rides a per-node collective."""
+            if "pid" not in hoisted:
+                hoisted["pid"] = jax.lax.all_gather(
+                    cols["span.parent_idx"], "sp", axis=1, tiled=True)
+                hoisted["val"] = gather_mask(valid)
+            return hoisted["pid"], hoisted["val"]
+
         def ev_struct(op, lm, rm):
-            """Structural relation on the mesh: lhs mask / parent table /
-            validity all_gather along 'sp' (span-axis bytes per block --
-            one collective per struct node), the relation runs on the
-            replicated (Bl, S) tables exactly as the single-chip kernel
-            (ops/filter.ev_struct), and each chip slices its own span
-            range back out to AND with the local rhs."""
+            """Structural relation on the mesh. The '>' relation needs
+            only the REPLICATED lhs mask (each row's parent index is in
+            the local shard already), so its per-node collective is one
+            bit-packed span-axis gather; '>>' and '~' additionally read
+            the hoisted parent/validity tables (parent_tables, once per
+            launch) and run the single-chip relation (ops/filter
+            ev_struct) on the replicated (Bl, S) tables, each chip
+            slicing its own span range back out to AND with the local
+            rhs."""
             Sl = lm.shape[1]
-            lm_g = jax.lax.all_gather(lm, "sp", axis=1, tiled=True)  # (Bl, S)
-            pid_g = jax.lax.all_gather(cols["span.parent_idx"], "sp",
-                                       axis=1, tiled=True)
-            val_g = jax.lax.all_gather(valid, "sp", axis=1, tiled=True)
+            if pack and op == ">":
+                lm_g = gather_mask(lm)  # lm is valid-masked at the leaves
+                pid_l = cols["span.parent_idx"]
+                has_p_l = (pid_l >= 0) & valid
+                hit = jnp.take_along_axis(
+                    lm_g, jnp.clip(pid_l, 0, lm_g.shape[1] - 1), 1)
+                return rm & has_p_l & hit & valid
+            lm_g = gather_mask(lm)  # (Bl, S)
+            if pack:
+                pid_g, val_g = parent_tables()
+            else:  # legacy: every node gathers all three tables
+                pid_g = jax.lax.all_gather(cols["span.parent_idx"], "sp",
+                                           axis=1, tiled=True)
+                val_g = jax.lax.all_gather(valid, "sp", axis=1, tiled=True)
             Sg = lm_g.shape[1]
             has_p = (pid_g >= 0) & val_g
             safe = jnp.clip(pid_g, 0, Sg - 1)
@@ -320,6 +362,16 @@ def _stack_operands(operands, B: int, n_conds: int):
     return ints, floats, tabs
 
 
+def struct_pack_enabled() -> bool:
+    """TEMPO_STRUCT_PACK=0 reverts struct nodes to the legacy
+    per-node unpacked triple gather -- the before/after leg of the
+    comm-shrink bench and the differential suite's byte-identity
+    anchor. Default: hoisted + bit-packed collectives."""
+    import os
+
+    return os.environ.get("TEMPO_STRUCT_PACK", "1") not in ("0", "false")
+
+
 def sharded_search(mesh, tree, conds, operands, cols: dict[str, np.ndarray],
                    n_spans: np.ndarray, nt: int | None = None):
     """Host entry. `operands`: one Operands (same codes for every block:
@@ -341,7 +393,9 @@ def sharded_search(mesh, tree, conds, operands, cols: dict[str, np.ndarray],
         tree = normalize_tree(tree, conds)
     ints, floats, tabs = _stack_operands(operands, B, len(conds))
     table_idxs = tuple(sorted(tabs))
-    fn = make_sharded_search(mesh, tree, conds, names, B, S, R, NT, table_idxs)
+    pack = struct_pack_enabled()
+    fn = make_sharded_search(mesh, tree, conds, names, B, S, R, NT, table_idxs,
+                             pack=pack)
     arrays = [jnp.asarray(tabs[i]) for i in table_idxs] + [jnp.asarray(cols[n]) for n in names]
     import time as _time
 
@@ -351,8 +405,11 @@ def sharded_search(mesh, tree, conds, operands, cols: dict[str, np.ndarray],
     ints_j = jnp.asarray(ints)
     floats_j = jnp.asarray(floats)
     nsp_j = jnp.asarray(n_spans, dtype=np.int32)
+    # the legacy (unpacked) program keeps its own costmodel op label so
+    # the comm-shrink bench can read both variants' walker prices
+    op = "mesh_search" if pack else "mesh_search_nopack"
     TEL.record_launch(
-        "mesh_search", ("search", tree, conds, names, B, S, R, NT, table_idxs), S,
+        op, ("search", tree, conds, names, B, S, R, NT, table_idxs, pack), S,
         cost=lambda: costmodel.spec(fn, ints_j, floats_j, nsp_j, *arrays,
                                     mesh=mesh))
     t0 = _time.perf_counter()
@@ -362,10 +419,10 @@ def sharded_search(mesh, tree, conds, operands, cols: dict[str, np.ndarray],
     with DISPATCH_LOCK:  # collective programs must not interleave enqueues
         tm, sc = fn(ints_j, floats_j, nsp_j, *arrays)
         out = np.asarray(tm), np.asarray(sc)
-    TEL.observe_device("mesh_search", S, t0)
+    TEL.observe_device(op, S, t0)
     # timeline: the mesh leg with its statically-priced collective bytes
     # (costmodel comm walker; zeros until the background capture lands)
-    comm = costmodel.COST.comm_for("mesh_search", str(S))
+    comm = costmodel.COST.comm_for(op, str(S))
     TEL.child_span(
         "mesh:search", t0_wall, _time.time(),
         {"blocks": B, "bucket": S, "comm_bytes": int(sum(comm.values())),

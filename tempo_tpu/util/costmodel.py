@@ -93,21 +93,34 @@ def _sub_jaxprs(params):
                     yield b.jaxpr
 
 
+def ring_wire_bytes(name: str, in_bytes: int, out_bytes: int, k: int) -> int:
+    """Wire bytes one collective moves for ONE group of k devices under
+    the standard ring algorithms (the walker's pricing model, exported
+    so tests and the mesh-batch bench can hand-compute the expected
+    totals and cross-check the jaxpr walk):
+      all_gather      out_bytes x (k-1)   (each of k receives the
+                                           (k-1)/k it lacks)
+      psum/pmax/pmin  2 x in_bytes x (k-1)  (ring all-reduce)
+      ppermute        in_bytes x k          (every shard moves)
+      psum_scatter / reduce_scatter /
+      all_to_all      in_bytes x (k-1)"""
+    if name == "all_gather":
+        return out_bytes * (k - 1)
+    if name in ("psum", "pmax", "pmin"):
+        return 2 * in_bytes * (k - 1)
+    if name == "ppermute":
+        return in_bytes * k
+    return in_bytes * (k - 1)  # psum_scatter / reduce_scatter / all_to_all
+
+
 def collective_comm_bytes(jaxpr, mesh_axis_sizes: dict[str, int],
                           total_devices: int) -> dict[str, int]:
     """Statically price every collective in a jaxpr: fleet-wide wire
     bytes per program execution, by collective name.
 
-    Model (ring algorithms, k = devices in one collective group,
-    g = total_devices / k independent groups running the collective):
-      all_gather      out_bytes x (k-1)         x g   (each of k receives
-                                                       the (k-1)/k it lacks)
-      psum/pmax/pmin  2 x in_bytes x (k-1)      x g   (ring all-reduce)
-      psum_scatter /
-      reduce_scatter  in_bytes x (k-1)          x g
-      all_to_all      in_bytes x (k-1)          x g
-      ppermute        in_bytes x k              x g   (every shard moves)
-    Shapes inside shard_map are PER-SHARD; in/out bytes above are the
+    Model: ring_wire_bytes (k = devices in one collective group) times
+    g = total_devices / k independent groups running the collective.
+    Shapes inside shard_map are PER-SHARD; in/out bytes are the
     eqn's own aval bytes, so the model needs no sharding inference.
     Recursion: sub-jaxprs (pjit/shard_map/custom calls) count once,
     `scan` bodies multiply by the trip count, `cond` branches take the
@@ -129,14 +142,7 @@ def collective_comm_bytes(jaxpr, mesh_axis_sizes: dict[str, int],
                 in_b = sum(_aval_bytes(v.aval) for v in eqn.invars
                            if hasattr(v, "aval"))
                 out_b = sum(_aval_bytes(v.aval) for v in eqn.outvars)
-                if name == "all_gather":
-                    wire = out_b * (k - 1)
-                elif name in ("psum", "pmax", "pmin"):
-                    wire = 2 * in_b * (k - 1)
-                elif name == "ppermute":
-                    wire = in_b * k
-                else:  # psum_scatter / reduce_scatter / all_to_all
-                    wire = in_b * (k - 1)
+                wire = ring_wire_bytes(name, in_b, out_b, k)
                 acc[name] = acc.get(name, 0) + wire * groups
             if name == "cond":
                 branches = [walk(b.jaxpr if hasattr(b, "jaxpr") else b)

@@ -135,6 +135,22 @@ class KernelTelemetry:
             "tempo_batch_demux_total",
             help="per-query results demultiplexed out of fused launches")
         self._batches: dict[str, dict] = {}
+        # mesh-batched serving (parallel/multiquery): one admission
+        # window lowered to a single Q-programs x sharded-rows launch
+        # across every chip -- launches and per-launch occupancy
+        self.mesh_batch_launches = Counter(
+            "tempo_mesh_batch_launches_total",
+            help="batched multi-query mesh launches (one admission "
+                 "window -> all chips)")
+        self.mesh_batch_queries = Counter(
+            "tempo_mesh_batch_queries_total",
+            help="queries fused into batched mesh launches")
+        self.mesh_batch_occupancy = Histogram(
+            "tempo_mesh_batch_occupancy_queries",
+            buckets=(1, 2, 4, 8, 16, 32, 64),
+            help="queries per batched mesh launch")
+        self._mesh_batches: dict = {"launches": 0, "queries": 0,
+                                    "max_occupancy": 0}
         # compaction pipeline (db/compact_pipeline): per-stage wall
         # times, admission-gate occupancy, prefetch effectiveness
         self.compact_stage_time = Histogram(
@@ -256,7 +272,9 @@ class KernelTelemetry:
             self.staged_cache_misses, self.routing,
             self.batch_groups, self.batch_queries,
             self.batch_occupancy, self.batch_window_wait,
-            self.batch_demux, self.compact_stage_time,
+            self.batch_demux, self.mesh_batch_launches,
+            self.mesh_batch_queries, self.mesh_batch_occupancy,
+            self.compact_stage_time,
             self.compact_jobs, self.compact_input_bytes,
             self.compact_prefetch, self.compact_jobs_inflight,
             self.compact_bytes_inflight, self.compact_queue_depth,
@@ -425,6 +443,30 @@ class KernelTelemetry:
                 b["max_occupancy"] = max(b["max_occupancy"], int(occupancy))
         except Exception:
             pass
+
+    def record_mesh_batch(self, occupancy: int) -> None:
+        """One batched mesh launch executed: the whole window ran as a
+        single Q-programs x sharded-rows program across every chip."""
+        try:
+            self.mesh_batch_launches.inc()
+            self.mesh_batch_queries.inc(occupancy)
+            self.mesh_batch_occupancy.observe(float(occupancy))
+            with self._lock:
+                mb = self._mesh_batches
+                mb["launches"] += 1
+                mb["queries"] += int(occupancy)
+                mb["max_occupancy"] = max(mb["max_occupancy"], int(occupancy))
+        except Exception:
+            pass
+
+    def mesh_batch_stats(self) -> dict:
+        """Mesh-batch aggregates for /status/kernels and the bench row:
+        occupancy = queries per mesh launch (1.0 = no amortization)."""
+        with self._lock:
+            mb = dict(self._mesh_batches)
+        mb["occupancy"] = round(
+            mb["queries"] / mb["launches"], 3) if mb["launches"] else 0.0
+        return mb
 
     def record_demux(self, name: str, n: int = 1) -> None:
         try:
@@ -914,6 +956,7 @@ class KernelTelemetry:
             "query_costs": self.query_cost_stats(),
             "selftrace": self.selftrace_stats(),
             "batching": self.batch_stats(),
+            "mesh_batch": self.mesh_batch_stats(),
             "compaction": self.compaction_stats(),
             "stream": self.stream_stats(),
             "livestage": self.livestage_stats(),

@@ -1,0 +1,33 @@
+"""Seeded batched-mesh launch-key violations: the Q-bucket of a
+mesh multiquery factory keys the compiled shard_map program exactly
+like an axis bucket, so deriving it from DATA (a live occupancy count
+off an array) compiles one program per occupancy -- a compile storm
+the jit-value-key pass must keep catching on the new module shape.
+Every EXPECT marker is asserted by tests/test_analysis.py. This file
+is never imported."""
+
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+
+
+@lru_cache(maxsize=32)
+def make_mesh_multiquery(shape, q_b: int, n_spans_b: int):
+    @jax.jit
+    def run(span_mat, progs):
+        return jnp.cumsum(span_mat, axis=1)[:q_b]
+
+    return run
+
+
+def launch_window(shape, span_mat, progs, occupancy_rows):
+    # q_b must be the padded power-of-two window bucket, never a value
+    # read back off a device array
+    fn = make_mesh_multiquery(shape, int(occupancy_rows.max()), 1024)  # EXPECT: jit-value-key
+    return fn(span_mat, progs)
+
+
+def launch_window_ok(shape, span_mat, progs, q_b: int):
+    fn = make_mesh_multiquery(shape, q_b, span_mat.shape[1])
+    return fn(span_mat, progs)

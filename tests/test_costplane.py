@@ -126,9 +126,11 @@ def test_comm_walker_exact_bytes_on_synthetic_shard_map():
 
 
 def test_comm_walker_counts_struct_all_gathers():
-    """Cross-check of the struct budget term: the compiled mesh search
-    program carries exactly 3 all_gathers per struct node (lm / pid /
-    valid), the replication _stacked_words_est prices."""
+    """Cross-check of the struct budget term on the SHRUNK program:
+    one (bit-packed) lhs-mask all_gather per struct node, plus one
+    hoisted parent + validity gather pair per launch when any '>>'/'~'
+    node needs the replicated parent table ('>' runs off the local
+    parent column) -- the replication _stacked_words_est prices."""
     import jax
 
     from tempo_tpu.db.search import _count_struct_nodes
@@ -174,14 +176,22 @@ def test_comm_walker_counts_struct_all_gathers():
 
         return walk(jaxpr.jaxpr)
 
-    assert count_gathers(one) == 3
-    assert count_gathers(two) == 6
+    # '>' alone: just its packed lhs mask
+    assert count_gathers(one) == 1
+    # '>' nested under '>>': two per-node masks + the once-per-launch
+    # hoisted pid + packed-validity pair
+    assert count_gathers(two) == 4
 
 
-def test_struct_budget_scales_with_node_count():
-    """The pre-IO stacked estimate grows by exactly 6*S_b*sp words per
-    additional struct node -- the regression the eval_shard budget fix
-    closes (one node used to price a whole chain)."""
+def test_struct_budget_scales_with_node_count(monkeypatch):
+    """The pre-IO stacked estimate grows per additional struct node --
+    the regression the eval_shard budget fix closes (one node used to
+    price a whole chain). Post-shrink pricing: S_b*sp per node (the
+    replicated mask) + 4*S_b*sp once when the added node is a '>>'/'~'
+    (the hoisted parent/validity tables and closure temps). With the
+    TEMPO_STRUCT_PACK=0 escape hatch the budget must price the legacy
+    triple-gather program (6*S_b*sp per node) -- what will actually
+    run on device."""
     from tempo_tpu.backend.mem import MemBackend
     from tempo_tpu.db import TempoDB, TempoDBConfig
     from tempo_tpu.db.search import (
@@ -210,7 +220,13 @@ def test_struct_budget_scales_with_node_count():
 
     e1 = est_for('{ name = "GET /api" } > { true }')
     e2 = est_for('{ name = "GET /api" } > { true } >> { name = "db.query" }')
-    assert e2 - e1 == 6 * 4096 * 4
+    # the added '>>' node: one more replicated mask + the hoisted tables
+    assert e2 - e1 == (1 + 4) * 4096 * 4
+    monkeypatch.setenv("TEMPO_STRUCT_PACK", "0")
+    l1 = est_for('{ name = "GET /api" } > { true }')
+    l2 = est_for('{ name = "GET /api" } > { true } >> { name = "db.query" }')
+    assert l2 - l1 == 6 * 4096 * 4  # legacy: lm/pid/valid + temps per node
+    assert l1 - e1 == 5 * 4096 * 4  # one '>' node: 6x legacy vs 1x packed
     db.close()
 
 

@@ -222,6 +222,74 @@ def test_sharded_search_generic_attr_matches_oracle(mesh):
         np.testing.assert_array_equal(tm[b], counts > 0, err_msg=f"block {b}")
 
 
+def test_sharded_search_struct_orphans_on_shard_cuts(mesh):
+    """The '~' sibling relation's orphan rule (pid == -2 rows are
+    mutual siblings when ANY lhs orphan exists) must survive the
+    hoisted-gather refactor when orphans land on NON-ZERO sp shards --
+    prior oracle coverage only ever placed orphans on shard 0. Checked
+    against numpy for all three relations on rows whose parent chains
+    and orphans straddle every one of the 4 shard cuts."""
+    rng = np.random.default_rng(31)
+    B, S_rows, NT = 2, 64, 8  # 4-way sp split: shards of 16 rows
+    cols = {
+        "span.trace_sid": np.sort(
+            rng.integers(0, NT, size=(B, S_rows)).astype(np.int32), axis=1),
+        "span.dur_us": rng.integers(0, 100, size=(B, S_rows)).astype(np.int32),
+        "span.parent_idx": np.full((B, S_rows), -1, np.int32),
+    }
+    for b in range(B):
+        sid = cols["span.trace_sid"][b]
+        prev_same = np.zeros(S_rows, bool)
+        prev_same[1:] = sid[1:] == sid[:-1]
+        pidx = np.where(prev_same & (rng.random(S_rows) < 0.6),
+                        np.arange(S_rows) - 1, -1).astype(np.int32)
+        # orphans pinned onto shards 1..3 (rows 16+), never shard 0
+        for row in (17, 33, 49, 62):
+            pidx[row] = -2
+        cols["span.parent_idx"][b] = pidx
+    n_spans = np.asarray([64, 52], dtype=np.int32)  # ragged: pads shard 3
+    conds = (
+        Cond(target=T_SPAN, col="span.dur_us", op="lt"),
+        Cond(target=T_SPAN, col="span.dur_us", op="ge"),
+    )
+    operands = Operands.build([(0, 80, 0, 0.0, 0.0), (0, 20, 0, 0.0, 0.0)])
+    for op in (">", ">>", "~"):
+        tree = ("struct", op, ("cond", 0), ("cond", 1))
+        tm, sc = sharded_search(mesh, tree, conds, operands, cols, n_spans,
+                                nt=NT)
+        for b in range(B):
+            valid = np.arange(S_rows) < n_spans[b]
+            lhs = (cols["span.dur_us"][b] < 80) & valid
+            rhs = (cols["span.dur_us"][b] >= 20) & valid
+            pidx = cols["span.parent_idx"][b]
+            has_p = (pidx >= 0) & valid
+            safe = np.clip(pidx, 0, S_rows - 1)
+            if op == ">":
+                rel = has_p & lhs[safe]
+            elif op == ">>":
+                rel = np.zeros(S_rows, bool)
+                for i in range(S_rows):
+                    p = pidx[i] if valid[i] else -1
+                    while p >= 0:
+                        if lhs[p]:
+                            rel[i] = True
+                            break
+                        p = pidx[p]
+            else:  # '~'
+                cnt = np.zeros(S_rows, np.int32)
+                np.add.at(cnt, safe, (lhs & has_p).astype(np.int32))
+                sibs = cnt[safe] - (lhs & has_p).astype(np.int32)
+                orphan = (pidx == -2) & valid
+                rel = (has_p & (sibs > 0)) | (orphan & np.any(lhs & orphan))
+            sm = rhs & rel & valid
+            counts = np.bincount(cols["span.trace_sid"][b][sm],
+                                 minlength=NT)[:NT]
+            np.testing.assert_array_equal(sc[b], counts,
+                                          err_msg=f"{op} block {b}")
+            np.testing.assert_array_equal(tm[b], counts > 0,
+                                          err_msg=f"{op} block {b}")
+
+
 def test_sharded_bloom_union(mesh):
     blooms = []
     all_ids = []
@@ -283,8 +351,11 @@ def test_distributed_query_step_one_jit(mesh):
 
 
 def test_graft_dryrun_multichip_entry():
-    """Run the exact entry the driver invokes (__graft_entry__.dryrun_multichip)
-    on the virtual 8-device CPU mesh, so a driver-side failure reproduces here."""
+    """Run the toy correctness leg the driver invokes first
+    (__graft_entry__.dryrun_multichip's fast-failure shape) on the
+    virtual 8-device CPU mesh, so a driver-side failure reproduces
+    here. The default toy-then-scale run is covered (once) by
+    test_graft_dryrun_scale_shape."""
     import sys
     from pathlib import Path
 
@@ -292,16 +363,20 @@ def test_graft_dryrun_multichip_entry():
     try:
         import __graft_entry__ as graft
 
-        graft.dryrun_multichip(8)
+        graft.dryrun_multichip(8, scale=False)
     finally:
         sys.path.pop(0)
 
 
-def test_graft_dryrun_scale_shape():
-    """The --scale dryrun: >= 1M padded span rows per chip, ragged
-    per-block sizes, generic-attr conds, per-chip memory budget, host
+def test_graft_dryrun_scale_shape(capsys):
+    """The default (toy-then-scale) dryrun: >= 1M padded span rows per
+    chip, ragged per-block sizes, generic-attr conds, a struct-op node,
+    the batched (Q>1) multi-query mesh window, the per-chip memory
+    budget INCLUDING the batched program's padded Q-axis, and the host
     oracle -- the dryrun stand-in for the 100M-span sharded Find/search
-    baseline config."""
+    baseline config. The MULTICHIP artifact tail (scale shape + comm
+    walker volume) must be printed and well-formed."""
+    import json
     import sys
     from pathlib import Path
 
@@ -312,6 +387,15 @@ def test_graft_dryrun_scale_shape():
         graft.dryrun_multichip(8, scale=True)
     finally:
         sys.path.pop(0)
+    tail_lines = [ln for ln in capsys.readouterr().out.splitlines()
+                  if ln.startswith("MULTICHIP_SCALE ")]
+    assert tail_lines, "scale dryrun printed no artifact tail"
+    tail = json.loads(tail_lines[-1].split(" ", 1)[1])
+    assert tail["padded_rows_per_chip"] >= 1_000_000
+    assert tail["mq_window_q"] > 1 and tail["struct_op"]
+    assert tail["per_chip_bytes"] <= tail["budget_bytes"]
+    assert "mesh_step" in tail["comm_bytes_per_launch"]
+    assert "mesh_multiquery" in tail["comm_bytes_per_launch"]
 
 
 def test_graft_dryrun_subprocess_fallback(monkeypatch):
@@ -326,7 +410,7 @@ def test_graft_dryrun_subprocess_fallback(monkeypatch):
         import __graft_entry__ as graft
 
         monkeypatch.setattr(graft, "_force_virtual_devices", lambda n: False)
-        graft.dryrun_multichip(8)
+        graft.dryrun_multichip(8, scale=False)  # --no-scale flag plumbing
     finally:
         sys.path.pop(0)
 
