@@ -309,6 +309,20 @@ class Soak:
         return report
 
 
+# the default --chaos mix: transient backend 5xx at 5% plus a little
+# injected RPC latency -- the faults the resilience plane (retries,
+# hedging, shard degradation, breaker half-open) exists to mask. The
+# soak must still pass end to end with this active.
+DEFAULT_CHAOS_SPEC = json.dumps({
+    "seed": 1,
+    "rules": [
+        {"site": "backend.read", "action": "error", "p": 0.05},
+        {"site": "rpc.client", "action": "latency", "latency_s": 0.02,
+         "p": 0.1},
+    ],
+})
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser("tempo-tpu-soak")
     ap.add_argument("--target", default="", help="base URL of a running instance")
@@ -333,6 +347,14 @@ def main(argv=None) -> int:
                          "percentiles fold into the summary (probe "
                          "failures fail the run)")
     ap.add_argument("--vulture-interval", type=float, default=2.0)
+    ap.add_argument("--chaos", nargs="?", const=DEFAULT_CHAOS_SPEC,
+                    default="", metavar="SPEC",
+                    help="run the soak under fault injection: SPEC is "
+                         "inline JSON rules / a rules file for "
+                         "TEMPO_CHAOS (bare --chaos = a transient 5%% "
+                         "backend-fault + RPC-latency mix the retry/"
+                         "hedge/breaker armor must mask); self-host "
+                         "only -- the env reaches the spawned app")
     ap.add_argument("--write-p95", type=float, default=1.0)
     ap.add_argument("--search-p95", type=float, default=3.0)
     args = ap.parse_args(argv)
@@ -354,10 +376,10 @@ def main(argv=None) -> int:
             cmd.append("--multitenancy")
         if args.overrides:
             cmd.append(f"--overrides.path={args.overrides}")
-        proc = subprocess.Popen(
-            cmd,
-            env={**os.environ, "JAX_PLATFORMS": os.environ.get("JAX_PLATFORMS", "cpu")},
-        )
+        env = {**os.environ, "JAX_PLATFORMS": os.environ.get("JAX_PLATFORMS", "cpu")}
+        if args.chaos:
+            env["TEMPO_CHAOS"] = args.chaos
+        proc = subprocess.Popen(cmd, env=env)
         target = f"http://127.0.0.1:{port}"
         for _ in range(100):
             try:
@@ -419,6 +441,32 @@ def main(argv=None) -> int:
                 "failures": vs["failures"][:5],
             }
             report["ok"] = bool(report["ok"]) and bad == 0
+        if args.chaos:
+            # the proof artifact: how many faults were actually
+            # injected (a chaos soak that injected nothing proves
+            # nothing) next to the retry/hedge/breaker counters that
+            # absorbed them
+            if proc is None:
+                print("soak: --chaos only arms a --self-host app; the "
+                      "remote target keeps its own TEMPO_CHAOS",
+                      file=sys.stderr)
+            try:
+                st = json.loads(urllib.request.urlopen(
+                    target + "/status/chaos", timeout=10).read())
+                report["chaos"] = {
+                    "enabled": st.get("enabled", False),
+                    "injected_total": st.get("injected_total", 0),
+                    "retries": st.get("retries", {}),
+                    "hedging": st.get("hedging", {}),
+                    "breakers": {leg: b.get("state")
+                                 for leg, b in st.get("breakers", {}).items()},
+                }
+                if proc is not None and not st.get("injected_total"):
+                    report["ok"] = False
+                    report.setdefault("errors", []).append(
+                        "chaos: plane armed but zero faults injected")
+            except Exception as e:
+                report["chaos"] = {"error": f"{type(e).__name__}: {e}"}
         print(json.dumps(report, indent=2))
         return 0 if report["ok"] else 1
     finally:

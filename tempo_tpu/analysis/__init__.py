@@ -14,7 +14,8 @@ Scopes (directories relative to the scanned root, normally the
 tempo_tpu package):
 
   * kernel-contract rules (jit-*):   ops/, parallel/
-  * concurrency rules (global-/lock-*): services/, util/, ops/, db/
+  * concurrency rules (global-/lock-*): services/, util/, ops/, db/,
+    chaos/
   * twin registry rules (twin-*):    ops/ + parallel/ vs db/ executors
   * parse-error:                     every scanned file
 
@@ -40,7 +41,9 @@ from .jitrules import run_jit_rules, run_value_key_cross
 from .twinrules import run_twin_rules
 
 KERNEL_SCOPE = ("ops/", "parallel/")
-CONCURRENCY_SCOPE = ("services/", "util/", "ops/", "db/")
+# chaos/ is in scope on purpose: the fault plane is exactly the kind of
+# process-wide registry the concurrency rules exist to guard
+CONCURRENCY_SCOPE = ("services/", "util/", "ops/", "db/", "chaos/")
 
 
 def default_root() -> Path:

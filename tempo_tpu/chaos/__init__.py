@@ -1,0 +1,27 @@
+"""tempo_tpu.chaos: deterministic fault injection across every
+IO/device seam (see plane.py for the full model).
+
+    from tempo_tpu.chaos import plane
+    plane.configure([{"site": "backend.read", "action": "error",
+                      "p": 0.05}], seed=7)
+
+Seams tapped: backend objects (chaos.backendwrap via db/tempodb),
+ingester-client + querier-worker RPC (transport/client, services/
+worker), device launches (ops/device.launch_tap via kerneltel),
+WAL append/fsync (db/wal), and gossip send/recv (transport/gossip).
+"""
+
+from .backendwrap import ChaosBackend, maybe_wrap  # noqa: F401
+from .plane import (  # noqa: F401
+    ACTIONS,
+    DROP,
+    SITES,
+    FaultPlane,
+    FaultRule,
+    clear,
+    configure,
+    configure_spec,
+    is_active,
+    parse_rules,
+    status,
+)

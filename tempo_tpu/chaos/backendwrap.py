@@ -1,0 +1,132 @@
+"""Chaos taps over the RawBackend seam.
+
+`ChaosBackend` interposes between TempoDB and the real backend so
+every object-store operation -- the seam where the real world fails
+most -- can take injected latency, 5xx, truncated ranged reads or
+corrupt bytes. It forwards to `inner` verbatim (preserving backend-
+specific fast paths: LocalBackend's streamed appender, S3's server-side
+CopyObject) and keeps an `.inner` attribute so the /metrics wrapper
+walk (cache hits, hedged requests) still reaches the stack below.
+
+`maybe_wrap` only interposes when the process is ARMED (TEMPO_CHAOS
+set, --chaos.rules, or a plane configured programmatically before the
+TempoDB was built): an unarmed process pays zero indirection, which is
+what the faults-off differential certifies. Rules installed at runtime
+(POST /internal/chaos) reach backend taps only in an armed process;
+every other seam's inline tap engages regardless.
+"""
+
+from __future__ import annotations
+
+from ..backend.base import Appender, RawBackend
+from . import plane
+
+
+class _NullAppender(Appender):
+    """A dropped open_append: accepts every append, writes nothing."""
+
+    def close(self) -> None:
+        self._parts = []
+
+
+class ChaosBackend(RawBackend):
+    def __init__(self, inner: RawBackend):
+        self.inner = inner
+        self.is_remote = inner.is_remote
+
+    # ---- read
+    def read(self, tenant, block_id, name):
+        return plane.call("backend.read",
+                          lambda: self.inner.read(tenant, block_id, name),
+                          tenant=tenant, key=f"{block_id}/{name}")
+
+    def read_range(self, tenant, block_id, name, offset, length):
+        return plane.call(
+            "backend.read_range",
+            lambda: self.inner.read_range(tenant, block_id, name,
+                                          offset, length),
+            tenant=tenant, key=f"{block_id}/{name}")
+
+    def read_tenant_object(self, tenant, name):
+        return plane.call("backend.read_tenant",
+                          lambda: self.inner.read_tenant_object(tenant, name),
+                          tenant=tenant, key=name)
+
+    # ---- write (drop = the operation is silently LOST -- the torn-
+    # commit / eventual-consistency fault class)
+    def write(self, tenant, block_id, name, data):
+        if plane.tap("backend.write", tenant=tenant,
+                     key=f"{block_id}/{name}") is plane.DROP:
+            return
+        self.inner.write(tenant, block_id, name, data)
+
+    def write_tenant_object(self, tenant, name, data):
+        if plane.tap("backend.write_tenant", tenant=tenant,
+                     key=name) is plane.DROP:
+            return
+        self.inner.write_tenant_object(tenant, name, data)
+
+    def open_append(self, tenant, block_id, name) -> Appender:
+        # tap at open; the appender itself stays the inner backend's
+        # (LocalBackend streams true appends -- wrapping per-append
+        # would change its IO shape, not just inject into it). A drop
+        # discards the WHOLE object: everything appended goes nowhere.
+        if plane.tap("backend.write", tenant=tenant,
+                     key=f"{block_id}/{name}") is plane.DROP:
+            return _NullAppender(self, tenant, block_id, name)
+        return self.inner.open_append(tenant, block_id, name)
+
+    def copy_object(self, tenant, src_block_id, name, dst_block_id):
+        if plane.tap("backend.copy", tenant=tenant,
+                     key=f"{src_block_id}/{name}") is plane.DROP:
+            return 0  # the part silently never lands
+        return self.inner.copy_object(tenant, src_block_id, name,
+                                      dst_block_id)
+
+    # ---- list
+    def tenants(self):
+        plane.tap("backend.list", key="")
+        return self.inner.tenants()
+
+    def blocks(self, tenant):
+        plane.tap("backend.list", tenant=tenant, key=tenant)
+        return self.inner.blocks(tenant)
+
+    # ---- delete (drop = the delete silently no-ops: retention and
+    # compacted-marker garbage survives)
+    def delete_block(self, tenant, block_id):
+        if plane.tap("backend.delete", tenant=tenant,
+                     key=block_id) is plane.DROP:
+            return
+        self.inner.delete_block(tenant, block_id)
+
+    def delete_tenant_object(self, tenant, name):
+        if plane.tap("backend.delete", tenant=tenant,
+                     key=name) is plane.DROP:
+            return
+        self.inner.delete_tenant_object(tenant, name)
+
+    def _delete_object(self, tenant, block_id, name):
+        if plane.tap("backend.delete", tenant=tenant,
+                     key=f"{block_id}/{name}") is plane.DROP:
+            return
+        self.inner._delete_object(tenant, block_id, name)
+
+    # ---- compacted-marker protocol: the inner backend may override it
+    # (marker semantics are backend-specific); its object ops come back
+    # through the wrapper only for the base implementation, so tap the
+    # marker write explicitly to keep the seam covered either way
+    def mark_compacted(self, tenant, block_id):
+        if plane.tap("backend.write", tenant=tenant,
+                     key=f"{block_id}/meta.compacted.json") is plane.DROP:
+            return  # the marker rename is silently lost
+        self.inner.mark_compacted(tenant, block_id)
+
+
+def maybe_wrap(backend: RawBackend) -> RawBackend:
+    """Interpose the chaos wrapper iff the process is armed."""
+    if isinstance(backend, ChaosBackend):
+        return backend
+    if plane.is_active():
+        return ChaosBackend(backend)
+    return backend

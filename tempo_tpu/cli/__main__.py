@@ -430,6 +430,74 @@ def cmd_vulture(args):
         sys.exit(1)
 
 
+def cmd_chaos(args):
+    """Chaos-plane tooling: `sites` lists every injectable seam,
+    `validate` checks a rules file without running anything, `inject`
+    swaps the fault rules of a RUNNING instance over /internal/chaos
+    (and `--clear` tears them down), `status` prints /status/chaos."""
+    from ..chaos import plane as chaos_plane
+
+    if args.chaos_cmd == "sites":
+        for site in sorted(chaos_plane.SITES):
+            print(f"{site:22} {chaos_plane.SITES[site]}")
+        print(f"\nactions: {', '.join(chaos_plane.ACTIONS)}")
+        print("triggers: p (probability), nth, begin_s/for_s window, "
+              "max_fires; one plane seed replays the whole run")
+        return
+    if args.chaos_cmd == "validate":
+        try:
+            with open(args.rules) as f:
+                doc = json.load(f)
+            rules, seed = chaos_plane.parse_rules(doc)
+        except (OSError, ValueError) as e:
+            print(f"invalid chaos rules: {e}", file=sys.stderr)
+            sys.exit(1)
+        from dataclasses import asdict
+
+        print(json.dumps({"seed": seed,
+                          "rules": [{k: v for k, v in asdict(r).items()
+                                     if k not in ("calls", "fires")}
+                                    for r in rules]}, indent=2))
+        print(f"ok: {len(rules)} rule(s)", file=sys.stderr)
+        return
+
+    # inject / status against a running instance
+    import urllib.request
+
+    base = args.target.rstrip("/")
+    headers = {"Content-Type": "application/json"}
+    if args.internal_token:
+        headers["X-Tempo-Internal-Token"] = args.internal_token
+    if args.chaos_cmd == "status":
+        with urllib.request.urlopen(base + "/status/chaos",
+                                    timeout=args.timeout) as r:
+            print(json.dumps(json.load(r), indent=2))
+        return
+    if args.clear:
+        payload: dict = {"clear": True}
+    else:
+        if args.rules:
+            with open(args.rules) as f:
+                doc = json.load(f)
+        elif args.rule:
+            doc = json.loads(args.rule)
+            if isinstance(doc, dict) and "site" in doc:
+                doc = [doc]
+        else:
+            print("chaos inject needs --rules FILE, --rule JSON or --clear",
+                  file=sys.stderr)
+            sys.exit(1)
+        rules, seed = chaos_plane.parse_rules(doc)  # validate client-side
+        payload = {"seed": args.seed if args.seed is not None else seed,
+                   "rules": (doc.get("rules") if isinstance(doc, dict)
+                             else doc)}
+    req = urllib.request.Request(
+        base + "/internal/chaos", data=json.dumps(payload).encode(),
+        headers=headers)
+    with urllib.request.urlopen(req, timeout=args.timeout) as r:
+        print(json.dumps(json.load(r), indent=2))
+
+
 def cmd_slo(args):
     """Fetch /status/slo from a running instance and render the
     objective table: per-window burn rates and verdicts -- the
@@ -688,6 +756,32 @@ def main(argv=None):
                    help="storage path for fresh-reader cold probes")
     p.add_argument("--seed", type=int, default=None)
     p.set_defaults(fn=cmd_vulture)
+
+    p = sub.add_parser("chaos",
+                       help="fault-injection tooling: list sites, "
+                            "validate a rules file, inject/clear rules "
+                            "on a running instance")
+    csub = p.add_subparsers(dest="chaos_cmd", required=True)
+    cp = csub.add_parser("sites", help="list every injectable seam")
+    cp.set_defaults(fn=cmd_chaos)
+    cp = csub.add_parser("validate", help="parse + check a rules file")
+    cp.add_argument("rules", help="JSON rules file")
+    cp.set_defaults(fn=cmd_chaos)
+    for name, hlp in (("inject", "swap the fault rules of a running "
+                                 "instance (POST /internal/chaos)"),
+                      ("status", "print /status/chaos")):
+        cp = csub.add_parser(name, help=hlp)
+        cp.add_argument("target", help="base URL, e.g. http://localhost:3200")
+        cp.add_argument("--rules", default="", help="JSON rules file")
+        cp.add_argument("--rule", default="",
+                        help="one inline JSON rule (or a rule list)")
+        cp.add_argument("--seed", type=int, default=None)
+        cp.add_argument("--clear", action="store_true",
+                        help="tear the fault plane down")
+        cp.add_argument("--internal-token", default="",
+                        help="shared token for non-loopback targets")
+        cp.add_argument("--timeout", type=float, default=15.0)
+        cp.set_defaults(fn=cmd_chaos)
 
     p = sub.add_parser("slo",
                        help="fetch /status/slo and render burn rates + "

@@ -58,7 +58,12 @@ class TempoDBConfig:
 class TempoDB:
     def __init__(self, cfg: TempoDBConfig, backend: RawBackend | None = None):
         self.cfg = cfg
-        self.backend = backend or open_backend(cfg.backend)
+        # chaos seam: in an armed process (TEMPO_CHAOS / --chaos.rules)
+        # every backend op runs through the fault-injection wrapper;
+        # unarmed processes get the raw backend with zero indirection
+        from ..chaos.backendwrap import maybe_wrap
+
+        self.backend = maybe_wrap(backend or open_backend(cfg.backend))
         os.makedirs(cfg.wal_path, exist_ok=True)
         self.wal = WAL(os.path.join(cfg.wal_path, "wal"))
         self.blocklist = Blocklist()

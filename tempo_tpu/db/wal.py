@@ -28,6 +28,7 @@ import struct
 import uuid
 from dataclasses import dataclass, field
 
+from ..chaos import plane as _chaos
 from ..wire import pbwire as w
 
 WAL_VERSION = "w1"
@@ -68,7 +69,16 @@ class WALBlock:
         body = tid + _REC_HDR.pack(start_s & 0xFFFFFFFF, end_s & 0xFFFFFFFF) + segment
         hdr = bytearray()
         w.write_varint(hdr, len(body))
-        self._f.write(bytes(hdr) + body)
+        rec = bytes(hdr) + body
+        # chaos seam (gated: this is the hottest write path): truncate
+        # = a torn append (crash mid-write; replay must drop the
+        # tail), drop = a lost record, error = disk fault
+        if _chaos.is_active():
+            rec = _chaos.mangle("wal.append", rec, tenant=self.tenant,
+                                key=self.block_id)
+            if not rec:
+                return  # dropped: nothing hit the file
+        self._f.write(rec)
         self._unflushed += 1
 
     def flush(self, sync: bool = False) -> None:
@@ -81,6 +91,11 @@ class WALBlock:
 
             now = _time.monotonic()
             if sync or now - self._last_fsync >= self._fsync_interval_s:
+                # chaos seam: an injected fsync error is a failed
+                # stable write -- the push must NOT be acked as durable
+                if _chaos.is_active():
+                    _chaos.tap("wal.fsync", tenant=self.tenant,
+                               key=self.block_id)
                 os.fsync(self._f.fileno())
                 self._last_fsync = now
                 self._unsynced = False

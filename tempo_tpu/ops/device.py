@@ -23,6 +23,18 @@ MIN_BUCKET = 1024
 PAD_I32 = np.int32(-(2**31))  # sentinel for code/int columns (never a valid code)
 
 
+def launch_tap(op: str) -> None:
+    """Chaos launch shim: every device-kernel launch passes here (via
+    TEL.record_launch, the one chokepoint all entry points share) so a
+    chaos rule on site `device.launch` can simulate an XLA compile
+    failure, a device OOM (RESOURCE_EXHAUSTED), or a slow launch --
+    keyed by op name. Only called when a fault plane is active; with
+    chaos off the kerneltel fast path never reaches this module."""
+    from ..chaos import plane as chaos_plane
+
+    chaos_plane.tap("device.launch", key=str(op))
+
+
 def bucket(n: int) -> int:
     """Next power-of-two >= max(n, MIN_BUCKET)."""
     b = MIN_BUCKET

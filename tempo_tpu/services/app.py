@@ -112,6 +112,14 @@ class AppConfig:
     # env, else <storage_path>/cost_ledger.json): find/live-search/
     # block-scan routing seeds from it at startup (util/costledger)
     cost_ledger_path: str = ""
+    # chaos plane (tempo_tpu/chaos): fault-injection rules as inline
+    # JSON or a file path ("" = TEMPO_CHAOS env, else off). Armed
+    # processes also accept runtime rule swaps via POST /internal/chaos.
+    chaos_rules: str = ""
+    # AOT warmup: compile the CostLedger's recorded (op, shape-bucket)
+    # corpus through the persistent compile cache BEFORE serving, so
+    # the first query stops paying the XLA compile storm (util/warmup)
+    warmup_shapes: bool = False
 
 
 class App:
@@ -134,6 +142,14 @@ class App:
         if not cfg.instance_id:
             cfg.instance_id = f"tempo-{cfg.http_port}"
         self.cfg = cfg
+
+        # chaos plane: arm BEFORE any backend/TempoDB exists so the
+        # object-store seam gets its injection wrapper; an explicit
+        # --chaos.rules wins over (and replaces) the TEMPO_CHAOS env
+        from ..chaos import plane as chaos_plane
+
+        if cfg.chaos_rules:
+            chaos_plane.configure_spec(cfg.chaos_rules)
 
         def has(role: str) -> bool:
             return cfg.target in ("all", role)
@@ -294,6 +310,7 @@ class App:
         from .usagestats import UsageReporter
 
         self.usage = UsageReporter(self.db.backend, cfg.target)
+        self.warmup_report: dict | None = None
         self._started = False
         self.otlp_grpc = None
         self.opencensus = None
@@ -385,6 +402,13 @@ class App:
             except ValueError:
                 slo_interval = 15.0  # a typo'd env must not abort startup
             self.slo.start(interval_s=slo_interval)
+        if self.cfg.warmup_shapes:
+            # pre-serve AOT warmup: compile the ledger's recorded
+            # (op, bucket) corpus (through the persistent compile
+            # cache when enabled) before the first query arrives
+            from ..util.warmup import run_warmup
+
+            self.warmup_report = run_warmup()
         self.db.enable_polling()
         self._started = True
 
@@ -621,6 +645,23 @@ def _make_handler(app: App):
 
                     return self._send(
                         200, json.dumps(COST.status_snapshot(), indent=2))
+                if u.path == "/status/chaos":
+                    # chaos + resilience surface: active fault rules
+                    # with call/fire counts, the recent injection log,
+                    # circuit-breaker legs, retry-budget + hedge
+                    # counters, and the warmup report when --warmup.
+                    # shapes ran
+                    from ..chaos import plane as chaos_plane
+                    from ..util.breaker import breakers_snapshot
+                    from ..util.kerneltel import TEL
+
+                    out = chaos_plane.status()
+                    out["breakers"] = breakers_snapshot()
+                    out["retries"] = TEL.retry_stats()
+                    out["hedging"] = TEL.hedge_stats()
+                    if app.warmup_report is not None:
+                        out["warmup"] = app.warmup_report
+                    return self._send(200, json.dumps(out, indent=2))
                 if u.path == "/status/slo":
                     # the SLO plane's verdict surface: every objective
                     # with its multi-window burn rates (util/slo),
@@ -1283,6 +1324,13 @@ def main(argv=None):
                     help="measured-crossover CostLedger artifact (default: "
                          "TEMPO_COST_LEDGER env, else "
                          "<storage.path>/cost_ledger.json)")
+    ap.add_argument("--chaos.rules", dest="chaos_rules", default=None,
+                    help="fault-injection rules: inline JSON or a rules "
+                         "file path (default: TEMPO_CHAOS env, else off)")
+    ap.add_argument("--warmup.shapes", dest="warmup_shapes",
+                    action="store_const", const=True, default=None,
+                    help="AOT-compile the CostLedger's recorded (op, "
+                         "shape-bucket) corpus before serving")
     ap.add_argument("--querier.search-external-endpoints", dest="search_external",
                     default=None,
                     help="comma-separated serverless search handler URLs")
@@ -1316,6 +1364,8 @@ def main(argv=None):
         "self_tracing_tenant": args.self_tracing_tenant,
         "compile_cache_dir": args.compile_cache_dir,
         "cost_ledger_path": args.cost_ledger_path,
+        "chaos_rules": args.chaos_rules,
+        "warmup_shapes": args.warmup_shapes,
         "search_external_endpoints": args.search_external,
         "kafka_brokers": args.kafka_brokers,
         "kafka_topic": args.kafka_topic,

@@ -47,6 +47,7 @@ from ..db.search import (
     response_from_dict,
 )
 from ..ring.ring import InMemoryKV, InstanceDesc, InstanceState, Ring, deterministic_tokens
+from ..util.breaker import CircuitOpen, RetryBudget, get_breaker
 from ..wire.combine import combine_traces, sort_trace
 from .overrides import QueryAdmission
 from .querier import Querier
@@ -56,6 +57,15 @@ DEFAULT_CONCURRENT_JOBS = 50
 MAX_RETRIES = 3
 MAX_BLOCKS_PER_BATCH = 64
 FIND_SHARD_BLOCKS = 16  # candidate blocks per ID-shard find job
+
+# job kinds that scan backend blocks: the legs the backend circuit
+# breaker guards (a shed search shard degrades coverage via the
+# existing failed-shard tolerance; find/metrics fail fast -- their
+# shard-loss rules forbid silent partials -- instead of hammering a
+# dying backend)
+BACKEND_KINDS = frozenset(
+    {"search_blocks", "search_block_shard", "find_blocks",
+     "metrics_query_range"})
 
 AFFINITY_RING_KEY = "querier-affinity"
 AFFINITY_STEAL_MS = 75.0  # default anti-starvation steal timeout
@@ -327,6 +337,17 @@ class _Job:
     affinity_key: str | None = None
     queued_at: float = 0.0
     placement: str = ""
+    # resilience plane: the query-wide retry budget this job draws
+    # from, the caller's wall-clock deadline (rides the wire job so
+    # remote workers skip work nobody can use), and hedge attribution
+    # (exec_seq counts dispatches; the leg that lands the result says
+    # whether the hedge twin won, lost, or never even started)
+    retry_budget: object = None
+    deadline_unix: float = 0.0
+    exec_seq: int = 0
+    hedge_started: bool = False
+    hedge_outcome: str = ""
+    lease_redispatched: bool = False  # re-enqueued by lease expiry
 
     def finish(self) -> None:
         if not self.done.is_set():  # a late hedge twin must not clobber
@@ -411,9 +432,13 @@ class Frontend:
         # overrides-driven, so without overrides there is no gate
         self.qos = QueryAdmission(overrides) if overrides is not None else None
         self._remote_workers: dict[str, float] = {}  # worker id -> last poll
-        # lease id -> ([(tenant, job), ...], expiry); a `multi` wire job
-        # leases its whole merged batch under one id
-        self._leases: dict[str, tuple[list[tuple[str, _Job]], float]] = {}
+        # backend-leg circuit breaker (util/breaker): block-scanning
+        # jobs shed fast onto the shard-degradation path while the
+        # backend is dying, with half-open probes for recovery
+        self.backend_breaker = get_breaker("backend")
+        # lease id -> ([(tenant, job), ...], expiry, [exec_seq, ...]);
+        # a `multi` wire job leases its whole merged batch under one id
+        self._leases: dict[str, tuple] = {}
         self._lease_lock = threading.Lock()
         self.stats_jobs_remote = 0
         self.stats_jobs_local = 0
@@ -438,6 +463,8 @@ class Frontend:
                 continue
             attrs = {"cancelled": j.cancelled, "hedged": j.hedged,
                      "error": j.error is not None}
+            if j.hedge_outcome:
+                attrs["hedge"] = j.hedge_outcome  # win | lose | unneeded
             if j.tries:
                 attrs["tries"] = j.tries
             if j.placement:
@@ -593,10 +620,24 @@ class Frontend:
         from ..util.kerneltel import TEL
         from .selftrace import reset_current_span, set_current_span
 
+        br = self._breaker_for(live[0][1].kind)
+        if br is not None and br.state != "closed":
+            # open/half-open: run the group per job so breaker probe
+            # accounting stays one allow() per call -- a fused batch
+            # would ram N block scans through one half-open probe slot
+            # (and close the breaker off N records from one grant)
+            for t, j in live:
+                self._execute_one(t, j)
+            return
         now_wall = time.time()
+        seqs: dict[int, int] = {}
         for _, j in live:
             if not j.dequeued_wall:
                 j.dequeued_wall = now_wall
+            j.exec_seq += 1
+            seqs[id(j)] = j.exec_seq
+            if j.exec_seq >= 2:
+                j.hedge_started = True
         lead = live[0][1]
         token = (TEL.set_active_trace(lead.trace)
                  if lead.trace is not None else None)
@@ -632,8 +673,13 @@ class Frontend:
                 if isinstance(r, Exception):
                     # per-item failure inside the batch: same retry
                     # policy as single execution, isolated to this job
+                    if br is not None and _retryable(r):
+                        br.record(False)
                     self._fail_job(t, j, r)
                     continue
+                if br is not None:
+                    br.record(True)
+                self._note_result(j, seqs.get(id(j), 1))
                 if not j.done.is_set():
                     j.result = r
                 self.stats_jobs_local += 1
@@ -642,13 +688,66 @@ class Frontend:
             for t, j in live:
                 self._execute_one(t, j)
 
+    def _breaker_for(self, kind: str):
+        """The backend-leg breaker for block-scanning kinds (lazy: a
+        partially-built Frontend -- tests use __new__ -- still gets
+        one on first use)."""
+        if kind not in BACKEND_KINDS:
+            return None
+        br = getattr(self, "backend_breaker", None)
+        if br is None:
+            br = self.backend_breaker = get_breaker("backend")
+        return br
+
+    def _grant_retry(self, job) -> bool:
+        """One more dispatch for a retryable shard failure? The per-
+        query RetryBudget caps TOTAL retries across all of a query's
+        jobs, so a dying backend can't amplify one query into a
+        jobs x MAX_RETRIES storm."""
+        from ..util.kerneltel import TEL
+
+        b = job.retry_budget
+        if b is None or b.take():
+            TEL.record_retry("retry")
+            return True
+        TEL.record_retry("budget_exhausted")
+        return False
+
+    def _note_result(self, job, seq: int) -> None:
+        """Hedge attribution, called by the execution leg that produced
+        a result BEFORE publishing it: on the first completion of a
+        hedged job, say whether the twin won (seq >= 2), lost (the
+        original won after the twin started), or was unneeded (the
+        original won before the twin ever ran). A job that also
+        RETRIED is left unattributed: retry re-dispatches share the
+        exec_seq counter, so a retry completion would masquerade as a
+        hedge win exactly in the fault regimes hedging is watched in."""
+        if not job.hedged or job.done.is_set() or job.hedge_outcome:
+            return
+        if job.tries or job.lease_redispatched:
+            # retries and lease-expiry redispatches share exec_seq, so
+            # their completions would masquerade as hedge wins exactly
+            # in the fault regimes this metric is watched in
+            return
+        from ..util.kerneltel import TEL
+
+        if seq >= 2:
+            outcome = "win"
+        elif job.exec_seq >= 2 or job.hedge_started:
+            outcome = "lose"
+        else:
+            outcome = "unneeded"
+        job.hedge_outcome = outcome
+        TEL.record_hedge(outcome)
+
     def _fail_job(self, tenant: str, job, e: Exception) -> None:
         """Apply the single-job failure policy (transient -> re-enqueue
-        up to MAX_RETRIES, else error) to one job."""
+        up to MAX_RETRIES within the query's retry budget, else error)
+        to one job."""
         if job.done.is_set():
             return
         job.tries += 1
-        if _retryable(e) and job.tries < MAX_RETRIES:
+        if _retryable(e) and job.tries < MAX_RETRIES and self._grant_retry(job):
             try:
                 job.affinity_key = None  # retry dodges the failing owner
                 self.queue.enqueue(tenant, job)
@@ -666,9 +765,32 @@ class Frontend:
         if job.cancelled or job.done.is_set():
             job.finish()
             return
+        if job.deadline_unix and time.time() > job.deadline_unix:
+            # the caller's deadline already passed: don't burn an
+            # engine pass nobody can use. Stamp the SAME TimeoutError
+            # the dispatch deadline does -- a silently-cancelled shard
+            # would let find/metrics return partial results their
+            # shard-loss rule forbids
+            job.error = TimeoutError("query deadline exceeded before "
+                                     "execution")
+            job.cancelled = True
+            job.finish()
+            return
         from ..util.kerneltel import TEL
         from .selftrace import reset_current_span, set_current_span
 
+        br = self._breaker_for(job.kind)
+        if br is not None and not br.allow():
+            # shed fast onto the shard-degradation path: search merges
+            # what the healthy shards return; CircuitOpen is not
+            # retryable, so the job never re-enters the open breaker
+            job.error = CircuitOpen("backend circuit breaker open")
+            job.finish()
+            return
+        job.exec_seq += 1
+        seq = job.exec_seq
+        if seq >= 2:
+            job.hedge_started = True
         if not job.dequeued_wall:
             job.dequeued_wall = time.time()
         token = (TEL.set_active_trace(job.trace)
@@ -678,6 +800,9 @@ class Frontend:
         ptoken = TEL.set_affinity_placement(getattr(job, "placement", ""))
         try:
             res = job.fn(*job.args)
+            if br is not None:
+                br.record(True)
+            self._note_result(job, seq)
             if not job.done.is_set():
                 job.result = res
             self.stats_jobs_local += 1
@@ -686,6 +811,11 @@ class Frontend:
             # only, modules/frontend/retry.go); a parse error or bad
             # argument fails identically every try. A hedge twin's
             # failure must never clobber its sibling's success.
+            # Breaker food is TRANSIENT IO failures only: a device
+            # fault / bad query failing a block job says nothing about
+            # backend health and must not open the backend leg.
+            if br is not None and _retryable(e):
+                br.record(False)
             self._fail_job(tenant, job, e)
             return
         finally:
@@ -776,19 +906,45 @@ class Frontend:
             for t, j in [(tenant, job)] + list(extras):
                 if j.cancelled or j.done.is_set():
                     j.finish()
+                elif (j.kind in BACKEND_KINDS
+                      and not self._breaker_for(j.kind).allow()):
+                    # remote pulls shed at the same breaker as local
+                    # workers: an open backend breaker means NOBODY
+                    # scans blocks, not just this process
+                    j.error = CircuitOpen("backend circuit breaker open")
+                    j.finish()
                 else:
                     pairs.append((t, j))
             if not pairs:
                 continue
             self._note_placements([j for _, j in pairs])
             now_wall = time.time()
+            seqs = []
             for _, j in pairs:
                 if not j.dequeued_wall:
                     j.dequeued_wall = now_wall
+                j.exec_seq += 1
+                seqs.append(j.exec_seq)
+                if j.exec_seq >= 2:
+                    j.hedge_started = True
             jid = uuid.uuid4().hex
             with self._lease_lock:
-                self._leases[jid] = (pairs, time.monotonic() + self.lease_s)
+                self._leases[jid] = (pairs, time.monotonic() + self.lease_s,
+                                     seqs)
             placement = pairs[0][1].placement
+            # deadline propagation, gRPC-style RELATIVE budget: the
+            # remaining seconds at dispatch ride the wire job, so the
+            # worker's skip decision never depends on clock agreement
+            # between the two hosts (an absolute unix deadline would
+            # silently shrink -- or zero -- under NTP skew). A merged
+            # multi job spans SEVERAL queries, so it carries the MAX:
+            # the worker may only skip when every window-mate's caller
+            # has given up -- min() would let one expired straggler
+            # poison fresh queries merged into its window
+            deadlines = [j.deadline_unix for _, j in pairs
+                         if j.deadline_unix]
+            deadline_in_s = (round(max(deadlines) - time.time(), 3)
+                             if deadlines else None)
             # self-trace propagation: the remote leg records its spans
             # against (trace_id, parent=this job's span) and ships them
             # back with the result -- one timeline tree, wherever the
@@ -801,16 +957,19 @@ class Frontend:
                 t0, j0 = pairs[0]
                 return {"id": jid, "tenant": t0, "kind": j0.kind,
                         "payload": j0.payload, "placement": placement,
+                        "deadline_in_s": deadline_in_s,
                         "trace": trace_ctx}
             return {"id": jid, "tenant": pairs[0][0], "kind": "multi",
                     "placement": placement, "trace": trace_ctx,
+                    "deadline_in_s": deadline_in_s,
                     "payload": {"kind": pairs[0][1].kind,
                                 "tenants": [t for t, _ in pairs],
                                 "jobs": [j.payload for _, j in pairs]}}
 
     def complete_job(self, jid: str, ok: bool, result: dict | None = None,
                      error: str = "", retryable: bool = False,
-                     self_spans: list | None = None) -> None:
+                     self_spans: list | None = None,
+                     skipped: bool = False) -> None:
         """Remote worker posts a job result (or a `multi` result list,
         demuxed per leased job). Unknown/expired lease ids are dropped
         (the job was re-dispatched or timed out). self_spans: the remote
@@ -820,21 +979,29 @@ class Frontend:
             lease = self._leases.pop(jid, None)
         if lease is None:
             return
-        pairs, _ = lease
+        pairs, _, lease_seqs = lease
         if self_spans:
             lead = pairs[0][1]
             if lead.trace is not None:
                 lead.trace.add_remote_spans(self_spans)
+        # whether this result actually EXERCISED the backend: worker-
+        # side deadline skips and (below) undecodable/short results are
+        # client/worker faults -- feeding them to the backend breaker
+        # would let a backlogged queue or a buggy worker trip it and
+        # shed block scans while the object store is perfectly healthy
+        backend_exercised = not skipped
         results: list = [result or {}]
         if ok and len(pairs) > 1:
             results = (result or {}).get("results") or []
             if len(results) != len(pairs):
                 ok, retryable = False, True
                 error = error or "multi result arity mismatch"
+                backend_exercised = False
         for i, (tenant, job) in enumerate(pairs):
             if job.done.is_set():
                 continue
             job_ok, job_retryable, job_error = ok, retryable, error
+            job_exercised = backend_exercised
             # results may be short (worker posted ok=False, or a multi
             # arity mismatch): never index past it -- every leased job
             # must still reach the retry/fail policy below, not hang
@@ -851,17 +1018,29 @@ class Frontend:
                 job_error = str(res_i["__job_error__"])
             elif job_ok:
                 try:
-                    job.result = decode_job_result(job.kind, res_i)
+                    decoded = decode_job_result(job.kind, res_i)
                 except Exception as e:  # malformed result from a buggy
                     # worker: treat as a retryable failure so the request
                     # doesn't hang with its lease already popped
                     job_ok, job_retryable = False, True
                     job_error = f"undecodable result: {e}"
+                    job_exercised = False  # worker bug, not a backend one
                 else:
+                    self._note_result(
+                        job, lease_seqs[i] if i < len(lease_seqs) else 1)
+                    job.result = decoded
                     self.stats_jobs_remote += 1
+            # breaker food is results that exercised the backend AND
+            # (on failure) look transient -- deterministic failures
+            # (bad query, missing object) say nothing about its health
+            if job_exercised and (job_ok or job_retryable):
+                br = self._breaker_for(job.kind)
+                if br is not None:
+                    br.record(job_ok)
             if not job_ok:
                 job.tries += 1
-                if job_retryable and job.tries < MAX_RETRIES:
+                if (job_retryable and job.tries < MAX_RETRIES
+                        and self._grant_retry(job)):
                     try:
                         # demote to placement-free: a sick-but-alive
                         # owner polls fastest right after failing and
@@ -879,28 +1058,48 @@ class Frontend:
         now = time.monotonic()
         expired = []
         with self._lease_lock:
-            for jid, (pairs, exp) in list(self._leases.items()):
+            for jid, (pairs, exp, _seqs) in list(self._leases.items()):
                 if exp < now:
                     expired.extend(pairs)
                     del self._leases[jid]
         for tenant, job in expired:
             if not (job.done.is_set() or job.cancelled):
                 try:
+                    job.lease_redispatched = True
                     self.queue.enqueue(tenant, job)
                 except TooManyRequests:
                     job.error = TimeoutError("job lease expired, queue full")
                     job.finish()
 
     # ---------------------------------------------------------- dispatch
+    @staticmethod
+    def _retry_budget_total(n_jobs: int) -> int:
+        """Per-query retry cap: enough to absorb transient faults on a
+        few shards, sublinear in fan-out so a dying backend sees
+        additive (not multiplicative) retry load. TEMPO_RETRY_BUDGET
+        overrides."""
+        try:
+            env = int(os.environ.get("TEMPO_RETRY_BUDGET", "") or 0)
+        except ValueError:
+            env = 0
+        return env if env > 0 else max(4, n_jobs // 4)
+
     def _run_jobs(self, tenant: str, jobs: list[_Job], early_exit=None,
                   timeout: float = 60.0) -> None:
         """Enqueue with bounded in-flight jobs, reap completions in ANY
         order (one slow shard no longer stalls dispatch), hedge jobs
         stuck past hedge_after_s, and cancel everything at the deadline
-        so late workers see job.cancelled and skip."""
+        so late workers see job.cancelled and skip. Every job shares
+        one RetryBudget (total retries per QUERY, not per job) and
+        carries the wall-clock deadline so remote workers skip jobs
+        whose caller already gave up."""
         cv = threading.Condition()
+        budget = RetryBudget(self._retry_budget_total(len(jobs)))
+        deadline_unix = time.time() + timeout
         for j in jobs:
             j.batch_cv = cv
+            j.retry_budget = budget
+            j.deadline_unix = deadline_unix
         deadline = time.monotonic() + timeout
         pending = list(jobs)
         inflight: list[_Job] = []

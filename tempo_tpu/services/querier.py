@@ -29,6 +29,45 @@ class QuerierStats:
     external_failures: int = 0  # external legs that fell back to local
 
 
+class _BreakerLeg:
+    """Circuit-breaker proxy over one remote ingester client: every
+    method call asks the breaker first (CircuitOpen when shedding --
+    the caller's existing failed-leg tolerance absorbs it) and records
+    its outcome after."""
+
+    def __init__(self, inner, br):
+        self._inner = inner
+        self._br = br
+
+    def __getattr__(self, name):
+        fn = getattr(self._inner, name)
+        if not callable(fn):
+            return fn
+        br = self._br
+
+        def call(*args, **kwargs):
+            from ..util.breaker import CircuitOpen
+
+            if not br.allow():
+                raise CircuitOpen("ingester leg breaker open")
+            try:
+                out = fn(*args, **kwargs)
+            except Exception as e:
+                # breaker food is TRANSIENT failures only (same filter
+                # as the frontend's backend leg): a deterministic 400/
+                # 429 PushError from a healthy ingester must not open
+                # the leg for every other tenant
+                from .frontend import _retryable
+
+                if _retryable(e):
+                    br.record(False)
+                raise
+            br.record(True)
+            return out
+
+        return call
+
+
 class Querier:
     def __init__(self, db: TempoDB, ring: Ring | None, client_for, workers: int = 8,
                  external_endpoints: list[str] | None = None,
@@ -63,14 +102,30 @@ class Querier:
         return self.pool.submit(ctx.run, fn, *args)
 
     def _ingester_clients(self):
+        """Resolved clients for every healthy ring instance. Remote
+        (HTTP) legs come back wrapped in a per-addr circuit breaker:
+        a leg that keeps failing is shed fast (degrading that leg's
+        coverage, exactly like the existing failed-leg tolerance)
+        instead of paying its timeout on every query, with half-open
+        probes re-admitting it when it recovers. In-process clients
+        cannot partition and stay bare."""
         if self.ring is None:
             return []
+        from ..transport.client import HTTPIngesterClient
+
         out = []
         for d in self.ring.healthy_instances():
             try:
-                out.append(self.client_for(d.addr))
+                c = self.client_for(d.addr)
             except KeyError:
                 continue  # unresolvable addr degrades that leg, not the query
+            if isinstance(c, HTTPIngesterClient):
+                # type check, not addr check: the single binary registers
+                # its in-process ingester under its http advertise addr
+                from ..util.breaker import get_breaker
+
+                c = _BreakerLeg(c, get_breaker(f"ingester:{d.addr}"))
+            out.append(c)
         return out
 
     # ----------------------------------------------------------- trace by id

@@ -157,7 +157,17 @@ class QuerierWorker:
     def stop(self) -> None:
         self._stop.set()
 
+    # frontend-down backoff: exponential with full jitter, capped -- a
+    # restarting frontend must not be thundering-herded by a fleet of
+    # workers all polling again on the same fixed 1 s tick
+    BACKOFF_BASE_S = 0.5
+    BACKOFF_CAP_S = 5.0
+
     def _post(self, addr: str, path: str, payload: dict, timeout: float) -> dict | None:
+        from ..chaos import plane as chaos_plane
+
+        if chaos_plane.tap("rpc.worker", key=path) is chaos_plane.DROP:
+            raise OSError("chaos: worker rpc black-holed")
         headers = {"Content-Type": "application/json"}
         if self.token:
             headers["X-Tempo-Internal-Token"] = self.token
@@ -169,6 +179,9 @@ class QuerierWorker:
             return json.loads(body) if body else None
 
     def _loop(self, addr: str) -> None:
+        import random
+
+        backoff = self.BACKOFF_BASE_S
         while not self._stop.is_set():
             try:
                 job = self._post(addr, "/internal/jobs/poll",
@@ -176,9 +189,34 @@ class QuerierWorker:
                                   "worker_id": self.worker_id},
                                  timeout=self.poll_wait_s + 10.0)
             except (urllib.error.URLError, ConnectionError, OSError):
-                self._stop.wait(1.0)  # frontend down: back off, retry
+                # full jitter: sleep U(0, backoff), then double the cap
+                self._stop.wait(random.random() * backoff)
+                backoff = min(backoff * 2, self.BACKOFF_CAP_S)
                 continue
+            backoff = self.BACKOFF_BASE_S  # frontend answered: reset
             if not job or not job.get("id"):
+                continue
+            # deadline propagation: the frontend stamps the caller's
+            # REMAINING time budget (relative seconds, so worker and
+            # frontend clocks never need to agree) on the wire job --
+            # a non-positive budget means the caller already gave up
+            # and dispatch cancelled the job; scanning would burn
+            # device time nobody can use
+            dl = job.get("deadline_in_s")
+            if dl is not None and float(dl) <= 0.0:
+                TEL.record_routing("worker_job", "skipped",
+                                   "deadline_exceeded")
+                try:
+                    # skipped=True: the job never exercised the backend
+                    # -- it must not feed the frontend's breaker stats
+                    self._post(addr, "/internal/jobs/result",
+                               {"id": job["id"], "ok": False,
+                                "error": "deadline exceeded before "
+                                         "execution", "retryable": False,
+                                "skipped": True},
+                               timeout=10.0)
+                except (urllib.error.URLError, ConnectionError, OSError):
+                    pass
                 continue
             out = {"id": job["id"]}
             # the frontend's dequeue placement (own/steal/unowned) rides

@@ -49,7 +49,18 @@ class HTTPIngesterClient:
         self.timeout = timeout
         self.token = token
 
+    @staticmethod
+    def _chaos_tap(path: str) -> None:
+        """RPC chaos seam: injected latency/error/black-hole on every
+        ingester-client call (drop surfaces as a transport error -- a
+        black-holed request IS a timeout to its caller)."""
+        from ..chaos import plane as chaos_plane
+
+        if chaos_plane.tap("rpc.client", key=path) is chaos_plane.DROP:
+            raise TransportError(0, "chaos: request black-holed")
+
     def _post(self, path: str, payload: dict) -> dict:
+        self._chaos_tap(path)
         headers = {"Content-Type": "application/json"}
         if self.token:
             headers["X-Tempo-Internal-Token"] = self.token
@@ -68,6 +79,7 @@ class HTTPIngesterClient:
     def _post_frames(self, path: str, body: bytes) -> None:
         from . import frames
 
+        self._chaos_tap(path)
         headers = {"Content-Type": frames.CONTENT_TYPE}
         if self.token:
             headers["X-Tempo-Internal-Token"] = self.token
@@ -123,6 +135,7 @@ class HTTPIngesterClient:
         otlp-proto trace (Accept negotiation keeps old servers working)."""
         from ..wire import otlp_pb
 
+        self._chaos_tap("/internal/find")
         headers = {"Content-Type": "application/json",
                    "Accept": "application/x-protobuf"}
         if self.token:
@@ -211,6 +224,24 @@ def handle_internal(app, path: str, payload: dict, raw_body: bytes = b"",
         tenant, traces = frames.decode_traces(raw_body)
         app.generator.push(tenant, traces)
         return 200, {}
+    if path == "/internal/chaos":
+        # runtime fault-rule control (tempo-tpu-cli chaos inject):
+        # {"rules": [...], "seed": n} swaps the plane, {"clear": true}
+        # tears it down. Token-gated like every /internal route. Note:
+        # the backend seam's wrapper interposes at TempoDB build time,
+        # so rules injected into a process that started UNARMED reach
+        # the rpc/device/wal/gossip seams only.
+        from ..chaos import plane as chaos_plane
+
+        try:
+            if payload.get("clear"):
+                chaos_plane.clear()
+            elif "rules" in payload or "seed" in payload:
+                rules, seed = chaos_plane.parse_rules(payload)
+                chaos_plane.configure(rules, seed=seed)
+        except (ValueError, TypeError) as e:
+            return 400, {"error": f"bad chaos rules: {e}"}
+        return 200, chaos_plane.status()
     if path == "/internal/jobs/poll":
         # remote querier pull (services/worker.py) against this frontend
         if app.frontend is None:
@@ -226,6 +257,7 @@ def handle_internal(app, path: str, payload: dict, raw_body: bytes = b"",
             result=payload.get("result"), error=payload.get("error", ""),
             retryable=bool(payload.get("retryable")),
             self_spans=payload.get("self_spans"),
+            skipped=bool(payload.get("skipped")),
         )
         return 200, {}
     if path == "/internal/genpush":

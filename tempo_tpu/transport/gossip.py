@@ -140,7 +140,12 @@ class GossipKV:
             return {"state": state, "peers": {**self._peers, self.addr: now}}
 
     def _merge_and_snapshot(self, theirs: dict) -> dict:
-        self._merge(theirs)
+        # chaos seam (inbound): a dropped recv answers with local state
+        # but ignores the peer's -- a one-directional partition
+        from ..chaos import plane as chaos_plane
+
+        if chaos_plane.tap("gossip.recv", key=self.addr) is not chaos_plane.DROP:
+            self._merge(theirs)
         return self._snapshot()
 
     def _merge(self, theirs: dict) -> None:
@@ -171,6 +176,15 @@ class GossipKV:
             if not peers:
                 return False
             peer = random.choice(peers)
+        # chaos seam (outbound): drop = this sync never leaves the host
+        # (partition toward `peer`); error/latency simulate a flaky link
+        from ..chaos import plane as chaos_plane
+
+        try:
+            if chaos_plane.tap("gossip.sync", key=peer) is chaos_plane.DROP:
+                return False
+        except (OSError, ConnectionError):
+            return False
         host, _, port = peer.partition(":")
         try:
             with socket.create_connection((host, int(port)), timeout=3.0) as s:

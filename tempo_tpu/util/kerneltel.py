@@ -42,6 +42,7 @@ import threading
 import time
 from collections import OrderedDict, deque
 
+from ..chaos import plane as _chaos
 from .metrics import Counter, Gauge, Histogram
 
 # device kernels run sub-ms to ~seconds: a finer low end than the
@@ -262,6 +263,21 @@ class KernelTelemetry:
         self.query_outcomes = Counter(
             "tempo_query_outcomes_total",
             help="frontend queries by op and outcome (ok/error/shed)")
+        # resilience plane (PR 14): hedge outcomes (win = the hedge
+        # twin finished first; lose = the original won after the twin
+        # started; unneeded = the original won before the twin ran),
+        # and per-query retry-budget consumption (retry = a shard
+        # retry was granted; budget_exhausted = a retryable failure
+        # was refused because the query's budget ran dry)
+        self.hedge_total = Counter(
+            "tempo_hedge_total",
+            help="frontend hedged jobs by outcome (win/lose/unneeded)")
+        self.retry_total = Counter(
+            "tempo_retry_total",
+            help="frontend shard retries by outcome "
+                 "(retry/budget_exhausted)")
+        self._hedges: dict[str, int] = {}
+        self._retries: dict[str, int] = {}
         # every instrument exported through /metrics -- ONE list shared
         # by metrics_lines() and help_entries() so an instrument can't
         # ship samples without its HELP (or vice versa)
@@ -283,7 +299,7 @@ class KernelTelemetry:
             self.affinity_jobs, self.qos_shed, self.staged_placement,
             self.livestage_rows, self.livestage_delta_bytes,
             self.livestage_lag, self.selftrace_spans, self.query_cost,
-            self.query_outcomes,
+            self.query_outcomes, self.hedge_total, self.retry_total,
         )
         # full compile-key signatures, LRU-bounded (SEEN_SIGNATURES_MAX)
         self._seen: OrderedDict = OrderedDict()
@@ -323,6 +339,14 @@ class KernelTelemetry:
         launch (new or cached) also ticks the costmodel's launch
         counter, which turns static per-program comm bytes into the
         tempo_mesh_comm_bytes_total series."""
+        if _chaos.is_active():
+            # chaos launch shim (ops/device.launch_tap): deliberately
+            # OUTSIDE the swallow-everything block below -- an injected
+            # compile failure / device OOM must reach the caller like a
+            # real one would
+            from ..ops.device import launch_tap
+
+            launch_tap(op)
         blab = str(bucket)
         try:
             with self._lock:
@@ -356,6 +380,16 @@ class KernelTelemetry:
                     COST.enqueue(op, blab, cost())
             except Exception:
                 pass  # cost capture must not flip the compile verdict
+            if new:
+                try:
+                    # AOT warmup corpus: every first compile of an (op,
+                    # bucket) pair is remembered in the CostLedger so a
+                    # restarted process can pre-compile it (--warmup.shapes)
+                    from .warmup import note_compile
+
+                    note_compile(op, blab)
+                except Exception:
+                    pass
             return new
         except Exception:
             return False
@@ -790,6 +824,33 @@ class KernelTelemetry:
         except Exception:
             pass
 
+    # --------------------------------------------------------- hedging
+    def record_hedge(self, outcome: str) -> None:
+        """One hedged job resolved: win / lose / unneeded."""
+        try:
+            self.hedge_total.inc(labels=f'outcome="{outcome}"')
+            with self._lock:
+                self._hedges[outcome] = self._hedges.get(outcome, 0) + 1
+        except Exception:
+            pass
+
+    def record_retry(self, outcome: str) -> None:
+        """One retry decision: retry (granted) / budget_exhausted."""
+        try:
+            self.retry_total.inc(labels=f'outcome="{outcome}"')
+            with self._lock:
+                self._retries[outcome] = self._retries.get(outcome, 0) + 1
+        except Exception:
+            pass
+
+    def hedge_stats(self) -> dict:
+        with self._lock:
+            return dict(self._hedges)
+
+    def retry_stats(self) -> dict:
+        with self._lock:
+            return dict(self._retries)
+
     # --------------------------------------------------------- query log
     def record_query(self, op: str, seconds: float, trace_id: str = "",
                      detail: str = "", outcome: str = "ok") -> None:
@@ -952,6 +1013,8 @@ class KernelTelemetry:
                 "cache_misses": int(self.staged_cache_misses.get()),
             },
             "routing": routing,
+            "hedging": self.hedge_stats(),
+            "retries": self.retry_stats(),
             "affinity": self.affinity_stats(),
             "query_costs": self.query_cost_stats(),
             "selftrace": self.selftrace_stats(),
@@ -977,6 +1040,18 @@ class KernelTelemetry:
             out += COST.metrics_lines()
         except Exception:
             pass
+        # chaos + circuit-breaker planes ride the same exposition
+        # chokepoint so /metrics can't ship one plane without the other
+        try:
+            out += _chaos.metrics_lines()
+        except Exception:
+            pass
+        try:
+            from . import breaker as _breaker
+
+            out += _breaker.metrics_lines()
+        except Exception:
+            pass
         return out
 
     def help_entries(self) -> dict[str, str]:
@@ -989,6 +1064,16 @@ class KernelTelemetry:
             from .costmodel import COST
 
             out.update(COST.help_entries())
+        except Exception:
+            pass
+        try:
+            out.update(_chaos.help_entries())
+        except Exception:
+            pass
+        try:
+            from . import breaker as _breaker
+
+            out.update(_breaker.help_entries())
         except Exception:
             pass
         return out

@@ -1,0 +1,44 @@
+"""Seeded circuit-breaker / retry-budget concurrency violations (the
+PR-14 resilience shapes). Every EXPECT marker is asserted by
+tests/test_analysis.py: the breaker registry and the process-wide
+retry-budget counter are exactly the kind of shared state the live
+tree (util/breaker.py) must keep lock-guarded."""
+
+import threading
+
+_breakers = {}
+_budget = {"total": 8, "used": 0}
+_registry_lock = threading.Lock()
+_state_lock = threading.Lock()
+
+
+def get_breaker_nolock(name):
+    br = _breakers.get(name)
+    if br is None:
+        _breakers[name] = br = object()  # EXPECT: global-mutation-unlocked
+    return br
+
+
+def take_budget_nolock():
+    _budget["used"] = _budget["used"] + 1  # EXPECT: global-mutation-unlocked
+    return _budget["used"] <= _budget["total"]
+
+
+def trip(name):
+    # establishes the module-wide order: registry OUTER, state INNER
+    with _registry_lock:
+        with _state_lock:
+            _breakers[name] = "open"
+
+
+def half_open(name):
+    with _state_lock:
+        with _registry_lock:  # EXPECT: lock-order
+            _breakers[name] = "half_open"
+
+
+def probe_quota():
+    _state_lock.acquire()  # EXPECT: lock-bare-acquire
+    n = _budget["total"]
+    _state_lock.release()
+    return n

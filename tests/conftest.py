@@ -29,5 +29,21 @@ def rng():
     return random.Random(1234)
 
 
+@pytest.fixture(autouse=True)
+def _isolate_resilience_plane():
+    """The chaos plane and the circuit-breaker registry are process-wide
+    singletons (by design: one state for /status, /metrics and every
+    seam). Between tests they must not leak -- a breaker opened by one
+    test's injected failures would shed another test's shard jobs."""
+    from tempo_tpu.chaos import plane
+    from tempo_tpu.util import breaker
+
+    plane.reset_for_tests()
+    breaker.reset_for_tests()
+    yield
+    plane.reset_for_tests()
+    breaker.reset_for_tests()
+
+
 def pytest_configure(config):
     config.addinivalue_line("markers", "slow: multi-process / long-running e2e tests")
