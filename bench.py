@@ -976,6 +976,23 @@ def bench_search_concurrent(tmp: str) -> None:
             lats_tr.extend(ex.map(one_traced, range(Q)))
     tel["selftrace_overhead_ratio"] = round(
         float(np.median(lats_tr)) / max(float(np.median(lats)), 1e-9), 4)
+
+    # always-on profiler overhead on the same warm batched shape: the
+    # background sampler is ~19 Hz of raw stack walks, so this ratio
+    # must stay under the 1.02x gate (profiling off = the `lats` legs
+    # above, which never started the sampler)
+    from tempo_tpu.util.profiler import PROF
+
+    PROF.start(hz=19.0)
+    try:
+        lats_prof: list[float] = []
+        for _ in range(iters):
+            with ThreadPoolExecutor(Q) as ex:
+                lats_prof.extend(ex.map(one, range(Q)))
+    finally:
+        PROF.stop()
+    tel["profile_overhead_ratio"] = round(
+        float(np.median(lats_prof)) / max(float(np.median(lats)), 1e-9), 4)
     _emit("search_concurrent_p50_ms", float(np.median(lats)) * 1e3, "ms",
           tel=tel)
     db.close()

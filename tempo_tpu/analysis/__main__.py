@@ -72,8 +72,11 @@ def main(argv: list[str] | None = None) -> int:
         try:
             apply_baseline(report, load_baseline(Path(args.baseline)))
         except (OSError, ValueError, KeyError, TypeError) as e:
-            print(f"error: cannot read baseline {args.baseline}: {e}",
-                  file=sys.stderr)
+            # structured shim (util/log is stdlib-only, like this CLI)
+            from ..util.log import get_logger
+
+            get_logger("analysis").error(
+                "cannot read baseline %s: %s", args.baseline, e)
             return 3
 
     wall_ms = (time.perf_counter() - t0) * 1e3

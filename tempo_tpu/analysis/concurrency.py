@@ -54,7 +54,8 @@ MUTABLE_CTORS = {"dict", "list", "set", "defaultdict", "deque",
                  "OrderedDict", "Counter"}
 
 
-_LOCK_TOKENS = {"lock", "rlock", "mutex", "cv", "cond", "condition"}
+_LOCK_TOKENS = {"lock", "rlock", "mutex", "cv", "cond", "condition",
+                "timedlock", "timedrlock"}
 
 
 def _is_lockish(expr: ast.AST) -> bool:
@@ -62,7 +63,9 @@ def _is_lockish(expr: ast.AST) -> bool:
     is 'block', so `with staged_block:` must NOT read as a lock.
     Condition variables count (cv/cond tokens): `with self._cv:` holds
     the condition's underlying lock -- the stream/compaction pipelines'
-    turnstile-and-gate shape."""
+    turnstile-and-gate shape. The profiler's TimedLock/TimedRLock
+    wrappers (util/profiler) count too: a hot lock adopting contention
+    timing must keep counting as a lock to every concurrency rule."""
     d = dotted_name(expr)
     if d is None and isinstance(expr, ast.Call):
         d = dotted_name(expr.func)

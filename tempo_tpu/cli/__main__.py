@@ -252,6 +252,112 @@ def cmd_self_trace(args):
     _render_timeline(tr)
 
 
+def _render_folded(text: str, top_k: int = 25) -> None:
+    """Render a folded (flamegraph-collapsed) profile artifact as a
+    hottest-stacks table: header comments pass through, stack lines
+    aggregate and sort by sample count."""
+    stacks: list[tuple[int, str]] = []
+    total = 0
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("#"):
+            print(line)
+            continue
+        stack, _, count = line.rpartition(" ")
+        try:
+            n = int(count)
+        except ValueError:
+            continue
+        stacks.append((n, stack))
+        total += n
+    stacks.sort(key=lambda s: -s[0])
+    print(f"# {total} samples, {len(stacks)} distinct stacks")
+    for n, stack in stacks[:top_k]:
+        print(f"\n{n:>6} samples ({100.0 * n / max(1, total):5.1f}%)")
+        for frame in stack.split(";")[-12:]:
+            print(f"        {frame}")
+
+
+def cmd_profile(args):
+    """Continuous-profiling tooling against a running instance:
+
+      cpu       burst CPU profile via /debug/profile (text, or raw
+                folded flamegraph-collapsed lines with --folded);
+      device    record a jax.profiler trace via /debug/profile/device
+                and download the zipped artifact;
+      lock      render the lock-contention table from /status/profile;
+      artifact  fetch one profile artifact by id (slow-query captures
+                from the slow-query log, device zips) and render
+                folded text or save binary with -o.
+    """
+    import urllib.error
+    import urllib.request
+
+    base = args.target.rstrip("/")
+    headers = {}
+    if getattr(args, "internal_token", ""):
+        headers["X-Tempo-Internal-Token"] = args.internal_token
+
+    def fetch(path: str, timeout: float) -> bytes:
+        req = urllib.request.Request(base + path, headers=headers)
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as r:
+                return r.read()
+        except urllib.error.HTTPError as e:
+            print(f"{base}{path}: HTTP {e.code}: "
+                  f"{e.read().decode(errors='replace')[:300]}",
+                  file=sys.stderr)
+            sys.exit(1)
+
+    if args.profile_cmd == "cpu":
+        fmt = "folded" if args.folded else "text"
+        data = fetch(f"/debug/profile?seconds={args.seconds}"
+                     f"&hz={args.hz}&format={fmt}",
+                     timeout=args.seconds + 30.0)
+        sys.stdout.write(data.decode(errors="replace"))
+        return
+    if args.profile_cmd == "device":
+        out = json.loads(fetch(
+            f"/debug/profile/device?seconds={args.seconds}",
+            timeout=args.seconds + 60.0))
+        aid = out["artifact_id"]
+        data = fetch(f"/debug/profile/artifact/{aid}", timeout=60.0)
+        path = args.output or aid
+        with open(path, "wb") as f:
+            f.write(data)
+        print(f"device profile {aid}: {out.get('files', '?')} trace "
+              f"file(s), {len(data)} bytes -> {path}")
+        return
+    if args.profile_cmd == "lock":
+        status = json.loads(fetch("/status/profile", timeout=15.0))
+        locks = status.get("locks", {})
+        if not locks:
+            print("no timed locks armed (start the server with "
+                  "TEMPO_LOCK_PROFILE=1)")
+            return
+        print(f"{'lock':24} {'acquisitions':>12} {'contended':>10} "
+              f"{'wait_sum_s':>12} {'wait_max_s':>12}")
+        for name, row in locks.items():
+            print(f"{name:24} {row['acquisitions']:>12} "
+                  f"{row['contended']:>10} {row['wait_sum_s']:>12.6f} "
+                  f"{row['wait_max_s']:>12.6f}")
+        return
+    # artifact: fetch + render (or save)
+    data = fetch(f"/debug/profile/artifact/{args.artifact_id}", timeout=60.0)
+    if args.output:
+        with open(args.output, "wb") as f:
+            f.write(data)
+        print(f"{args.artifact_id}: {len(data)} bytes -> {args.output}")
+        return
+    if args.artifact_id.endswith(".folded"):
+        _render_folded(data.decode(errors="replace"))
+    else:
+        print(f"{args.artifact_id}: {len(data)} bytes (binary; use "
+              f"-o FILE to save)", file=sys.stderr)
+        sys.exit(1)
+
+
 def cmd_calibrate(args):
     """Measure THIS box's host-vs-device crossovers and commit them to
     the CostLedger (util/costledger) so `auto` routing stops guessing:
@@ -298,8 +404,11 @@ def cmd_calibrate(args):
             meta = scratch.write_block(
                 "_calibrate", make_traces(512, seed=1, n_spans=8))
             picked = ("_calibrate", [meta])
-            print("backend empty: calibrating against one synthetic block "
-                  "in a throwaway store", file=sys.stderr)
+            from ..util.log import get_logger
+
+            get_logger("cli").info(
+                "backend empty: calibrating against one synthetic block "
+                "in a throwaway store")
         tenant, metas = picked
         src_db = scratch or db
         blocks = [src_db.open_block(m) for m in metas[:8]]
@@ -450,7 +559,9 @@ def cmd_chaos(args):
                 doc = json.load(f)
             rules, seed = chaos_plane.parse_rules(doc)
         except (OSError, ValueError) as e:
-            print(f"invalid chaos rules: {e}", file=sys.stderr)
+            from ..util.log import get_logger
+
+            get_logger("cli").error("invalid chaos rules: %s", e)
             sys.exit(1)
         from dataclasses import asdict
 
@@ -722,6 +833,49 @@ def main(argv=None):
                    help="self-tracing tenant (default: self)")
     p.add_argument("--timeout", type=float, default=30.0)
     p.set_defaults(fn=cmd_self_trace)
+
+    p = sub.add_parser("profile",
+                       help="continuous-profiling tooling: burst CPU "
+                            "profile, device trace capture, lock-"
+                            "contention table, artifact fetch/render")
+    psub = p.add_subparsers(dest="profile_cmd", required=True)
+    pp = psub.add_parser("cpu", help="burst CPU profile (/debug/profile)")
+    pp.add_argument("--target", required=True,
+                    help="base URL, e.g. http://localhost:3200")
+    pp.add_argument("--seconds", type=float, default=2.0)
+    pp.add_argument("--hz", type=float, default=200.0)
+    pp.add_argument("--folded", action="store_true",
+                    help="raw flamegraph-collapsed lines instead of the "
+                         "hottest-stacks text")
+    pp.add_argument("--internal-token", default="",
+                    help="shared token for non-loopback targets")
+    pp.set_defaults(fn=cmd_profile)
+    pp = psub.add_parser("device",
+                         help="record a jax.profiler device trace "
+                              "(/debug/profile/device) and download the "
+                              "zipped artifact")
+    pp.add_argument("--target", required=True)
+    pp.add_argument("--seconds", type=float, default=2.0)
+    pp.add_argument("-o", "--output", default="",
+                    help="output path (default: the artifact id)")
+    pp.add_argument("--internal-token", default="")
+    pp.set_defaults(fn=cmd_profile)
+    pp = psub.add_parser("lock",
+                         help="lock-contention table from /status/profile "
+                              "(arm with TEMPO_LOCK_PROFILE=1)")
+    pp.add_argument("--target", required=True)
+    pp.add_argument("--internal-token", default="")
+    pp.set_defaults(fn=cmd_profile)
+    pp = psub.add_parser("artifact",
+                         help="fetch one profile artifact by id (ids in "
+                              "the slow-query log and /status/profile) "
+                              "and render folded text or save binary")
+    pp.add_argument("artifact_id")
+    pp.add_argument("--target", required=True)
+    pp.add_argument("-o", "--output", default="",
+                    help="save raw bytes instead of rendering")
+    pp.add_argument("--internal-token", default="")
+    pp.set_defaults(fn=cmd_profile)
 
     p = sub.add_parser("calibrate",
                        help="measure host-vs-device crossovers (find race, "

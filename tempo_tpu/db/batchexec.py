@@ -40,6 +40,8 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
+from ..util.profiler import timed_lock
+
 DEFAULT_WINDOW_MS = 3.0
 DEFAULT_MAX_BATCH = 16
 _FOLLOWER_TIMEOUT_S = 600.0
@@ -97,7 +99,9 @@ class BatchExecutor:
         self.window_s = window_s
         self.max_batch = max_batch
         self.enabled = enabled
-        self._lock = threading.Lock()
+        # cataloged hot lock: every submitter serializes through the
+        # admission window here (TEMPO_LOCK_PROFILE arms wait timing)
+        self._lock = timed_lock(f"batchexec_{name}")
         self._groups: dict = {}
         self._inflight = 0  # submitters currently inside submit_many
 

@@ -50,6 +50,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..util.profiler import timed_rlock
 from ..wire.segment import segment_to_trace
 from .device import PAD_I32, bucket, pad_rows
 from .stage import GKEY_ORIGIN_S
@@ -375,7 +376,10 @@ class LiveStager:
     COMPACT_DEAD_FRACTION = 0.5
 
     def __init__(self, dictionary: LiveDict | None = None):
-        self.lock = threading.RLock()
+        # cataloged hot lock: pushes, refreshes and retirements all
+        # serialize on the tail here (TEMPO_LOCK_PROFILE arms timing;
+        # the wrapper's RLock keeps refresh->retire recursion legal)
+        self.lock = timed_rlock("livestage_tail")
         self.dict = dictionary or LiveDict()
         self.tails: dict[bytes, _TraceTail] = {}
         self.generation = 0

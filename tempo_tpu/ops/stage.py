@@ -13,7 +13,6 @@ high latency."""
 
 from __future__ import annotations
 
-import threading
 import weakref
 from collections import OrderedDict
 from dataclasses import dataclass, field
@@ -24,6 +23,7 @@ import numpy as np
 
 from ..block import schema as S
 from ..block.reader import BackendBlock
+from ..util.profiler import timed_lock
 from .device import PAD_I32, bucket, pad_rows
 
 _CACHE_MAX_ENTRIES = 32  # per block
@@ -33,7 +33,9 @@ _CACHE_MAX_ENTRY_BYTES = 256 << 20
 # LRU over (block, entry) pairs, so a wide working set evicts the
 # coldest block's columns instead of growing until HBM OOMs
 _GLOBAL_CACHE_BUDGET = 4 << 30
-_lru_lock = threading.Lock()
+# a cataloged hot lock: TEMPO_LOCK_PROFILE arms contention timing
+# (tempo_lock_wait_seconds{lock="stage_lru"}); off = a raw Lock
+_lru_lock = timed_lock("stage_lru")
 _lru: OrderedDict[tuple[int, tuple], tuple] = OrderedDict()  # -> (blk weakref, nbytes)
 _lru_bytes = 0
 

@@ -176,6 +176,13 @@ class App:
             costledger.configure(
                 cfg.cost_ledger_path
                 or os.path.join(cfg.storage_path, "cost_ledger.json"))
+        # continuous profiling plane (util/profiler): the bounded
+        # profile-artifact store lives under the storage path (an
+        # explicit TEMPO_PROFILE_DIR env wins inside configure)
+        from ..util import profiler as _profiler
+
+        _profiler.PROF.configure_artifacts(
+            os.path.join(cfg.storage_path, "profiles"))
 
         # per-instance WAL dir: ingesters sharing --storage.path must never
         # replay (and delete) each other's live WAL files
@@ -402,6 +409,13 @@ class App:
             except ValueError:
                 slo_interval = 15.0  # a typo'd env must not abort startup
             self.slo.start(interval_s=slo_interval)
+        # always-on attributed sampler (TEMPO_PROFILE_HZ, 0 = strict
+        # no-op) + the Go-runtime-equivalent GC/thread/RSS gauges
+        from ..util import profiler as _profiler
+        from ..util import runtimestats as _runtimestats
+
+        _profiler.PROF.ensure_sampler()
+        _runtimestats.install()
         if self.cfg.warmup_shapes:
             # pre-serve AOT warmup: compile the ledger's recorded
             # (op, bucket) corpus (through the persistent compile
@@ -462,7 +476,7 @@ class App:
         """WAL dirs are per --instance.id; a renamed instance would silently
         strand its predecessor's unflushed data, so surface any sibling
         WAL dir that still holds files."""
-        import logging
+        from ..util.log import get_logger
 
         try:
             entries = os.listdir(wal_root)
@@ -471,7 +485,7 @@ class App:
         for name in entries:
             p = os.path.join(wal_root, name)
             if name != instance_id and os.path.isdir(p) and os.listdir(p):
-                logging.getLogger("tempo_tpu").warning(
+                get_logger("app").warning(
                     "orphaned WAL dir %s holds unreplayed files from instance %r; "
                     "restart with --instance.id %s to replay it",
                     p, name, name,
@@ -675,6 +689,15 @@ def _make_handler(app: App):
                         200, json.dumps(app.slo.evaluate(), indent=2))
                 if u.path == "/status/usage-stats":
                     return self._send(200, json.dumps(app.usage.report(app), indent=2))
+                if u.path == "/status/profile":
+                    # continuous profiling plane (util/profiler):
+                    # sampler state + per-component sample counts +
+                    # top-stack summaries, lock-contention table,
+                    # slow-capture count and the artifact index
+                    from ..util.profiler import PROF
+
+                    return self._send(
+                        200, json.dumps(PROF.status_snapshot(), indent=2))
                 if u.path == "/debug/threads":
                     # every thread's current stack (the role the
                     # reference's pprof goroutine dump plays): first stop
@@ -693,27 +716,77 @@ def _make_handler(app: App):
                         parts.extend(_tb.format_stack(frame))
                     return self._send(200, "".join(parts), "text/plain")
                 if u.path == "/debug/profile":
-                    # sampling CPU profile over ?seconds=N (default 2,
-                    # capped): the pprof profile endpoint analog. Samples
-                    # sys._current_frames() across ALL threads at ~200 Hz
-                    # (a tracing profiler would only see this handler's
-                    # thread) and reports the hottest stacks. One at a
-                    # time: overlapping scrapes get a 409. Gated like
+                    # on-demand burst CPU profile over ?seconds=N
+                    # (default 2, capped): the pprof profile endpoint
+                    # analog (util/profiler.sample_cpu). Samples
+                    # sys._current_frames() across ALL threads at
+                    # ?hz= (default 200; a tracing profiler would only
+                    # see this handler's thread). ?format=text renders
+                    # the hottest stacks; ?format=folded streams the
+                    # flamegraph-collapsed table. One at a time:
+                    # overlapping scrapes get a 409. Gated like
                     # /internal/*: a repeatable multi-second CPU burn
                     # must not be open to unauthenticated remote peers.
                     if not self._authorized_internal():
                         return self._err(403, "forbidden")
+                    from ..util.profiler import PROF
+
+                    fmt = q.get("format", "text")
+                    if fmt not in ("text", "folded"):
+                        return self._err(
+                            400, f"unknown format {fmt!r}; text or folded")
                     try:
                         secs = min(max(float(q.get("seconds", 2.0)), 0.1), 30.0)
+                        hz = float(q.get("hz", 200.0))
+                    except ValueError:
+                        return self._err(400, "seconds/hz must be numbers")
+                    if not app._profile_lock.acquire(blocking=False):
+                        return self._err(409, "a profile is already running")
+                    try:
+                        return self._send(200, PROF.sample_cpu(secs, hz, fmt),
+                                          "text/plain")
+                    finally:
+                        app._profile_lock.release()
+                if u.path == "/debug/profile/device":
+                    # device profile: record jax.profiler trace events
+                    # for ?seconds=N while serving continues and publish
+                    # the zipped trace directory as an artifact (fetch
+                    # via /debug/profile/artifact/<id> or
+                    # `tempo-tpu-cli profile device`)
+                    if not self._authorized_internal():
+                        return self._err(403, "forbidden")
+                    from ..util.profiler import PROF, ProfilerUnavailable
+
+                    try:
+                        secs = min(max(float(q.get("seconds", 2.0)), 0.1), 60.0)
                     except ValueError:
                         return self._err(400, "seconds must be a number")
                     if not app._profile_lock.acquire(blocking=False):
                         return self._err(409, "a profile is already running")
                     try:
-                        return self._send(200, _sample_profile(secs),
-                                          "text/plain")
+                        aid, summary = PROF.capture_device_profile(secs)
+                    except ProfilerUnavailable as e:
+                        return self._err(503, f"device profiler: {e}")
                     finally:
                         app._profile_lock.release()
+                    return self._send(
+                        200, json.dumps({"artifact_id": aid, **summary}))
+                m = re.fullmatch(r"/debug/profile/artifact/([^/]+)", u.path)
+                if m:
+                    # download one profile artifact (slow-query folded
+                    # snapshots, device trace zips) from the bounded
+                    # store -- ids come from the slow-query log,
+                    # /status/profile, or the device endpoint
+                    if not self._authorized_internal():
+                        return self._err(403, "forbidden")
+                    from ..util.profiler import PROF
+
+                    data = PROF.artifact_bytes(m.group(1))
+                    if data is None:
+                        return self._err(404, f"no artifact {m.group(1)!r}")
+                    ctype = ("text/plain" if m.group(1).endswith(".folded")
+                             else "application/octet-stream")
+                    return self._send(200, data, ctype)
                 if app.querier is None:
                     return self._err(404, f"target {app.cfg.target} serves no query API")
                 tenant = app.tenant_of(self.headers, read=True)
@@ -965,43 +1038,6 @@ def _make_handler(app: App):
                 return self._err(500, f"{type(e).__name__}: {e}")
 
     return Handler
-
-
-def _sample_profile(seconds: float, hz: float = 200.0) -> str:
-    """Statistical profile: sample every thread's stack via
-    sys._current_frames() and count (thread, stack) occurrences. The
-    own sampling thread is excluded. Output: hottest stacks first with
-    their sample share -- enough to answer "where is the CPU going"
-    without a tracing profiler's overhead or its single-thread limit."""
-    import sys
-    import threading
-    import traceback
-    from collections import Counter
-
-    me = threading.get_ident()
-    names = {t.ident: t.name for t in threading.enumerate()}
-    counts: Counter = Counter()
-    total = 0
-    deadline = time.monotonic() + seconds
-    period = 1.0 / hz
-    while time.monotonic() < deadline:
-        for tid, frame in sys._current_frames().items():
-            if tid == me:
-                continue
-            stack = tuple(
-                f"{fs.filename.rsplit('/', 1)[-1]}:{fs.lineno} {fs.name}"
-                for fs in traceback.extract_stack(frame)[-12:]
-            )
-            counts[(names.get(tid, str(tid)), stack)] += 1
-            total += 1
-        time.sleep(period)
-    lines = [f"# sampling profile: {seconds:.1f}s at ~{hz:.0f} Hz, "
-             f"{total} thread-samples\n"]
-    for (tname, stack), n in counts.most_common(25):
-        lines.append(f"\n--- {tname}: {n} samples "
-                     f"({100.0 * n / max(1, total):.1f}%)\n")
-        lines.extend(f"    {fr}\n" for fr in stack)
-    return "".join(lines)
 
 
 def build_default_slo(frontend):

@@ -48,6 +48,7 @@ from ..db.search import (
 )
 from ..ring.ring import InMemoryKV, InstanceDesc, InstanceState, Ring, deterministic_tokens
 from ..util.breaker import CircuitOpen, RetryBudget, get_breaker
+from ..util.profiler import timed_lock
 from ..wire.combine import combine_traces, sort_trace
 from .overrides import QueryAdmission
 from .querier import Querier
@@ -95,7 +96,10 @@ class RequestQueue:
     CLAIM_RECHECK_S = 0.02  # re-scan cadence while steal clocks run
 
     def __init__(self, max_per_tenant: int = 2000):
-        self.lock = threading.Lock()
+        # cataloged hot lock: every enqueue/dequeue (and the affinity
+        # claim scan) serializes here; TEMPO_LOCK_PROFILE arms wait
+        # timing. The Condition wraps the same lock either way.
+        self.lock = timed_lock("frontend_queue")
         self.cv = threading.Condition(self.lock)
         self.queues: dict[str, deque] = {}
         self.order: deque[str] = deque()

@@ -150,11 +150,12 @@ class Instance:
             # walk, and the routing counter must say WHY, or an import
             # regression ships as an unexplained latency cliff
             self.live_engine = None
-            import sys
+            from ..util.log import get_logger
 
-            print(f"tempo: live-head engine unavailable for tenant "
-                  f"{tenant!r}, falling back to index search: "
-                  f"{type(e).__name__}: {e}", file=sys.stderr)
+            get_logger("ingester").error(
+                "live-head engine unavailable for tenant %r, falling "
+                "back to index search: %s: %s",
+                tenant, type(e).__name__, e)
             try:
                 from ..util.kerneltel import TEL
 

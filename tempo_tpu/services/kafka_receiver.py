@@ -22,14 +22,14 @@ above this layer, not Kafka groups.
 from __future__ import annotations
 
 import io
-import logging
 import socket
 import struct
 import threading
 import time
 
+from ..util.log import get_logger
 
-log = logging.getLogger("tempo_tpu")
+log = get_logger("kafka")
 
 DEFAULT_TOPIC = "otlp_spans"
 

@@ -88,7 +88,11 @@ class CircuitBreaker:
         self.probes = probes if probes is not None else env["probes"]
         self.probe_timeout_s = (probe_timeout_s if probe_timeout_s is not None
                                 else env["probe_timeout_s"])
-        self._lock = threading.Lock()
+        # cataloged hot lock: every guarded call crosses allow()/record()
+        # here (TEMPO_LOCK_PROFILE arms contention timing)
+        from .profiler import timed_lock
+
+        self._lock = timed_lock("breaker")
         self.state = "closed"
         self._window: deque = deque()  # (monotonic, ok)
         self._opened_at = 0.0

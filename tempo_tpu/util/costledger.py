@@ -32,7 +32,6 @@ from __future__ import annotations
 
 import json
 import os
-import sys
 import threading
 import time
 
@@ -76,9 +75,11 @@ class CostLedger:
         except Exception as e:  # corrupt artifact: degrade loudly, keep serving
             self.load_error = f"{type(e).__name__}: {e}"
             self._entries = {}
-            print(f"tempo-tpu: cost ledger {self.path} unreadable "
-                  f"({self.load_error}); starting from an empty ledger",
-                  file=sys.stderr)
+            from .log import get_logger
+
+            get_logger("costledger").error(
+                "cost ledger %s unreadable (%s); starting from an "
+                "empty ledger", self.path, self.load_error)
 
     # ------------------------------------------------------------- access
     def get(self, key: str) -> dict | None:
@@ -117,8 +118,10 @@ class CostLedger:
             os.replace(tmp, self.path)  # atomic publish: readers see old or new
             return True
         except OSError as e:
-            print(f"tempo-tpu: cost ledger publish to {self.path} failed: {e}",
-                  file=sys.stderr)
+            from .log import get_logger
+
+            get_logger("costledger").error(
+                "cost ledger publish to %s failed: %s", self.path, e)
             try:
                 os.unlink(tmp)
             except OSError:

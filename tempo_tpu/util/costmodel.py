@@ -46,7 +46,6 @@ raise into the query path.
 from __future__ import annotations
 
 import os
-import sys
 import threading
 import time
 
@@ -619,8 +618,10 @@ def enable_compile_cache(cache_dir: str) -> bool:
             _cc_state["dir"] = cache_dir
         return True
     except Exception as e:
-        print(f"tempo-tpu: persistent compile cache at {cache_dir!r} "
-              f"unavailable: {e}", file=sys.stderr)
+        from .log import get_logger
+
+        get_logger("costmodel").warning(
+            "persistent compile cache at %r unavailable: %s", cache_dir, e)
         return False
 
 

@@ -859,11 +859,26 @@ class KernelTelemetry:
                 labels=f'op="{op}",outcome="{outcome}"')
         except Exception:
             pass
+        artifact = ""
+        try:
+            # slow-query auto-capture (util/profiler): latency past the
+            # query class's SLO p99 threshold snapshots the sampler
+            # ring into a folded artifact whose id rides the log entry
+            # beside the self-trace id -- page -> /status/slo ->
+            # slow-query log -> timeline + profile
+            from .profiler import PROF
+
+            if PROF.sampling:
+                artifact = PROF.capture_slow_query(op, float(seconds),
+                                                   trace_id)
+        except Exception:
+            artifact = ""
         with self._lock:
             self._queries.append({
                 "op": op,
                 "seconds": round(float(seconds), 6),
                 "self_trace_id": trace_id,
+                "profile_artifact_id": artifact,
                 "detail": detail[:200],
                 "outcome": outcome,
                 "at_unix": round(time.time(), 3),
@@ -939,16 +954,36 @@ class KernelTelemetry:
         except Exception:
             return None
 
+    @staticmethod
+    def _note_profiler_thread(trace) -> None:
+        """Mirror the active trace into the profiler's thread registry
+        (set/reset run ON the executing thread) so background samples
+        attribute to the query. One attribute check when sampling is
+        off -- the profiling-off path stays effectively free."""
+        try:
+            from .profiler import PROF
+
+            if PROF.sampling:
+                PROF.note_thread_trace(threading.get_ident(),
+                                       getattr(trace, "trace_id", None))
+        except Exception:
+            pass
+
     def set_active_trace(self, trace):
         """Park the active SelfTracer trace for this execution context;
         returns a token for reset_active_trace."""
-        return _active_trace.set(trace)
+        token = _active_trace.set(trace)
+        self._note_profiler_thread(trace)
+        return token
 
     def reset_active_trace(self, token) -> None:
         try:
             _active_trace.reset(token)
         except Exception:
             pass
+        # restore the registry to whatever the context now holds
+        # (nested set/reset pairs land back on the outer trace)
+        self._note_profiler_thread(_active_trace.get())
 
     def active_trace(self):
         return _active_trace.get()
@@ -1052,6 +1087,27 @@ class KernelTelemetry:
             out += _breaker.metrics_lines()
         except Exception:
             pass
+        # continuous-profiling plane: sampler/lock-wait/log/runtime
+        # families ride the same chokepoint, so every /metrics surface
+        # (app, vulture sidecars) ships them with the rest
+        try:
+            from . import profiler as _profiler
+
+            out += _profiler.metrics_lines()
+        except Exception:
+            pass
+        try:
+            from . import log as _log
+
+            out += _log.metrics_lines()
+        except Exception:
+            pass
+        try:
+            from . import runtimestats as _rt
+
+            out += _rt.metrics_lines()
+        except Exception:
+            pass
         return out
 
     def help_entries(self) -> dict[str, str]:
@@ -1074,6 +1130,24 @@ class KernelTelemetry:
             from . import breaker as _breaker
 
             out.update(_breaker.help_entries())
+        except Exception:
+            pass
+        try:
+            from . import profiler as _profiler
+
+            out.update(_profiler.help_entries())
+        except Exception:
+            pass
+        try:
+            from . import log as _log
+
+            out.update(_log.help_entries())
+        except Exception:
+            pass
+        try:
+            from . import runtimestats as _rt
+
+            out.update(_rt.help_entries())
         except Exception:
             pass
         return out

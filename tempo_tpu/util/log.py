@@ -1,0 +1,140 @@
+"""Structured logging shim: JSON lines with level + component.
+
+The reference leans on go-kit structured logging everywhere; this repo
+had one stdlib logging call and half a dozen bare stderr prints. This
+shim is the single seam they migrate onto:
+
+  * one JSON object per line on stderr: ts, level, component, msg --
+    machine-parseable by any log pipeline without a format contract;
+  * the ambient self-trace id (kerneltel's active trace) is attached
+    when present, so a log line from deep in a query links straight to
+    its timeline (`tempo-tpu-cli self-trace <id>`);
+  * rate-limited repeat suppression: the same (component, template)
+    emits once per window, repeats are counted and surfaced as
+    `repeats_suppressed` on the next emission -- a hot failing loop
+    cannot flood stderr;
+  * tempo_log_messages_total{level,component} counts every message
+    that passes the level filter (suppressed repeats included: they
+    happened, they just didn't print), exported through the kerneltel
+    /metrics chokepoint.
+
+Stdlib-only and import-light on purpose: the analysis CLI (stdlib-only
+by contract) and the earliest startup paths use it too.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+
+from .metrics import Counter
+
+LEVEL_ENV = "TEMPO_LOG_LEVEL"
+REPEAT_WINDOW_S = 10.0
+_REPEAT_KEYS_MAX = 512
+
+_LEVELS = {"debug": 10, "info": 20, "warning": 30, "error": 40}
+
+MESSAGES = Counter(
+    "tempo_log_messages_total",
+    help="structured log messages by level and component "
+         "(rate-suppressed repeats included)")
+
+_state_lock = threading.Lock()
+# (component, template) -> [window_start_monotonic, suppressed_count]
+_repeats: dict[tuple[str, str], list] = {}
+
+
+def _threshold() -> int:
+    return _LEVELS.get(os.environ.get(LEVEL_ENV, "").lower(), 20)
+
+
+def _esc(v: str) -> str:
+    return (v.replace("\\", "\\\\").replace('"', '\\"')
+             .replace("\n", "\\n"))
+
+
+def _active_trace_hex() -> str:
+    """Ambient self-trace id, if a query is executing on this thread.
+    Lazy + guarded: log must work before (and without) kerneltel."""
+    try:
+        from .kerneltel import TEL
+
+        t = TEL.active_trace()
+        tid = getattr(t, "trace_id", None)
+        return tid.hex() if tid is not None else ""
+    except Exception:
+        return ""
+
+
+class Logger:
+    __slots__ = ("component",)
+
+    def __init__(self, component: str):
+        self.component = component
+
+    # printf-style args match the stdlib logging call sites this shim
+    # replaces; keyword fields land as extra JSON keys
+    def debug(self, msg: str, *args, **fields) -> None:
+        self._emit("debug", msg, args, fields)
+
+    def info(self, msg: str, *args, **fields) -> None:
+        self._emit("info", msg, args, fields)
+
+    def warning(self, msg: str, *args, **fields) -> None:
+        self._emit("warning", msg, args, fields)
+
+    def error(self, msg: str, *args, **fields) -> None:
+        self._emit("error", msg, args, fields)
+
+    def _emit(self, level: str, msg: str, args: tuple, fields: dict) -> None:
+        try:
+            if _LEVELS[level] < _threshold():
+                return
+            MESSAGES.inc(labels=f'level="{level}",'
+                                f'component="{_esc(self.component)}"')
+            now = time.monotonic()
+            key = (self.component, msg)
+            with _state_lock:
+                st = _repeats.get(key)
+                if st is not None and now - st[0] < REPEAT_WINDOW_S:
+                    st[1] += 1  # suppressed: counted, not printed
+                    return
+                suppressed = st[1] if st is not None else 0
+                _repeats[key] = [now, 0]
+                if len(_repeats) > _REPEAT_KEYS_MAX:
+                    # bounded: drop the stalest window
+                    oldest = min(_repeats, key=lambda k: _repeats[k][0])
+                    _repeats.pop(oldest, None)
+            rec = {
+                "ts": round(time.time(), 3),
+                "level": level,
+                "component": self.component,
+                "msg": (msg % args) if args else msg,
+            }
+            trace_hex = _active_trace_hex()
+            if trace_hex:
+                rec["trace_id"] = trace_hex
+            if suppressed:
+                rec["repeats_suppressed"] = suppressed
+            if fields:
+                rec.update(fields)
+            sys.stderr.write(json.dumps(rec) + "\n")
+            sys.stderr.flush()
+        except Exception:
+            pass  # logging must never fail the caller
+
+
+def get_logger(component: str) -> Logger:
+    return Logger(component)
+
+
+def metrics_lines() -> list[str]:
+    return MESSAGES.text()
+
+
+def help_entries() -> dict[str, str]:
+    return {"tempo_log_messages": MESSAGES.help}

@@ -1,0 +1,118 @@
+"""Runtime health gauges: the Go-runtime metrics the reference gets
+for free (goroutines, GC pauses, RSS), for a CPython process.
+
+  tempo_runtime_gc_collections_total{generation}  via gc.callbacks
+  tempo_runtime_gc_pause_seconds{generation}      stop-the-world pause
+  tempo_runtime_threads                           live thread count
+  tempo_runtime_rss_bytes                         resident set size
+  tempo_runtime_open_fds                          open file descriptors
+
+Counters accumulate from the moment install() first runs (the app
+installs at start; the /metrics chokepoint installs lazily as a
+belt-and-braces). Point-in-time gauges refresh at scrape.
+"""
+
+from __future__ import annotations
+
+import gc
+import os
+import threading
+import time
+
+from .metrics import Counter, Gauge, Histogram
+
+# CPython gen-0 sweeps run sub-ms; a gen-2 pass over a large heap can
+# stall tens of ms -- exactly the tail-latency blip worth a bucket edge
+GC_PAUSE_BUCKETS = (1e-5, 5e-5, 1e-4, 5e-4, 1e-3, 5e-3, 0.01, 0.025,
+                    0.05, 0.1, 0.25, 1.0)
+
+GC_COLLECTIONS = Counter(
+    "tempo_runtime_gc_collections_total",
+    help="CPython garbage collections by generation")
+GC_PAUSE = Histogram(
+    "tempo_runtime_gc_pause_seconds", buckets=GC_PAUSE_BUCKETS,
+    help="CPython GC stop-the-world pause by generation")
+THREADS = Gauge("tempo_runtime_threads",
+                help="live Python threads (the goroutine-count analog)")
+RSS = Gauge("tempo_runtime_rss_bytes",
+            help="resident set size of this process")
+OPEN_FDS = Gauge("tempo_runtime_open_fds",
+                 help="open file descriptors of this process")
+
+_install_lock = threading.Lock()
+_installed = False
+_gc_lock = threading.Lock()
+_gc_t0: dict[int, float] = {}  # generation -> collection start
+
+
+def _gc_cb(phase: str, info: dict) -> None:
+    try:
+        gen = int(info.get("generation", 0))
+        if phase == "start":
+            with _gc_lock:
+                _gc_t0[gen] = time.perf_counter()
+            return
+        with _gc_lock:
+            t0 = _gc_t0.pop(gen, None)
+        GC_COLLECTIONS.inc(labels=f'generation="{gen}"')
+        if t0 is not None:
+            GC_PAUSE.observe(time.perf_counter() - t0,
+                             f'generation="{gen}"')
+    except Exception:
+        pass  # a GC callback must never raise into the collector
+
+
+def install() -> None:
+    """Register the GC callback once per process."""
+    global _installed
+    with _install_lock:
+        if _installed:
+            return
+        gc.callbacks.append(_gc_cb)
+        _installed = True
+
+
+def _rss_bytes() -> int:
+    try:
+        with open("/proc/self/statm") as f:
+            pages = int(f.read().split()[1])
+        return pages * os.sysconf("SC_PAGE_SIZE")
+    except Exception:
+        try:
+            import resource
+
+            # ru_maxrss is KiB on Linux: peak, not current -- still a
+            # usable ceiling where /proc is absent
+            return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+        except Exception:
+            return 0
+
+
+def _open_fds() -> int:
+    try:
+        return len(os.listdir("/proc/self/fd"))
+    except OSError:
+        return 0
+
+
+def refresh() -> None:
+    THREADS.set(threading.active_count())
+    RSS.set(_rss_bytes())
+    OPEN_FDS.set(_open_fds())
+
+
+def metrics_lines() -> list[str]:
+    install()  # lazy belt-and-braces: scrape implies counting
+    refresh()
+    return (GC_COLLECTIONS.text() + GC_PAUSE.text() + THREADS.text()
+            + RSS.text() + OPEN_FDS.text())
+
+
+def help_entries() -> dict[str, str]:
+    return {
+        "tempo_runtime_gc_collections": GC_COLLECTIONS.help,
+        "tempo_runtime_gc_pause_seconds": GC_PAUSE.help,
+        "tempo_runtime_threads": THREADS.help,
+        "tempo_runtime_rss_bytes": RSS.help,
+        "tempo_runtime_open_fds": OPEN_FDS.help,
+    }

@@ -214,10 +214,11 @@ class Vulture:
         if cfg.flush_every and not cfg.internal_token:
             host = urllib.parse.urlparse(self.push_url).hostname or ""
             if host not in ("127.0.0.1", "::1", "localhost"):
-                import sys
+                from .util.log import get_logger
 
-                print("vulture: cold-read probes disabled (remote target, "
-                      "no --internal-token for /flush)", file=sys.stderr)
+                get_logger("vulture").warning(
+                    "cold-read probes disabled (remote target, "
+                    "no --internal-token for /flush)")
                 cfg.flush_every = 0
         self.rng = random.Random(cfg.seed)
         self.run_id = f"{self.rng.getrandbits(32):08x}"

@@ -1,0 +1,72 @@
+"""Seeded violations in the continuous-profiling shapes: the sampler's
+ring buffer + thread-tag registry (module containers under the sampler
+lock) and the TimedLock wrapper's stats table (stats mutex inside the
+wrapped lock) -- the lock pairs util/profiler.py uses, so the
+concurrency rules provably cover the profiling plane. Also proves the
+TimedLock/TimedRLock token teach-in: a `with`-held wrapper attribute
+named like the wrapper class still counts as a lock."""
+
+import threading
+from collections import deque
+
+_sampler_lock = threading.Lock()
+_ring: deque = deque()
+_thread_tags: dict[int, str] = {}
+_stats_mutex = threading.Lock()
+_wait_stats: dict[str, list] = {}
+
+
+def push_sample(row):
+    with _sampler_lock:
+        _ring.append(row)
+        while len(_ring) > 4096:
+            _ring.popleft()
+
+
+def push_sample_racy(row):
+    _ring.append(row)  # EXPECT: global-mutation-unlocked
+
+
+def tag_thread(tid, tag):
+    with _sampler_lock:
+        if tag:
+            _thread_tags[tid] = tag
+        else:
+            _thread_tags.pop(tid, None)
+
+
+def tag_thread_racy(tid, tag):
+    _thread_tags[tid] = tag  # EXPECT: global-mutation-unlocked
+
+
+class TimedLockish:
+    """The wrapper shape: a wrapped inner lock plus a module stats
+    table guarded by its own mutex."""
+
+    def __init__(self, name):
+        self.name = name
+        self.inner_timedlock = threading.Lock()
+
+    def note_wait(self):
+        # sanctioned order: wrapped lock outer, stats mutex inner
+        with self.inner_timedlock:
+            with _stats_mutex:
+                _wait_stats.setdefault(self.name, [0, 0.0])
+
+    def stats_then_inner_racy(self):
+        with _stats_mutex:
+            with self.inner_timedlock:  # EXPECT: lock-order
+                _wait_stats.pop(self.name, None)
+
+    def probe_racy(self):
+        self.inner_timedlock.acquire()  # EXPECT: lock-bare-acquire
+        n = len(_wait_stats)
+        self.inner_timedlock.release()
+        return n
+
+    def probe_safe(self):
+        self.inner_timedlock.acquire()
+        try:
+            _wait_stats.clear()
+        finally:
+            self.inner_timedlock.release()
