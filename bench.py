@@ -26,7 +26,10 @@ Lines, in order:
   3. compaction_mb_per_sec -- BASELINE config #4 shape: level-0->1
      columnar compaction of many small blocks, MB/s of input consumed.
   4. ingest_otlp_mb_per_sec -- raw-bytes OTLP write path (native scan +
-     splice), vs the reference's 15 MB/s per-tenant rate-limit default.
+     splice + columnar WAL windows), vs the reference's 15 MB/s
+     per-tenant rate-limit default; the row carries a per-stage
+     breakdown (decode / wal_append / stage_delta / cut / flush ms)
+     read from the kerneltel ingest ledger.
   5. spanmetrics_reduce_spans_per_sec -- BASELINE config #5: span-metrics
      segmented reduce (calls + latency sum + histogram) on device.
   5b. search_concurrent_p50_ms -- Q parallel identical-shape queries on
@@ -888,17 +891,44 @@ def bench_ingest(tmp: str) -> None:
         traces = make_traces(200, seed=3, n_spans=20)
         payloads = [otlp_pb.encode_trace(t) for _, t in traces]
         raw_bytes = sum(len(p) for p in payloads)
-        app.distributor.push_raw(tenant, payloads[0])  # warm
+        # collectors batch: one export request carries many traces
+        # (concatenated Export payloads are protobuf-valid), and the
+        # columnar WAL turns each window into ONE framed record
+        per_window = 40
+        windows = [b"".join(payloads[i:i + per_window])
+                   for i in range(0, len(payloads), per_window)]
+        app.distributor.push_raw(tenant, windows[0])  # warm
         iters = 2
 
         def window():
             for _ in range(iters):
-                for p in payloads:
+                for p in windows:
                     app.distributor.push_raw(tenant, p)
 
         dt = best_window(window, windows=3)
         mbs = raw_bytes * iters / dt / 1e6
-        _emit("ingest_otlp_mb_per_sec", mbs, "MB/s", mbs / 15.0)
+
+        # per-stage breakdown (ISSUE 16): one more measured pass, then a
+        # staging refresh + forced cut/flush so every write-path stage
+        # records into the kerneltel ingest ledger
+        from tempo_tpu.util.kerneltel import TEL
+
+        def _stage_s(stats: dict) -> dict:
+            return {k: v["seconds"] for k, v in stats["stages"].items()}
+
+        inst = app.ingester.instance(tenant)
+        if inst.live_engine is not None:  # drain the timing passes' backlog
+            inst.live_engine.maybe_refresh()
+        app.ingester.sweep_all(force=True)
+        s0 = _stage_s(TEL.ingest_stats())
+        window()
+        if inst.live_engine is not None:
+            inst.live_engine.maybe_refresh()
+        app.ingester.sweep_all(force=True)
+        s1 = _stage_s(TEL.ingest_stats())
+        tel = {f"{st}_ms": round((s1.get(st, 0.0) - s0.get(st, 0.0)) * 1e3, 2)
+               for st in ("decode", "wal_append", "stage_delta", "cut", "flush")}
+        _emit("ingest_otlp_mb_per_sec", mbs, "MB/s", mbs / 15.0, tel=tel)
     finally:
         app.stop()
 

@@ -43,7 +43,8 @@ from .twinrules import run_twin_rules
 KERNEL_SCOPE = ("ops/", "parallel/")
 # chaos/ is in scope on purpose: the fault plane is exactly the kind of
 # process-wide registry the concurrency rules exist to guard
-CONCURRENCY_SCOPE = ("services/", "util/", "ops/", "db/", "chaos/")
+CONCURRENCY_SCOPE = ("services/", "util/", "ops/", "db/", "chaos/",
+                     "ingest/")
 
 
 def default_root() -> Path:

@@ -1,4 +1,5 @@
-"""Concurrency lint over services/, util/, ops/ and db/.
+"""Concurrency lint over services/, util/, ops/, db/, chaos/ and
+ingest/.
 
 The process-wide registries this codebase leans on (TEL, the staged
 LRU, RequestQueue rotation) are exactly the state the mesh-dispatch

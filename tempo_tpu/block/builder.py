@@ -28,6 +28,21 @@ DICT_NAME = "dict.vtpu"
 BLOOM_PREFIX = "bloom-"
 
 
+def _cut_kernels():
+    """The device block-cut kernel module (ops/blockcut) when the cut
+    router picks the device engine, else None -- the host code inline
+    below IS each kernel's registered twin, so both paths are
+    bit-identical. Lazy so block/ imports without jax."""
+    try:
+        from ..ops import blockcut
+
+        if blockcut.cut_engine() == "device":
+            return blockcut
+    except Exception:
+        pass
+    return None
+
+
 def _attr_row(dictb: DictBuilder, value) -> tuple[int, int, int, float, int, float]:
     """-> (vtype, str_id, int32, f32, int64, f64)."""
     if isinstance(value, bool):
@@ -304,7 +319,9 @@ class BlockBuilder:
         n_spans = len(self.sp_trace_sid)
         n_traces = len(self.tr_ids)
         dictionary, remap = self.dictb.finalize()
-        rm = lambda lst: apply_remap(np.asarray(lst, dtype=np.int32), remap)  # noqa: E731
+        kern = _cut_kernels()
+        rm_arr = kern.remap_codes_device if kern is not None else apply_remap
+        rm = lambda lst: rm_arr(np.asarray(lst, dtype=np.int32), remap)  # noqa: E731
 
         start_ns = np.asarray(self.sp_start_ns, dtype=np.uint64)
         end_ns = np.asarray(self.sp_end_ns, dtype=np.uint64)
@@ -390,11 +407,11 @@ class BlockBuilder:
             (self.lnattr, "lnattr", "ln"),
         ):
             tcols = table.columns(prefix, owner)
-            tcols[f"{prefix}.key_id"] = apply_remap(tcols[f"{prefix}.key_id"], remap)
-            tcols[f"{prefix}.str_id"] = apply_remap(tcols[f"{prefix}.str_id"], remap)
+            tcols[f"{prefix}.key_id"] = rm_arr(tcols[f"{prefix}.key_id"], remap)
+            tcols[f"{prefix}.str_id"] = rm_arr(tcols[f"{prefix}.str_id"], remap)
             cols.update(tcols)
 
-        axes, col_axis, row_groups = self._compute_row_groups(cols, start_ms, dur_us)
+        axes, col_axis, row_groups = self._compute_row_groups(cols, start_ms, dur_us, kern)
 
         m = self.meta
         m.total_traces = n_traces
@@ -411,14 +428,19 @@ class BlockBuilder:
                 bloom = ShardedBloom.for_estimated_items(max(self.estimated_traces, n_traces))
             else:
                 bloom = ShardedBloom.for_estimated_items(max(n_traces, 1))
-            bloom.add_many(self.tr_ids)
+            if kern is not None and self.tr_ids:
+                bloom.words = kern.bloom_bits_device(bloom.words, self.tr_ids,
+                                                     bloom.shard_bits)
+            else:
+                bloom.add_many(self.tr_ids)
         m.bloom_shards = bloom.n_shards
         m.bloom_shard_bits = bloom.shard_bits
 
         return FinalizedBlock(m, cols, axes, col_axis, dictionary, bloom)
 
-    def _compute_row_groups(self, cols, start_ms, dur_us):
-        return compute_row_groups(cols, start_ms, dur_us, self.row_group_spans)
+    def _compute_row_groups(self, cols, start_ms, dur_us, kernels=None):
+        return compute_row_groups(cols, start_ms, dur_us, self.row_group_spans,
+                                  kernels=kernels)
 
 
 def build_tres(trace_sid: np.ndarray, res_idx: np.ndarray, n_traces: int) -> dict[str, np.ndarray]:
@@ -445,9 +467,11 @@ def build_tres(trace_sid: np.ndarray, res_idx: np.ndarray, n_traces: int) -> dic
     }
 
 
-def compute_row_groups(cols, start_ms, dur_us, row_group_spans):
+def compute_row_groups(cols, start_ms, dur_us, row_group_spans, kernels=None):
     """Row-group boundaries + per-group pruning stats from assembled
-    columns (shared by the builder and the columnar compactor)."""
+    columns (shared by the builder and the columnar compactor).
+    `kernels` (ops/blockcut, optional) runs the per-group min/max as one
+    device segmented reduce; stats are identical either way."""
     n_spans = len(cols["span.trace_sid"])
     bounds = list(range(0, n_spans, row_group_spans)) + [n_spans]
     if len(bounds) < 2:
@@ -487,6 +511,10 @@ def compute_row_groups(cols, start_ms, dur_us, row_group_spans):
             col_axis[name] = ax
 
     trace_sid = cols["span.trace_sid"]
+    # with any spans at all, every bounds group is non-empty, so the
+    # segmented reduce covers all of them
+    mm = (kernels.rowgroup_minmax_device(start_ms, dur_us, bounds)
+          if kernels is not None and n_spans > 0 else None)
     row_groups = []
     for g in range(span_ax.n_groups):
         lo, hi = bounds[g], bounds[g + 1]
@@ -499,9 +527,9 @@ def compute_row_groups(cols, start_ms, dur_us, row_group_spans):
                 span_hi=hi,
                 trace_lo=int(trace_sid[lo]),
                 trace_hi=int(trace_sid[hi - 1]) + 1,
-                start_ms_min=int(start_ms[lo:hi].min()),
-                start_ms_max=int(start_ms[lo:hi].max()),
-                dur_us_max=int(dur_us[lo:hi].max()),
+                start_ms_min=int(mm[0][g] if mm else start_ms[lo:hi].min()),
+                start_ms_max=int(mm[1][g] if mm else start_ms[lo:hi].max()),
+                dur_us_max=int(mm[2][g] if mm else dur_us[lo:hi].max()),
             )
         )
     return axes, col_axis, row_groups

@@ -62,7 +62,15 @@ class LiveEngine:
 
     def __init__(self, instance):
         self.inst = instance
-        self.stager = LiveStager()
+        # share the instance's columnar-ingest plane when present: one
+        # LiveDict for staging + WAL feature checkpoints, and staging
+        # reads decoded features from the shared cache (decode once)
+        col = getattr(instance, "columnar", None)
+        if col is not None:
+            self.stager = LiveStager(dictionary=col.dict,
+                                     features_fn=col.features_for)
+        else:
+            self.stager = LiveStager()
         self._pending_lock = threading.Lock()
         self._pending_push: dict[bytes, float] = {}  # tid -> first unstaged push
         self.enabled = _env_flag("TEMPO_LIVE_STAGE", "1") != "0"

@@ -1,0 +1,28 @@
+"""Device-native ingest: the write path's columnar subsystem.
+
+Three planes (ISSUE 16): OTLP push windows append to the WAL as single
+windowed records with per-record CRC (walcodec, the "w2" format db/wal
+writes and replays); segments decode ONCE into coded features shared by
+live-search staging, WAL feature checkpoints and flush-time block
+assembly (columnar.ColumnarIngest over the never-remapping LiveDict);
+and block cut runs its bloom bit-setting / dictionary remap / row-group
+min-max work as device kernels (ops/blockcut, twins in ops/twins.py).
+"""
+
+from .columnar import (
+    ColumnarIngest,
+    LiveDict,
+    SegFeatures,
+    compute_features,
+    kv_pair_key,
+)
+from .walcodec import WAL2_VERSION
+
+__all__ = [
+    "ColumnarIngest",
+    "LiveDict",
+    "SegFeatures",
+    "WAL2_VERSION",
+    "compute_features",
+    "kv_pair_key",
+]

@@ -1,0 +1,197 @@
+"""Batched columnar decode for the write path.
+
+One OTLP push window decodes ONCE into flat coded features -- span
+names and (attr key, lowered value) pairs as codes in the never-
+remapping LiveDict, plus the segment's span-time bounds -- instead of
+each consumer (live-search staging, WAL feature checkpoints, search
+indexes) re-running the per-span Python object walk. The decode is
+keyed by SEGMENT OBJECT IDENTITY: the ingester keeps one bytes object
+per segment across the live/cut/flushing lifecycle, so the cache ref
+IS the aliasing guard (holding the segment pins its id; an entry can
+never be shadowed by a recycled id while it exists).
+
+Lock order: callers may hold the livestage tail lock when computing
+features (LiveStager._stage_trace_locked -> features_for); the cache
+lock here is a leaf and never calls out while held.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import NamedTuple
+
+from ..wire.segment import segment_to_trace
+
+
+class LiveDict:
+    """Append-only string<->code dictionary: codes are assigned in
+    arrival order and NEVER remap (unlike block dictionaries, which
+    sort+remap at finalize), so rows staged in earlier generations stay
+    valid forever. Misses on lookup are exact prunes: a string absent
+    here is provably absent from every staged row."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._code: dict[str, int] = {"": 0}
+        self._strings: list[str] = [""]
+
+    def code(self, s: str) -> int:
+        with self._lock:
+            c = self._code.get(s)
+            if c is None:
+                c = self._code[s] = len(self._strings)
+                self._strings.append(s)
+            return c
+
+    def lookup(self, s: str) -> int:
+        with self._lock:
+            return self._code.get(s, -1)
+
+    def string(self, code: int) -> str:
+        with self._lock:
+            return self._strings[code] if 0 <= code < len(self._strings) else ""
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._strings)
+
+
+def kv_pair_key(key: str, value: str) -> str:
+    """Dictionary key for one (attr key, lowered value) membership pair
+    -- a single code per pair keeps the tag test one equality on
+    device. NUL can't appear in either half (attr keys and stringified
+    values), so the join is collision-free."""
+    return key + "\x00" + value
+
+
+class SegFeatures(NamedTuple):
+    """One segment's coded contribution to its trace's staged features.
+    EXACTLY the per-span extraction services/ingester._SearchEntry.build
+    performs, coded through the LiveDict: the union over a trace's
+    segments is a conservative superset of the entry built from the
+    combined trace (combine_traces dedupes by (span_id, start, name),
+    so dropped duplicates only SHRINK the combined sets). lo/hi None =
+    the segment carried no spans."""
+
+    kv_codes: tuple[int, ...]
+    name_codes: tuple[int, ...]
+    lo_ns: int | None
+    hi_ns: int | None
+
+
+def compute_features(seg: bytes, ldict: LiveDict) -> SegFeatures:
+    """Decode one segment's proto and code its features (first-seen
+    order, deduped within the segment)."""
+    tr = segment_to_trace(seg)
+    code = ldict.code
+    kv_codes: list[int] = []
+    kv_seen: set[int] = set()
+    name_codes: list[int] = []
+    name_seen: set[int] = set()
+    lo = hi = None
+    for res, _, sp in tr.all_spans():
+        c = code(sp.name)
+        if c not in name_seen:
+            name_seen.add(c)
+            name_codes.append(c)
+        for attrs in (sp.attrs, res.attrs):
+            for k, v in attrs.items():
+                c = code(kv_pair_key(k, str(v).lower()))
+                if c not in kv_seen:
+                    kv_seen.add(c)
+                    kv_codes.append(c)
+        if lo is None or sp.start_unix_nano < lo:
+            lo = sp.start_unix_nano
+        if hi is None or sp.end_unix_nano > hi:
+            hi = sp.end_unix_nano
+    return SegFeatures(tuple(kv_codes), tuple(name_codes), lo, hi)
+
+
+class ColumnarIngest:
+    """Per-instance columnar decode plane: one LiveDict shared by
+    live-search staging and the WAL's feature checkpoints, plus the
+    identity-keyed feature cache that makes 'decode once' true across
+    consumers. Thread-safe; the internal lock is a leaf."""
+
+    # cache ceiling (segments). Overflow evicts oldest-inserted half --
+    # evicted entries recompute on next touch, so the cap only bounds
+    # memory, never correctness.
+    MAX_ENTRIES = 1 << 16
+
+    def __init__(self, dictionary: LiveDict | None = None):
+        self.dict = dictionary if dictionary is not None else LiveDict()
+        self._lock = threading.Lock()
+        # id(seg) -> (seg, SegFeatures); the held seg ref pins the id
+        self._feats: dict[int, tuple[bytes, SegFeatures]] = {}
+        self.decodes = 0  # proto decodes actually performed
+        self.seeded = 0  # features installed without a decode (replay)
+
+    # ------------------------------------------------------------ decode
+    def features_for(self, seg: bytes) -> SegFeatures:
+        """The segment's features, computing (and caching) on miss.
+        This IS the batched-decode chokepoint: staging, WAL feature
+        flushes and replay all read through here."""
+        key = id(seg)
+        with self._lock:
+            ent = self._feats.get(key)
+            if ent is not None:
+                return ent[1]
+        t0 = time.perf_counter()
+        feat = compute_features(seg, self.dict)
+        dt = time.perf_counter() - t0
+        try:
+            from ..util.kerneltel import TEL
+
+            TEL.record_ingest_stage("decode", dt)
+        except Exception:
+            pass
+        with self._lock:
+            self.decodes += 1
+            self._install_locked(key, seg, feat)
+        return feat
+
+    def decode_window(self, batch: list[tuple[bytes, int, int, bytes]]) -> list[SegFeatures]:
+        """Eager decode of one push window's segments
+        ([(tid, start_s, end_s, seg)]), returned in order."""
+        return [self.features_for(seg) for _, _, _, seg in batch]
+
+    def cached(self, seg: bytes) -> SegFeatures | None:
+        """Cache-only lookup (never decodes): the WAL feature flush uses
+        this so checkpointing never ADDS decode work to the write path."""
+        with self._lock:
+            ent = self._feats.get(id(seg))
+            return ent[1] if ent is not None else None
+
+    # ------------------------------------------------------------ replay
+    def seed_strings(self, seg: bytes, kv: tuple[str, ...],
+                     names: tuple[str, ...], lo_ns: int | None,
+                     hi_ns: int | None) -> None:
+        """Install replayed WAL feature strings as this instance's codes
+        -- the no-proto-decode replay path. kv strings are the joined
+        kv_pair_key form, exactly as the dictionary stores them."""
+        feat = SegFeatures(tuple(self.dict.code(s) for s in kv),
+                           tuple(self.dict.code(n) for n in names),
+                           lo_ns, hi_ns)
+        with self._lock:
+            self.seeded += 1
+            self._install_locked(id(seg), seg, feat)
+
+    # ---------------------------------------------------------- lifecycle
+    def discard(self, segs: list[bytes]) -> None:
+        """Drop cache entries for segments leaving the live window (a
+        flushed block landed, or the WAL head was cleared)."""
+        with self._lock:
+            for seg in segs:
+                self._feats.pop(id(seg), None)
+
+    def _install_locked(self, key: int, seg: bytes, feat: SegFeatures) -> None:
+        if len(self._feats) >= self.MAX_ENTRIES:
+            for k in list(self._feats)[: self.MAX_ENTRIES // 2]:
+                del self._feats[k]
+        self._feats[key] = (seg, feat)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"cached": len(self._feats), "decodes": self.decodes,
+                    "seeded": self.seeded, "dict_size": len(self.dict)}

@@ -50,8 +50,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+# LiveDict / kv_pair_key moved to the columnar ingest plane (ISSUE 16)
+# so WAL feature checkpoints and staging share one dictionary; re-
+# exported here for existing importers
+from ..ingest.columnar import LiveDict, compute_features, kv_pair_key  # noqa: F401
 from ..util.profiler import timed_rlock
-from ..wire.segment import segment_to_trace
 from .device import PAD_I32, bucket, pad_rows
 from .stage import GKEY_ORIGIN_S
 
@@ -87,71 +90,6 @@ def _delta_bucket(n: int, floor: int = 64) -> int:
     return b
 
 
-class LiveDict:
-    """Append-only string<->code dictionary: codes are assigned in
-    arrival order and NEVER remap (unlike block dictionaries, which
-    sort+remap at finalize), so rows staged in earlier generations stay
-    valid forever. Misses on lookup are exact prunes: a string absent
-    here is provably absent from every staged row."""
-
-    def __init__(self):
-        self._lock = threading.Lock()
-        self._code: dict[str, int] = {"": 0}
-        self._strings: list[str] = [""]
-
-    def code(self, s: str) -> int:
-        with self._lock:
-            c = self._code.get(s)
-            if c is None:
-                c = self._code[s] = len(self._strings)
-                self._strings.append(s)
-            return c
-
-    def lookup(self, s: str) -> int:
-        with self._lock:
-            return self._code.get(s, -1)
-
-    def string(self, code: int) -> str:
-        with self._lock:
-            return self._strings[code] if 0 <= code < len(self._strings) else ""
-
-    def __len__(self) -> int:
-        with self._lock:
-            return len(self._strings)
-
-
-def segment_features(seg: bytes):
-    """One segment's contribution to its trace's staged features:
-    (kv pairs, span names, min start_ns, max end_ns). EXACTLY the
-    per-span extraction _SearchEntry.build performs -- the union over a
-    trace's segments is a conservative superset of the entry built from
-    the combined trace (combine_traces dedupes by (span_id, start,
-    name), so dropped duplicates only SHRINK the combined sets)."""
-    tr = segment_to_trace(seg)
-    kv: set = set()
-    names: set = set()
-    lo = hi = None
-    for res, _, sp in tr.all_spans():
-        names.add(sp.name)
-        for k, v in sp.attrs.items():
-            kv.add((k, str(v).lower()))
-        for k, v in res.attrs.items():
-            kv.add((k, str(v).lower()))
-        if lo is None or sp.start_unix_nano < lo:
-            lo = sp.start_unix_nano
-        if hi is None or sp.end_unix_nano > hi:
-            hi = sp.end_unix_nano
-    return kv, names, lo, hi
-
-
-def kv_pair_key(key: str, value: str) -> str:
-    """Dictionary key for one (attr key, lowered value) membership pair
-    -- a single code per pair keeps the tag test one equality on
-    device. NUL can't appear in either half (attr keys and stringified
-    values), so the join is collision-free."""
-    return key + "\x00" + value
-
-
 @dataclass
 class _TraceTail:
     """Host-side per-trace fragment: which segments are staged and the
@@ -162,8 +100,8 @@ class _TraceTail:
     staged_segs: list = field(default_factory=list)  # segment refs
     kv_codes: list = field(default_factory=list)
     name_codes: list = field(default_factory=list)
-    kv_seen: set = field(default_factory=set)
-    name_seen: set = field(default_factory=set)
+    kv_seen: set = field(default_factory=set)  # staged kv CODES
+    name_seen: set = field(default_factory=set)  # staged name CODES
     min_start_ns: int | None = None
     max_end_ns: int | None = None
     state: str = "live"
@@ -375,12 +313,16 @@ class LiveStager:
     # rebuild the tails once dead slots or dead rows dominate
     COMPACT_DEAD_FRACTION = 0.5
 
-    def __init__(self, dictionary: LiveDict | None = None):
+    def __init__(self, dictionary: LiveDict | None = None, features_fn=None):
         # cataloged hot lock: pushes, refreshes and retirements all
         # serialize on the tail here (TEMPO_LOCK_PROFILE arms timing;
         # the wrapper's RLock keeps refresh->retire recursion legal)
         self.lock = timed_rlock("livestage_tail")
         self.dict = dictionary or LiveDict()
+        # seg -> SegFeatures source: the instance's ColumnarIngest cache
+        # when wired (decode once per segment across consumers), else a
+        # direct compute against this stager's own dictionary
+        self._features = features_fn or (lambda seg: compute_features(seg, self.dict))
         self.tails: dict[bytes, _TraceTail] = {}
         self.generation = 0
         # slot columns (numpy, capacity-grown; n_slots is the high-water)
@@ -496,12 +438,12 @@ class LiveStager:
             tail = self._alloc_slot_locked(tid)
         dirty = False
         for seg in segs[len(tail.staged_segs):]:
-            kv, names, lo, hi = segment_features(seg)
-            kv_add = [self.dict.code(kv_pair_key(k, v))
-                      for k, v in kv if (k, v) not in tail.kv_seen]
-            tail.kv_seen.update(kv)
-            nm_add = [self.dict.code(n) for n in names if n not in tail.name_seen]
-            tail.name_seen.update(names)
+            feat = self._features(seg)
+            lo, hi = feat.lo_ns, feat.hi_ns
+            kv_add = [c for c in feat.kv_codes if c not in tail.kv_seen]
+            tail.kv_seen.update(kv_add)
+            nm_add = [c for c in feat.name_codes if c not in tail.name_seen]
+            tail.name_seen.update(nm_add)
             if kv_add:
                 self.kv_owner = self._append_rows(
                     self.kv_owner, self.n_kv, [tail.slot] * len(kv_add))
@@ -590,15 +532,23 @@ class LiveStager:
         snapshot, segments merged flushing+cut+live per tid) and return
         the new generation's snapshot. stage_device=False keeps the
         refresh host-only (the tiny-head path pays no upload)."""
+        import time as _time
+
         from ..util.kerneltel import TEL
 
         with self.lock:
+            t_delta = _time.perf_counter()
             dirty = False
             for tid in [t for t in self.tails if t not in items]:
                 self._retire_locked(tid, self.tails[tid])
                 dirty = True
             for tid, (segs, state, start_s, end_s) in items.items():
                 dirty |= self._stage_trace_locked(tid, segs, start_s, end_s, state)
+            if dirty:
+                # ingest-stage ledger: the host delta encode (includes any
+                # segment decodes the columnar cache had not absorbed)
+                TEL.record_ingest_stage("stage_delta",
+                                        _time.perf_counter() - t_delta)
             total_rows = self.n_kv + self.n_name
             dead_rows = self.dead_kv + self.dead_name
             if self.n_slots and (

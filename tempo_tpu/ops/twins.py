@@ -46,6 +46,11 @@ DEVICE_HOST_TWINS: dict[str, str] = {
     # the tiny-head path and the differential harness
     "ops.livestage.eval_live_device": "ops.livestage.eval_live_host",
     "ops.livestage.find_slot_device": "ops.livestage.find_slot_host",
+    # block-cut kernels (write path): pure integer ops, so the numpy
+    # twins are bit-identical and double as the jax-less fallback
+    "ops.blockcut.remap_codes_device": "ops.blockcut.remap_codes_host",
+    "ops.blockcut.bloom_bits_device": "ops.blockcut.bloom_bits_host",
+    "ops.blockcut.rowgroup_minmax_device": "ops.blockcut.rowgroup_minmax_host",
 }
 
 # Device entry points with no host twin BY DESIGN; each carries the

@@ -260,15 +260,27 @@ class Distributor:
         # group traces by replica instance (ring.DoBatch analog);
         # snapshot the healthy set once for the whole batch
         healthy = self.ring.healthy_instances()
+        if not healthy:
+            raise PushError(500, "no healthy ingesters in the ring")
         by_instance: dict[str, list] = defaultdict(list)
         quorum_need: dict[bytes, int] = {}
-        for tid, (s, e, seg) in lim_filtered.items():
-            rs = self.ring.get(ring_token(tenant, tid), instances=healthy)
-            if not rs.instances:
-                raise PushError(500, "no healthy ingesters in the ring")
-            quorum_need[tid] = len(rs.instances) - rs.max_errors
-            for inst in rs.instances:
-                by_instance[inst.addr].append((tid, s, e, seg))
+        if len(healthy) == 1:
+            # single-ingester fast path (the single-binary topology):
+            # every token resolves to the one instance with quorum 1, so
+            # skip the per-trace ring walk -- on large push windows the
+            # hash+bisect loop is real write-path time
+            addr = healthy[0].addr
+            by_instance[addr] = [(tid, s, e, seg)
+                                 for tid, (s, e, seg) in lim_filtered.items()]
+            quorum_need = dict.fromkeys(lim_filtered, 1)
+        else:
+            for tid, (s, e, seg) in lim_filtered.items():
+                rs = self.ring.get(ring_token(tenant, tid), instances=healthy)
+                if not rs.instances:
+                    raise PushError(500, "no healthy ingesters in the ring")
+                quorum_need[tid] = len(rs.instances) - rs.max_errors
+                for inst in rs.instances:
+                    by_instance[inst.addr].append((tid, s, e, seg))
 
         ok_count: dict[bytes, int] = defaultdict(int)
         errors = []

@@ -21,7 +21,8 @@ from dataclasses import dataclass, field
 
 from ..db.search import SearchRequest, SearchResponse, SearchResult
 from ..db.tempodb import TempoDB
-from ..db.wal import WAL, WALBlock
+from ..db.wal import DEFAULT_WAL_VERSION, WAL, WALBlock
+from ..ingest.columnar import ColumnarIngest
 from ..wire.combine import combine_traces, sort_trace
 from ..wire.model import Trace
 from ..util.metrics import Counter, Histogram, timed
@@ -113,6 +114,10 @@ class IngesterConfig:
     # covered by RF-way replication). RF=1 deployments set 0 to fsync
     # every flush.
     wal_fsync_interval_s: float = 0.25
+    # WAL write format: "w2" (columnar windows + feature checkpoints,
+    # db/wal.WAL2Block) or "w1" (legacy one-record-per-segment). Replay
+    # reads BOTH regardless, so flipping this is a live migration.
+    wal_version: str = DEFAULT_WAL_VERSION
 
 
 class Instance:
@@ -126,7 +131,12 @@ class Instance:
         self.cfg = cfg
         self.lock = threading.RLock()
         self.live: dict[bytes, LiveTrace] = {}
-        self.head: WALBlock = wal.new_block(tenant)
+        # columnar ingest plane: the shared LiveDict + decode-once
+        # feature cache feeding live-search staging AND the WAL's
+        # feature checkpoints (created BEFORE the live engine so the
+        # engine's stager adopts the shared dictionary)
+        self.columnar = ColumnarIngest()
+        self.head: WALBlock = wal.new_block(tenant, cfg.wal_version)
         self.head_created = time.time()
         # traces cut from the live map, waiting to go into the next block
         self.cut: dict[bytes, LiveTrace] = {}
@@ -193,12 +203,45 @@ class Instance:
                 lt.last_append = now
                 lt.start_s = min(lt.start_s or s, s)
                 lt.end_s = max(lt.end_s, e)
-                self.head.append(tid, s, e, seg)
+            t_wal = time.perf_counter()
+            if hasattr(self.head, "append_window"):
+                # columnar WAL: the whole push window is ONE framed
+                # record -- one CRC, one file write on the ack path
+                self.head.append_window(batch)
+            else:
+                for tid, s, e, seg in batch:
+                    self.head.append(tid, s, e, seg)
             self.head.flush()
+            t_wal = time.perf_counter() - t_wal
+        try:
+            from ..util.kerneltel import TEL
+
+            TEL.record_ingest_stage("wal_append", t_wal)
+            TEL.record_ingest_window(len(batch),
+                                     sum(len(seg) for *_, seg in batch))
+        except Exception:
+            pass
         if self.live_engine is not None:
             # staging-lag clock only -- the delta decode itself happens
             # at the next refresh, OFF this push path
             self.live_engine.note_push([tid for tid, *_ in batch], now)
+
+    def flush_wal_features(self) -> int:
+        """Checkpoint already-decoded segment features into the columnar
+        WAL head (WAL2Block.flush_features): replay of a checkpointed
+        segment re-enters the stage buckets without proto re-decode.
+        Only features the columnar cache ALREADY holds are written --
+        this never adds decode work. No-op on a legacy (w1) head."""
+        head = self.head
+        if not hasattr(head, "flush_features"):
+            return 0
+        with self.lock:
+            if self.head is not head:  # rotated while unlocked: next sweep
+                return 0
+            n = head.flush_features(self.columnar.cached, self.columnar.dict)
+            if n:
+                head.flush()
+            return n
 
     # ------------------------------------------------------------ lifecycle
     def cut_complete_traces(self, force: bool = False, now: float | None = None) -> int:
@@ -233,7 +276,7 @@ class Instance:
                 if (force or (now - self.head_created) > self.cfg.max_block_age_s) \
                         and not self.live and self.head.size_bytes() > 0:
                     old = self.head
-                    self.head = self.wal.new_block(self.tenant)
+                    self.head = self.wal.new_block(self.tenant, self.cfg.wal_version)
                     self.head_created = now
                     old.clear()
                 return None
@@ -241,6 +284,7 @@ class Instance:
             size = self.head.size_bytes()
             if not (force or age >= self.cfg.max_block_age_s or size >= self.cfg.max_block_bytes):
                 return None
+            t_cut = time.perf_counter()
             traces = []
             cut_snapshot = dict(self.cut)
             for tid, lt in self.cut.items():
@@ -251,18 +295,42 @@ class Instance:
             # live traces staying behind move to the NEW head's WAL file so
             # the old file can be deleted after the block lands
             old_head = self.head
-            self.head = self.wal.new_block(self.tenant)
+            self.head = self.wal.new_block(self.tenant, self.cfg.wal_version)
             self.head_created = now
-            for lt in self.live.values():
-                for seg in lt.segments:
-                    self.head.append(lt.trace_id, lt.start_s, lt.end_s, seg)
+            carry = [(lt.trace_id, lt.start_s, lt.end_s, seg)
+                     for lt in self.live.values() for seg in lt.segments]
+            if hasattr(self.head, "append_window"):
+                if carry:
+                    self.head.append_window(carry)
+                    # carried segments were already decoded for staging:
+                    # checkpoint those features into the fresh file so a
+                    # crash-now replay skips their proto decode too
+                    self.head.flush_features(self.columnar.cached,
+                                             self.columnar.dict)
+            else:
+                for tid, s, e, seg in carry:
+                    self.head.append(tid, s, e, seg)
             # the new head is about to become the ONLY wal copy of the
             # carried-over live traces (the old file is deleted once the
             # block lands): force the fsync
             self.head.flush(sync=True)
+            t_cut = time.perf_counter() - t_cut
         try:
+            from ..util.kerneltel import TEL
+
+            TEL.record_ingest_stage("cut", t_cut)
+        except Exception:
+            pass
+        try:
+            t_flush = time.perf_counter()
             with timed(FLUSH_DURATION):
                 meta = self.db.write_block(self.tenant, traces)
+            try:
+                from ..util.kerneltel import TEL
+
+                TEL.record_ingest_stage("flush", time.perf_counter() - t_flush)
+            except Exception:
+                pass
         except Exception:
             FLUSH_FAILURES.inc()
             # block write failed: restore the cut set for the next retry;
@@ -289,6 +357,10 @@ class Instance:
             for tid, lt in cut_snapshot.items():
                 if self.flushing.get(tid) is lt:
                     del self.flushing[tid]
+            # flushed segments left the live window: release their
+            # decoded-feature cache entries
+            for lt in cut_snapshot.values():
+                self.columnar.discard(lt.segments)
         old_head.clear()  # checkpoint advanced: block is durable in backend
         return meta
 
@@ -521,6 +593,11 @@ class Ingester:
                 continue
             inst = self.instance(rb.tenant)
             with inst.lock:
+                # seed the file's dictionary delta FIRST, in file-code
+                # order, so replayed feature codes land deterministically
+                # in the instance dictionary before any staging touches it
+                for s in rb.dict_delta:
+                    inst.columnar.dict.code(s)
                 for rec in rb.records:
                     lt = inst.live.setdefault(rec.trace_id, LiveTrace(rec.trace_id))
                     lt.segments.append(rec.segment)
@@ -528,6 +605,17 @@ class Ingester:
                     lt.start_s = min(lt.start_s or rec.start_s, rec.start_s)
                     lt.end_s = max(lt.end_s, rec.end_s)
                     lt.last_append = 0.0  # replayed = instantly idle
+                for i, feat in rb.features.items():
+                    # checkpointed features replay straight into the
+                    # columnar cache: staging needs no proto re-decode
+                    inst.columnar.seed_strings(rb.records[i].segment, *feat)
+            try:
+                from ..util.kerneltel import TEL
+
+                TEL.record_ingest_replay(len(rb.records), len(rb.features),
+                                         torn=not rb.clean)
+            except Exception:
+                pass
             n += len(rb.records)
             # records now tracked by the instance's new head after next cut;
             # the old file is superseded once a cut block lands
@@ -553,6 +641,12 @@ class Ingester:
                     inst.live_engine.maybe_refresh()
                 except Exception:  # staging must never block cuts
                     pass
+            try:
+                # features decoded by the refresh above checkpoint into
+                # the WAL head so replay skips their proto decode
+                inst.flush_wal_features()
+            except Exception:  # checkpointing must never block cuts
+                pass
             # per-tenant exponential backoff after a failed flush
             # (reference: flushqueues retry-with-backoff, flush.go:62-67)
             # -- a broken backend must not be hammered every sweep, and

@@ -240,6 +240,19 @@ class KernelTelemetry:
             "uploads": 0, "full_uploads": 0, "delta_bytes": 0,
             "delta_rows": 0, "lag_count": 0, "lag_sum": 0.0, "lag_max": 0.0,
         }
+        # device-native ingest (tempo_tpu/ingest): per-stage write-path
+        # seconds (decode / wal_append / stage_delta / cut / flush),
+        # window/feature-checkpoint volume, replay outcomes
+        self.ingest_stage_time = Histogram(
+            "tempo_ingest_stage_seconds",
+            buckets=(0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0),
+            help="write-path stage wall seconds by stage "
+                 "(decode/wal_append/stage_delta/cut/flush)")
+        self._ingest: dict = {
+            "stages": {}, "windows": 0, "window_traces": 0,
+            "window_bytes": 0, "feature_entries": 0,
+            "replays": {"records": 0, "features": 0, "torn": 0},
+        }
         # self-tracing pipeline health (services/selftrace): spans
         # shipped vs whole traces dropped at the bounded in-flight queue
         self.selftrace_spans = Counter(
@@ -298,7 +311,8 @@ class KernelTelemetry:
             self.stream_units, self.stream_bytes_inflight,
             self.affinity_jobs, self.qos_shed, self.staged_placement,
             self.livestage_rows, self.livestage_delta_bytes,
-            self.livestage_lag, self.selftrace_spans, self.query_cost,
+            self.livestage_lag, self.ingest_stage_time,
+            self.selftrace_spans, self.query_cost,
             self.query_outcomes, self.hedge_total, self.retry_total,
         )
         # full compile-key signatures, LRU-bounded (SEEN_SIGNATURES_MAX)
@@ -817,6 +831,62 @@ class KernelTelemetry:
         out["routing"] = routing
         return out
 
+    # ----------------------------------------------------------- ingest
+    def record_ingest_stage(self, stage: str, seconds: float) -> None:
+        """One write-path stage interval: decode / wal_append /
+        stage_delta / cut / flush (tempo_tpu/ingest)."""
+        try:
+            self.ingest_stage_time.observe(float(seconds),
+                                           labels=f'stage="{stage}"')
+            with self._lock:
+                st = self._ingest["stages"].setdefault(
+                    stage, {"count": 0, "seconds": 0.0})
+                st["count"] += 1
+                st["seconds"] += float(seconds)
+        except Exception:
+            pass
+
+    def record_ingest_window(self, traces: int, nbytes: int) -> None:
+        """One push window appended to the columnar WAL."""
+        try:
+            with self._lock:
+                self._ingest["windows"] += 1
+                self._ingest["window_traces"] += int(traces)
+                self._ingest["window_bytes"] += int(nbytes)
+        except Exception:
+            pass
+
+    def record_ingest_features(self, entries: int) -> None:
+        """Segment features checkpointed into the WAL."""
+        try:
+            with self._lock:
+                self._ingest["feature_entries"] += int(entries)
+        except Exception:
+            pass
+
+    def record_ingest_replay(self, records: int, features: int,
+                             torn: bool = False) -> None:
+        """One WAL file replayed at startup."""
+        try:
+            with self._lock:
+                rp = self._ingest["replays"]
+                rp["records"] += int(records)
+                rp["features"] += int(features)
+                if torn:
+                    rp["torn"] += 1
+        except Exception:
+            pass
+
+    def ingest_stats(self) -> dict:
+        """Write-path aggregates for /status/kernels."""
+        with self._lock:
+            out = dict(self._ingest)
+            out["stages"] = {k: dict(v) for k, v in self._ingest["stages"].items()}
+            out["replays"] = dict(self._ingest["replays"])
+        for st in out["stages"].values():
+            st["seconds"] = round(st["seconds"], 6)
+        return out
+
     def record_passthrough(self, nbytes: int) -> None:
         """Compressed bytes a compaction output inherited verbatim."""
         try:
@@ -1058,6 +1128,7 @@ class KernelTelemetry:
             "compaction": self.compaction_stats(),
             "stream": self.stream_stats(),
             "livestage": self.livestage_stats(),
+            "ingest": self.ingest_stats(),
             "slow_queries": self.slow_queries(slow_k),
         }
 

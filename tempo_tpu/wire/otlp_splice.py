@@ -53,11 +53,18 @@ def split_by_trace(payload: bytes):
     res = otlp_splice(payload)
     if res is not None:
         tids, seg_off, seg_len, st, en, out, n_spans = res
+        # one bulk copy out of the native buffer, then plain python
+        # slicing -- per-element numpy indexing is the slow part here
+        tidb = tids.tobytes()
+        outb = out[: int(seg_off[-1] + seg_len[-1])].tobytes() if len(seg_off) else b""
+        offs = seg_off.tolist()
+        lens = seg_len.tolist()
+        sts = st.tolist()
+        ens = en.tolist()
         segments: dict[bytes, tuple[int, int, bytes]] = {}
-        for u in range(tids.shape[0]):
-            o = int(seg_off[u])
-            segments[tids[u].tobytes()] = (
-                int(st[u]), int(en[u]), out[o : o + int(seg_len[u])].tobytes())
+        for u, o in enumerate(offs):
+            segments[tidb[u * 16 : u * 16 + 16]] = (
+                sts[u], ens[u], outb[o : o + lens[u]])
         return segments, n_spans
     return _split_by_trace_py(payload)
 

@@ -1,0 +1,72 @@
+"""Seeded violations in the ingest-plane lock shapes (PR-16
+device-native ingest): the columnar feature cache's install/evict
+lock, the WAL head's segment append path, and the feature-checkpoint
+condition variable -- the lock pairs ingest/columnar.py and
+services/ingester.py use, so the concurrency rules provably cover the
+write path's new state. Every EXPECT marker is asserted by
+tests/test_analysis.py against the exact line it sits on."""
+
+import threading
+
+_cache_lock = threading.Lock()
+_features: dict[int, tuple] = {}  # id(segment) -> SegFeatures
+_head_lock = threading.Lock()
+_pending: list[tuple[int, int]] = []  # (window_idx, trace_idx)
+_checkpoint_cv = threading.Condition()
+_windows = 0
+
+
+def install(seg_id, feat):
+    # sanctioned: cache mutation under its dedicated lock
+    with _cache_lock:
+        _features[seg_id] = feat
+        return len(_features)
+
+
+def install_racy(seg_id, feat):
+    _features[seg_id] = feat  # EXPECT: global-mutation-unlocked
+
+
+def append_window_racy(n_traces):
+    global _windows
+    _windows = _windows + 1  # EXPECT: global-mutation-unlocked
+    for i in range(n_traces):
+        _pending.append((_windows, i))  # EXPECT: global-mutation-unlocked
+
+
+def checkpoint_features():
+    # sanctioned order: checkpoint cv outer, head lock inner (the
+    # sweeper drains pending features, then touches the append file)
+    with _checkpoint_cv:
+        drained = list(_pending)
+        with _head_lock:
+            _checkpoint_cv.notify_all()
+        return drained
+
+
+def append_then_checkpoint_racy():
+    with _head_lock:
+        with _checkpoint_cv:  # EXPECT: lock-order
+            _pending.clear()
+
+
+def pending_depth_unsafe():
+    _checkpoint_cv.acquire()  # EXPECT: lock-bare-acquire
+    n = len(_pending)
+    _checkpoint_cv.release()
+    return n
+
+
+def pending_depth_safe():
+    _checkpoint_cv.acquire()
+    try:
+        return len(_pending)
+    finally:
+        _checkpoint_cv.release()
+
+
+def evict_half():
+    with _cache_lock:
+        for k in list(_features)[: len(_features) // 2]:
+            _features.pop(k, None)
+    return len(_features)

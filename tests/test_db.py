@@ -59,7 +59,8 @@ def test_wal_torn_tail(tmp_path):
     assert not replayed[0].clean
     assert len(replayed[0].records) == 2  # last record dropped
     # file is truncated to a clean boundary: re-open and append works
-    blk2 = WALBlock(str(tmp_path), TENANT, replayed[0].block_id)
+    # (same format class the block was written with -- w2 by default)
+    blk2 = type(blk)(str(tmp_path), TENANT, replayed[0].block_id)
     tid, t = make_traces(1, seed=9)[0]
     blk2.append(tid, 1, 2, segment.segment_for_write(t, 1, 2))
     blk2.flush()

@@ -88,8 +88,24 @@ def _query(rng: random.Random) -> str:
     return q
 
 
-@pytest.mark.parametrize("seed", [101, 202, 303])
-def test_fuzz_host_device_oracle_agree(tmp_path, seed):
+# Tier-1 runs a deterministic PREFIX of each seed's query stream (the
+# first _QUICK cases); the full-depth streams ride in tier-2 under the
+# slow marker. Same seeds, same generator state, so a quick-run failure
+# always reproduces at full depth -- the split only moves wall-clock
+# (device-engine compiles dominate at ~7s/query) out of the 870s tier-1
+# budget.
+_QUICK = 12
+_FULL = 40
+
+
+def _depths(seeds):
+    for s in seeds:
+        yield pytest.param(s, _QUICK, id=f"{s}")
+        yield pytest.param(s, _FULL, id=f"{s}-full", marks=pytest.mark.slow)
+
+
+@pytest.mark.parametrize("seed,n_cases", _depths([101, 202, 303]))
+def test_fuzz_host_device_oracle_agree(tmp_path, seed, n_cases):
     rng = random.Random(seed)
     db = TempoDB(TempoDBConfig(wal_path=str(tmp_path / "w")), backend=MemBackend())
     traces = make_traces(50, seed=seed, n_spans=8)
@@ -97,7 +113,7 @@ def test_fuzz_host_device_oracle_agree(tmp_path, seed):
     blk = db.open_block(db.blocklist.metas(TENANT)[0])
 
     checked = 0
-    for _ in range(40):
+    for _ in range(n_cases):
         q = _query(rng)
         ast = parse(q)  # generator only emits grammar-valid queries
         want = {tid.hex() for tid, t in traces if trace_matches(ast, t)}
@@ -108,11 +124,11 @@ def test_fuzz_host_device_oracle_agree(tmp_path, seed):
             blk, SearchRequest(query=q, limit=1000), mode="device").traces}
         assert got_d == want, (q, sorted(got_d ^ want)[:4])
         checked += 1
-    assert checked == 40
+    assert checked == n_cases
 
 
-@pytest.mark.parametrize("seed", [404, 505])
-def test_fuzz_mesh_path_agrees(tmp_path, seed):
+@pytest.mark.parametrize("seed,n_cases", _depths([404, 505]))
+def test_fuzz_mesh_path_agrees(tmp_path, seed, n_cases):
     """Fourth leg: the stacked MESH program (blocks over dp, span AND
     generic-attr rows over sp, structural ops via all_gathered parent
     tables, parallel/search.py) against the wire oracle on the
@@ -139,7 +155,7 @@ def test_fuzz_mesh_path_agrees(tmp_path, seed):
     all_traces = traces1 + traces2
 
     mesh_ran = 0
-    for _ in range(40):
+    for _ in range(n_cases):
         q = _query(rng)
         ast = parse(q)
         want = {tid.hex() for tid, t in all_traces if trace_matches(ast, t)}
@@ -149,4 +165,4 @@ def test_fuzz_mesh_path_agrees(tmp_path, seed):
         got = {t.trace_id for t in resp.traces}
         assert got == want, (q, sorted(got ^ want)[:4])
         mesh_ran += 1
-    assert mesh_ran >= 20, f"only {mesh_ran} queries ran the mesh path"
+    assert mesh_ran >= n_cases // 2, f"only {mesh_ran} queries ran the mesh path"
