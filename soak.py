@@ -347,6 +347,16 @@ def main(argv=None) -> int:
                          "percentiles fold into the summary (probe "
                          "failures fail the run)")
     ap.add_argument("--vulture-interval", type=float, default=2.0)
+    ap.add_argument("--generator", action="store_true",
+                    help="fold generated-series freshness verdicts into "
+                         "the summary: runs the vulture sidecar (if not "
+                         "already on) with its span_metrics / "
+                         "service_graph probe families and reports the "
+                         "push->series-visible percentiles, the "
+                         "series_visible SLO verdict and the target's "
+                         "generator plane counters; generator probe "
+                         "failures or a critical freshness SLO fail "
+                         "the run")
     ap.add_argument("--chaos", nargs="?", const=DEFAULT_CHAOS_SPEC,
                     default="", metavar="SPEC",
                     help="run the soak under fault injection: SPEC is "
@@ -393,7 +403,7 @@ def main(argv=None) -> int:
     # for (correctness under load, not at rest). Runs in its own
     # thread against the same target/tenant.
     vult = vstop = vthread = None
-    if args.vulture:
+    if args.vulture or args.generator:
         from tempo_tpu.vulture import Vulture, VultureConfig
 
         # Vulture itself disables cold-read /flush probes for remote
@@ -441,6 +451,39 @@ def main(argv=None) -> int:
                 "failures": vs["failures"][:5],
             }
             report["ok"] = bool(report["ok"]) and bad == 0
+            if args.generator:
+                # series-freshness verdicts: the vulture generator
+                # families' outcomes + the series_visible SLO beside
+                # the target's own generator plane counters, so one
+                # soak summary answers "are generated series fresh
+                # and correct UNDER this load"
+                fams = {f: vs["outcomes"].get(f, {})
+                        for f in ("span_metrics", "service_graph")}
+                gen_bad = sum(n for fam in fams.values()
+                              for out, n in fam.items()
+                              if out not in ("ok", "shed"))
+                slo_obj = vs["slo"].get("objectives", {}).get(
+                    "freshness-series_visible", {})
+                try:
+                    ks = json.loads(urllib.request.urlopen(
+                        target + "/status/kernels", timeout=10).read())
+                    tgt = ks.get("generator", {})
+                except Exception:
+                    tgt = {}
+                report["generator"] = {
+                    "series_freshness": vs["freshness"].get(
+                        "series_visible", {}),
+                    "slo_verdict": slo_obj.get("verdict"),
+                    "burn_rates": slo_obj.get("burn_rates"),
+                    "outcomes": fams,
+                    "probe_failures": gen_bad,
+                    "target": {k: tgt.get(k) for k in (
+                        "windows", "window_spans", "edges_completed",
+                        "unpaired", "expired", "freshness_avg_s",
+                        "freshness_max_s")},
+                }
+                report["ok"] = (bool(report["ok"]) and gen_bad == 0
+                                and slo_obj.get("verdict") != "critical")
         if args.chaos:
             # the proof artifact: how many faults were actually
             # injected (a chaos soak that injected nothing proves

@@ -21,6 +21,8 @@ import threading
 import time
 from typing import NamedTuple
 
+import numpy as np
+
 from ..wire.segment import segment_to_trace
 
 
@@ -65,6 +67,55 @@ def kv_pair_key(key: str, value: str) -> str:
     return key + "\x00" + value
 
 
+_FNV64_OFFSET = 0xCBF29CE484222325
+_FNV64_PRIME = 0x100000001B3
+_U64 = (1 << 64) - 1
+
+
+def _fnv1a_64(data: bytes, seed: int = _FNV64_OFFSET) -> int:
+    """64-bit FNV-1a over raw bytes: the coded edge-store key hash.
+    Python-side (runs inside the one-time decode walk); 64 bits keep
+    accidental (trace, span) key collisions out of reach."""
+    h = seed
+    for b in data:
+        h = ((h ^ b) * _FNV64_PRIME) & _U64
+    return h
+
+
+def edge_key_client(trace_id: bytes, span_id: bytes) -> int:
+    """Coded pairing key for a CLIENT span: hash(trace_id || span_id).
+    The matching SERVER span hashes (trace_id || parent_span_id) to the
+    SAME integer, so client/server pairing is one dict probe on an int
+    instead of a byte-tuple key. 0 is reserved for "no edge role"."""
+    return _fnv1a_64(span_id, _fnv1a_64(trace_id)) or 1
+
+
+class SpanColumns(NamedTuple):
+    """Per-span coded columns for the streaming metrics-generator,
+    filled inside the SAME decode that codes the search features. All
+    arrays share span (document) order:
+
+      svc_code/name_code  int32 LiveDict codes (resource service.name,
+                          span name -- never remap, so series keys
+                          assembled from them stay stable forever)
+      kind/status         int32 raw enum values
+      dur_s               float32 max(0, duration_nanos)/1e9 (exactly
+                          the legacy processors' duration definition)
+      edge_key            uint64 service-graph pairing key: CLIENT
+                          spans hash (trace_id, span_id), SERVER spans
+                          hash (trace_id, parent_span_id), others 0
+      tid_hex             the segment's trace id (exemplars)
+    """
+
+    svc_code: np.ndarray
+    name_code: np.ndarray
+    kind: np.ndarray
+    status: np.ndarray
+    dur_s: np.ndarray
+    edge_key: np.ndarray
+    tid_hex: str
+
+
 class SegFeatures(NamedTuple):
     """One segment's coded contribution to its trace's staged features.
     EXACTLY the per-span extraction services/ingester._SearchEntry.build
@@ -72,17 +123,64 @@ class SegFeatures(NamedTuple):
     segments is a conservative superset of the entry built from the
     combined trace (combine_traces dedupes by (span_id, start, name),
     so dropped duplicates only SHRINK the combined sets). lo/hi None =
-    the segment carried no spans."""
+    the segment carried no spans.
+
+    `spans` (per-span generator columns) is optional: WAL replay seeds
+    features from checkpointed strings WITHOUT a proto decode, and the
+    generator tap only consumes freshly-pushed windows -- so replayed
+    entries legitimately carry None here."""
 
     kv_codes: tuple[int, ...]
     name_codes: tuple[int, ...]
     lo_ns: int | None
     hi_ns: int | None
+    spans: SpanColumns | None = None
+
+
+# SpanKind values with a service-graph edge role (wire/model.py:
+# SERVER=2, CLIENT=3)
+_KIND_SERVER = 2
+_KIND_CLIENT = 3
+
+
+def span_columns_from_trace(tr, code) -> SpanColumns:
+    """Per-span generator columns from an already-decoded Trace; `code`
+    is a LiveDict.code bound method. Shared by compute_features (the
+    write-path single decode) and the remote-generator push path (which
+    receives decoded traces over /internal/genpush)."""
+    svc: list[int] = []
+    name: list[int] = []
+    kind: list[int] = []
+    status: list[int] = []
+    dur: list[float] = []
+    ekey: list[int] = []
+    tid_hex = ""
+    for res, _, sp in tr.all_spans():
+        svc.append(code(res.service_name))
+        name.append(code(sp.name))
+        k = int(sp.kind)
+        kind.append(k)
+        status.append(int(sp.status_code))
+        dur.append(max(0, sp.duration_nanos) / 1e9)
+        if k == _KIND_CLIENT:
+            ekey.append(edge_key_client(sp.trace_id, sp.span_id))
+        elif k == _KIND_SERVER:
+            ekey.append(edge_key_client(sp.trace_id, sp.parent_span_id))
+        else:
+            ekey.append(0)
+        if not tid_hex and sp.trace_id:
+            tid_hex = sp.trace_id.hex()
+    return SpanColumns(
+        np.asarray(svc, np.int32), np.asarray(name, np.int32),
+        np.asarray(kind, np.int32), np.asarray(status, np.int32),
+        np.asarray(dur, np.float32), np.asarray(ekey, np.uint64), tid_hex)
 
 
 def compute_features(seg: bytes, ldict: LiveDict) -> SegFeatures:
     """Decode one segment's proto and code its features (first-seen
-    order, deduped within the segment)."""
+    order, deduped within the segment). The generator's per-span
+    columns ride the same walk -- one decode serves search staging,
+    WAL checkpoints AND the streaming metrics-generator."""
     tr = segment_to_trace(seg)
     code = ldict.code
     kv_codes: list[int] = []
@@ -105,7 +203,8 @@ def compute_features(seg: bytes, ldict: LiveDict) -> SegFeatures:
             lo = sp.start_unix_nano
         if hi is None or sp.end_unix_nano > hi:
             hi = sp.end_unix_nano
-    return SegFeatures(tuple(kv_codes), tuple(name_codes), lo, hi)
+    return SegFeatures(tuple(kv_codes), tuple(name_codes), lo, hi,
+                       span_columns_from_trace(tr, code))
 
 
 class ColumnarIngest:

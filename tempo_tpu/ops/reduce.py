@@ -60,6 +60,100 @@ def _reduce_host(sid: np.ndarray, dur_s: np.ndarray, n_series: int,
             hist.astype(np.int64))
 
 
+@partial(jax.jit, static_argnames=("n_edges_b", "n_buckets"))
+def _edge_reduce_kernel(eid, cdur, sdur, failed, n_valid, edges,
+                        n_edges_b: int, n_buckets: int):
+    """One fused program for a window's completed service-graph edges:
+    eid (N,) int32 (pad: n_edges_b), cdur/sdur (N,) f32, failed (N,)
+    int32 -> counts (E,), failed_counts (E,), client_sum (E,),
+    server_sum (E,), client_hist (E, nb), server_hist (E, nb). Six
+    segment reduces sharing one upload instead of the legacy two
+    span_metrics launches + host bincount."""
+    valid = jnp.arange(eid.shape[0]) < n_valid
+    seg = jnp.where(valid, eid, n_edges_b)
+    ones = valid.astype(jnp.int32)
+    ns = n_edges_b + 1
+    counts = jax.ops.segment_sum(ones, seg, num_segments=ns)[:n_edges_b]
+    fcounts = jax.ops.segment_sum(jnp.where(valid, failed, 0), seg,
+                                  num_segments=ns)[:n_edges_b]
+    csum = jax.ops.segment_sum(jnp.where(valid, cdur, 0.0), seg,
+                               num_segments=ns)[:n_edges_b]
+    ssum = jax.ops.segment_sum(jnp.where(valid, sdur, 0.0), seg,
+                               num_segments=ns)[:n_edges_b]
+    nhist = n_edges_b * n_buckets + 1
+    ccombo = jnp.where(valid, seg * n_buckets + jnp.searchsorted(edges, cdur),
+                       n_edges_b * n_buckets)
+    scombo = jnp.where(valid, seg * n_buckets + jnp.searchsorted(edges, sdur),
+                       n_edges_b * n_buckets)
+    chist = jax.ops.segment_sum(ones, ccombo, num_segments=nhist)[:-1]
+    shist = jax.ops.segment_sum(ones, scombo, num_segments=nhist)[:-1]
+    return (counts, fcounts, csum, ssum,
+            chist.reshape(n_edges_b, n_buckets),
+            shist.reshape(n_edges_b, n_buckets))
+
+
+def _edge_reduce_host(eid: np.ndarray, cdur: np.ndarray, sdur: np.ndarray,
+                      failed: np.ndarray, n_edges: int, bucket_edges: tuple):
+    """Host twin of the edge kernel: composes the span-metrics host fold
+    per side plus a failed bincount -- numerically EXACTLY the legacy
+    ServiceGraphsProcessor.collect sequence, which is what makes the
+    streaming-vs-legacy differential bit-for-bit."""
+    counts, csum, chist = _reduce_host(eid, cdur, n_edges, bucket_edges)
+    _, ssum, shist = _reduce_host(eid, sdur, n_edges, bucket_edges)
+    fcounts = np.bincount(eid[failed.astype(bool)],
+                          minlength=n_edges)[:n_edges].astype(np.int64)
+    return counts, fcounts, csum, ssum, chist, shist
+
+
+def edge_metrics_reduce(eid: np.ndarray, cdur: np.ndarray, sdur: np.ndarray,
+                        failed: np.ndarray, n_edges: int, bucket_edges: tuple):
+    """-> (counts, failed_counts, client_sum, server_sum, client_hist,
+    server_hist) per edge id, as numpy. Same engine policy as
+    span_metrics_reduce: host fold through a high-latency link, one
+    fused device program otherwise."""
+    n = eid.shape[0]
+    nb = len(bucket_edges) + 1
+    if n == 0 or n_edges == 0:
+        z = np.zeros(n_edges, np.int64)
+        zf = np.zeros(n_edges, np.float64)
+        zh = np.zeros((n_edges, nb), np.int64)
+        return z, z.copy(), zf, zf.copy(), zh, zh.copy()
+    from ..util.kerneltel import TEL
+    from ..util.linkcost import link_rtt_ms
+
+    if link_rtt_ms() > 2.0:
+        TEL.record_routing("edge_reduce", "host", "link_rtt")
+        return _edge_reduce_host(eid, cdur, sdur, failed, n_edges, bucket_edges)
+    TEL.record_routing("edge_reduce", "device", "link_fast")
+    Np = pow2(n)
+    Eb = pow2(n_edges)
+    eid_p = np.full(Np, Eb, dtype=np.int32)
+    eid_p[:n] = eid
+    cdur_p = np.zeros(Np, dtype=np.float32)
+    cdur_p[:n] = cdur
+    sdur_p = np.zeros(Np, dtype=np.float32)
+    sdur_p[:n] = sdur
+    failed_p = np.zeros(Np, dtype=np.int32)
+    failed_p[:n] = failed.astype(np.int32)
+    import time as _time
+
+    TEL.record_launch("edge_reduce", ("edge_reduce", Np, Eb, nb), Np)
+    t0 = _time.perf_counter()
+    counts, fcounts, csum, ssum, chist, shist = _edge_reduce_kernel(
+        jnp.asarray(eid_p), jnp.asarray(cdur_p), jnp.asarray(sdur_p),
+        jnp.asarray(failed_p), jnp.int32(n),
+        jnp.asarray(np.asarray(bucket_edges, np.float32)), Eb, nb
+    )
+    out = (np.asarray(counts[:n_edges]).astype(np.int64),
+           np.asarray(fcounts[:n_edges]).astype(np.int64),
+           np.asarray(csum[:n_edges]).astype(np.float64),
+           np.asarray(ssum[:n_edges]).astype(np.float64),
+           np.asarray(chist[:n_edges]).astype(np.int64),
+           np.asarray(shist[:n_edges]).astype(np.int64))
+    TEL.observe_device("edge_reduce", Np, t0)
+    return out
+
+
 def span_metrics_reduce(sid: np.ndarray, dur_s: np.ndarray, n_series: int,
                         bucket_edges: tuple) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
     """-> (calls (n_series,), latency_sum (n_series,),

@@ -42,6 +42,9 @@ DEVICE_HOST_TWINS: dict[str, str] = {
     "parallel.search.sharded_search": "ops.hostfilter.eval_block_host",
     # span-metrics segmented reduce routes to its host fold internally
     "ops.reduce.span_metrics_reduce": "ops.reduce._reduce_host",
+    # service-graph fused edge reduce (streaming generator): host twin
+    # replays the legacy two-launch + bincount sequence bit-exactly
+    "ops.reduce.edge_metrics_reduce": "ops.reduce._edge_reduce_host",
     # live-head engine: staged slot filter + id lookup, numpy twins run
     # the tiny-head path and the differential harness
     "ops.livestage.eval_live_device": "ops.livestage.eval_live_host",

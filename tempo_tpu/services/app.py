@@ -255,9 +255,19 @@ class App:
                 if shared_ring and self.generator is None
                 else None
             )
+            # streaming tap: when the generator AND the ingester share
+            # this process, the tap reads coded span columns out of the
+            # ingester's ColumnarIngest cache (the write path already
+            # decoded them) instead of re-decoding traces
+            gen_window = (
+                self._generator_window
+                if self.generator is not None and self.ingester is not None
+                else None
+            )
             self.distributor = Distributor(
                 self.ring, self.client_for, self.overrides,
                 generator_forward=gen_forward, generator_ring=gen_ring,
+                generator_window=gen_window,
             )
 
         self.querier = self.frontend = self.querier_worker = None
@@ -312,7 +322,8 @@ class App:
         # this process already collects, evaluated as multi-window burn
         # rates on /status/slo + tempo_slo_burn_rate gauges. Query-
         # serving roles only -- a standalone compactor has no read SLIs.
-        self.slo = build_default_slo(self.frontend) if self.frontend else None
+        self.slo = (build_default_slo(self.frontend, self.generator)
+                    if (self.frontend or self.generator) else None)
 
         from .usagestats import UsageReporter
 
@@ -327,6 +338,22 @@ class App:
         self.remote_writer = None
         self.http_server: ThreadingHTTPServer | None = None
         self._profile_lock = threading.Lock()  # one /debug/profile at a time
+
+    def _generator_window(self, tenant: str, segs: list, push_ts: float) -> None:
+        """Streaming generator tap (runs on the distributor's tap
+        worker): resolve each segment's coded span columns from the
+        tenant instance's ColumnarIngest -- the staging path filled
+        that identity-keyed cache before the tap item was enqueued, so
+        this is a pure cache read with ZERO extra proto decodes
+        (ColumnarIngest.decodes proves it) -- and fold the window."""
+        col = self.ingester.instance(tenant).columnar
+        cols = []
+        for seg in segs:
+            feat = col.features_for(seg)
+            if feat.spans is not None:
+                cols.append(feat.spans)
+        if cols:
+            self.generator.push_window(tenant, cols, col.dict, push_ts)
 
     # ------------------------------------------------------------ lifecycle
     def start(self) -> None:
@@ -1040,15 +1067,17 @@ def _make_handler(app: App):
     return Handler
 
 
-def build_default_slo(frontend):
+def build_default_slo(frontend, generator=None):
     """The serving objectives every query-capable target ships with
     (util/slo): availability over the frontend's per-class outcome
     counters (QoS sheds excluded -- admission refusing work is the
     budget system functioning), p99-under-threshold latency per query
     class from the frontend latency histogram, and live-head freshness
-    from the push->device-visible staging-lag histogram. Thresholds
-    sit on bucket edges; TEMPO_SLO_<CLASS>_P99_S env overrides let an
-    operator retune without code."""
+    from the push->device-visible staging-lag histogram. Targets that
+    host a metrics-generator additionally carry the push->series-
+    visible generator-freshness objective. Thresholds sit on bucket
+    edges; TEMPO_SLO_<CLASS>_P99_S env overrides let an operator
+    retune without code."""
     from ..util import slo as slomod
     from ..util.kerneltel import TEL
 
@@ -1060,42 +1089,45 @@ def build_default_slo(frontend):
 
     engine = slomod.SLOEngine()
 
-    def outcomes_sli():
-        # resolve the instrument through TEL at call time: TEL.reset()
-        # (tests) swaps the counter object under us
-        return slomod.counter_sli(
-            TEL.query_outcomes,
-            good=lambda l: 'outcome="ok"' in l,
-            bad=lambda l: 'outcome="error"' in l)()
+    if frontend is not None:
+        def outcomes_sli():
+            # resolve the instrument through TEL at call time:
+            # TEL.reset() (tests) swaps the counter object under us
+            return slomod.counter_sli(
+                TEL.query_outcomes,
+                good=lambda l: 'outcome="ok"' in l,
+                bad=lambda l: 'outcome="error"' in l)()
 
-    engine.register(slomod.Objective(
-        name="read-availability", kind="availability", target=0.999,
-        sli=outcomes_sli,
-        description="queries served without error across every query "
-                    "class (429 QoS sheds excluded)"))
-
-    for op, env, default in (("traces", "TEMPO_SLO_TRACES_P99_S", 1.0),
-                             ("search", "TEMPO_SLO_SEARCH_P99_S", 2.5),
-                             ("search_stream", "TEMPO_SLO_STREAM_P99_S", 5.0),
-                             ("metrics", "TEMPO_SLO_METRICS_P99_S", 10.0)):
-        thr = _thr(env, default)
         engine.register(slomod.Objective(
-            name=f"latency-{op}", kind="latency", target=0.99,
-            sli=slomod.histogram_sli(
-                frontend.query_latency, thr,
-                labels_pred=lambda l, _op=op: f'op="{_op}"' in l),
-            description=f"{op} queries completing within {thr:g}s"))
+            name="read-availability", kind="availability", target=0.999,
+            sli=outcomes_sli,
+            description="queries served without error across every query "
+                        "class (429 QoS sheds excluded)"))
 
-    fresh_thr = _thr("TEMPO_SLO_FRESHNESS_P99_S", 2.5)
+        for op, env, default in (("traces", "TEMPO_SLO_TRACES_P99_S", 1.0),
+                                 ("search", "TEMPO_SLO_SEARCH_P99_S", 2.5),
+                                 ("search_stream", "TEMPO_SLO_STREAM_P99_S", 5.0),
+                                 ("metrics", "TEMPO_SLO_METRICS_P99_S", 10.0)):
+            thr = _thr(env, default)
+            engine.register(slomod.Objective(
+                name=f"latency-{op}", kind="latency", target=0.99,
+                sli=slomod.histogram_sli(
+                    frontend.query_latency, thr,
+                    labels_pred=lambda l, _op=op: f'op="{_op}"' in l),
+                description=f"{op} queries completing within {thr:g}s"))
 
-    def freshness_sli():
-        return slomod.histogram_sli(TEL.livestage_lag, fresh_thr)()
+        fresh_thr = _thr("TEMPO_SLO_FRESHNESS_P99_S", 2.5)
+        engine.register(slomod.freshness_objective(
+            "live-freshness", lambda: TEL.livestage_lag, fresh_thr,
+            description=f"pushes device-visible to live search within "
+                        f"{fresh_thr:g}s (livestage staging lag)"))
 
-    engine.register(slomod.Objective(
-        name="live-freshness", kind="freshness", target=0.99,
-        sli=freshness_sli,
-        description=f"pushes device-visible to live search within "
-                    f"{fresh_thr:g}s (livestage staging lag)"))
+    if generator is not None:
+        gen_thr = _thr("TEMPO_SLO_GENERATOR_FRESHNESS_P99_S", 2.5)
+        engine.register(slomod.freshness_objective(
+            "generator-freshness", lambda: TEL.generator_freshness, gen_thr,
+            description=f"pushed spans reflected in generated series "
+                        f"within {gen_thr:g}s (streaming tap fold lag)"))
     return engine
 
 

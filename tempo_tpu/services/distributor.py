@@ -40,19 +40,27 @@ class DistributorStats:
 
 class Distributor:
     def __init__(self, ring: Ring, client_for, overrides: Overrides,
-                 generator_forward=None, generator_ring: Ring | None = None):
+                 generator_forward=None, generator_ring: Ring | None = None,
+                 generator_window=None):
         """client_for(addr) -> object with push_segments(tenant, batch);
         generator_forward(tenant, traces) optional in-process
         metrics-generator tap (single binary). generator_ring selects
         REMOTE generators instead, per-tenant shuffle-sharded
         (distributor.go:410-442: metrics_generator_ring_size members
-        per tenant, traces routed within the shard by id hash)."""
+        per tenant, traces routed within the shard by id hash).
+        generator_window(tenant, segs, push_ts) is the STREAMING
+        in-process tap: it receives the post-filter segment bytes --
+        the same objects the ingester just staged, so the generator
+        reads their coded features out of ColumnarIngest's identity-
+        keyed cache with zero extra proto decodes. When set it replaces
+        generator_forward's decode-per-push leg."""
         self.ring = ring
         self.client_for = client_for
         self.overrides = overrides
         self.limiter = RateLimiter(overrides)
         self.generator_forward = generator_forward
         self.generator_ring = generator_ring
+        self.generator_window = generator_window
         self.stats = DistributorStats()
         from ..util.metrics import Histogram
 
@@ -71,13 +79,17 @@ class Distributor:
         self._gen_pending = 0  # queued + in-flight tap items
         self._gen_stop = False
 
-    def _forward_to_generators(self, tenant: str, segs: dict, traces_fn) -> None:
-        """segs: {tid: (s, e, segment)}; traces_fn() -> {tid: Trace},
-        resolved ONLY by the in-process leg -- and on the TAP WORKER,
-        not the push path. The remote-ring leg ships proto blobs sliced
-        straight from the segments (segment_payload), so the sharded
-        production topology never decodes on the distributor at all."""
-        if self.generator_ring is None and self.generator_forward is None:
+    def _forward_to_generators(self, tenant: str, segs, traces_fn,
+                               push_ts: float) -> None:
+        """segs: {tid: (s, e, segment)} for the ring and streaming legs,
+        the post-filter id set for the legacy in-process leg. traces_fn()
+        -> {tid: Trace} is resolved ONLY by the legacy leg -- and on the
+        TAP WORKER, not the push path. The remote-ring leg ships proto
+        blobs sliced straight from the segments (segment_payload) and
+        the streaming leg hands the segment bytes to the generator's
+        columnar tap, so neither ever decodes on the distributor."""
+        if (self.generator_ring is None and self.generator_forward is None
+                and self.generator_window is None):
             return
         import queue as _queue
 
@@ -89,20 +101,32 @@ class Distributor:
                     target=self._gen_tap_loop, daemon=True, name="generator-tap")
                 self._gen_thread.start()
             try:
-                self._gen_q.put_nowait((tenant, segs, traces_fn))
+                self._gen_q.put_nowait((tenant, segs, traces_fn, push_ts))
                 self._gen_pending += 1
             except _queue.Full:
                 self.stats.gen_tap_dropped += 1
 
     def _gen_tap_loop(self) -> None:
+        import queue as _queue
+
         while not self._gen_stop:
             try:
                 item = self._gen_q.get(timeout=0.5)
             except Exception:
                 continue
+            # greedy drain: everything already queued folds in THIS
+            # pass, merged per tenant into one push window -- a backlog
+            # amortizes to one device reduce per tenant instead of one
+            # per push, so push->series-visible lag stays bounded by
+            # fold time rather than queue depth under sustained load
+            items = [item]
+            while len(items) < 64:
+                try:
+                    items.append(self._gen_q.get_nowait())
+                except _queue.Empty:
+                    break
             try:
-                tenant, segs, traces_fn = item
-                self._forward_now(tenant, segs, traces_fn)
+                self._forward_batch(items)
             except Exception:
                 pass  # metrics tap must never crash its worker
             finally:
@@ -110,7 +134,37 @@ class Distributor:
                 # AFTER processing: flush can't slip through the window
                 # between queue pop and the work happening
                 with self._gen_lock:
-                    self._gen_pending -= 1
+                    self._gen_pending -= len(items)
+
+    def _forward_batch(self, items: list) -> None:
+        """Forward one drained tap batch. The streaming-window leg
+        merges items per tenant (segment lists concatenate -- the same
+        trace may continue across pushes, so never dedupe by id) and
+        stamps the merged window with its OLDEST push_ts, keeping the
+        freshness histogram an honest upper bound. The ring and legacy
+        legs keep per-item semantics."""
+        use_window = (self.generator_window is not None
+                      and self.generator_ring is None)
+        if not use_window or len(items) == 1:
+            for tenant, segs, traces_fn, push_ts in items:
+                try:
+                    self._forward_now(tenant, segs, traces_fn, push_ts)
+                except Exception:
+                    pass
+            return
+        merged: dict[str, tuple[list, float]] = {}
+        for tenant, segs, _fn, push_ts in items:
+            ent = merged.get(tenant)
+            if ent is None:
+                merged[tenant] = ([seg for _, _, seg in segs.values()], push_ts)
+            else:
+                ent[0].extend(seg for _, _, seg in segs.values())
+                merged[tenant] = (ent[0], min(ent[1], push_ts))
+        for tenant, (seg_list, ts) in merged.items():
+            try:
+                self.generator_window(tenant, seg_list, ts)
+            except Exception:
+                pass
 
     def flush_generator_tap(self, timeout_s: float = 5.0) -> None:
         """Drain the tap queue (tests / graceful shutdown)."""
@@ -125,7 +179,8 @@ class Distributor:
         self.flush_generator_tap(timeout_s=2.0)
         self._gen_stop = True
 
-    def _forward_now(self, tenant: str, segs: dict, traces_fn) -> None:
+    def _forward_now(self, tenant: str, segs, traces_fn,
+                     push_ts: float) -> None:
         if self.generator_ring is not None:
             from ..util.hashing import fnv1a_32
             from ..wire.segment import segment_payload
@@ -143,6 +198,12 @@ class Distributor:
                     self.client_for(addr).push_generator_blobs(tenant, blobs)
                 except Exception:
                     pass  # metrics tap must never fail ingest
+        elif self.generator_window is not None:
+            try:
+                self.generator_window(
+                    tenant, [seg for _, _, seg in segs.values()], push_ts)
+            except Exception:
+                pass  # metrics tap must never fail ingest
         elif self.generator_forward is not None and traces_fn is not None:
             try:
                 # restrict to the post-filter set: segs is lim_filtered,
@@ -303,18 +364,22 @@ class Distributor:
         self.stats.traces_pushed += len(lim_filtered)
 
         # forward the POST-filter set (a trace refused from storage must
-        # not produce span metrics); the model closure ships only when
-        # the in-process leg exists -- the ring leg never resolves it,
-        # and holding decoded models in the tap queue for nothing would
-        # double its memory
-        # the ring leg ships the segment bytes; the in-process leg only
-        # needs the post-filter id SET (holding segments in the queue
-        # would pin multi-MB batches for nothing)
+        # not produce span metrics). The ring and streaming legs ship
+        # the segment bytes (the streaming tap NEEDS the exact objects
+        # the ingester staged -- ColumnarIngest's feature cache is
+        # identity-keyed, and holding the refs pins the cache entries
+        # until the tap reads them); the legacy in-process leg only
+        # needs the post-filter id SET plus the model closure, resolved
+        # on the tap worker
+        use_window = (self.generator_window is not None
+                      and self.generator_ring is None)
         self._forward_to_generators(
             tenant,
-            lim_filtered if self.generator_ring is not None
+            lim_filtered if (self.generator_ring is not None or use_window)
             else frozenset(lim_filtered),
-            traces_fn if self.generator_forward is not None else None)
+            traces_fn if (self.generator_forward is not None
+                          and not use_window) else None,
+            now)
 
     # ------------------------------------------------------------ rebatch
     @staticmethod

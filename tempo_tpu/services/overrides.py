@@ -33,6 +33,8 @@ class Limits:
     metrics_generator_processors: tuple[str, ...] = ()
     metrics_generator_max_active_series: int = 0
     metrics_generator_ring_size: int = 0  # shuffle-shard size; 0 = all
+    # per-tenant registry staleness window; 0 = generator default
+    metrics_generator_stale_series_s: float = 0.0
 
 
 @dataclass

@@ -253,6 +253,28 @@ class KernelTelemetry:
             "window_bytes": 0, "feature_entries": 0,
             "replays": {"records": 0, "features": 0, "torn": 0},
         }
+        # streaming metrics-generator (services/generator): per-stage
+        # fold seconds, push->series-visible freshness, per-tenant
+        # series-limit sheds, window/pairing volume
+        self.generator_stage_time = Histogram(
+            "tempo_generator_stage_seconds",
+            buckets=(0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0),
+            help="streaming generator fold wall seconds by stage "
+                 "(span-metrics/service-graphs)")
+        self.generator_freshness = Histogram(
+            "tempo_generator_freshness_seconds",
+            buckets=(0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0),
+            help="push received -> generated series visible to the "
+                 "next exposition scrape")
+        self.generator_shed = Counter(
+            "tempo_generator_series_shed_total",
+            help="spans shed by the per-tenant max-active-series limit")
+        self._generator: dict = {
+            "stages": {}, "windows": 0, "window_spans": 0,
+            "edges_completed": 0, "unpaired": 0, "expired": 0,
+            "shed": {}, "freshness_count": 0, "freshness_sum": 0.0,
+            "freshness_max": 0.0,
+        }
         # self-tracing pipeline health (services/selftrace): spans
         # shipped vs whole traces dropped at the bounded in-flight queue
         self.selftrace_spans = Counter(
@@ -312,6 +334,8 @@ class KernelTelemetry:
             self.affinity_jobs, self.qos_shed, self.staged_placement,
             self.livestage_rows, self.livestage_delta_bytes,
             self.livestage_lag, self.ingest_stage_time,
+            self.generator_stage_time, self.generator_freshness,
+            self.generator_shed,
             self.selftrace_spans, self.query_cost,
             self.query_outcomes, self.hedge_total, self.retry_total,
         )
@@ -887,6 +911,76 @@ class KernelTelemetry:
             st["seconds"] = round(st["seconds"], 6)
         return out
 
+    # -------------------------------------------------------- generator
+    def record_generator_stage(self, stage: str, seconds: float) -> None:
+        """One streaming-generator fold interval (span-metrics /
+        service-graphs) on the tap worker."""
+        try:
+            self.generator_stage_time.observe(float(seconds),
+                                              labels=f'stage="{stage}"')
+            with self._lock:
+                st = self._generator["stages"].setdefault(
+                    stage, {"count": 0, "seconds": 0.0})
+                st["count"] += 1
+                st["seconds"] += float(seconds)
+        except Exception:
+            pass
+
+    def record_generator_window(self, spans: int, edges: int,
+                                unpaired: int = 0, expired: int = 0) -> None:
+        """One push window folded: spans aggregated, service-graph
+        edges completed, plus the edge store's current unpaired depth
+        and cumulative expiries."""
+        try:
+            with self._lock:
+                g = self._generator
+                g["windows"] += 1
+                g["window_spans"] += int(spans)
+                g["edges_completed"] += int(edges)
+                g["unpaired"] = int(unpaired)
+                g["expired"] = int(expired)
+        except Exception:
+            pass
+
+    def record_generator_shed(self, tenant: str, n: int) -> None:
+        """Spans refused a new series by max-active-series."""
+        try:
+            self.generator_shed.inc(
+                int(n), labels=f'tenant="{_esc_label(tenant)}"')
+            with self._lock:
+                sh = self._generator["shed"]
+                sh[tenant] = sh.get(tenant, 0) + int(n)
+        except Exception:
+            pass
+
+    def record_generator_freshness(self, seconds: float) -> None:
+        """Push receive -> series visible, one window."""
+        try:
+            self.generator_freshness.observe(float(seconds))
+            with self._lock:
+                g = self._generator
+                g["freshness_count"] += 1
+                g["freshness_sum"] += float(seconds)
+                g["freshness_max"] = max(g["freshness_max"], float(seconds))
+        except Exception:
+            pass
+
+    def generator_stats(self) -> dict:
+        """Streaming-generator aggregates for /status/kernels."""
+        with self._lock:
+            out = dict(self._generator)
+            out["stages"] = {k: dict(v)
+                             for k, v in self._generator["stages"].items()}
+            out["shed"] = dict(self._generator["shed"])
+        for st in out["stages"].values():
+            st["seconds"] = round(st["seconds"], 6)
+        out["freshness_avg_s"] = round(
+            out["freshness_sum"] / out["freshness_count"],
+            6) if out["freshness_count"] else 0.0
+        out["freshness_max_s"] = round(out.pop("freshness_max"), 6)
+        out.pop("freshness_sum", None)
+        return out
+
     def record_passthrough(self, nbytes: int) -> None:
         """Compressed bytes a compaction output inherited verbatim."""
         try:
@@ -1129,6 +1223,7 @@ class KernelTelemetry:
             "stream": self.stream_stats(),
             "livestage": self.livestage_stats(),
             "ingest": self.ingest_stats(),
+            "generator": self.generator_stats(),
             "slow_queries": self.slow_queries(slow_k),
         }
 

@@ -104,6 +104,23 @@ def histogram_sli(hist: Histogram, threshold: float,
     return read
 
 
+def freshness_objective(name: str, hist_fn: Callable[[], Histogram],
+                        threshold: float, description: str = "",
+                        target: float = 0.99) -> Objective:
+    """Push->visible freshness objective over a lag histogram (live
+    staging lag, generator series-visible lag). `hist_fn` resolves the
+    Histogram at EVALUATION time: kerneltel's TEL.reset() (tests) swaps
+    instrument objects, and binding the object at registration would
+    silently freeze the SLI on the dead one. The threshold should sit
+    on a bucket edge (histogram_sli's rounding rule)."""
+
+    def sli() -> tuple[float, float]:
+        return histogram_sli(hist_fn(), threshold)()
+
+    return Objective(name=name, kind="freshness", target=target,
+                     sli=sli, description=description)
+
+
 class SLOEngine:
     """Evaluates registered objectives into per-window burn rates,
     verdicts, and exposition gauges.

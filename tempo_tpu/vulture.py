@@ -38,6 +38,16 @@ path the system has:
                 id each cycle, across compactions, against their
                 recorded content digest -- data loss detection long
                 after the write.
+  span_metrics  the metrics-generator's RED series: expected
+                traces_spanmetrics_calls_total per (service, name,
+                kind, status) computed client-side from the spans just
+                pushed and compared exactly against the target's
+                /metrics; the retry lag is the push->series-visible
+                freshness histogram (the generator freshness SLO).
+  service_graph a dedicated client/server span pair across two derived
+                services: exactly one service-graph edge (request,
+                failed, server-latency count) must materialize from
+                the coded edge store.
 
 Outcomes per probe: ok | miss (data absent) | corrupt (content
 mismatch) | timeout (never became visible) | error (transport/HTTP) |
@@ -116,6 +126,9 @@ class VultureConfig:
     # backend storage path for TRUE fresh-reader cold probes (every
     # byte off disk through a new TempoDB); "" = cold reads over HTTP
     backend_path: str = ""
+    # metrics-generator probes (span_metrics + service_graph): read
+    # generated series off the target's main /metrics endpoint
+    generator_probes: bool = True
     seed: int | None = None
 
 
@@ -197,6 +210,37 @@ def _make_probe_trace(rng: random.Random, tid: bytes, svc: str,
     return t
 
 
+def _make_graph_trace(rng: random.Random, tid: bytes, svc: str,
+                      base_ns: int) -> Trace:
+    """The minimal trace that must materialize exactly one service-graph
+    edge: a CLIENT span in `svc`-client whose span id is the SERVER
+    span's parent id over in `svc`-server. The server span carries
+    status=ERROR so the failed counter is exercised too."""
+    cid = rng.getrandbits(64).to_bytes(8, "big")
+    sid = rng.getrandbits(64).to_bytes(8, "big")
+    t = Trace()
+    client_rs = ResourceSpans(resource=Resource(attrs={
+        "service.name": f"{svc}-client", "vulture.probe": True}))
+    css = ScopeSpans(scope=Scope(name="tempo-vulture", version="2"))
+    css.spans.append(Span(
+        trace_id=tid, span_id=cid, name="graph-call", kind=3,  # CLIENT
+        start_unix_nano=base_ns, end_unix_nano=base_ns + 4_000_000,
+        status_code=0))
+    client_rs.scope_spans.append(css)
+    server_rs = ResourceSpans(resource=Resource(attrs={
+        "service.name": f"{svc}-server", "vulture.probe": True}))
+    sss = ScopeSpans(scope=Scope(name="tempo-vulture", version="2"))
+    sss.spans.append(Span(
+        trace_id=tid, span_id=sid, parent_span_id=cid,
+        name="graph-serve", kind=2,  # SERVER
+        start_unix_nano=base_ns + 1_000_000,
+        end_unix_nano=base_ns + 3_000_000,
+        status_code=2))  # ERROR -> one failed edge expected
+    server_rs.scope_spans.append(sss)
+    t.resource_spans += [client_rs, server_rs]
+    return t
+
+
 class Vulture:
     """The continuous-verification prober. One instance owns the probe
     loop, the metric registry, the durability ledger and the SLO
@@ -220,6 +264,20 @@ class Vulture:
                     "cold-read probes disabled (remote target, "
                     "no --internal-token for /flush)")
                 cfg.flush_every = 0
+        # generator probes read generated series off the TARGET's main
+        # /metrics endpoint; a remote topology may host its generators
+        # on other ring members (or run generator-less), so -- same
+        # stance as the /flush guard -- only loopback targets keep them
+        # on by default
+        if cfg.generator_probes:
+            host = urllib.parse.urlparse(self.query_url).hostname or ""
+            if host not in ("127.0.0.1", "::1", "localhost"):
+                from .util.log import get_logger
+
+                get_logger("vulture").warning(
+                    "generator probes disabled (remote target: generated "
+                    "series may live on another ring member)")
+                cfg.generator_probes = False
         self.rng = random.Random(cfg.seed)
         self.run_id = f"{self.rng.getrandbits(32):08x}"
         self.seq = 0
@@ -230,7 +288,8 @@ class Vulture:
         # raw lag samples (bounded) for summary percentiles
         self._lags: dict[str, deque] = {
             k: deque(maxlen=2048)
-            for k in ("live_visible", "searchable", "cold_readable")}
+            for k in ("live_visible", "searchable", "cold_readable",
+                      "series_visible")}
 
         # ------------------------------ metrics (util/metrics Registry)
         self.registry = Registry()
@@ -267,7 +326,8 @@ class Vulture:
                         "(QoS sheds excluded)"))
         for kind, thr, tgt in (("live_visible", 2.5, 0.99),
                                ("searchable", 5.0, 0.99),
-                               ("cold_readable", 10.0, 0.99)):
+                               ("cold_readable", 10.0, 0.99),
+                               ("series_visible", 2.5, 0.99)):
             self.slo.register(slomod.Objective(
                 name=f"freshness-{kind}", kind="freshness", target=tgt,
                 sli=slomod.histogram_sli(
@@ -525,6 +585,14 @@ class Vulture:
         run("query_range",
             lambda: self._query_range_probe(svc, traces, base_ns), svc)
 
+        # -- generated series: client-side expected RED counts + the
+        # dedicated service-graph edge against the target's /metrics
+        if self.cfg.generator_probes:
+            run("span_metrics",
+                lambda: self._span_metrics_probe(svc, traces), svc)
+            run("service_graph",
+                lambda: self._service_graph_probe(svc), svc)
+
         # -- cold_read + durability ledger maintenance
         if self.cfg.flush_every and self.seq % self.cfg.flush_every == 0:
             run("cold_read",
@@ -653,6 +721,99 @@ class Vulture:
                 detail=f"{svc} expected {want_n} spans across "
                        f"{len(expect)} buckets, got {n}")
         return ProbeResult("query_range", "ok")
+
+    # ---------------------------------------- generated-series probes
+    def _metrics_lines(self) -> list[str]:
+        return self._request(
+            self.query_url + "/metrics").decode().splitlines()
+
+    def _span_metrics_probe(self, svc: str, traces) -> ProbeResult:
+        """Expected RED counts computed client-side from the spans just
+        pushed -- one traces_spanmetrics_calls_total series per unique
+        (service, span name, kind, status) with an exact call count --
+        compared against the generated series on the target's main
+        /metrics. The retry lag is the push->series-visible freshness
+        sample: the generator freshness SLO measured end to end."""
+        from .services.remotewrite import parse_exposition
+        from .wire.model import SpanKind, StatusCode
+
+        expect: dict[tuple, int] = {}
+        for _tid, tr in traces:
+            for res, _sc, sp in tr.all_spans():
+                k = (res.service_name, sp.name,
+                     SpanKind(int(sp.kind)).name,
+                     StatusCode(int(sp.status_code)).name)
+                expect[k] = expect.get(k, 0) + 1
+
+        def read() -> dict[tuple, int]:
+            got: dict[tuple, int] = {}
+            for lab, v in parse_exposition(self._metrics_lines()):
+                if (lab.get("__name__") == "traces_spanmetrics_calls_total"
+                        and lab.get("service") == svc):
+                    k = (svc, lab.get("span_name", ""),
+                         lab.get("span_kind", ""),
+                         lab.get("status_code", ""))
+                    got[k] = got.get(k, 0) + int(v)
+            return got
+
+        got, lag = self._await(lambda: (read() == expect) or None)
+        if got is None:
+            final = read()
+            # nothing, or a strict UNDER-count of expected series only:
+            # the window's fold is still in flight (freshness collapse,
+            # not corruption). Unexpected series or over-counts can't
+            # come from lag: that's corruption.
+            partial = final and all(
+                k in expect and v <= expect[k] for k, v in final.items())
+            outcome = "timeout" if (not final or partial) else "corrupt"
+            return ProbeResult(
+                "span_metrics", outcome, lag,
+                f"{svc} expected {len(expect)} RED series "
+                f"(calls {sum(expect.values())}), got {len(final)} "
+                f"(calls {sum(final.values())})")
+        self._freshness("series_visible", lag)
+        return ProbeResult("span_metrics", "ok", lag)
+
+    def _service_graph_probe(self, svc: str) -> ProbeResult:
+        """One dedicated client/server pair -> exactly one generated
+        edge: request_total 1, request_failed_total 1 (the server span
+        carries status=ERROR), server latency count 1. The edge only
+        exists if the coded edge store paired the two spans on
+        (trace id, span id / parent id) codes across the two pushed
+        resource blocks."""
+        from .services.remotewrite import parse_exposition
+
+        tid = make_trace_id(self.rng)
+        tr = _make_graph_trace(self.rng, tid, svc, time.time_ns())
+        self._push(tr)
+        client, server = f"{svc}-client", f"{svc}-server"
+        want = {"traces_service_graph_request_total": 1.0,
+                "traces_service_graph_request_failed_total": 1.0,
+                "traces_service_graph_request_server_seconds_count": 1.0}
+
+        def read() -> dict[str, float]:
+            got: dict[str, float] = {}
+            for lab, v in parse_exposition(self._metrics_lines()):
+                if (lab.get("__name__") in want
+                        and lab.get("client") == client
+                        and lab.get("server") == server):
+                    got[lab["__name__"]] = got.get(lab["__name__"], 0.0) + v
+            return got
+
+        got, lag = self._await(lambda: (read() == want) or None)
+        if got is None:
+            final = read()
+            # same partial-vs-corrupt split as span_metrics: an edge
+            # whose series under-count `want` is a fold in flight
+            partial = final and all(
+                k in want and v <= want[k] for k, v in final.items())
+            outcome = "timeout" if (not final or partial) else "corrupt"
+            return ProbeResult(
+                "service_graph", outcome, lag,
+                f"{svc} edge {client}->{server} expected {want}, "
+                f"got {final or 'nothing'}")
+        self._freshness("series_visible", lag)
+        return ProbeResult("service_graph", "ok", lag)
 
     # ------------------------------------------------- cold probe
     def _cold_probe(self, svc: str, traces, want) -> ProbeResult:
@@ -883,6 +1044,9 @@ def main(argv=None) -> int:
     ap.add_argument("--backend-path", default="",
                     help="backend storage path for fresh-reader cold "
                          "probes (every byte off disk)")
+    ap.add_argument("--no-generator-probes", action="store_true",
+                    help="skip the span_metrics / service_graph probes "
+                         "(generated-series verification)")
     ap.add_argument("--metrics-port", type=int, default=0,
                     help="serve vulture's own /metrics + /status here")
     ap.add_argument("--seed", type=int, default=None)
@@ -905,7 +1069,8 @@ def main(argv=None) -> int:
         push_url=push_url, query_url=query_url, tenant=args.tenant,
         visibility_timeout_s=args.visibility_timeout,
         flush_every=args.flush_every, internal_token=args.internal_token,
-        backend_path=backend_path, seed=args.seed,
+        backend_path=backend_path,
+        generator_probes=not args.no_generator_probes, seed=args.seed,
     )
     v = Vulture(cfg, app=app)
     if args.metrics_port:

@@ -1,0 +1,63 @@
+"""Seeded violations over the metrics-generator shapes: the coded
+edge store (pending client/server halves) and the series registry,
+whose module-level maps are exactly the state the concurrency passes
+must keep honest under the streaming tap's worker thread."""
+
+import threading
+
+_edge_lock = threading.Lock()
+_series_lock = threading.Lock()
+_pending_edges: dict[int, tuple] = {}
+_series: dict[int, int] = {}
+_EXPIRED = 0
+
+
+def open_edge(key, svc):
+    _pending_edges[key] = (svc, None)  # EXPECT: global-mutation-unlocked
+
+
+def expire_edges(cutoff):
+    global _EXPIRED
+    _EXPIRED = cutoff  # EXPECT: global-mutation-unlocked
+
+
+def open_edge_guarded(key, svc):
+    with _edge_lock:
+        _pending_edges[key] = (svc, None)
+
+
+def _drain_pending_locked():
+    # *_locked convention: the caller holds _edge_lock
+    _pending_edges.clear()
+
+
+def fold_then_pair(sid, key):
+    with _series_lock:
+        with _edge_lock:
+            _series[sid] = _series.get(sid, 0) + 1
+            return _pending_edges.get(key)
+
+
+def pair_then_fold(sid, key):
+    with _edge_lock:
+        with _series_lock:  # EXPECT: lock-order
+            _series[sid] = _series.get(sid, 0) + 1
+            return _pending_edges.get(key)
+
+
+def shed_series_unsafe(sid):
+    _series_lock.acquire()  # EXPECT: lock-bare-acquire
+    n = _series.get(sid, 0)
+    _series_lock.release()
+    return n
+
+
+def shed_series_safe(sid):
+    # sanctioned non-with form: the try body holds the lock, so the
+    # registry mutation inside must NOT fire the global rule
+    _series_lock.acquire()
+    try:
+        _series[sid] = 0
+        return len(_series)
+    finally:
+        _series_lock.release()
