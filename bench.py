@@ -1652,7 +1652,35 @@ def bench_generator_tap(tmp: str) -> None:
     _emit("service_graph_edges_per_sec", E * iters / dt, "edges/s", tel=tel)
 
 
+def bench_fleet() -> None:
+    """`python bench.py --fleet`: multi-process fleet certification.
+
+    Delegates to tempo_tpu.fleet.harness (QPS scaling 1->4 queriers +
+    rolling ingester restart at RF=2 under vulture) and emits the two
+    headline numbers as bench rows alongside the FLEET_SCALE.json
+    artifact. Kept out of the default run: it spawns ~8 processes and
+    owns its own wall-clock budget."""
+    from tempo_tpu.fleet import harness as fleet_harness
+
+    base = tempfile.mkdtemp(prefix="tempo-fleet-bench-")
+    try:
+        artifact = fleet_harness.certify("FLEET_SCALE.json", base,
+                                         quick="--quick" in sys.argv)
+    finally:
+        shutil.rmtree(base, ignore_errors=True)
+    qps = artifact["qps_scaling"]
+    _emit("fleet_qps_scaling_ratio_4q", qps["ratio"], "x",
+          qps["ratio"] / qps["target_ratio"])
+    rolling = artifact["rolling_restart"]
+    _emit("fleet_rolling_restart_miss_free_cycles",
+          float(rolling["cycles"]), "cycles",
+          1.0 if rolling["pass"] else 0.0)
+
+
 def main() -> None:
+    if "--fleet" in sys.argv:
+        bench_fleet()
+        return
     bench_analysis()
     bench_kernel()
     bench_mesh_1x1_overhead()

@@ -58,8 +58,11 @@ class Soak:
     def __init__(self, target: str, writers: int, readers: int,
                  spans_per_trace: int = 8, batch: int = 5,
                  tenants: list[str] | None = None, zipf: float = 1.2,
-                 live_tail: bool = False):
+                 live_tail: bool = False, query_target: str = ""):
         self.target = target.rstrip("/")
+        # split-role fleets write to the distributor and read from the
+        # query-frontend; "" = one process serves both (today's default)
+        self.query_target = (query_target or target).rstrip("/")
         self.writers = writers
         self.readers = readers
         self.spans_per_trace = spans_per_trace
@@ -99,7 +102,7 @@ class Soak:
             return r.read()
 
     def _get(self, path: str, tenant: str = ""):
-        req = urllib.request.Request(self.target + path,
+        req = urllib.request.Request(self.query_target + path,
                                      headers=self._headers(tenant))
         with urllib.request.urlopen(req, timeout=15) as r:
             return r.read()
@@ -326,6 +329,9 @@ DEFAULT_CHAOS_SPEC = json.dumps({
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser("tempo-tpu-soak")
     ap.add_argument("--target", default="", help="base URL of a running instance")
+    ap.add_argument("--query-target", default="",
+                    help="base URL reads go to (fleet topologies: the "
+                         "query-frontend; '' = same as --target)")
     ap.add_argument("--self-host", action="store_true",
                     help="spawn a single-binary app for the run")
     ap.add_argument("--duration", type=float, default=30.0)
@@ -429,7 +435,8 @@ def main(argv=None) -> int:
 
     try:
         soak = Soak(target, args.writers, args.readers, tenants=tenants,
-                    zipf=args.zipf, live_tail=args.live_tail)
+                    zipf=args.zipf, live_tail=args.live_tail,
+                    query_target=args.query_target)
         report = soak.run(args.duration, max_write_p95_s=args.write_p95,
                           max_search_p95_s=args.search_p95)
         if vult is not None:

@@ -44,7 +44,7 @@ KERNEL_SCOPE = ("ops/", "parallel/")
 # chaos/ is in scope on purpose: the fault plane is exactly the kind of
 # process-wide registry the concurrency rules exist to guard
 CONCURRENCY_SCOPE = ("services/", "util/", "ops/", "db/", "chaos/",
-                     "ingest/")
+                     "ingest/", "fleet/")
 
 
 def default_root() -> Path:

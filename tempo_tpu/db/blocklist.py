@@ -116,23 +116,41 @@ class Poller:
         self.build_index = build_index
         self.stale_max = stale_index_max_age_s
         self.concurrency = concurrency
+        # ring-sharded polling hook (fleet.PollerShard.install): when
+        # this poller's instance does NOT own a tenant, it reads the
+        # owner's index instead of listing the backend -- each member
+        # pays 1/M of the poll. Default: own everything (solo poller).
+        self.owns_tenant = lambda tenant: True
+        self.last_shard: dict[str, list[str]] = {"owned": [], "deferred": []}
 
     def poll(self) -> tuple[dict[str, list[BlockMeta]], dict[str, list[BlockMeta]]]:
         metas: dict[str, list[BlockMeta]] = {}
         compacted: dict[str, list[BlockMeta]] = {}
+        shard: dict[str, list[str]] = {"owned": [], "deferred": []}
         for tenant in self.backend.tenants():
-            m, c = self.poll_tenant(tenant)
+            owned = self.owns_tenant(tenant)
+            shard["owned" if owned else "deferred"].append(tenant)
+            m, c = self.poll_tenant(tenant, owned=owned)
             metas[tenant] = m
             compacted[tenant] = c
+        self.last_shard = shard
         return metas, compacted
 
-    def poll_tenant(self, tenant: str) -> tuple[list[BlockMeta], list[BlockMeta]]:
+    def poll_tenant(self, tenant: str,
+                    owned: bool = True) -> tuple[list[BlockMeta], list[BlockMeta]]:
+        if not owned:
+            # non-owner: the shard owner's index IS the blocklist; fall
+            # through to a full list only when no owner has written one
+            # yet (cold start), so correctness never depends on sharding
+            got = self._read_index(tenant)
+            if got is not None:
+                return got
         if not self.build_index:
             got = self._read_index(tenant)
             if got is not None:
                 return got
         metas, compacted = self._list_tenant(tenant)
-        if self.build_index:
+        if self.build_index and owned:
             self._write_index(tenant, metas, compacted)
         return metas, compacted
 

@@ -1,0 +1,60 @@
+"""Ring-sharded blocklist polling: each querier pays 1/M of the poll.
+
+Unsharded, every querier lists every tenant's every block on every poll
+cycle -- M queriers x N blocks of backend LIST traffic for one logical
+blocklist.  Sharding reuses the compactor's owns-job pattern on the
+querier ring: the querier owning the token of ``blocklist-poll/<tenant>``
+is that tenant's poller; it lists the backend and publishes the result
+as the per-tenant index (the same ``index.json.gz`` the Poller already
+writes), and every non-owner serves its blocklist from the owner's
+index instead of listing.  Ownership moves with ring membership, so a
+dead querier's tenants are re-polled by the survivors within one
+heartbeat-prune interval.
+"""
+
+from __future__ import annotations
+
+from ..ring.ring import Ring
+
+
+def shard_hash(tenant: str) -> str:
+    return f"blocklist-poll/{tenant}"
+
+
+class PollerShard:
+    """Binds one querier's Poller to its slice of the tenant space."""
+
+    def __init__(self, ring: Ring, instance_id: str):
+        self.ring = ring
+        self.instance_id = instance_id
+
+    def owns(self, tenant: str) -> bool:
+        """Solo fallback: an empty ring (shard plane not yet gossiped)
+        must not stop a querier from polling -- own everything."""
+        owner = self.ring.owner_of(shard_hash(tenant))
+        return owner is None or owner == self.instance_id
+
+    def shard_map(self, tenants: list[str]) -> dict[str, str]:
+        """tenant -> owning querier instance id, for /status/fleet."""
+        out = {}
+        for t in tenants:
+            owner = self.ring.owner_of(shard_hash(t))
+            out[t] = owner if owner is not None else self.instance_id
+        return out
+
+    def install(self, db) -> None:
+        """Wire this shard into a TempoDB's poller: owners build and
+        write the tenant index, non-owners read the owner's index."""
+        db.poller.owns_tenant = self.owns
+
+    def status(self, tenants: list[str]) -> dict:
+        members = [d.instance_id for d in self.ring.healthy_instances()]
+        return {
+            "instance_id": self.instance_id,
+            "members": members,
+            "owned": [t for t in tenants if self.owns(t)],
+            "shard_map": self.shard_map(tenants),
+        }
+
+
+__all__ = ["PollerShard", "shard_hash"]

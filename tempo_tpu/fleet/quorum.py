@@ -1,0 +1,62 @@
+"""Quorum/merged reads: make one dead ingester invisible to readers.
+
+With RF>=2 every trace's segments live on several replicas of the
+owning ring token, and (because replicas diverge under failure: a
+replica that missed a partial write holds a subset) the replicas are
+near-duplicates of each other.  A naive fan-out-and-combine would pay
+the duplicate decode cost RF times over; a naive first-answer-wins
+read would silently drop the spans only a surviving replica holds.
+
+The merge here does neither: each replica returns its raw segment
+snapshot tagged with a content digest, the merge layer unions the
+snapshots **by (trace id, segment digest)** so every distinct segment
+is decoded exactly once, and the read succeeds as long as R replicas
+of the owning token answered -- R from the same ReplicationSet rule
+the write path uses (majority; RF=2's minSuccess=1), so the read
+quorum always intersects the write quorum.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+
+class ReadQuorumError(OSError):
+    """Too few replicas of the owning token answered a live read.
+
+    Deliberately an OSError: the frontend's retry policy treats OSError
+    as retryable, and a quorum miss (a restarting replica mid-deploy)
+    is exactly the transient a requeued job survives.
+    """
+
+
+def segment_digest(seg: bytes) -> str:
+    """Stable content digest for replica-side dedupe of one segment."""
+    return hashlib.blake2b(seg, digest_size=8).hexdigest()
+
+
+def merge_snapshots(snapshots: list[list[tuple[str, bytes]]]) -> list[bytes]:
+    """Union replica snapshots of ONE trace, deduped by segment digest.
+
+    Each snapshot is the [(digest, segment-bytes), ...] a replica holds
+    for the trace; first sighting of a digest wins. Returns the unique
+    segments in first-seen order (the combiner sorts spans anyway).
+    """
+    seen: set[str] = set()
+    out: list[bytes] = []
+    for snap in snapshots:
+        for digest, seg in snap:
+            if digest not in seen:
+                seen.add(digest)
+                out.append(seg)
+    return out
+
+
+def read_quorum_need(replica_count: int, max_errors: int) -> int:
+    """R for a replication set: same arithmetic as the write quorum, so
+    reads succeed exactly when they must intersect an acked write."""
+    return max(1, replica_count - max_errors)
+
+
+__all__ = ["ReadQuorumError", "segment_digest", "merge_snapshots",
+           "read_quorum_need"]

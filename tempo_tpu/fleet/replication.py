@@ -1,0 +1,112 @@
+"""RF>=2 replicated ingest: the distributor's quorum-write policy.
+
+The distributor already walks the ring and fans each push window to the
+`replication_factor` successive replicas of the owning token; what this
+module adds is the cluster discipline around that fan-out:
+
+- per-replica circuit breakers on the existing transport seam, so a
+  flapping ingester sheds its own leg instead of stalling every push;
+- the write-outcome classification the fleet alerts key on.  Per trace:
+
+      quorum   ok_count >= desired replicas  (all RF copies landed)
+      partial  quorum <= ok_count < desired  (acked, but under-replicated)
+      failed   ok_count < quorum             (push rejected, 5xx to client)
+
+  `desired` is the ring's replication factor, NOT the size of the
+  replication set actually obtained -- a ring with fewer healthy
+  instances than RF writes every trace as "partial", which is exactly
+  the under-replication signal TempoReplicationPartialWrites fires on.
+
+Quorum itself stays the ring's call (`ReplicationSet.max_errors`):
+majority, except RF=2's eventually-consistent minSuccess=1 -- see the
+design note in ring/ring.py.
+"""
+
+from __future__ import annotations
+
+from ..util.breaker import CircuitBreaker, CircuitOpen, get_breaker
+from ..util.metrics import Counter
+
+REPLICATION_WRITES = Counter(
+    "tempo_replication_writes_total",
+    help="Replicated write outcomes per trace: quorum (all RF copies), "
+    "partial (acked under quorum semantics but under-replicated), "
+    "failed (below quorum, push rejected).")
+
+# Breaker tuning for the replica-push leg: pushes are frequent and the
+# quorum layer already tolerates one dead replica, so the breaker can
+# trip fast and probe often.
+_PUSH_BREAKER_PARAMS = dict(window_s=30.0, min_volume=5,
+                            error_rate=0.5, open_s=5.0, probes=2)
+
+
+def push_breaker(addr: str) -> CircuitBreaker:
+    """The per-replica breaker guarding distributor -> ingester pushes."""
+    return get_breaker(f"ingester-push:{addr}", **_PUSH_BREAKER_PARAMS)
+
+
+def guarded_push(client, addr: str, tenant: str, batch) -> None:
+    """Push one replica batch through its breaker.
+
+    Raises CircuitOpen without touching the wire when the replica's
+    breaker is open (the quorum layer counts that as a replica failure),
+    and records success/failure so the breaker tracks replica health.
+    """
+    br = push_breaker(addr)
+    if not br.allow():
+        raise CircuitOpen(f"replica {addr} push breaker open")
+    try:
+        client.push_segments(tenant, batch)
+    except Exception:
+        br.record(False)
+        raise
+    br.record(True)
+
+
+def record_write_outcomes(quorum_need: dict[bytes, int],
+                          ok_count: dict[bytes, int],
+                          desired: int) -> dict[str, int]:
+    """Classify every trace of one push window and bump the counter.
+
+    Returns the {outcome: n} tally (handy for tests and /status/fleet).
+    """
+    tally = {"quorum": 0, "partial": 0, "failed": 0}
+    for tid, need in quorum_need.items():
+        ok = ok_count.get(tid, 0)
+        if ok < need:
+            outcome = "failed"
+        elif ok >= desired:
+            outcome = "quorum"
+        else:
+            outcome = "partial"
+        tally[outcome] += 1
+    for outcome, n in tally.items():
+        if n:
+            REPLICATION_WRITES.inc(n, labels=f'outcome="{outcome}"')
+    return tally
+
+
+def replication_snapshot() -> dict[str, int]:
+    """Current counter state keyed by outcome, for /status/fleet."""
+    out = {"quorum": 0, "partial": 0, "failed": 0}
+    for labels, v in REPLICATION_WRITES.snapshot().items():
+        for outcome in out:
+            if f'outcome="{outcome}"' in labels:
+                out[outcome] += int(v)
+    return out
+
+
+def metrics_lines() -> list[str]:
+    return REPLICATION_WRITES.text()
+
+
+def help_entries() -> dict[str, tuple[str, str]]:
+    return {"tempo_replication_writes_total":
+            ("counter", REPLICATION_WRITES.help)}
+
+
+__all__ = [
+    "REPLICATION_WRITES", "push_breaker", "guarded_push",
+    "record_write_outcomes", "replication_snapshot",
+    "metrics_lines", "help_entries", "CircuitOpen",
+]

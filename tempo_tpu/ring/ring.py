@@ -186,10 +186,24 @@ class Ring:
 
 
 class Lifecycler:
-    """Joins an instance into a ring and heartbeats it."""
+    """Joins an instance into a ring and heartbeats it.
+
+    With `prune_timeout` set, every heartbeat also evicts ring entries
+    whose own heartbeat is older than the timeout. A SIGKILLed peer
+    never writes a LEAVE, so without pruning it stays in the ring until
+    every reader's heartbeat_timeout filter -- but FileKV/GossipKV
+    readers outside this process (the distributor picking replicas)
+    keep seeing it as a token owner and send it doomed replica writes.
+    Pruning removes the entry from the shared KV itself, so the dead
+    instance leaves the write ring within ~one heartbeat period of the
+    timeout expiring. A pruned-but-alive peer (partition, GC pause)
+    re-resurrects itself: its next heartbeat writes a newer entry, and
+    GossipKV's newest-wins merge propagates it back everywhere.
+    """
 
     def __init__(self, kv: InMemoryKV, ring_key: str, instance_id: str, addr: str = "",
-                 num_tokens: int = NUM_TOKENS, heartbeat_period: float = 5.0):
+                 num_tokens: int = NUM_TOKENS, heartbeat_period: float = 5.0,
+                 prune_timeout: float | None = None):
         self.kv = kv
         self.ring_key = ring_key
         self.desc = InstanceDesc(
@@ -198,6 +212,7 @@ class Lifecycler:
             tokens=deterministic_tokens(ring_key, instance_id, num_tokens),
         )
         self.heartbeat_period = heartbeat_period
+        self.prune_timeout = prune_timeout
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
@@ -210,12 +225,28 @@ class Lifecycler:
         self.desc.heartbeat_ts = time.time()
         self.kv.update(self.ring_key, self.desc)
 
+    def prune(self, now: float | None = None) -> list[str]:
+        """Evict peers whose heartbeat exceeded prune_timeout; returns
+        the pruned instance ids."""
+        if self.prune_timeout is None:
+            return []
+        now = time.time() if now is None else now
+        pruned = []
+        for iid, desc in self.kv.get_all(self.ring_key).items():
+            if iid == self.desc.instance_id:
+                continue
+            if now - desc.heartbeat_ts > self.prune_timeout:
+                self.kv.remove(self.ring_key, iid)
+                pruned.append(iid)
+        return pruned
+
     def start(self) -> None:
         self.join()
 
         def loop():
             while not self._stop.wait(self.heartbeat_period):
                 self.heartbeat()
+                self.prune()
 
         self._thread = threading.Thread(target=loop, daemon=True, name=f"lifecycler-{self.ring_key}")
         self._thread.start()

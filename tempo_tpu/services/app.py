@@ -41,6 +41,7 @@ TENANT_HEADER = "X-Scope-OrgID"  # reference: shared orgid header
 INGESTER_RING = "ingester-ring"
 COMPACTOR_RING = "compactor-ring"
 GENERATOR_RING = "generator-ring"
+QUERIER_RING = "querier-ring"  # blocklist-poll sharding (fleet/)
 
 
 @dataclass
@@ -120,6 +121,19 @@ class AppConfig:
     # corpus through the persistent compile cache BEFORE serving, so
     # the first query stops paying the XLA compile storm (util/warmup)
     warmup_shapes: bool = False
+    # fleet knobs (tempo_tpu/fleet): ring liveness window in seconds
+    # (0 = ring.HEARTBEAT_TIMEOUT_S); lifecyclers also PRUNE peers past
+    # it, so a SIGKILLed ingester leaves the write ring within about
+    # one heartbeat period of the timeout instead of soaking doomed
+    # replica writes until every reader's local filter catches up
+    ring_heartbeat_timeout: float = 0.0
+    # per-RPC deadline for remote ingester clients (replica writes,
+    # quorum-read snapshots): the replica-leg timeout the quorum
+    # arithmetic absorbs
+    rpc_deadline_s: float = 10.0
+    # standalone-querier worker threads against the frontend job API
+    # (reference: querier.max-concurrent-queries)
+    worker_concurrency: int = 4
 
 
 class App:
@@ -210,13 +224,23 @@ class App:
             self.kv = FileKV(cfg.kv_dir)
         else:
             self.kv = InMemoryKV()
-        self.ring = Ring(self.kv, INGESTER_RING, replication_factor=cfg.replication_factor)
+        from ..ring.ring import HEARTBEAT_TIMEOUT_S
+
+        hb_timeout = cfg.ring_heartbeat_timeout or HEARTBEAT_TIMEOUT_S
+        # heartbeat fast enough that a live instance never looks dead
+        # inside its own liveness window (harnesses run 2 s windows)
+        hb_period = min(5.0, max(0.2, hb_timeout / 4.0))
+        self._hb_timeout, self._hb_period = hb_timeout, hb_period
+        self.ring = Ring(self.kv, INGESTER_RING,
+                         replication_factor=cfg.replication_factor,
+                         heartbeat_timeout=hb_timeout)
 
         # addr -> client: in-process registry + HTTP for remote addrs
         from ..transport import client_registry
 
         self._clients: dict[str, object] = {}
-        self.client_for = client_registry(self._clients, token=cfg.internal_token)
+        self.client_for = client_registry(self._clients, token=cfg.internal_token,
+                                          timeout=cfg.rpc_deadline_s)
 
         self.ingester = self.lifecycler = None
         if has("ingester"):
@@ -229,7 +253,9 @@ class App:
                 # explicit --wal.path may live beside unrelated directories
                 self._warn_orphan_wals(os.path.dirname(wal_path), cfg.instance_id)
             self.lifecycler = Lifecycler(self.kv, INGESTER_RING, cfg.instance_id,
-                                         addr=cfg.advertise_addr)
+                                         addr=cfg.advertise_addr,
+                                         heartbeat_period=hb_period,
+                                         prune_timeout=hb_timeout)
             self._clients[self.lifecycler.desc.addr] = self.ingester
 
         self.generator = self.generator_lifecycler = None
@@ -297,8 +323,24 @@ class App:
                     self.querier,
                     [a.strip() for a in cfg.frontend_addr.split(",") if a.strip()],
                     token=cfg.internal_token,
+                    concurrency=cfg.worker_concurrency,
                     worker_id=cfg.instance_id,
                 )
+
+        # blocklist-poll sharding (fleet/poller_shard): standalone
+        # queriers on a shared ring join the querier ring and each polls
+        # only the tenants it owns, reading peers' indexes for the rest
+        self.querier_lifecycler = self.poller_shard = None
+        if shared_ring and cfg.target == "querier":
+            from ..fleet.poller_shard import PollerShard
+
+            self.querier_lifecycler = Lifecycler(
+                self.kv, QUERIER_RING, cfg.instance_id,
+                heartbeat_period=hb_period, prune_timeout=hb_timeout)
+            self.poller_shard = PollerShard(
+                Ring(self.kv, QUERIER_RING, heartbeat_timeout=hb_timeout),
+                cfg.instance_id)
+            self.poller_shard.install(self.db)
 
         self.compactor = self.compactor_lifecycler = None
         if has("compactor"):
@@ -363,6 +405,8 @@ class App:
             self.compactor_lifecycler.start()
         if self.generator_lifecycler:
             self.generator_lifecycler.start()
+        if self.querier_lifecycler:
+            self.querier_lifecycler.start()
         if self.ingester:
             self.ingester.start_sweeper()
         if self.compactor:
@@ -485,6 +529,8 @@ class App:
             self.compactor_lifecycler.leave()
         if self.generator_lifecycler:
             self.generator_lifecycler.leave()
+        if self.querier_lifecycler:
+            self.querier_lifecycler.leave()
         self.db.close()
         if hasattr(self.kv, "close"):
             self.kv.close()  # gossip mode: stop the server + sync loop
@@ -703,6 +749,14 @@ def _make_handler(app: App):
                     if app.warmup_report is not None:
                         out["warmup"] = app.warmup_report
                     return self._send(200, json.dumps(out, indent=2))
+                if u.path == "/status/fleet":
+                    # the cluster operator's one-stop view: ring members
+                    # with heartbeat ages, RF + quorum arithmetic,
+                    # replica push-leg breaker health, replication write
+                    # outcomes, the poller shard map and per-tenant
+                    # queue depths
+                    return self._send(
+                        200, json.dumps(_fleet_status(app), indent=2))
                 if u.path == "/status/slo":
                     # the SLO plane's verdict surface: every objective
                     # with its multi-window burn rates (util/slo),
@@ -1156,6 +1210,10 @@ _BLOCKLIST_GAUGE = _Gauge("tempo_blocklist_length",
                           help="blocks across all tenants in the blocklist")
 _WAL_DEPTH_GAUGE = _Gauge("tempo_ingester_wal_bytes",
                           help="bytes buffered in open WAL head blocks")
+_QUEUE_DEPTH_GAUGE = _Gauge(
+    "tempo_query_queue_depth",
+    help="queued query jobs per tenant (the querier-pool autoscaling "
+         "SLI: sustained depth means too few queriers for the load)")
 
 # family -> help for the OpenMetrics renderer (families not listed get a
 # generated default; TYPE is inferred from the suffix conventions)
@@ -1168,6 +1226,10 @@ _METRIC_HELP = {
     "tempo_kernel_device_seconds": "per-op device wall time",
     "tempo_engine_routing": "engine routing decisions (layer/engine/reason)",
     "tempo_stage_transfer_bytes": "host->device staging upload bytes",
+    "tempo_replication_writes_total":
+        "replicated write outcomes per trace (quorum/partial/failed)",
+    "tempo_query_queue_depth":
+        "queued query jobs per tenant (querier-pool autoscaling SLI)",
 }
 
 
@@ -1247,6 +1309,23 @@ def _metrics_text(app: App) -> str:
             f"tempo_frontend_jobs_local_total {app.frontend.stats_jobs_local}",
             f"tempo_frontend_jobs_remote_total {app.frontend.stats_jobs_remote}",
         ]
+        # per-tenant queue depth, zeroing tenants that drained since the
+        # last scrape so the gauge never freezes on a stale depth
+        depths = app.frontend.queue.depths()
+        # unlabeled aggregate always exists, so the queue-depth alert
+        # has a series to evaluate even on an idle frontend
+        _QUEUE_DEPTH_GAUGE.set(sum(depths.values()))
+        stale = getattr(app, "_queue_depth_tenants", set()) - set(depths)
+        for t in stale:
+            _QUEUE_DEPTH_GAUGE.set(0, labels=f'tenant="{t}"')
+        for t, n in depths.items():
+            _QUEUE_DEPTH_GAUGE.set(n, labels=f'tenant="{t}"')
+        app._queue_depth_tenants = set(depths) | stale
+        lines += _QUEUE_DEPTH_GAUGE.text()
+    if app.distributor:
+        from ..fleet import replication as _replication
+
+        lines += _replication.metrics_lines()
     if app.generator is not None:
         lines.extend(app.generator.metrics_text())
     # kernel telemetry (compiles, cache hits, device time, staging,
@@ -1278,6 +1357,60 @@ def _metrics_text(app: App) -> str:
     if app.slo is not None:
         helps.update(app.slo.help_entries())
     return render_openmetrics(lines, helps=helps)
+
+
+def _fleet_status(app: App) -> dict:
+    """The /status/fleet payload: ring view with heartbeat ages, RF and
+    quorum arithmetic, replica-push breaker health, replication write
+    outcomes, the blocklist-poll shard map and per-tenant queue depths."""
+    import time as _time
+
+    from ..fleet.replication import replication_snapshot
+    from ..util.breaker import breakers_snapshot
+
+    now = _time.time()
+    members = [{
+        "instance_id": d.instance_id,
+        "addr": d.addr,
+        "state": d.state.value,
+        "heartbeat_age_s": round(now - d.heartbeat_ts, 3),
+        "healthy": d.healthy(now, app.ring.heartbeat_timeout),
+    } for d in app.ring.instances()]
+    rf = app.ring.rf
+    # mirror ring.ReplicationSet: majority quorum, except RF=2's
+    # eventually-consistent minSuccess=1 (see ring/ring.py)
+    write_quorum = 1 if rf <= 2 else rf - (rf - 1) // 2
+    brs = breakers_snapshot()
+    out = {
+        "target": app.cfg.target,
+        "instance_id": app.cfg.instance_id,
+        "ring": {
+            "key": INGESTER_RING,
+            "replication_factor": rf,
+            "write_quorum": write_quorum,
+            "heartbeat_timeout_s": app.ring.heartbeat_timeout,
+            "members": members,
+            "healthy": sum(1 for m in members if m["healthy"]),
+        },
+        "replication": {
+            "writes": replication_snapshot(),
+            "push_breakers": {k: v for k, v in brs.items()
+                              if k.startswith("ingester-push:")},
+            "read_breakers": {k: v for k, v in brs.items()
+                              if k.startswith("ingester:")},
+        },
+    }
+    if app.frontend is not None:
+        out["queue_depths"] = app.frontend.queue.depths()
+    if app.poller_shard is not None:
+        out["poller_shard"] = app.poller_shard.status(
+            sorted(set(app.db.blocklist.tenants())
+                   | set(app.db.poller.last_shard.get("owned", []))
+                   | set(app.db.poller.last_shard.get("deferred", []))))
+    else:
+        out["poller_shard"] = {"instance_id": app.cfg.instance_id,
+                               "solo": True, **app.db.poller.last_shard}
+    return out
 
 
 def _config_dict(cfg: AppConfig) -> dict:
@@ -1407,6 +1540,17 @@ def main(argv=None):
     ap.add_argument("--distributor.kafka-topic", dest="kafka_topic", default=None)
     ap.add_argument("--distributor.kafka-tenant", dest="kafka_tenant", default=None,
                     help="tenant kafka messages ingest into (required with multitenancy)")
+    ap.add_argument("--ring.heartbeat-timeout", dest="ring_heartbeat_timeout",
+                    type=float, default=None,
+                    help="ring liveness window in seconds; lifecyclers "
+                         "also prune peers past it (0 = default 60s)")
+    ap.add_argument("--rpc.deadline", dest="rpc_deadline", type=float,
+                    default=None,
+                    help="per-RPC deadline for remote ingester clients")
+    ap.add_argument("--querier.worker-concurrency", dest="worker_concurrency",
+                    type=int, default=None,
+                    help="standalone-querier worker threads pulling "
+                         "frontend jobs")
     args = ap.parse_args(argv)
     base = (load_config_file(args.config_file, args.config_expand_env)
             if args.config_file else {})
@@ -1438,6 +1582,9 @@ def main(argv=None):
         "kafka_brokers": args.kafka_brokers,
         "kafka_topic": args.kafka_topic,
         "kafka_tenant": args.kafka_tenant,
+        "ring_heartbeat_timeout": args.ring_heartbeat_timeout,
+        "rpc_deadline_s": args.rpc_deadline,
+        "worker_concurrency": args.worker_concurrency,
     }
     base.update({k: v for k, v in flag_vals.items() if v is not None})
     cfg = AppConfig(**base)

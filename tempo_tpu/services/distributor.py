@@ -14,6 +14,7 @@ import time
 from collections import defaultdict
 from dataclasses import dataclass, field
 
+from ..fleet.replication import guarded_push, record_write_outcomes
 from ..ring.ring import Ring
 from ..util.hashing import ring_token
 from ..wire.model import ResourceSpans, ScopeSpans, Trace
@@ -325,11 +326,16 @@ class Distributor:
             raise PushError(500, "no healthy ingesters in the ring")
         by_instance: dict[str, list] = defaultdict(list)
         quorum_need: dict[bytes, int] = {}
-        if len(healthy) == 1:
+        replicated = self.ring.rf > 1
+        if len(healthy) == 1 and not replicated:
             # single-ingester fast path (the single-binary topology):
             # every token resolves to the one instance with quorum 1, so
             # skip the per-trace ring walk -- on large push windows the
-            # hash+bisect loop is real write-path time
+            # hash+bisect loop is real write-path time. MUST stay gated
+            # on rf<=1: at RF>1 the ring walk still yields one replica
+            # when only one is healthy, but only the walk path records
+            # the write as under-replicated ("partial") instead of
+            # silently degrading replication to RF=1.
             addr = healthy[0].addr
             by_instance[addr] = [(tid, s, e, seg)
                                  for tid, (s, e, seg) in lim_filtered.items()]
@@ -347,11 +353,19 @@ class Distributor:
         errors = []
         for addr, batch in by_instance.items():
             try:
-                self.client_for(addr).push_segments(tenant, batch)
+                if replicated:
+                    # per-replica breaker: a flapping replica sheds its
+                    # own leg fast; the quorum math below absorbs it
+                    guarded_push(self.client_for(addr), addr, tenant, batch)
+                else:
+                    self.client_for(addr).push_segments(tenant, batch)
                 for tid, *_ in batch:
                     ok_count[tid] += 1
             except Exception as e:  # replica failure: quorum decides below
                 errors.append(e)
+        if replicated:
+            record_write_outcomes(quorum_need, ok_count,
+                                  desired=max(self.ring.rf, 1))
         failed = [tid for tid, need in quorum_need.items() if ok_count[tid] < need]
         if failed:
             self.stats.push_failures += len(failed)

@@ -137,6 +137,13 @@ class RequestQueue:
             # own job and every dequeue would pay the steal timeout
             self.cv.notify_all()
 
+    def depths(self) -> dict[str, int]:
+        """Per-tenant queue depth snapshot -- the fleet's autoscaling
+        SLI (tempo_query_queue_depth): sustained depth means the
+        querier pool is under-provisioned for the offered load."""
+        with self.cv:
+            return {t: len(q) for t, q in self.queues.items() if q}
+
     def _prune_locked(self, tenant: str, q) -> None:
         """Drop a drained tenant from both maps (invariant: a tenant is
         in self.order iff it has a non-empty deque)."""

@@ -384,6 +384,19 @@ class Instance:
             return None
         return sort_trace(combine_traces([segment_to_trace(s) for s in segs]))
 
+    def trace_segments(self, trace_id: bytes) -> list[bytes]:
+        """Raw live/cut/flushing segments for one trace -- the quorum
+        read's replica snapshot. Returned UNDECODED: the querier-side
+        merge dedupes replicas by content digest before paying the
+        decode, so shipping bytes (not Trace objects) is the point."""
+        with self.lock:
+            segs: list[bytes] = []
+            for src in (self.live.get(trace_id), self.cut.get(trace_id),
+                        self.flushing.get(trace_id)):
+                if src is not None:
+                    segs.extend(src.segments)
+        return segs
+
     def _index_of(self, lt: LiveTrace) -> tuple[_SearchEntry, Trace]:
         """The trace's search index, (re)built only when segments arrived
         since the last build; the decoded trace is cached alongside so
@@ -577,6 +590,16 @@ class Ingester:
         with self.lock:
             inst = self.instances.get(tenant)
         return inst.metrics_query_range(req) if inst else None
+
+    def trace_snapshot(self, tenant: str, trace_id: bytes) -> list[tuple[str, bytes]]:
+        """[(segment digest, segment bytes)] this replica holds for a
+        trace; the querier's quorum read unions these across replicas."""
+        with self.lock:
+            inst = self.instances.get(tenant)
+        if inst is None:
+            return []
+        from ..fleet.quorum import segment_digest
+        return [(segment_digest(s), s) for s in inst.trace_segments(trace_id)]
 
     # ---------------------------------------------------------- lifecycle
     def replay_wal(self) -> int:

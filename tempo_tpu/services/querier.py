@@ -101,8 +101,8 @@ class Querier:
         ctx = contextvars.copy_context()
         return self.pool.submit(ctx.run, fn, *args)
 
-    def _ingester_clients(self):
-        """Resolved clients for every healthy ring instance. Remote
+    def _ingester_legs(self):
+        """(addr, client) for every healthy ring instance. Remote
         (HTTP) legs come back wrapped in a per-addr circuit breaker:
         a leg that keeps failing is shed fast (degrading that leg's
         coverage, exactly like the existing failed-leg tolerance)
@@ -125,8 +125,11 @@ class Querier:
                 from ..util.breaker import get_breaker
 
                 c = _BreakerLeg(c, get_breaker(f"ingester:{d.addr}"))
-            out.append(c)
+            out.append((d.addr, c))
         return out
+
+    def _ingester_clients(self):
+        return [c for _, c in self._ingester_legs()]
 
     # ----------------------------------------------------------- trace by id
     def find_trace_by_id(self, tenant: str, trace_id: bytes,
@@ -136,6 +139,9 @@ class Querier:
         """Both legs by default; the frontend's sharded pipeline sets
         query_backend=False for the ingester-leg job (backend blocks go
         through its own find_blocks shard jobs)."""
+        if query_ingesters and self.ring is not None and self.ring.rf > 1:
+            return self._quorum_find(tenant, trace_id, time_start, time_end,
+                                     query_backend)
         futures = []
         if query_ingesters:
             for c in self._ingester_clients():
@@ -152,6 +158,75 @@ class Querier:
                 continue  # tolerate failed legs like TolerateFailedBlocks
             if t is not None:
                 partials.append(t)
+        if not partials:
+            return None
+        self.stats.traces_found += 1
+        return sort_trace(combine_traces(partials)) if len(partials) > 1 else partials[0]
+
+    @staticmethod
+    def _leg_snapshot(c, tenant: str, trace_id: bytes):
+        """One leg of a quorum read: ("snap", [(digest, seg)]) from a
+        snapshot-capable replica, ("trace", Trace|None) from a
+        pre-upgrade ingester that only speaks /internal/find."""
+        from ..transport.client import TransportError
+
+        try:
+            return "snap", c.trace_snapshot(tenant, trace_id)
+        except AttributeError:
+            pass  # in-process client without the snapshot API
+        except TransportError as e:
+            if e.status != 404:
+                raise  # real failure: the leg did NOT answer
+        return "trace", c.find_trace_by_id(tenant, trace_id)
+
+    def _quorum_find(self, tenant: str, trace_id: bytes, time_start: int,
+                     time_end: int, query_backend: bool) -> Trace | None:
+        """RF>1 live read: fan snapshots to every healthy leg, dedupe by
+        (trace id, segment digest), and require R answers from the
+        OWNING replica set -- the same quorum arithmetic the write path
+        used, so a successful read always intersects an acked write and
+        one dead ingester is invisible to readers. Non-replica legs are
+        read too (membership churn strands segments off-set) but only
+        replicas count toward R."""
+        from ..fleet.quorum import (ReadQuorumError, merge_snapshots,
+                                    read_quorum_need)
+        from ..util.hashing import ring_token
+        from ..wire.segment import segment_to_trace
+
+        healthy = self.ring.healthy_instances()
+        rs = self.ring.get(ring_token(tenant, trace_id), instances=healthy)
+        replica_addrs = {d.addr for d in rs.instances}
+        futures = {self._submit(self._leg_snapshot, c, tenant, trace_id): addr
+                   for addr, c in self._ingester_legs()}
+        backend_fut = (self._submit(self.db.find_trace_by_id, tenant,
+                                    trace_id, time_start, time_end)
+                       if query_backend else None)
+        snapshots, partials = [], []
+        replica_ok = 0
+        for f, addr in futures.items():
+            try:
+                kind, val = f.result()
+            except Exception:
+                continue  # failed leg: absorbed by the quorum check
+            if addr in replica_addrs:
+                replica_ok += 1  # an empty snapshot is still an answer
+            if kind == "snap":
+                snapshots.append(val)
+            elif val is not None:
+                partials.append(val)
+        need = read_quorum_need(len(rs.instances), rs.max_errors)
+        if rs.instances and replica_ok < need:
+            raise ReadQuorumError(
+                f"read quorum not met for {trace_id.hex()}: "
+                f"{replica_ok}/{need} replicas answered")
+        partials.extend(segment_to_trace(s) for s in merge_snapshots(snapshots))
+        if backend_fut is not None:
+            try:
+                t = backend_fut.result()
+                if t is not None:
+                    partials.append(t)
+            except Exception:
+                pass  # backend leg tolerance unchanged
         if not partials:
             return None
         self.stats.traces_found += 1

@@ -181,9 +181,20 @@ class HTTPIngesterClient:
         )
         return metrics_response_from_dict(out) if out else None
 
+    def trace_snapshot(self, tenant: str, trace_id: bytes) -> list[tuple[str, bytes]]:
+        """Replica segment snapshot for a quorum read: [(digest, seg)]."""
+        out = self._post(
+            "/internal/snapshot",
+            {"tenant": tenant, "trace_id": trace_id.hex()},
+        )
+        return [(d, base64.b64decode(seg))
+                for d, seg in out.get("segments", [])]
 
-def client_registry(local: dict, token: str = ""):
-    """addr -> client resolver: in-process objects first, HTTP for the rest."""
+
+def client_registry(local: dict, token: str = "", timeout: float = 10.0):
+    """addr -> client resolver: in-process objects first, HTTP for the
+    rest. `timeout` is the per-RPC deadline every HTTP client gets (the
+    fleet's replica-write/read deadline knob)."""
     cache: dict[str, HTTPIngesterClient] = {}
 
     def resolve(addr: str):
@@ -192,7 +203,8 @@ def client_registry(local: dict, token: str = ""):
         if addr.startswith("http://") or addr.startswith("https://"):
             c = cache.get(addr)
             if c is None:
-                c = cache[addr] = HTTPIngesterClient(addr, token=token)
+                c = cache[addr] = HTTPIngesterClient(addr, timeout=timeout,
+                                                     token=token)
             return c
         raise KeyError(f"unknown instance addr {addr!r}")
 
@@ -289,6 +301,11 @@ def handle_internal(app, path: str, payload: dict, raw_body: bytes = b"",
 
         resp = app.ingester.search(tenant, request_from_dict(payload.get("req", {})))
         return 200, response_to_dict(resp)
+    if path == "/internal/snapshot":
+        # quorum-read replica snapshot: raw segments + content digests
+        segs = app.ingester.trace_snapshot(tenant, bytes.fromhex(payload["trace_id"]))
+        return 200, {"segments": [[d, base64.b64encode(s).decode()]
+                                  for d, s in segs]}
     if path == "/internal/metrics":
         # live-head TraceQL metrics leg (querier merges it with blocks)
         from ..db.metrics_exec import (

@@ -129,6 +129,16 @@ class VultureConfig:
     # metrics-generator probes (span_metrics + service_graph): read
     # generated series off the target's main /metrics endpoint
     generator_probes: bool = True
+    # restrict the cycle to these read families (push always runs);
+    # () = all. The fleet rolling-restart probe runs only the families
+    # whose zero-miss guarantee it certifies: ("find_by_id", "search")
+    families: tuple[str, ...] = ()
+    # bounded retry of transient push failures (5xx / connection reset),
+    # mirroring an OTLP exporter's retry-on-retryable behavior: during a
+    # replica outage window the distributor may 500 one window before
+    # the ring prunes the corpse; a retried-and-acked push still honors
+    # the write contract, a persistent failure still records error
+    push_retries: int = 2
     seed: int | None = None
 
 
@@ -365,9 +375,19 @@ class Vulture:
             raise
 
     def _push(self, tr: Trace) -> None:
-        self._request(self.push_url + "/v1/traces",
-                      data=otlp_json.dumps(tr).encode(),
-                      ctype="application/json")
+        data = otlp_json.dumps(tr).encode()
+        for attempt in range(self.cfg.push_retries + 1):
+            try:
+                self._request(self.push_url + "/v1/traces", data=data,
+                              ctype="application/json")
+                return
+            except Shed:
+                raise  # 429 is the QoS budget, never retried
+            except (urllib.error.HTTPError, urllib.error.URLError,
+                    ConnectionError, TimeoutError):
+                if attempt >= self.cfg.push_retries:
+                    raise
+                time.sleep(0.25 * (attempt + 1))
 
     def _get_trace(self, tid_hex: str) -> Trace | None:
         try:
@@ -478,7 +498,13 @@ class Vulture:
         want = {tid.hex(): canonical_spans(tr) for tid, tr in traces}
         results: list[ProbeResult] = []
 
+        sel = set(self.cfg.families)
+
         def run(family, fn, detail):
+            if sel and family != "push" and family not in sel:
+                # family filter: skipped families record nothing at all
+                # (a non-probe must not dilute ok()/miss statistics)
+                return ProbeResult(family, "ok", detail="skipped")
             results.append(self._run_family(family, fn, detail))
             return results[-1]
 

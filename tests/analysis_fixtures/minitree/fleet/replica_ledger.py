@@ -1,0 +1,45 @@
+"""Seeded fleet-serving concurrency violations (the PR-18 replication
+shapes). Every EXPECT marker is asserted by tests/test_analysis.py: the
+per-replica write ledger and the poller shard-map cache are process-wide
+registries shared by the distributor's push threads and the blocklist
+poll loop -- exactly the shapes the live tree (fleet/replication.py,
+fleet/poller_shard.py) must keep lock-guarded."""
+
+import threading
+
+_write_ledger = {"quorum": 0, "partial": 0, "failed": 0}
+_shard_cache = {}
+_ledger_lock = threading.Lock()
+_shard_lock = threading.Lock()
+
+
+def record_outcome_nolock(outcome):
+    _write_ledger[outcome] = _write_ledger[outcome] + 1  # EXPECT: global-mutation-unlocked
+    return _write_ledger[outcome]
+
+
+def cache_owner_nolock(tenant, owner):
+    br = _shard_cache.get(tenant)
+    if br is None:
+        _shard_cache[tenant] = br = owner  # EXPECT: global-mutation-unlocked
+    return br
+
+
+def reset_tenant(tenant):
+    # establishes the module-wide order: ledger OUTER, shard INNER
+    with _ledger_lock:
+        with _shard_lock:
+            _shard_cache.pop(tenant, None)
+
+
+def rebalance(tenant, owner):
+    with _shard_lock:
+        with _ledger_lock:  # EXPECT: lock-order
+            _shard_cache[tenant] = owner
+
+
+def quorum_floor():
+    _ledger_lock.acquire()  # EXPECT: lock-bare-acquire
+    n = _write_ledger["quorum"]
+    _ledger_lock.release()
+    return n

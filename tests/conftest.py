@@ -47,3 +47,6 @@ def _isolate_resilience_plane():
 
 def pytest_configure(config):
     config.addinivalue_line("markers", "slow: multi-process / long-running e2e tests")
+    config.addinivalue_line(
+        "markers",
+        "fleet: fleet-topology e2e (replication / quorum / rolling restart)")

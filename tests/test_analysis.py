@@ -99,7 +99,7 @@ def test_parse_error_exits_nonzero_unless_skipped(tmp_path, capsys):
 def test_json_report_shape(capsys):
     assert analysis_main([str(MINITREE), "--json"]) == 0  # not strict
     out = json.loads(capsys.readouterr().out)
-    assert out["files_scanned"] == 17
+    assert out["files_scanned"] == 18
     assert set(out["rules"]) == set(RULES)
     sample = out["findings"][0]
     assert {"file", "line", "rule", "message", "hint"} <= set(sample)

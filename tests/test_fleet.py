@@ -1,0 +1,353 @@
+"""Fleet-scale serving: RF>=2 replicated writes, quorum/merged reads,
+heartbeat prune, the sharded blocklist poller and the /status/fleet
+observability surface -- the fast in-process half of the fleet story
+(tests/test_fleet_e2e.py drives the same seams as real processes)."""
+
+import time
+
+import pytest
+
+from tempo_tpu.backend import MemBackend
+from tempo_tpu.db.blocklist import Poller
+from tempo_tpu.db.tempodb import TempoDB, TempoDBConfig
+from tempo_tpu.db.wal import WAL
+from tempo_tpu.fleet.poller_shard import PollerShard
+from tempo_tpu.fleet.quorum import (ReadQuorumError, merge_snapshots,
+                                    read_quorum_need, segment_digest)
+from tempo_tpu.fleet.replication import (REPLICATION_WRITES,
+                                         record_write_outcomes,
+                                         replication_snapshot)
+from tempo_tpu.ring.ring import InMemoryKV, Lifecycler, Ring
+from tempo_tpu.services.distributor import Distributor, PushError
+from tempo_tpu.services.ingester import Ingester
+from tempo_tpu.services.overrides import Overrides
+from tempo_tpu.services.querier import Querier
+from tempo_tpu.util.testdata import make_traces
+
+TENANT = "t1"
+
+
+def _counter_delta(before: dict, after: dict) -> dict:
+    return {k: after.get(k, 0) - before.get(k, 0)
+            for k in set(before) | set(after)
+            if after.get(k, 0) != before.get(k, 0)}
+
+
+def _mk_ingester(tmp_path, name: str, overrides: Overrides):
+    db = TempoDB(TempoDBConfig(wal_path=str(tmp_path / f"{name}-dbw")),
+                 backend=MemBackend())
+    return db, Ingester(WAL(str(tmp_path / f"{name}-wal")), db, overrides)
+
+
+def _rf2_cluster(tmp_path, n: int = 2):
+    """n in-process ingesters joined to one RF=2 ring."""
+    overrides = Overrides()
+    kv = InMemoryKV()
+    dbs, clients = [], {}
+    for i in range(n):
+        lc = Lifecycler(kv, "ing", f"ing-{i}")
+        lc.join()
+        db, ing = _mk_ingester(tmp_path, f"ing-{i}", overrides)
+        dbs.append(db)
+        clients[lc.desc.addr] = ing
+    ring = Ring(kv, "ing", replication_factor=2)
+    dist = Distributor(ring, clients.__getitem__, overrides)
+    return kv, ring, dist, clients, dbs
+
+
+# --------------------------------------------------------- write outcomes
+
+
+def test_record_write_outcomes_classification():
+    before = REPLICATION_WRITES.snapshot()
+    tally = record_write_outcomes(
+        quorum_need={"a": 1, "b": 1, "c": 1},
+        ok_count={"a": 2, "b": 1, "c": 0},
+        desired=2,
+    )
+    assert tally == {"quorum": 1, "partial": 1, "failed": 1}
+    delta = _counter_delta(before, REPLICATION_WRITES.snapshot())
+    assert delta == {'outcome="quorum"': 1, 'outcome="partial"': 1,
+                     'outcome="failed"': 1}
+    snap = replication_snapshot()
+    assert set(snap) <= {"quorum", "partial", "failed"}
+
+
+def test_rf2_write_lands_on_both_replicas(tmp_path):
+    _kv, _ring, dist, clients, dbs = _rf2_cluster(tmp_path)
+    before = REPLICATION_WRITES.snapshot()
+    traces = make_traces(8, seed=2, n_spans=4)
+    for _tid, tr in traces:
+        dist.push(TENANT, tr.resource_spans)
+    # RF=2 with 2 healthy: every trace is on BOTH ingesters
+    for ing in clients.values():
+        for tid, _tr in traces:
+            assert ing.trace_snapshot(TENANT, tid), (
+                f"trace {tid.hex()} missing from a replica")
+    delta = _counter_delta(before, REPLICATION_WRITES.snapshot())
+    assert delta.get('outcome="quorum"', 0) >= len(traces)
+    assert 'outcome="failed"' not in delta
+    for db in dbs:
+        db.close()
+
+
+def test_rf2_fast_path_gated_one_replica_down(tmp_path):
+    """PR 16's single-healthy-ingester fast path must stay OFF at RF>1:
+    with one replica dead the push still succeeds (eventually-consistent
+    W=1 at RF=2) and the under-replication is RECORDED as a partial
+    outcome -- the fast path would have skipped the bookkeeping."""
+    kv, _ring, dist, clients, dbs = _rf2_cluster(tmp_path)
+    kv.get_all("ing")["ing-1"].heartbeat_ts = time.time() - 9999
+    before = REPLICATION_WRITES.snapshot()
+    traces = make_traces(5, seed=3, n_spans=4)
+    for _tid, tr in traces:
+        dist.push(TENANT, tr.resource_spans)  # quorum met: no PushError
+    delta = _counter_delta(before, REPLICATION_WRITES.snapshot())
+    assert delta.get('outcome="partial"', 0) >= len(traces)
+    assert 'outcome="failed"' not in delta
+    # and the survivor really has the data
+    live = [ing for addr, ing in clients.items()
+            if any(ing.trace_snapshot(TENANT, tid) for tid, _ in traces)]
+    assert live
+    for db in dbs:
+        db.close()
+
+
+def test_rf2_push_fails_below_write_quorum(tmp_path):
+    """Both replicas down-or-failing -> the push must NOT be acked."""
+    overrides = Overrides()
+    kv = InMemoryKV()
+    for i in range(2):
+        Lifecycler(kv, "ing", f"ing-{i}").join()
+    ring = Ring(kv, "ing", replication_factor=2)
+
+    class Down:
+        def push_segments(self, tenant, batch):
+            raise OSError("replica down")
+
+    dist = Distributor(ring, (lambda addr: Down()), overrides)
+    before = REPLICATION_WRITES.snapshot()
+    tid, tr = make_traces(1, seed=4)[0]
+    with pytest.raises(PushError):
+        dist.push(TENANT, tr.resource_spans)
+    delta = _counter_delta(before, REPLICATION_WRITES.snapshot())
+    assert delta.get('outcome="failed"', 0) >= 1
+
+
+# ----------------------------------------------------------- quorum reads
+
+
+def test_segment_digest_and_merge_snapshots():
+    a, b = b"seg-a" * 10, b"seg-b" * 10
+    assert segment_digest(a) == segment_digest(a) != segment_digest(b)
+    merged = merge_snapshots([
+        [(segment_digest(a), a), (segment_digest(b), b)],
+        [(segment_digest(a), a)],  # replica copy: same digest, deduped
+        [],
+    ])
+    assert sorted(merged) == sorted([a, b])
+    assert merge_snapshots([]) == []
+
+
+def test_read_quorum_need():
+    assert read_quorum_need(2, 1) == 1  # RF=2: one dead replica invisible
+    assert read_quorum_need(3, 1) == 2  # RF=3: majority
+    assert read_quorum_need(1, 0) == 1
+    assert read_quorum_need(0, 0) == 1  # floor
+
+
+def test_quorum_read_dedupes_replica_copies(tmp_path):
+    """RF=2 read fans to both replicas; identical segments must merge to
+    ONE copy of each span, not two."""
+    _kv, ring, dist, clients, dbs = _rf2_cluster(tmp_path)
+    traces = make_traces(6, seed=5, n_spans=5)
+    for _tid, tr in traces:
+        dist.push(TENANT, tr.resource_spans)
+    q = Querier(dbs[0], ring, clients.__getitem__)
+    for tid, tr in traces:
+        got = q.find_trace_by_id(TENANT, tid)
+        assert got is not None
+        assert got.span_count() == tr.span_count()  # deduped, not doubled
+    for db in dbs:
+        db.close()
+
+
+def test_quorum_read_survives_one_dead_replica(tmp_path):
+    _kv, ring, dist, clients, dbs = _rf2_cluster(tmp_path)
+    traces = make_traces(4, seed=6, n_spans=4)
+    for _tid, tr in traces:
+        dist.push(TENANT, tr.resource_spans)
+
+    dead_addr = next(iter(clients))
+
+    class DeadThenLive:
+        def __init__(self, addr):
+            self.addr = addr
+
+        def __getattr__(self, name):
+            inner = clients[self.addr]
+            if self.addr == dead_addr:
+                def boom(*a, **k):
+                    raise OSError("replica SIGKILLed")
+                return boom
+            return getattr(inner, name)
+
+    q = Querier(dbs[1], ring, lambda addr: DeadThenLive(addr))
+    for tid, tr in traces:
+        got = q.find_trace_by_id(TENANT, tid)
+        assert got is not None and got.span_count() == tr.span_count()
+    for db in dbs:
+        db.close()
+
+
+def test_quorum_read_raises_below_r(tmp_path):
+    """No replica answers -> ReadQuorumError (an OSError: the frontend
+    retries the job instead of caching a false 'not found')."""
+    overrides = Overrides()
+    kv = InMemoryKV()
+    for i in range(2):
+        Lifecycler(kv, "ing", f"ing-{i}").join()
+    ring = Ring(kv, "ing", replication_factor=2)
+
+    class Dead:
+        def __getattr__(self, name):
+            def boom(*a, **k):
+                raise OSError("down")
+            return boom
+
+    db = TempoDB(TempoDBConfig(wal_path=str(tmp_path / "dbw")),
+                 backend=MemBackend())
+    q = Querier(db, ring, lambda addr: Dead())
+    tid = make_traces(1, seed=7)[0][0]
+    with pytest.raises(ReadQuorumError) as ei:
+        q.find_trace_by_id(TENANT, tid)
+    assert isinstance(ei.value, OSError)
+    db.close()
+
+
+# ------------------------------------------------------- lifecycler prune
+
+
+def test_lifecycler_prunes_stale_peer():
+    kv = InMemoryKV()
+    lc = Lifecycler(kv, "ing", "alive", prune_timeout=1.0)
+    lc.join()
+    dead = Lifecycler(kv, "ing", "dead")
+    dead.join()  # then SIGKILL: no LEAVE record, heartbeat goes stale
+    kv.get_all("ing")["dead"].heartbeat_ts = time.time() - 5.0
+    assert lc.prune() == ["dead"]
+    assert "dead" not in kv.get_all("ing")
+    assert "alive" in kv.get_all("ing")  # never prunes itself
+    assert lc.prune() == []  # idempotent
+
+
+def test_lifecycler_prune_disabled_by_default():
+    kv = InMemoryKV()
+    lc = Lifecycler(kv, "ing", "alive")
+    lc.join()
+    stale = Lifecycler(kv, "ing", "stale")
+    stale.join()
+    kv.get_all("ing")["stale"].heartbeat_ts = time.time() - 99999
+    assert lc.prune() == []  # prune_timeout=None: opt-in only
+    assert "stale" in kv.get_all("ing")
+
+
+# ------------------------------------------------------ sharded poller
+
+
+def test_poller_shard_partitions_tenants():
+    kv = InMemoryKV()
+    for i in range(3):
+        Lifecycler(kv, "querier-ring", f"q-{i}").join()
+    shards = [PollerShard(Ring(kv, "querier-ring"), f"q-{i}")
+              for i in range(3)]
+    tenants = [f"tenant-{i}" for i in range(12)]
+    for t in tenants:
+        owners = [s for s in shards if s.owns(t)]
+        assert len(owners) == 1, f"{t} owned by {len(owners)} shards"
+    # every member computes the same shard map
+    maps = [s.shard_map(tenants) for s in shards]
+    assert maps[0] == maps[1] == maps[2]
+    st = shards[0].status(tenants)
+    assert st["members"] == ["q-0", "q-1", "q-2"]
+    assert sorted(st["owned"]) == sorted(
+        t for t, o in maps[0].items() if o == "q-0")
+
+
+def test_poller_shard_empty_ring_owns_everything():
+    kv = InMemoryKV()
+    shard = PollerShard(Ring(kv, "querier-ring"), "q-solo")
+    assert shard.owns("any-tenant")
+    assert shard.status(["a", "b"])["members"] == []
+
+
+def test_poller_non_owner_reads_owner_index(tmp_path):
+    backend = MemBackend()
+    db = TempoDB(TempoDBConfig(wal_path=str(tmp_path / "dbw")),
+                 backend=backend)
+    overrides = Overrides()
+    ing = Ingester(WAL(str(tmp_path / "wal")), db, overrides)
+    kv = InMemoryKV()
+    Lifecycler(kv, "ing", "i0").join()
+    dist = Distributor(Ring(kv, "ing"),
+                       (lambda addr: ing), overrides)
+    for _tid, tr in make_traces(5, seed=8, n_spans=4):
+        dist.push(TENANT, tr.resource_spans)
+    ing.sweep_all(force=True)  # cut + flush -> backend blocks
+
+    owner = Poller(backend, build_index=True)
+    metas, _ = owner.poll()
+    assert len(metas[TENANT]) >= 1
+
+    # the non-owner lists NOTHING: it reads the owner's index object
+    class NoListBackend:
+        def __getattr__(self, name):
+            if name == "blocks":
+                raise AssertionError("non-owner must not list the backend")
+            return getattr(backend, name)
+
+    non_owner = Poller(NoListBackend(), build_index=True)
+    non_owner.owns_tenant = lambda tenant: False
+    nmetas, _ = non_owner.poll()
+    assert ([m.block_id for m in nmetas[TENANT]]
+            == [m.block_id for m in metas[TENANT]])
+    assert non_owner.last_shard["deferred"] == [TENANT]
+    assert owner.last_shard["owned"] == [TENANT]
+    db.close()
+
+
+# -------------------------------------------------- /status/fleet surface
+
+
+def test_status_fleet_and_queue_depth_metrics(tmp_path):
+    from tempo_tpu.services.app import (App, AppConfig, _fleet_status,
+                                        _metrics_text)
+
+    app = App(AppConfig(target="all", storage_path=str(tmp_path / "s"),
+                        replication_factor=1))
+    try:
+        app.lifecycler.join()  # register without starting the loops
+        for _tid, tr in make_traces(3, seed=9, n_spans=4):
+            app.distributor.push(TENANT, tr.resource_spans)
+        st = _fleet_status(app)
+        assert st["ring"]["replication_factor"] == 1
+        assert st["ring"]["write_quorum"] == 1
+        assert st["ring"]["healthy"] == 1
+        assert st["ring"]["members"][0]["healthy"] is True
+        assert "writes" in st["replication"]
+        assert st["poller_shard"]["solo"] is True
+        assert isinstance(st.get("queue_depths", {}), dict)
+        text = _metrics_text(app)
+        assert "tempo_query_queue_depth" in text
+        assert "tempo_replication_writes_total" in text
+    finally:
+        app.stop()
+
+
+def test_fleet_status_quorum_arithmetic():
+    from tempo_tpu.services.app import _fleet_status  # noqa: F401
+
+    # the surface mirrors ring.ReplicationSet: RF=2 is the eventually-
+    # consistent W=1 special case, RF>=3 is majority
+    for rf, want in ((1, 1), (2, 1), (3, 2), (5, 3)):
+        assert (1 if rf <= 2 else rf - (rf - 1) // 2) == want

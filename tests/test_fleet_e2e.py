@@ -1,0 +1,72 @@
+"""Fleet e2e: the ISSUE's satellite #3 certification as a pytest --
+rolling SIGKILL restart of every ingester at RF=2 while vulture
+find_by_id/search probes run continuously against the real multi-process
+topology (gossip membership, replicated distributor, quorum-reading
+queriers behind a dispatcher frontend). Zero miss/corrupt allowed; sheds
+are acceptable.
+
+Marked BOTH slow (excluded from the tier-1 870s box) and fleet (so
+`pytest -m fleet` runs exactly the fleet certs). Wall-clock is bounded:
+the quick topology (2 ingesters, 1 querier) plus short settle windows
+keeps a full run well under the e2e budget; a hard deadline assertion
+makes a hung fleet fail fast instead of eating the suite."""
+
+import threading
+import time
+
+import pytest
+
+from tempo_tpu.fleet.harness import FleetTopology
+from tempo_tpu.vulture import Vulture, VultureConfig
+
+pytestmark = [pytest.mark.slow, pytest.mark.fleet]
+
+E2E_DEADLINE_S = 240.0
+
+
+def test_rolling_restart_rf2_zero_miss(tmp_path):
+    t_start = time.time()
+    topo = FleetTopology(str(tmp_path), ingesters=2, queriers=1, rf=2,
+                         worker_concurrency=2)
+    outcomes: dict[str, int] = {}
+    fails: list[str] = []
+    stop = threading.Event()
+
+    def vloop(v: Vulture) -> None:
+        while not stop.is_set():
+            for r in v.cycle():
+                outcomes[r.outcome] = outcomes.get(r.outcome, 0) + 1
+                if r.outcome not in ("ok", "shed") and len(fails) < 10:
+                    fails.append(f"{r.family}: {r.outcome} {r.detail}")
+
+    try:
+        topo.start()
+        topo.push_traces(3, seed=21)
+        v = Vulture(VultureConfig(
+            push_url=topo.dist_url, query_url=topo.fe_url,
+            families=("find_by_id", "search"), flush_every=0,
+            generator_probes=False, visibility_timeout_s=30.0,
+            spans_per_trace=3, batch_ids=2, seed=17))
+        vt = threading.Thread(target=vloop, args=(v,), daemon=True)
+        vt.start()
+        time.sleep(2.0)  # probes in flight before the first kill
+        for name in list(topo._ingesters):
+            topo.kill_ingester(name)       # SIGKILL: no LEAVE record
+            time.sleep(topo.hb + 1.0)      # heartbeat prune window
+            topo.respawn_ingester(name)
+            time.sleep(2.0)                # WAL replay + rejoin settle
+        time.sleep(2.0)  # post-roll probes against the healed fleet
+        stop.set()
+        vt.join(timeout=90)
+        assert not vt.is_alive(), "vulture probe loop hung"
+        assert v.cycles > 0, "no probe cycle completed during the roll"
+        misses = outcomes.get("miss", 0) + outcomes.get("timeout", 0)
+        corrupt = outcomes.get("corrupt", 0)
+        errors = outcomes.get("error", 0)
+        assert misses == 0 and corrupt == 0 and errors == 0, (
+            f"outcomes={outcomes} failures={fails}")
+        assert time.time() - t_start < E2E_DEADLINE_S, (
+            "fleet e2e blew its wall-clock budget")
+    finally:
+        stop.set()
+        topo.stop()
