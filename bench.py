@@ -381,7 +381,9 @@ def bench_analysis() -> None:
     _emit("static_analysis_ms", wall_ms, "ms",
           tel={"rules": len(RULES), "files_scanned": report.files_scanned,
                "findings": len(report.findings),
-               "suppressed": report.suppressed})
+               "suppressed": report.suppressed,
+               "family_ms": {k: round(v, 1)
+                             for k, v in sorted(report.family_ms.items())}})
 
 
 def bench_kernel() -> None:

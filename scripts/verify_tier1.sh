@@ -13,6 +13,14 @@ cd "$(dirname "$0")/.."
 
 FLOOR=$(python -c "import json; print(json.load(open('TIER1_BASELINE.json'))['dots_passed_floor'])")
 
+# full-tree contract analysis first: it is seconds, and a contract
+# violation fails fast with an actionable finding instead of surfacing
+# as a distant test failure (warn-severity findings print, don't gate)
+if ! JAX_PLATFORMS=cpu python -m tempo_tpu.analysis --strict; then
+  echo "tier-1 FAILED (static analysis --strict)"
+  exit 1
+fi
+
 set -o pipefail
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \

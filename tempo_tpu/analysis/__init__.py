@@ -25,26 +25,34 @@ or the code under analysis, so it runs in milliseconds anywhere.
 
 from __future__ import annotations
 
+import time
 from pathlib import Path
 
 from .concurrency import run_concurrency_rules
 from .core import (  # noqa: F401  (re-exported API)
+    RULE_HINTS,
     RULES,
+    SCHEMA_VERSION,
     Finding,
     Report,
     SourceModule,
     apply_baseline,
     load_baseline,
+    run_pragma_rules,
     walk_py,
 )
+from .envrules import find_doc_texts, run_env_rules
 from .jitrules import run_jit_rules, run_value_key_cross
+from .lockgraph import run_lock_graph
+from .resiliencerules import run_resilience_rules
+from .telemetryrules import run_telemetry_rules
 from .twinrules import run_twin_rules
 
 KERNEL_SCOPE = ("ops/", "parallel/")
 # chaos/ is in scope on purpose: the fault plane is exactly the kind of
 # process-wide registry the concurrency rules exist to guard
 CONCURRENCY_SCOPE = ("services/", "util/", "ops/", "db/", "chaos/",
-                     "ingest/", "fleet/")
+                     "ingest/", "fleet/", "transport/")
 
 
 def default_root() -> Path:
@@ -75,18 +83,32 @@ def _resolve_package_roots(root: Path) -> list[Path]:
 
 
 def run_analysis(root: Path | None = None,
-                 files: list[Path] | None = None) -> Report:
+                 files: list[Path] | None = None,
+                 scope_files: bool = False) -> Report:
     """Scan a package root (directory walk + scoped passes + twin
-    cross-check) or an explicit file list (every per-file pass, no twin
-    check -- there is no tree to cross-reference)."""
+    cross-check) or an explicit file list (per-file passes, no twin
+    check -- there is no tree to cross-reference). scope_files applies
+    the directory scoping to a file list rooted under `root` (--diff
+    mode: a changed file outside every scope must not surface findings
+    the full scoped run would never report)."""
     report = Report()
     root = Path(root) if root is not None else default_root()
 
     if files is not None:
         # key by the path as given, not the basename: same-named files
         # in different directories must not collide (and baseline
-        # matching on (file, rule) must distinguish them)
-        todo = [(Path(f), str(f)) for f in files]
+        # matching on (file, rule) must distinguish them). Under
+        # scope_files the key is root-relative so scopes can match.
+        todo = []
+        for f in files:
+            rel = str(f)
+            if scope_files:
+                try:
+                    rel = Path(f).resolve().relative_to(
+                        root.resolve()).as_posix()
+                except ValueError:
+                    pass  # outside the root: unscoped, full passes
+            todo.append((Path(f), rel))
         scoped = False
     else:
         roots = _resolve_package_roots(root)
@@ -105,6 +127,8 @@ def run_analysis(root: Path | None = None,
                     for f in sub.parse_errors)
                 report.files_scanned += sub.files_scanned
                 report.suppressed += sub.suppressed
+                for k, v in sub.family_ms.items():
+                    report.family_ms[k] = report.family_ms.get(k, 0.0) + v
             report.findings.sort(key=lambda f: (f.file, f.line, f.rule))
             return report
         root = roots[0]
@@ -127,19 +151,34 @@ def run_analysis(root: Path | None = None,
                 rel, 1, "parse-error", f"unreadable: {e}",
                 "fix the encoding (or run with --skip-unparsable)"))
 
+    def timed(family: str, fn, *a) -> None:
+        t0 = time.perf_counter()
+        fn(*a)
+        report.family_ms[family] = (report.family_ms.get(family, 0.0)
+                                    + (time.perf_counter() - t0) * 1e3)
+
+    use_scopes = scoped or scope_files
     for rel, mod in modules.items():
         # files at the root of a flat scan (no package layout) get every
         # per-file pass; inside a package layout the directory scopes
         # keep orchestration-only layers out of the kernel rules
         flat = "/" not in rel
-        if not scoped or flat or rel.startswith(KERNEL_SCOPE):
-            run_jit_rules(mod, report)
-        if not scoped or flat or rel.startswith(CONCURRENCY_SCOPE):
-            run_concurrency_rules(mod, report)
+        if not use_scopes or flat or rel.startswith(KERNEL_SCOPE):
+            timed("kernel", run_jit_rules, mod, report)
+        if not use_scopes or flat or rel.startswith(CONCURRENCY_SCOPE):
+            timed("concurrency", run_concurrency_rules, mod, report)
 
     if scoped:
-        run_twin_rules(modules, report)
-        run_value_key_cross(modules, report)
+        timed("kernel", run_twin_rules, modules, report)
+        timed("kernel", run_value_key_cross, modules, report)
+        timed("config", run_env_rules, modules, report,
+              find_doc_texts(root))
+        timed("telemetry", run_telemetry_rules, modules, report, root)
+        timed("resilience", run_resilience_rules, modules, report)
+        timed("lockgraph", run_lock_graph, modules, report)
+
+    # LAST: the pragma audit needs every other pass's suppression marks
+    timed("pragma", run_pragma_rules, modules, report, scoped)
 
     report.findings.sort(key=lambda f: (f.file, f.line, f.rule))
     return report

@@ -2,11 +2,17 @@
 
     python -m tempo_tpu.analysis [paths...] [--strict] [--json]
                                  [--baseline FILE] [--skip-unparsable]
-                                 [--list-rules]
+                                 [--list-rules] [--diff REV]
 
 Paths may be package roots (directory: full scoped run including the
 twin cross-check) or individual .py files (per-file passes only).
 Default: the tempo_tpu package this module ships in.
+
+--diff REV scans only the .py files `git diff --name-only REV` reports
+under the scan root (per-file passes; the cross-file families need the
+whole tree). An empty diff is a clean exit; a failing git invocation
+falls back to the full run -- "couldn't compute the diff" must degrade
+to MORE checking, never less.
 
 Exit codes:
   0  clean (or findings only outside --strict / covered by --baseline)
@@ -24,7 +30,15 @@ import sys
 import time
 from pathlib import Path
 
-from . import RULES, Report, apply_baseline, default_root, load_baseline, run_analysis
+from . import (
+    RULE_HINTS,
+    RULES,
+    Report,
+    apply_baseline,
+    default_root,
+    load_baseline,
+    run_analysis,
+)
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -45,12 +59,20 @@ def main(argv: list[str] | None = None) -> int:
                     help="report parse failures as findings but do not "
                          "exit 2 for them")
     ap.add_argument("--list-rules", action="store_true",
-                    help="print every rule id and description, then exit")
+                    help="print every rule id, description and fix hint, "
+                         "then exit")
+    ap.add_argument("--diff", metavar="REV",
+                    help="scan only files changed since REV (git diff "
+                         "--name-only); falls back to a full run if the "
+                         "diff cannot be computed")
     args = ap.parse_args(argv)
 
     if args.list_rules:
         for rid, desc in sorted(RULES.items()):
             print(f"{rid}: {desc}")
+            hint = RULE_HINTS.get(rid)
+            if hint:
+                print(f"    fix: {hint}")
         return 0
 
     t0 = time.perf_counter()
@@ -61,11 +83,23 @@ def main(argv: list[str] | None = None) -> int:
     if not roots and not files:
         roots = [default_root()]
 
+    diff_root: Path | None = None
+    if args.diff and not files:
+        diff = _diff_paths(args.diff, roots)
+        if diff is None:
+            print(f"analysis: cannot compute git diff vs {args.diff!r}; "
+                  "falling back to the full run", file=sys.stderr)
+        else:
+            diff_root, files, roots = roots[0], diff, []
+
     report = Report()
     for root in roots:
         sub = run_analysis(root)
         _merge(report, sub)
-    if files:
+    if files and diff_root is not None:
+        _merge(report, run_analysis(diff_root, files=files,
+                                    scope_files=True))
+    elif files:
         _merge(report, run_analysis(files[0].parent, files=files))
 
     if args.baseline:
@@ -97,9 +131,42 @@ def main(argv: list[str] | None = None) -> int:
 
     if report.parse_errors and not args.skip_unparsable:
         return 2
-    if args.strict and report.findings:
-        return 1
+    if args.strict and report.errors():
+        return 1  # warn-severity findings print but never gate
     return 0
+
+
+def _diff_paths(rev: str, roots: list[Path]) -> list[Path] | None:
+    """Changed .py files under the scan roots per `git diff --name-only
+    REV`, or None when git cannot answer (missing binary, not a repo,
+    bad rev): the caller falls back to the FULL run -- a broken diff
+    must degrade to more checking, never less. Deleted files have
+    nothing to scan and are dropped."""
+    import subprocess
+
+    def git(*argv: str) -> str | None:
+        try:
+            r = subprocess.run(["git", *argv], cwd=str(roots[0]),
+                               capture_output=True, text=True, timeout=30)
+        except (OSError, subprocess.TimeoutExpired):
+            return None
+        return r.stdout if r.returncode == 0 else None
+
+    top = git("rev-parse", "--show-toplevel")
+    names = git("diff", "--name-only", rev, "--")
+    if top is None or names is None:
+        return None
+    topdir = Path(top.strip())
+    root_strs = [r.resolve().as_posix() + "/" for r in roots]
+    out: list[Path] = []
+    for name in names.splitlines():
+        if not name.endswith(".py"):
+            continue
+        p = topdir / name
+        if p.is_file() and any(p.resolve().as_posix().startswith(rs)
+                               for rs in root_strs):
+            out.append(p)
+    return out
 
 
 def _merge(into: Report, sub: Report) -> None:
@@ -108,6 +175,8 @@ def _merge(into: Report, sub: Report) -> None:
     into.files_scanned += sub.files_scanned
     into.suppressed += sub.suppressed
     into.baselined += sub.baselined
+    for k, v in sub.family_ms.items():
+        into.family_ms[k] = into.family_ms.get(k, 0.0) + v
 
 
 if __name__ == "__main__":

@@ -1,0 +1,236 @@
+"""Shared interprocedural engine for the whole-program passes.
+
+PR-4's twin gate needed exactly one cross-file question answered --
+"which functions reach jax.jit?" -- and buried the machinery for it
+(import resolution, per-module facts, a reachability fixpoint) inside
+twinrules. The contract families ask the same *shape* of question about
+different properties (which call paths hold which locks, which RPC legs
+sit behind a breaker), so the machinery lives here now and the rule
+modules own only their property.
+
+Everything is AST-only and stdlib-only, like the rest of the package:
+the graph is built from one parsed tree per file, nothing is imported.
+
+Resolution model (deliberately first-order):
+
+  * functions are identified by ``<module fq>.<qualname>`` where the
+    module fq is the package-root-relative dotted path
+    (``db/wal.py`` -> ``db.wal``) and qualname includes one class level
+    (``WAL.append``);
+  * a call edge resolves through local defs, ``from X import name``,
+    ``import X [as y]`` + attribute access, ``self.method(...)`` inside
+    a class, and bare-name references (kernels get passed to executors
+    as values, so a Load of a function name counts as an edge);
+  * anything pointing outside the scanned root (stdlib, third-party)
+    resolves to nothing and simply contributes no edge.
+
+That is exact enough for the twin gate and the lock graph; dynamic
+dispatch through registries is invisible here on purpose -- those
+seams have their own runtime tests.
+"""
+
+from __future__ import annotations
+
+import ast
+from collections import deque
+from pathlib import Path
+
+from .core import SourceModule
+
+
+def fq_module(rel: str) -> str:
+    """'ops/filter.py' -> 'ops.filter' (package-root-relative)."""
+    return rel[:-3].replace("/", ".")
+
+
+def resolve_import(cur_pkg: str, node: ast.ImportFrom,
+                   packages: tuple[str, ...]) -> str | None:
+    """Package-root-relative module for an ImportFrom, or None when it
+    points outside the scanned root (stdlib, third-party). `packages`
+    is the set of top-level package dirs the scan actually holds, so an
+    absolute `tempo_tpu.ops.x` (or `<any root>.ops.x`) re-anchors at
+    the first recognized segment."""
+    mod = node.module or ""
+    if node.level == 0:
+        parts = mod.split(".")
+        for i, p in enumerate(parts):
+            if p in packages:
+                return ".".join(parts[i:])
+        return None
+    parts = cur_pkg.split("/") if cur_pkg else []
+    base = parts[:len(parts) - (node.level - 1)] if node.level - 1 else parts
+    if node.level - 1 > len(parts):
+        return None
+    prefix = ".".join(base)
+    return f"{prefix}.{mod}" if prefix and mod else (mod or prefix or None)
+
+
+class ModuleFacts:
+    """Per-module resolution facts: imports, defs (incl. one level of
+    class methods), and the names each definition references."""
+
+    def __init__(self, mod: SourceModule, packages: tuple[str, ...]):
+        self.rel = mod.rel
+        self.fq = fq_module(mod.rel)
+        self.mod = mod
+        # local name -> fq FUNCTION name (from X import f)
+        self.imports: dict[str, str] = {}
+        # local name -> fq MODULE name (import X as y / from . import X)
+        self.module_imports: dict[str, str] = {}
+        self.defs: dict[str, ast.FunctionDef] = {}
+        self.classes: set[str] = set()
+        # qualname ('f' or 'Cls.m') -> def node
+        self.functions: dict[str, ast.FunctionDef] = {}
+        cur_pkg = "/".join(Path(mod.rel).parts[:-1])
+        for n in ast.walk(mod.tree):
+            if isinstance(n, ast.ImportFrom):
+                target = resolve_import(cur_pkg, n, packages)
+                if target is None:
+                    continue
+                for al in n.names:
+                    local = al.asname or al.name
+                    # `from ..db import wal` imports a MODULE; record it
+                    # in both maps -- which one applies depends on how
+                    # the name is used (wal.append vs wal())
+                    self.imports[local] = f"{target}.{al.name}"
+                    self.module_imports[local] = f"{target}.{al.name}"
+            elif isinstance(n, ast.Import):
+                for al in n.names:
+                    parts = al.name.split(".")
+                    for i, p in enumerate(parts):
+                        if p in packages:
+                            fqm = ".".join(parts[i:])
+                            self.module_imports[al.asname or al.name] = fqm
+                            break
+        for n in mod.tree.body:
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.defs[n.name] = n
+                self.functions[n.name] = n
+            elif isinstance(n, ast.ClassDef):
+                self.classes.add(n.name)
+                for item in n.body:
+                    if isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        self.functions[f"{n.name}.{item.name}"] = item
+
+    # ---------------------------------------------------------- resolve
+    def resolve_call(self, node: ast.AST,
+                     class_name: str = "") -> str | None:
+        """fq function name a Name/Attribute reference resolves to
+        within this module, or None."""
+        if isinstance(node, ast.Name):
+            if node.id in self.defs:
+                return f"{self.fq}.{node.id}"
+            if node.id in self.imports:
+                return self.imports[node.id]
+            return None
+        if isinstance(node, ast.Attribute):
+            base = node.value
+            if (isinstance(base, ast.Name) and base.id == "self"
+                    and class_name):
+                qn = f"{class_name}.{node.attr}"
+                if qn in self.functions:
+                    return f"{self.fq}.{qn}"
+                return None
+            if isinstance(base, ast.Name):
+                fqm = self.module_imports.get(base.id)
+                if fqm is not None:
+                    return f"{fqm}.{node.attr}"
+            # Class.method on a locally-defined or imported class
+            if isinstance(base, ast.Name) and base.id in self.classes:
+                qn = f"{base.id}.{node.attr}"
+                if qn in self.functions:
+                    return f"{self.fq}.{qn}"
+        return None
+
+    def calls_of(self, fn: ast.FunctionDef, class_name: str = "",
+                 bare_names: bool = True) -> set[str]:
+        """fq names this definition references. With bare_names, a Load
+        of a function name counts even outside a call (kernels get
+        passed to executors/vmaps as values)."""
+        out: set[str] = set()
+        for n in ast.walk(fn):
+            if isinstance(n, ast.Call):
+                r = self.resolve_call(n.func, class_name)
+                if r:
+                    out.add(r)
+            elif (bare_names and isinstance(n, ast.Name)
+                    and isinstance(n.ctx, ast.Load)):
+                r = self.resolve_call(n, class_name)
+                if r:
+                    out.add(r)
+        return out
+
+
+class CallGraph:
+    """Whole-tree call graph: functions keyed by fq name, resolved call
+    edges, reachability fixpoints, and BFS witness paths."""
+
+    def __init__(self, modules: dict[str, SourceModule]):
+        self.packages = tuple(sorted(
+            {rel.split("/")[0] for rel in modules if "/" in rel}))
+        self.facts: dict[str, ModuleFacts] = {}
+        self.functions: dict[str, tuple[ModuleFacts, str,
+                                        ast.FunctionDef]] = {}
+        self.edges: dict[str, set[str]] = {}
+        for rel, mod in modules.items():
+            f = ModuleFacts(mod, self.packages)
+            self.facts[rel] = f
+            for qn, node in f.functions.items():
+                self.functions[f"{f.fq}.{qn}"] = (f, qn, node)
+        for fq, (f, qn, node) in self.functions.items():
+            cls = qn.split(".")[0] if "." in qn else ""
+            callees = f.calls_of(node, class_name=cls)
+            # keep only edges that land on a known function
+            self.edges[fq] = {c for c in callees if c in self.functions}
+
+    def reachable_from(self, fq: str) -> set[str]:
+        """Transitive callees of one function (not including itself
+        unless recursive)."""
+        seen: set[str] = set()
+        stack = list(self.edges.get(fq, ()))
+        while stack:
+            cur = stack.pop()
+            if cur in seen:
+                continue
+            seen.add(cur)
+            stack.extend(self.edges.get(cur, ()))
+        return seen
+
+    def witness_path(self, src: str, targets: set[str]) -> list[str]:
+        """Shortest call path src -> any target ([src] when src itself
+        is a target, [] when unreachable)."""
+        if src in targets:
+            return [src]
+        prev: dict[str, str] = {}
+        q = deque([src])
+        seen = {src}
+        while q:
+            cur = q.popleft()
+            for nxt in sorted(self.edges.get(cur, ())):
+                if nxt in seen:
+                    continue
+                seen.add(nxt)
+                prev[nxt] = cur
+                if nxt in targets:
+                    path = [nxt]
+                    while path[-1] != src:
+                        path.append(prev[path[-1]])
+                    return list(reversed(path))
+                q.append(nxt)
+        return []
+
+
+def reachable_fixpoint(seeds: set[str],
+                       edges: dict[str, set[str]]) -> set[str]:
+    """Callers-of-closure: everything that reaches a seed through the
+    edge relation (the twin gate's 'touches jit' question)."""
+    reach = set(seeds)
+    changed = True
+    while changed:
+        changed = False
+        for fq, callees in edges.items():
+            if fq not in reach and callees & reach:
+                reach.add(fq)
+                changed = True
+    return reach

@@ -22,17 +22,37 @@ from dataclasses import dataclass, field
 from pathlib import Path
 
 # rule-id -> one-line description; passes register at import time so the
-# CLI's --list-rules and the bench row see one authoritative set
+# CLI's --list-rules and the bench row see one authoritative set.
+# RULE_HINTS carries the one-line fix hint --list-rules prints beside
+# each id; RULE_SEVERITY marks the warn-only rules ("warn" findings
+# print and count but never fail --strict).
 RULES: dict[str, str] = {
     "parse-error": "file does not parse; the checker cannot vouch for it",
 }
+RULE_HINTS: dict[str, str] = {
+    "parse-error": "fix the syntax error (or --skip-unparsable to scan past)",
+}
+RULE_SEVERITY: dict[str, str] = {}
 
-IGNORE_RE = re.compile(r"#\s*tempo:\s*ignore(?:\[([A-Za-z0-9_\-, ]+)\])?")
+# the documented --json shape; bump when a field changes meaning
+SCHEMA_VERSION = 2
+
+IGNORE_RE = re.compile(
+    r"#\s*tempo:\s*ignore(?:\[([A-Za-z0-9_\-, ]+)\])?[ \t]*(.*)$")
 
 
-def register_rule(rule_id: str, description: str) -> str:
+def register_rule(rule_id: str, description: str, hint: str = "",
+                  severity: str = "error") -> str:
     RULES[rule_id] = description
+    if hint:
+        RULE_HINTS[rule_id] = hint
+    if severity != "error":
+        RULE_SEVERITY[rule_id] = severity
     return rule_id
+
+
+def rule_severity(rule_id: str) -> str:
+    return RULE_SEVERITY.get(rule_id, "error")
 
 
 @dataclass(frozen=True)
@@ -42,16 +62,19 @@ class Finding:
     rule: str
     message: str
     hint: str = ""
+    severity: str = "error"  # "warn" findings never fail --strict
 
     def render(self) -> str:
-        s = f"{self.file}:{self.line}: [{self.rule}] {self.message}"
+        tag = self.rule if self.severity == "error" else f"{self.rule}:warn"
+        s = f"{self.file}:{self.line}: [{tag}] {self.message}"
         if self.hint:
             s += f" (fix: {self.hint})"
         return s
 
     def to_dict(self) -> dict:
         return {"file": self.file, "line": self.line, "rule": self.rule,
-                "message": self.message, "hint": self.hint}
+                "message": self.message, "hint": self.hint,
+                "severity": self.severity}
 
 
 @dataclass
@@ -64,24 +87,48 @@ class SourceModule:
     tree: ast.Module
     # line -> set of suppressed rule ids ("*" = all)
     pragmas: dict[int, set[str]] = field(default_factory=dict)
+    # line -> the pragma carries trailing reason text
+    pragma_reasons: dict[int, bool] = field(default_factory=dict)
+    # pragma lines that actually suppressed a finding this run
+    pragma_used: set[int] = field(default_factory=set)
 
     @classmethod
     def load(cls, path: Path, rel: str) -> "SourceModule":
         text = path.read_text(encoding="utf-8")
         tree = ast.parse(text, filename=str(path))  # SyntaxError -> caller
         pragmas: dict[int, set[str]] = {}
-        for i, line in enumerate(text.splitlines(), start=1):
-            m = IGNORE_RE.search(line)
+        reasons: dict[int, bool] = {}
+        # only real COMMENT tokens count: a docstring *describing* the
+        # pragma syntax must not register as a suppression (and must
+        # not trip the pragma-unused audit)
+        import io
+        import tokenize
+
+        try:
+            tokens = list(tokenize.generate_tokens(io.StringIO(text).readline))
+        except (tokenize.TokenError, IndentationError):
+            tokens = []
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = IGNORE_RE.search(tok.string)
             if m:
+                i = tok.start[0]
                 rules = m.group(1)
                 pragmas[i] = ({r.strip() for r in rules.split(",")} if rules
                               else {"*"})
-        return cls(path=path, rel=rel, text=text, tree=tree, pragmas=pragmas)
+                # a chained `# ...` marker after the pragma is its own
+                # annotation, not the suppression's justification
+                reason = re.sub(r"#.*$", "", m.group(2) or "").strip()
+                reasons[i] = bool(reason)
+        return cls(path=path, rel=rel, text=text, tree=tree, pragmas=pragmas,
+                   pragma_reasons=reasons)
 
     def suppressed(self, line: int, rule: str) -> bool:
         for ln in (line, line - 1):
             rules = self.pragmas.get(ln)
             if rules and ("*" in rules or rule in rules):
+                self.pragma_used.add(ln)
                 return True
         return False
 
@@ -93,13 +140,22 @@ class Report:
     files_scanned: int = 0
     suppressed: int = 0
     baselined: int = 0
+    # rule family -> wall ms, filled by run_analysis (bench trajectory)
+    family_ms: dict[str, float] = field(default_factory=dict)
+
+    def errors(self) -> list[Finding]:
+        """The findings --strict gates on (warn-severity ones don't)."""
+        return [f for f in self.findings if f.severity == "error"]
 
     def to_dict(self) -> dict:
         return {
+            "schema_version": SCHEMA_VERSION,
             "rules": dict(sorted(RULES.items())),
             "files_scanned": self.files_scanned,
             "suppressed": self.suppressed,
             "baselined": self.baselined,
+            "family_ms": {k: round(v, 2)
+                          for k, v in sorted(self.family_ms.items())},
             "findings": [f.to_dict() for f in sorted(
                 self.findings, key=lambda f: (f.file, f.line, f.rule))],
             "parse_errors": [f.to_dict() for f in self.parse_errors],
@@ -138,7 +194,39 @@ def emit(module: SourceModule, report: Report, line: int, rule: str,
     if module.suppressed(line, rule):
         report.suppressed += 1
         return
-    report.findings.append(Finding(module.rel, line, rule, message, hint))
+    report.findings.append(Finding(module.rel, line, rule, message, hint,
+                                   severity=rule_severity(rule)))
+
+
+R_PRAGMA_NO_REASON = register_rule(
+    "pragma-no-reason",
+    "a `# tempo: ignore[...]` pragma without a trailing reason: the "
+    "suppression is policy, the reason is the review record",
+    hint="append why the violation is intentional after the bracket")
+R_PRAGMA_UNUSED = register_rule(
+    "pragma-unused",
+    "a `# tempo: ignore[...]` pragma that suppressed nothing this run: "
+    "the violation it excused is gone (or the rule id is misspelled)",
+    hint="delete the stale pragma (or fix the rule id inside the bracket)")
+
+
+def run_pragma_rules(modules: dict[str, "SourceModule"], report: Report,
+                     check_unused: bool = True) -> None:
+    """Audit the suppressions themselves. MUST run after every other
+    pass: pragma_used is only complete once all emits have happened.
+    check_unused is off in file mode (--diff): the cross-file passes
+    don't run there, so their suppressions would read as stale."""
+    for mod in modules.values():
+        for line in sorted(mod.pragmas):
+            if not mod.pragma_reasons.get(line):
+                emit(mod, report, line, R_PRAGMA_NO_REASON,
+                     "suppression carries no reason",
+                     "add the why after the bracket: "
+                     "# tempo: ignore[rule] <reason>")
+            if check_unused and line not in mod.pragma_used:
+                emit(mod, report, line, R_PRAGMA_UNUSED,
+                     "suppression matched no finding in this run",
+                     "delete it, or fix the rule id it names")
 
 
 def dotted_name(node: ast.AST) -> str | None:

@@ -1,0 +1,127 @@
+"""Config-registry contract: every TEMPO_* knob the code reads must be
+declared in tempo_tpu/config_registry.py, every declared knob must be
+read somewhere, and every declared knob must be documented.
+
+Detection is string-literal based on purpose: every read site in this
+codebase spells the env name as a full literal (os.environ.get, the
+ENV_DEFAULTS tables, SLOW_THRESHOLDS, f-string-free), so any Constant
+exactly matching ``TEMPO_[A-Z0-9_]+`` in package code counts as a
+reference. A knob name composed at runtime would evade this -- and
+would equally evade an operator grepping for it, which is exactly the
+property these rules exist to protect.
+
+The registry itself is read with ast.literal_eval off the parsed tree
+(never imported), and docs are plain-text membership checks against
+README.md and ops/README.md looked up beside the scan root.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+
+from .core import Report, SourceModule, emit, register_rule
+
+R_UNREGISTERED = register_rule(
+    "env-unregistered",
+    "code reads a TEMPO_* env var that is not declared in "
+    "config_registry.py: the knob is invisible to operators",
+    hint="add the name to KNOBS in tempo_tpu/config_registry.py with "
+         "type/default/doc")
+R_DEAD = register_rule(
+    "env-dead",
+    "config_registry.py declares a TEMPO_* knob no code reads: the "
+    "registry is drifting from reality",
+    hint="delete the entry (or wire the knob into the code that was "
+         "supposed to read it)")
+R_DOC_DRIFT = register_rule(
+    "env-doc-drift",
+    "registered TEMPO_* knob appears in no shipped doc (README.md / "
+    "ops/README.md): operators cannot discover it",
+    hint="document the knob in the README config table")
+
+ENV_RE = re.compile(r"^TEMPO_[A-Z0-9_]+$")
+REGISTRY_REL = "config_registry.py"
+
+
+def parse_registry(mod: SourceModule) -> tuple[dict[str, tuple], dict[str, int]]:
+    """(KNOBS literal, name -> declaration line) from the parsed tree."""
+    knobs: dict[str, tuple] = {}
+    lines: dict[str, int] = {}
+    for n in mod.tree.body:
+        target = None
+        if isinstance(n, ast.Assign) and len(n.targets) == 1:
+            target = n.targets[0]
+        elif isinstance(n, ast.AnnAssign):
+            target = n.target
+        if not (isinstance(target, ast.Name) and target.id == "KNOBS"
+                and isinstance(getattr(n, "value", None), ast.Dict)):
+            continue
+        try:
+            knobs.update(ast.literal_eval(n.value))
+        except ValueError:
+            continue
+        for k in n.value.keys:
+            if isinstance(k, ast.Constant):
+                lines[k.value] = k.lineno
+    return knobs, lines
+
+
+def _env_reads(mod: SourceModule) -> list[tuple[str, int]]:
+    out = []
+    for n in ast.walk(mod.tree):
+        if (isinstance(n, ast.Constant) and isinstance(n.value, str)
+                and ENV_RE.match(n.value)):
+            out.append((n.value, n.lineno))
+    return out
+
+
+def run_env_rules(modules: dict[str, SourceModule], report: Report,
+                  doc_texts: list[str]) -> None:
+    reg_mod = modules.get(REGISTRY_REL)
+    if reg_mod is None:
+        return  # no registry in this tree: nothing to hold it against
+    knobs, knob_lines = parse_registry(reg_mod)
+
+    read_names: set[str] = set()
+    for rel, mod in modules.items():
+        reads = _env_reads(mod)
+        if rel == REGISTRY_REL:
+            continue  # declarations are not reads
+        read_names.update(name for name, _ in reads)
+        for name, line in reads:
+            if name not in knobs:
+                emit(mod, report, line, R_UNREGISTERED,
+                     f"'{name}' read here is not in config_registry.KNOBS",
+                     "register it (name, type, default, doc) in "
+                     "tempo_tpu/config_registry.py")
+
+    docs = "\n".join(doc_texts)
+    for name in knobs:
+        line = knob_lines.get(name, 1)
+        if name not in read_names:
+            emit(reg_mod, report, line, R_DEAD,
+                 f"'{name}' is registered but never read",
+                 "delete the entry or wire the knob in")
+        if doc_texts and name not in docs:
+            emit(reg_mod, report, line, R_DOC_DRIFT,
+                 f"'{name}' is undocumented (README.md / ops/README.md)",
+                 "add it to the README config-knob table")
+
+
+def find_doc_texts(root: Path) -> list[str]:
+    """README.md + ops/README.md at the scan root, else one level up
+    (the live layout: tempo_tpu/ is scanned, docs sit beside it)."""
+    for base in (root, root.parent):
+        found = []
+        for rel in ("README.md", "ops/README.md"):
+            p = base / rel
+            if p.is_file():
+                try:
+                    found.append(p.read_text(encoding="utf-8"))
+                except OSError:
+                    pass
+        if found:
+            return found
+    return []

@@ -449,14 +449,15 @@ def run_value_key_cross(modules: dict[str, SourceModule],
     Phase 1 collects every kernel module's static-key callables under
     their fully-qualified names; phase 2 re-checks every module's calls
     to names imported from kernel modules."""
-    from .twinrules import KERNEL_PKGS, _fq_module, _resolve_import
+    from .callgraph import fq_module, resolve_import
+    from .twinrules import KERNEL_PKGS
     from pathlib import Path
 
     fq_callables: dict[str, StaticSpec] = {}
     for rel, mod in modules.items():
         if rel.split("/")[0] not in KERNEL_PKGS:
             continue
-        fq = _fq_module(rel)
+        fq = fq_module(rel)
         for name, spec in _collect_static_key_callables(mod.tree).items():
             fq_callables[f"{fq}.{name}"] = spec
 
@@ -464,12 +465,12 @@ def run_value_key_cross(modules: dict[str, SourceModule],
         return
     for rel, mod in modules.items():
         cur_pkg = "/".join(Path(rel).parts[:-1])
-        cur_fq = _fq_module(rel)
+        cur_fq = fq_module(rel)
         local: dict[str, StaticSpec] = {}
         for n in ast.walk(mod.tree):
             if not isinstance(n, ast.ImportFrom):
                 continue
-            target = _resolve_import(cur_pkg, n)
+            target = resolve_import(cur_pkg, n, KERNEL_PKGS)
             if target is None or target == cur_fq:
                 continue  # same-module calls: per-module pass owns them
             for al in n.names:

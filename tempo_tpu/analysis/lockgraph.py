@@ -1,0 +1,220 @@
+"""Whole-program lock-order analysis.
+
+PR-4's per-module `lock-order` rule catches a lexically inverted pair
+inside one file; the deadlocks that actually ship cross a module
+boundary -- function f in module A takes lock La then calls into module
+B whose helper takes Lb, while a B-side path takes Lb before calling
+back into A. Nothing lexical ever sees both orders.
+
+This pass lifts lock acquisition onto the analysis/callgraph engine:
+
+  1. per function: which locks its body acquires lexically (`with`
+     contexts passing concurrency's lockish test, TimedLock/TimedRLock
+     wrappers included), and which calls it makes *while holding* each;
+  2. transitively: Acq*(g) = locks g or anything it reaches acquires;
+  3. edges: La -> Lb whenever a path holds La while acquiring Lb
+     (lexical nesting, or a held call whose callee reaches an acquire);
+  4. cycles: an SCC in the lock digraph is a deadlock shape, reported
+     once as `lock-order-global` with a witness call path.
+
+Lock identity is namespaced heuristically -- `self.X` becomes
+`<module>.<Class>.X`, module globals become `<module>.X` (resolved
+through imports so one shared lock keeps one name), and
+`Condition(self.lock)` aliases back to the underlying lock. Cycles
+whose every edge is lexical inside a single module are skipped here:
+the per-module rule already owns those, with better line anchoring.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .callgraph import CallGraph, ModuleFacts
+from .concurrency import _is_lockish
+from .core import Report, SourceModule, dotted_name, emit, register_rule
+
+R_GLOBAL_ORDER = register_rule(
+    "lock-order-global",
+    "whole-program lock acquisition cycle across the callgraph: two "
+    "threads entering from different ends deadlock",
+    hint="pick one global order for the locks in the cycle (or collapse "
+         "them into one lock)")
+
+
+def _cond_aliases(facts: ModuleFacts) -> dict[str, str]:
+    """'Cls.attr' -> 'Cls.other' for `self.attr = Condition(self.other)`
+    style aliasing: waiting on the condition holds the underlying lock,
+    so both spellings must map to one node in the graph."""
+    out: dict[str, str] = {}
+    for n in facts.mod.tree.body:  # top-level classes only, one pass
+        if not isinstance(n, ast.ClassDef):
+            continue
+        for m in ast.walk(n):
+            if not (isinstance(m, ast.Assign) and len(m.targets) == 1
+                    and isinstance(m.value, ast.Call)):
+                continue
+            callee = m.value.func
+            cname = callee.id if isinstance(callee, ast.Name) else (
+                callee.attr if isinstance(callee, ast.Attribute) else "")
+            if cname != "Condition" or not m.value.args:
+                continue
+            t, arg = m.targets[0], m.value.args[0]
+            td, ad = dotted_name(t), dotted_name(arg)
+            if td and ad and td.startswith("self.") \
+                    and ad.startswith("self."):
+                out[td[5:]] = ad[5:]
+    return out
+
+
+class _FnLocks(ast.NodeVisitor):
+    """Lexical lock facts for one function body."""
+
+    def __init__(self, facts: ModuleFacts, class_name: str,
+                 aliases: dict[str, str]):
+        self.facts = facts
+        self.class_name = class_name
+        self.aliases = aliases
+        self.held: list[str] = []
+        self.acquires: dict[str, int] = {}  # label -> first line
+        self.pairs: list[tuple[str, str, int]] = []  # lexical L -> M
+        self.held_calls: list[tuple[str, str, int]] = []  # (L, callee, line)
+
+    def _label(self, expr: ast.AST) -> str:
+        d = dotted_name(expr) or (
+            dotted_name(expr.func) if isinstance(expr, ast.Call) else None)
+        if d is None:
+            return f"{self.facts.fq}.<lock>"
+        if d.startswith("self.") and self.class_name:
+            attr = self.aliases.get(d[5:], d[5:])
+            return f"{self.facts.fq}.{self.class_name}.{attr}"
+        root, _, rest = d.partition(".")
+        if root in self.facts.module_imports and rest:
+            return f"{self.facts.module_imports[root]}.{rest}"
+        if root in self.facts.imports and not rest:
+            return self.facts.imports[root]
+        return f"{self.facts.fq}.{d}"
+
+    def visit_FunctionDef(self, node) -> None:
+        return  # nested defs run later, without the held locks
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_Lambda = visit_FunctionDef
+
+    def visit_With(self, node: ast.With) -> None:
+        labels = [self._label(it.context_expr) for it in node.items
+                  if _is_lockish(it.context_expr)]
+        for lb in labels:
+            self.acquires.setdefault(lb, node.lineno)
+            for outer in self.held:
+                if outer != lb:
+                    self.pairs.append((outer, lb, node.lineno))
+            self.held.append(lb)
+        self.generic_visit(node)
+        del self.held[len(self.held) - len(labels):]
+
+    visit_AsyncWith = visit_With
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if self.held:
+            callee = self.facts.resolve_call(node.func, self.class_name)
+            if callee is not None:
+                for outer in self.held:
+                    self.held_calls.append((outer, callee, node.lineno))
+        self.generic_visit(node)
+
+
+def run_lock_graph(modules: dict[str, SourceModule], report: Report,
+                   graph: CallGraph | None = None) -> None:
+    graph = graph or CallGraph(modules)
+    aliases = {rel: _cond_aliases(f) for rel, f in graph.facts.items()}
+
+    fn_locks: dict[str, _FnLocks] = {}
+    for fq in sorted(graph.functions):
+        facts, qn, node = graph.functions[fq]
+        cls = qn.split(".")[0] if "." in qn else ""
+        fl = _FnLocks(facts, cls, aliases.get(facts.rel, {}))
+        for stmt in node.body:
+            fl.visit(stmt)
+        fn_locks[fq] = fl
+
+    # Acq*: locks each function (or anything it reaches) acquires --
+    # a fixpoint over the call edges, not a per-function DFS (the DFS
+    # form is quadratic over the live tree's ~3k functions)
+    acq_star: dict[str, set[str]] = {
+        fq: set(fl.acquires) for fq, fl in fn_locks.items()}
+    changed = True
+    while changed:
+        changed = False
+        for fq, callees in graph.edges.items():
+            mine = acq_star[fq]
+            before = len(mine)
+            for c in callees:
+                mine |= acq_star.get(c, set())
+            if len(mine) != before:
+                changed = True
+
+    # lock digraph: edge -> (rel, line, lexical, witness-call-path)
+    edges: dict[tuple[str, str], tuple[str, int, bool, list[str]]] = {}
+    direct_holders: dict[str, set[str]] = {}
+    for fq, fl in fn_locks.items():
+        for lb in fl.acquires:
+            direct_holders.setdefault(lb, set()).add(fq)
+    for fq in sorted(fn_locks):
+        fl = fn_locks[fq]
+        rel = graph.functions[fq][0].rel
+        for outer, inner, line in fl.pairs:
+            edges.setdefault((outer, inner), (rel, line, True, [fq]))
+        for outer, callee, line in fl.held_calls:
+            for inner in sorted(acq_star.get(callee, ())):
+                if inner == outer or (outer, inner) in edges:
+                    continue
+                path = graph.witness_path(
+                    callee, direct_holders.get(inner, set()))
+                edges[(outer, inner)] = (rel, line, False, [fq] + path)
+
+    # cycle detection: DFS from each lock, smallest-label-first, over
+    # the lock digraph; each cycle is canonicalized (rotated to its
+    # minimal lock) so it reports exactly once
+    adj: dict[str, list[str]] = {}
+    for (a, b) in edges:
+        adj.setdefault(a, []).append(b)
+    for v in adj.values():
+        v.sort()
+
+    seen_cycles: set[tuple[str, ...]] = set()
+    for start in sorted(adj):
+        stack: list[tuple[str, list[str]]] = [(start, [start])]
+        while stack:
+            cur, path = stack.pop()
+            for nxt in adj.get(cur, ()):
+                if nxt == start:
+                    if len(path) < 2:
+                        continue  # self-edge can't exist (outer != lb)
+                    i = path.index(min(path))
+                    canon = tuple(path[i:] + path[:i])
+                    if canon in seen_cycles:
+                        continue
+                    seen_cycles.add(canon)
+                    _report_cycle(modules, report, edges, list(canon))
+                elif nxt not in path and len(path) < 6:
+                    stack.append((nxt, path + [nxt]))
+
+
+def _report_cycle(modules: dict[str, SourceModule], report: Report,
+                  edges: dict, cycle: list[str]) -> None:
+    ring = cycle + [cycle[0]]
+    edge_infos = [edges[(ring[i], ring[i + 1])] for i in range(len(cycle))]
+    rels = {rel for rel, _, _, _ in edge_infos}
+    all_lexical = all(lex for _, _, lex, _ in edge_infos)
+    if all_lexical and len(rels) == 1:
+        return  # per-module lock-order owns single-file lexical cycles
+    # anchor on the minimal (file, line) edge for a deterministic site
+    rel, line, _, _ = min(edge_infos, key=lambda e: (e[0], e[1]))
+    witness = max((w for _, _, _, w in edge_infos), key=len)
+    mod = modules.get(rel)
+    if mod is None:
+        return
+    emit(mod, report, line, R_GLOBAL_ORDER,
+         "lock cycle " + " -> ".join(ring)
+         + "; witness call path: " + " -> ".join(witness),
+         "pick one global acquisition order for these locks")

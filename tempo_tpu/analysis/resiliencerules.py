@@ -1,0 +1,240 @@
+"""Resilience-propagation contract over services/, transport/ and
+fleet/: every remote leg carries a deadline, sits behind a breaker or a
+retry-budget/quorum error path, and is reachable through a declared
+chaos seam so the fault plane can exercise it.
+
+  * rpc-no-deadline: urlopen / HTTPIngesterClient / client_registry
+    without a timeout kwarg. An unbounded remote call turns one stuck
+    replica into a stuck fleet (the PR-14 deadline-propagation lesson).
+  * rpc-unguarded: a call of a known RPC method on a client-ish
+    receiver with no exception handler around it and no breaker /
+    retry-budget / quorum machinery in the enclosing function. The
+    receiver heuristic is deliberate: names containing "client", or
+    locals bound from a *client* call (client_for(addr), clients[i]).
+  * chaos-seam-gap: chaos/plane.py declares SEAM_MODULES (module ->
+    seams it taps). Every declared SITE must be claimed by a module,
+    every claimed module must actually name the seam, and every
+    urlopen in scope must live in a module that claims a seam --
+    a remote side effect the chaos plane cannot reach is a code path
+    the fault-injection certification never exercises.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Report, SourceModule, dotted_name, emit, register_rule
+
+R_NO_DEADLINE = register_rule(
+    "rpc-no-deadline",
+    "remote call site without a timeout/deadline: one stuck peer "
+    "wedges every caller above it",
+    hint="pass timeout= (thread cfg.rpc_deadline_s / deadline_in_s "
+         "through)")
+R_UNGUARDED = register_rule(
+    "rpc-unguarded",
+    "remote RPC leg with no breaker, retry-budget or error path "
+    "around it: a flapping replica cascades",
+    hint="wrap in try/except feeding the quorum math, or route through "
+         "a CircuitBreaker (fleet.replication.guarded_push style)")
+R_SEAM_GAP = register_rule(
+    "chaos-seam-gap",
+    "side-effect site not reachable through a declared chaos seam: "
+    "fault certification never exercises it",
+    hint="declare the seam in chaos/plane.py SITES + SEAM_MODULES and "
+         "tap the call site")
+
+SCOPE = ("services/", "transport/", "fleet/")
+RPC_METHODS = {"push_segments", "push_generator_blobs", "find_trace_by_id",
+               "search", "metrics_query_range", "trace_snapshot"}
+GUARD_TOKENS = ("breaker", "budget", "guarded", "quorum")
+PLANE_REL = "chaos/plane.py"
+
+
+def _callee_name(call: ast.Call) -> str:
+    f = call.func
+    return f.id if isinstance(f, ast.Name) else (
+        f.attr if isinstance(f, ast.Attribute) else "")
+
+
+def _has_kw(call: ast.Call, name: str) -> bool:
+    return any(k.arg == name or k.arg is None  # **kwargs may carry it
+               for k in call.keywords)
+
+
+# ------------------------------------------------------------ deadlines
+def _check_deadlines(mod: SourceModule, report: Report) -> None:
+    for n in ast.walk(mod.tree):
+        if not isinstance(n, ast.Call):
+            continue
+        name = _callee_name(n)
+        if name == "urlopen":
+            if not _has_kw(n, "timeout") and len(n.args) < 3:
+                emit(mod, report, n.lineno, R_NO_DEADLINE,
+                     "urlopen without timeout=",
+                     "pass an explicit timeout")
+        elif name.endswith("IngesterClient") or name == "client_registry":
+            if not _has_kw(n, "timeout"):
+                emit(mod, report, n.lineno, R_NO_DEADLINE,
+                     f"{name}(...) without timeout=: remote RPCs default "
+                     "instead of inheriting the configured deadline",
+                     "thread cfg.rpc_deadline_s through")
+
+
+# ------------------------------------------------------------- guarding
+def _client_locals(fn: ast.AST) -> set[str]:
+    """Names bound from client-producing expressions inside fn."""
+    out: set[str] = set()
+
+    def producer(v: ast.AST) -> bool:
+        if isinstance(v, ast.Call):
+            return "client" in _callee_name(v).lower()
+        if isinstance(v, ast.Subscript):
+            d = dotted_name(v.value)
+            return d is not None and "client" in d.lower()
+        return False
+
+    for n in ast.walk(fn):
+        if isinstance(n, ast.Assign) and producer(n.value):
+            for t in n.targets:
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+        elif isinstance(n, ast.For) and producer(n.iter) \
+                and isinstance(n.target, ast.Name):
+            out.add(n.target.id)
+    return out
+
+
+def _fn_tokens(fn: ast.AST) -> str:
+    """Lower-cased identifier soup of a function body: name references,
+    attribute names, call targets -- the guard-token haystack."""
+    parts = [getattr(fn, "name", "")]
+    for n in ast.walk(fn):
+        if isinstance(n, ast.Name):
+            parts.append(n.id)
+        elif isinstance(n, ast.Attribute):
+            parts.append(n.attr)
+    return " ".join(parts).lower()
+
+
+def _check_guarding(mod: SourceModule, report: Report) -> None:
+    fns = [n for n in ast.walk(mod.tree)
+           if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    for fn in fns:
+        clientish = _client_locals(fn)
+        guarded_fn = any(t in _fn_tokens(fn) for t in GUARD_TOKENS)
+
+        def scan(node: ast.AST, in_handler: bool) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node is not fn:
+                return  # nested defs get their own pass
+            if isinstance(node, ast.Try) and node.handlers:
+                for child in node.body + node.orelse:
+                    scan(child, True)
+                for h in node.handlers:
+                    for child in h.body:
+                        scan(child, in_handler)
+                for child in node.finalbody:
+                    scan(child, in_handler)
+                return
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in RPC_METHODS:
+                recv = node.func.value
+                root = recv
+                while isinstance(root, (ast.Attribute, ast.Subscript,
+                                        ast.Call)):
+                    root = root.func if isinstance(root, ast.Call) \
+                        else root.value
+                root_id = root.id if isinstance(root, ast.Name) else ""
+                is_client = ("client" in root_id.lower()
+                             or root_id in clientish
+                             or (isinstance(recv, ast.Call)
+                                 and "client" in _callee_name(recv).lower()))
+                if is_client and not in_handler and not guarded_fn:
+                    emit(mod, report, node.lineno, R_UNGUARDED,
+                         f".{node.func.attr}() on a remote client outside "
+                         "any error path",
+                         "wrap in try/except or a breaker-guarded helper")
+            for child in ast.iter_child_nodes(node):
+                scan(child, in_handler)
+
+        for stmt in fn.body:
+            scan(stmt, False)
+
+
+# ----------------------------------------------------------- chaos seams
+def _parse_plane(mod: SourceModule) -> tuple[dict[str, int], dict, int]:
+    """(SITES key->line, SEAM_MODULES literal, SEAM_MODULES line)."""
+    sites: dict[str, int] = {}
+    seams: dict = {}
+    seams_line = 0
+    for n in mod.tree.body:
+        if not (isinstance(n, ast.Assign) and len(n.targets) == 1
+                and isinstance(n.targets[0], ast.Name)
+                and isinstance(n.value, ast.Dict)):
+            continue
+        name = n.targets[0].id
+        if name == "SITES":
+            for k in n.value.keys:
+                if isinstance(k, ast.Constant):
+                    sites[k.value] = k.lineno
+        elif name == "SEAM_MODULES":
+            try:
+                seams = ast.literal_eval(n.value)
+            except ValueError:
+                seams = {}
+            seams_line = n.lineno
+    return sites, seams, seams_line
+
+
+def run_seam_rules(modules: dict[str, SourceModule],
+                   report: Report) -> None:
+    plane = modules.get(PLANE_REL)
+    if plane is None:
+        return
+    sites, seams, seams_line = _parse_plane(plane)
+    if not seams:
+        return  # registry predates SEAM_MODULES: nothing to check against
+
+    claimed: set[str] = set()
+    for rel, rel_sites in seams.items():
+        claimed.update(rel_sites)
+        m = modules.get(rel)
+        if m is None:
+            emit(plane, report, seams_line, R_SEAM_GAP,
+                 f"SEAM_MODULES names '{rel}' which is not in the tree",
+                 "fix the module path")
+            continue
+        for site in rel_sites:
+            if f'"{site}"' not in m.text and f"'{site}'" not in m.text:
+                emit(plane, report, seams_line, R_SEAM_GAP,
+                     f"'{rel}' claims seam '{site}' but never names it",
+                     "tap the site (plane.tap/call) or drop the claim")
+
+    for site, line in sites.items():
+        if site not in claimed:
+            emit(plane, report, line, R_SEAM_GAP,
+                 f"seam '{site}' is declared but no module claims it in "
+                 "SEAM_MODULES",
+                 "map the implementing module to the seam")
+
+    for rel, mod in modules.items():
+        if not rel.startswith(SCOPE) or rel in seams:
+            continue
+        for n in ast.walk(mod.tree):
+            if isinstance(n, ast.Call) and _callee_name(n) == "urlopen":
+                emit(mod, report, n.lineno, R_SEAM_GAP,
+                     "remote side effect outside every declared chaos "
+                     "seam: fault injection cannot reach it",
+                     "claim a seam for this module in chaos/plane.py "
+                     "and tap the call")
+
+
+def run_resilience_rules(modules: dict[str, SourceModule],
+                         report: Report) -> None:
+    for rel, mod in modules.items():
+        if rel.startswith(SCOPE):
+            _check_deadlines(mod, report)
+            _check_guarding(mod, report)
+    run_seam_rules(modules, report)

@@ -1,0 +1,247 @@
+"""Telemetry contract: the metric families the code emits vs the names
+ops/alerts.yaml, ops/dashboard-overview.json and the ops/README runbook
+reference. An alert on a family nothing emits pages nobody -- silently.
+
+Family extraction is AST-based over every scanned module:
+
+  * Counter/Gauge/Histogram constructor calls (aliased imports like
+    ``_Gauge`` count: callee name is matched stripped of leading
+    underscores, case-insensitive) take their first string arg;
+  * module-level ``METRIC_FAMILIES`` tuples declare families built
+    dynamically at runtime (util/slo's prefixed gauges);
+  * f-strings whose leading constant is ``tempo_x ...``/``tempo_x{``
+    (hand-rendered exposition lines) contribute the name part;
+  * a ``tempo_*`` string constant passed as a call's first argument or
+    assigned to a ``*_NAME``/``*_FAMILY`` constant counts too.
+
+Histogram families render as ``_bucket``/``_sum``/``_count`` series, so
+references are matched with those suffixes stripped as a fallback.
+
+Label hygiene: a label rendered from request-derived data (tenant, key,
+query, org) must pass through an escaping helper (util/metrics
+``escape_label`` or a local ``_esc*``) -- a raw ``{tenant}`` in a label
+f-string is an unbounded-cardinality + exposition-injection bug
+(PR-7's lesson).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+
+from .core import Finding, Report, SourceModule, emit, register_rule
+
+R_ALERT_UNKNOWN = register_rule(
+    "alert-unknown-metric",
+    "ops/alerts.yaml references a metric family no code emits: the "
+    "alert can never fire",
+    hint="fix the family name in the alert expr (or emit the metric)")
+R_DASH_UNKNOWN = register_rule(
+    "dashboard-unknown-metric",
+    "ops/dashboard-overview.json references a metric family no code "
+    "emits: the panel renders empty",
+    hint="fix the family name in the panel expr")
+R_LABEL_CARD = register_rule(
+    "metric-label-cardinality",
+    "request-derived label value rendered into a metric label without "
+    "the escaping helper: cardinality + exposition injection",
+    hint="wrap the value in util.metrics.escape_label()")
+R_ORPHAN = register_rule(
+    "metric-orphan",
+    "metric family emitted but absent from the ops/README runbook "
+    "mapping: on-call cannot act on it",
+    hint="add the family to ops/README's metric->runbook table",
+    severity="warn")
+
+FAMILY_RE = re.compile(r"^tempo_[a-z0-9_]+$")
+# tokens in ops files; names followed by / or . are paths/modules
+REF_RE = re.compile(r"tempo_[a-z0-9_]+")
+CTOR_NAMES = {"counter", "gauge", "histogram"}
+REQUEST_LABELS = ("tenant", "key", "query", "org")
+HIST_SUFFIXES = ("_bucket", "_sum", "_count")
+NAME_SUFFIXES = ("_NAME", "_FAMILY")
+
+
+def _ctor_name(call: ast.Call) -> str:
+    f = call.func
+    name = f.id if isinstance(f, ast.Name) else (
+        f.attr if isinstance(f, ast.Attribute) else "")
+    return name.lstrip("_").lower()
+
+
+def extract_families(mod: SourceModule) -> dict[str, int]:
+    """family -> first emission line in this module."""
+    out: dict[str, int] = {}
+
+    def note(name: str, line: int) -> None:
+        if FAMILY_RE.match(name) and name not in out:
+            out[name] = line
+
+    for n in ast.walk(mod.tree):
+        if isinstance(n, ast.Call):
+            args = n.args
+            if args and isinstance(args[0], ast.Constant) \
+                    and isinstance(args[0].value, str):
+                if _ctor_name(n) in CTOR_NAMES:
+                    note(args[0].value, args[0].lineno)
+                elif FAMILY_RE.match(args[0].value):
+                    # TEL.xyz("tempo_...") style emission helpers
+                    note(args[0].value, args[0].lineno)
+        elif isinstance(n, ast.JoinedStr) and n.values:
+            first = n.values[0]
+            if isinstance(first, ast.Constant) and isinstance(first.value, str):
+                m = re.match(r"(tempo_[a-z0-9_]+)[ {]", first.value)
+                if m:
+                    note(m.group(1), n.lineno)
+        elif isinstance(n, ast.Assign) and len(n.targets) == 1:
+            t, v = n.targets[0], n.value
+            if not isinstance(t, ast.Name):
+                continue
+            if t.id == "METRIC_FAMILIES" and isinstance(v, (ast.Tuple,
+                                                            ast.List)):
+                for el in v.elts:
+                    if isinstance(el, ast.Constant) \
+                            and isinstance(el.value, str):
+                        note(el.value, el.lineno)
+            elif t.id.endswith(NAME_SUFFIXES) and isinstance(v, ast.Constant) \
+                    and isinstance(v.value, str):
+                note(v.value, v.lineno)
+    return out
+
+
+# ---------------------------------------------------------------- labels
+def _is_escape_call(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    f = node.func
+    name = f.id if isinstance(f, ast.Name) else (
+        f.attr if isinstance(f, ast.Attribute) else "")
+    return name.lstrip("_").startswith("esc")
+
+
+def _escaped_names(fn: ast.AST) -> set[str]:
+    """Local names bound from an escape call (t = escape_label(x))."""
+    out: set[str] = set()
+    for n in ast.walk(fn):
+        if isinstance(n, ast.Assign) and _is_escape_call(n.value):
+            for t in n.targets:
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+    return out
+
+
+def _check_labels(mod: SourceModule, report: Report) -> None:
+    # innermost-function scoping: each f-string is judged against the
+    # escaped-locals of its nearest enclosing def (module level = whole
+    # tree minus function bodies)
+    def walk_scope(scope: ast.AST) -> None:
+        escaped = _escaped_names(scope)
+        stack = list(ast.iter_child_nodes(scope))
+        strings: list[ast.JoinedStr] = []
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                walk_scope(n)
+                continue
+            if isinstance(n, ast.JoinedStr):
+                strings.append(n)
+            stack.extend(ast.iter_child_nodes(n))
+        for n in strings:
+            for i, part in enumerate(n.values[:-1]):
+                if not (isinstance(part, ast.Constant)
+                        and isinstance(part.value, str)):
+                    continue
+                label = next((lb for lb in REQUEST_LABELS
+                              if part.value.endswith(f'{lb}="')), None)
+                if label is None:
+                    continue
+                nxt = n.values[i + 1]
+                if not isinstance(nxt, ast.FormattedValue):
+                    continue
+                v = nxt.value
+                if _is_escape_call(v):
+                    continue
+                if isinstance(v, ast.Name) and v.id in escaped:
+                    continue
+                emit(mod, report, n.lineno, R_LABEL_CARD,
+                     f'label {label}="..." rendered from an unescaped '
+                     "request value",
+                     "pass it through util.metrics.escape_label first")
+
+    walk_scope(mod.tree)
+
+
+# ------------------------------------------------------------- ops files
+def _ops_refs(text: str, skip_comments: bool) -> list[tuple[str, int]]:
+    out = []
+    for lineno, line in enumerate(text.splitlines(), start=1):
+        if skip_comments and line.lstrip().startswith("#"):
+            continue
+        for m in REF_RE.finditer(line):
+            end = m.end()
+            if end < len(line) and line[end] in "/.":
+                continue  # a path or module name, not a metric
+            if m.group(0) == "tempo_tpu":
+                continue
+            out.append((m.group(0), lineno))
+    return out
+
+
+def _known(ref: str, families: set[str]) -> bool:
+    if ref in families:
+        return True
+    for suf in HIST_SUFFIXES:
+        if ref.endswith(suf) and ref[:-len(suf)] in families:
+            return True
+    return False
+
+
+def find_ops_file(root: Path, rel: str) -> Path | None:
+    for base in (root, root.parent):
+        p = base / rel
+        if p.is_file():
+            return p
+    return None
+
+
+def run_telemetry_rules(modules: dict[str, SourceModule], report: Report,
+                        root: Path) -> None:
+    families: dict[str, tuple[str, int]] = {}  # family -> (rel, line)
+    for rel, mod in modules.items():
+        for fam, line in extract_families(mod).items():
+            families.setdefault(fam, (rel, line))
+        _check_labels(mod, report)
+    if not families:
+        return  # a tree that emits nothing has no telemetry contract
+    fam_set = set(families)
+
+    alerts = find_ops_file(root, "ops/alerts.yaml")
+    if alerts is not None:
+        for ref, line in _ops_refs(alerts.read_text(encoding="utf-8"),
+                                   skip_comments=True):
+            if not _known(ref, fam_set):
+                report.findings.append(Finding(
+                    "ops/alerts.yaml", line, R_ALERT_UNKNOWN,
+                    f"alert references '{ref}' which nothing emits",
+                    "fix the family name (or emit the metric)"))
+
+    dash = find_ops_file(root, "ops/dashboard-overview.json")
+    if dash is not None:
+        for ref, line in _ops_refs(dash.read_text(encoding="utf-8"),
+                                   skip_comments=False):
+            if not _known(ref, fam_set):
+                report.findings.append(Finding(
+                    "ops/dashboard-overview.json", line, R_DASH_UNKNOWN,
+                    f"panel references '{ref}' which nothing emits",
+                    "fix the family name in the panel expr"))
+
+    ops_readme = find_ops_file(root, "ops/README.md")
+    if ops_readme is not None:
+        runbook = ops_readme.read_text(encoding="utf-8")
+        for fam, (rel, line) in sorted(families.items()):
+            if fam not in runbook:
+                mod = modules[rel]
+                emit(mod, report, line, R_ORPHAN,
+                     f"'{fam}' has no ops/README runbook entry",
+                     "add it to the metric->runbook mapping table")

@@ -14,10 +14,12 @@ a kernel whose over-matches can never be settled. The contract lives in
     twin that does not exist -- or a "host" twin that itself reaches
     jit, which would make exact-verify recurse onto the device.
 
-Jit-reachability is a module-level call-graph fixpoint over ops/ and
-parallel/: a function is device-touching if its body uses jax.jit or
-calls (by local or imported name) another device-touching function.
-Everything is AST-only; nothing is imported.
+Jit-reachability is a call-graph fixpoint over ops/ and parallel/: a
+function is device-touching if its body uses jax.jit or calls (by
+local or imported name) another device-touching function. The graph
+machinery (import resolution, per-module facts, the fixpoint) lives in
+analysis/callgraph.py -- this pass owns only the jit property and the
+registry cross-check. Everything is AST-only; nothing is imported.
 """
 
 from __future__ import annotations
@@ -25,7 +27,8 @@ from __future__ import annotations
 import ast
 from pathlib import Path
 
-from .core import Report, SourceModule, dotted_name, emit, register_rule
+from .callgraph import ModuleFacts, reachable_fixpoint, resolve_import
+from .core import Report, SourceModule, emit, register_rule
 
 R_MISSING = register_rule(
     "twin-missing",
@@ -42,99 +45,30 @@ DB_EXECUTORS = ("db/search.py", "db/metrics_exec.py", "db/metrics_mesh.py",
 KERNEL_PKGS = ("ops", "parallel")
 
 
-def _fq_module(rel: str) -> str:
-    """'ops/filter.py' -> 'ops.filter' (package-root-relative)."""
-    return rel[:-3].replace("/", ".")
+def _direct_jit(fn: ast.FunctionDef) -> bool:
+    """One definition of 'jitted' shared with the jit rules: the two
+    passes must never disagree about it. ast.walk yields fn itself
+    first, so its own decorators are covered too."""
+    from .jitrules import _is_jax_jit, _jit_decorator_info
+
+    for n in ast.walk(fn):
+        if isinstance(n, ast.Call) and _is_jax_jit(n.func):
+            return True
+        if isinstance(n, ast.FunctionDef) and _jit_decorator_info(n)[0]:
+            return True
+    return False
 
 
-def _resolve_import(cur_pkg: str, node: ast.ImportFrom) -> str | None:
-    """Package-root-relative module for an ImportFrom, or None when it
-    points outside the scanned root (stdlib, third-party)."""
-    mod = node.module or ""
-    if node.level == 0:
-        # absolute: accept tempo_tpu.ops.x / <root>.ops.x by stripping
-        # leading segments until a kernel package name
-        parts = mod.split(".")
-        for i, p in enumerate(parts):
-            if p in KERNEL_PKGS:
-                return ".".join(parts[i:])
-        return None
-    parts = cur_pkg.split("/") if cur_pkg else []
-    # level=1 -> same package, level=2 -> parent, ...
-    base = parts[:len(parts) - (node.level - 1)] if node.level - 1 else parts
-    if node.level - 1 > len(parts):
-        return None
-    prefix = ".".join(base)
-    return f"{prefix}.{mod}" if prefix and mod else (mod or prefix or None)
-
-
-class _ModuleFacts:
-    """Per-module: top-level defs, their called names, jit usage."""
-
-    def __init__(self, mod: SourceModule):
-        self.rel = mod.rel
-        self.fq = _fq_module(mod.rel)
-        self.imports: dict[str, str] = {}  # local name -> fq function name
-        self.defs: dict[str, ast.FunctionDef] = {}
-        self.classes: set[str] = set()
-        cur_pkg = "/".join(Path(mod.rel).parts[:-1])
-        for n in ast.walk(mod.tree):
-            if isinstance(n, ast.ImportFrom):
-                target = _resolve_import(cur_pkg, n)
-                if target is None:
-                    continue
-                for al in n.names:
-                    self.imports[al.asname or al.name] = f"{target}.{al.name}"
-        for n in mod.tree.body:
-            if isinstance(n, ast.FunctionDef):
-                self.defs[n.name] = n
-            elif isinstance(n, ast.ClassDef):
-                self.classes.add(n.name)
-
-    def direct_jit(self, fn: ast.FunctionDef) -> bool:
-        """One definition of 'jitted' shared with the jit rules: the
-        two passes must never disagree about it. ast.walk yields fn
-        itself first, so its own decorators are covered too."""
-        from .jitrules import _is_jax_jit, _jit_decorator_info
-
-        for n in ast.walk(fn):
-            if isinstance(n, ast.Call) and _is_jax_jit(n.func):
-                return True
-            if isinstance(n, ast.FunctionDef) and _jit_decorator_info(n)[0]:
-                return True
-        return False
-
-    def calls_of(self, fn: ast.FunctionDef) -> set[str]:
-        """fq names of functions this def references (call or bare name:
-        kernels get passed to executors/vmaps as values too)."""
-        out: set[str] = set()
-        for n in ast.walk(fn):
-            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load):
-                if n.id in self.defs:
-                    out.add(f"{self.fq}.{n.id}")
-                elif n.id in self.imports:
-                    out.add(self.imports[n.id])
-        return out
-
-
-def _jit_reachable(kernel_mods: list[_ModuleFacts]) -> set[str]:
+def _jit_reachable(kernel_mods: list[ModuleFacts]) -> set[str]:
     direct: set[str] = set()
     edges: dict[str, set[str]] = {}
     for m in kernel_mods:
         for name, fn in m.defs.items():
             fq = f"{m.fq}.{name}"
-            if m.direct_jit(fn):
+            if _direct_jit(fn):
                 direct.add(fq)
             edges[fq] = m.calls_of(fn)
-    reach = set(direct)
-    changed = True
-    while changed:
-        changed = False
-        for fq, callees in edges.items():
-            if fq not in reach and callees & reach:
-                reach.add(fq)
-                changed = True
-    return reach
+    return reachable_fixpoint(direct, edges)
 
 
 def _parse_registry(mod: SourceModule) -> tuple[dict, dict, dict[str, int]]:
@@ -169,7 +103,7 @@ def _parse_registry(mod: SourceModule) -> tuple[dict, dict, dict[str, int]]:
 def run_twin_rules(modules: dict[str, SourceModule], report: Report) -> None:
     """`modules` is rel-path -> SourceModule for one scanned root."""
     reg_mod = modules.get("ops/twins.py")
-    kernel_mods = [_ModuleFacts(m) for rel, m in modules.items()
+    kernel_mods = [ModuleFacts(m, KERNEL_PKGS) for rel, m in modules.items()
                    if rel.split("/")[0] in KERNEL_PKGS
                    and rel != "ops/twins.py"]
     if not kernel_mods:
@@ -224,7 +158,7 @@ def run_twin_rules(modules: dict[str, SourceModule], report: Report) -> None:
         for n in ast.walk(m.tree):
             if not isinstance(n, ast.ImportFrom):
                 continue
-            target = _resolve_import(cur_pkg, n)
+            target = resolve_import(cur_pkg, n, KERNEL_PKGS)
             if target is None or target.split(".")[0] not in KERNEL_PKGS:
                 continue
             for al in n.names:

@@ -72,6 +72,11 @@ SITES = {
                     "drop = the part is never copied",
     "rpc.client": "ingester-client HTTP calls (key: URL path)",
     "rpc.worker": "querier-worker poll/result posts (key: URL path)",
+    "rpc.external": "querier calls to external serverless search "
+                    "endpoints (key: endpoint URL); drop = endpoint "
+                    "black-holed",
+    "rpc.remotewrite": "metrics-generator remote-write pushes "
+                       "(key: endpoint URL); drop = push silently lost",
     "device.launch": "device kernel launches (key: op name); "
                      "device_oom / compile_failure / slow launch",
     "wal.append": "WAL record append; truncate = torn tail, drop = lost",
@@ -95,7 +100,7 @@ DATA_SITES = frozenset(
 DROP_SITES = frozenset(
     {"backend.write", "backend.write_tenant", "backend.delete",
      "backend.copy", "wal.append", "gossip.sync", "gossip.recv",
-     "rpc.client", "rpc.worker"})
+     "rpc.client", "rpc.worker", "rpc.external", "rpc.remotewrite"})
 
 # what a bare action="error" means per seam family: the error class the
 # real world throws there (and the retry/breaker layers classify)
@@ -103,9 +108,34 @@ DEFAULT_ERROR = {
     "backend": "backend_5xx",
     "rpc.client": "transport",
     "rpc.worker": "oserror",
+    "rpc.external": "transport",
+    "rpc.remotewrite": "transport",
     "device": "device_oom",
     "wal": "oserror",
     "gossip": "connection",
+}
+
+# which module implements (taps) each seam, keyed by path relative to
+# the package root. This is the contract the static checker's
+# chaos-seam-gap rule enforces both ways: every SITES key must be
+# claimed here, every claim must be real (the module names the site),
+# and a module doing remote I/O in services/transport/fleet scope must
+# appear here at all -- an empty tuple declares "this module is a fault
+# *source*, not a seam" (the certification harness drives drills; its
+# own urlopens are the measurement, not the system under test).
+SEAM_MODULES = {
+    "chaos/backendwrap.py": (
+        "backend.read", "backend.read_range", "backend.read_tenant",
+        "backend.write", "backend.write_tenant", "backend.list",
+        "backend.delete", "backend.copy"),
+    "transport/client.py": ("rpc.client",),
+    "transport/gossip.py": ("gossip.sync", "gossip.recv"),
+    "services/worker.py": ("rpc.worker",),
+    "services/querier.py": ("rpc.external",),
+    "services/remotewrite.py": ("rpc.remotewrite",),
+    "ops/device.py": ("device.launch",),
+    "db/wal.py": ("wal.append", "wal.fsync"),
+    "fleet/harness.py": (),  # certification driver: fault source
 }
 
 

@@ -1203,6 +1203,7 @@ def _kernel_status(app: App) -> dict:
 
 # point-in-time gauges, set at scrape (the reference's promauto GaugeFunc)
 from ..util.metrics import Gauge as _Gauge  # noqa: E402
+from ..util.metrics import escape_label as _esc  # noqa: E402
 
 _JIT_CACHE_GAUGE = _Gauge("tempo_kernel_jit_cache_entries",
                           help="distinct compiled kernel signatures resident")
@@ -1317,9 +1318,9 @@ def _metrics_text(app: App) -> str:
         _QUEUE_DEPTH_GAUGE.set(sum(depths.values()))
         stale = getattr(app, "_queue_depth_tenants", set()) - set(depths)
         for t in stale:
-            _QUEUE_DEPTH_GAUGE.set(0, labels=f'tenant="{t}"')
+            _QUEUE_DEPTH_GAUGE.set(0, labels=f'tenant="{_esc(t)}"')
         for t, n in depths.items():
-            _QUEUE_DEPTH_GAUGE.set(n, labels=f'tenant="{t}"')
+            _QUEUE_DEPTH_GAUGE.set(n, labels=f'tenant="{_esc(t)}"')
         app._queue_depth_tenants = set(depths) | stale
         lines += _QUEUE_DEPTH_GAUGE.text()
     if app.distributor:

@@ -342,8 +342,11 @@ class Querier:
         import json
         import urllib.request
 
+        from ..chaos import plane as chaos_plane
         from ..db.search import response_from_dict
 
+        if chaos_plane.tap("rpc.external", key=endpoint) is chaos_plane.DROP:
+            return None  # endpoint black-holed: hedge/failover takes over
         try:
             r = urllib.request.urlopen(
                 urllib.request.Request(

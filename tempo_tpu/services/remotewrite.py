@@ -163,6 +163,11 @@ class RemoteWriter:
         }
         if self.tenant_header:
             headers["X-Scope-OrgID"] = self.tenant_header
+        from ..chaos import plane as chaos_plane
+
+        if chaos_plane.tap("rpc.remotewrite", key=self.url) is chaos_plane.DROP:
+            self.failures += 1  # push silently lost downstream
+            return False
         try:
             req = urllib.request.Request(self.url, data=body, headers=headers)
             with urllib.request.urlopen(req, timeout=self.timeout_s):

@@ -76,12 +76,12 @@ QOS_SHED_TENANTS_MAX = 128  # per-tenant shed rows kept before _overflow
 
 
 def _esc_label(v: str) -> str:
-    """Prometheus label-value escaping (backslash, quote, newline):
-    tenant names come straight off the X-Scope-OrgID header -- the one
-    caller-controlled string that reaches a label -- and an unescaped
-    quote would corrupt every subsequent /metrics scrape."""
-    return (v.replace("\\", "\\\\").replace('"', '\\"')
-             .replace("\n", "\\n"))
+    """Prometheus label-value escaping; delegates to the shared
+    util/metrics.escape_label (kept as a module-local name because the
+    call sites predate the public helper)."""
+    from .metrics import escape_label
+
+    return escape_label(v)
 
 
 class KernelTelemetry:

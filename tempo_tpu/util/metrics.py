@@ -29,6 +29,17 @@ def _fmt(name: str, labels: str) -> str:
     return f"{name}{{{labels}}}" if labels else name
 
 
+def escape_label(v: str) -> str:
+    """Prometheus label-value escaping (backslash, quote, newline).
+    Any caller-controlled string reaching a label value -- tenant names
+    off the X-Scope-OrgID header above all -- must pass through here:
+    one unescaped quote corrupts every subsequent /metrics scrape line.
+    (The static checker's metric-label-cardinality rule enforces this
+    for tenant=/key=/query= label interpolations.)"""
+    return (v.replace("\\", "\\\\").replace('"', '\\"')
+             .replace("\n", "\\n"))
+
+
 class Histogram:
     """Cumulative-bucket latency histogram.
 

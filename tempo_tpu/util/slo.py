@@ -49,6 +49,15 @@ SLOW_BURN = 6.0
 
 VERDICTS = ("ok", "warning", "critical")
 
+# every gauge family an SLOEngine can emit, over the name_prefix values
+# actually instantiated (services/app.py default + vulture.py): the
+# names are built with f-strings the telemetry contract checker cannot
+# see through, so the families are declared here instead
+METRIC_FAMILIES = (
+    "tempo_slo_burn_rate", "tempo_slo_verdict",
+    "tempo_vulture_slo_burn_rate", "tempo_vulture_slo_verdict",
+)
+
 
 @dataclass
 class Objective:
@@ -148,7 +157,7 @@ class SLOEngine:
         # evaluation, so skipping an append loses no accuracy.
         self._min_sample_gap = self._max_age / (self._history_max / 2)
         self.burn_gauge = Gauge(
-            f"{name_prefix}_burn_rate",
+            f"{name_prefix}_burn_rate",  # families: see METRIC_FAMILIES
             help="error-budget burn rate by objective and window "
                  "(1.0 = spending the budget exactly on schedule)")
         self.verdict_gauge = Gauge(

@@ -1,0 +1,14 @@
+"""Seeded chaos-seam registry: one healthy claim, one unclaimed site,
+one claim on a missing module, one claim the module never names."""
+
+SITES = {
+    "fix.tapped": "healthy: claimed by services/tapped.py which names it",
+    "fix.orphan_site": "declared but no module claims it",  # EXPECT: chaos-seam-gap
+}
+
+SEAM_MODULES = {  # EXPECT: chaos-seam-gap
+    "services/tapped.py": ("fix.tapped",),
+    "services/ghost.py": ("fix.ghost",),
+    "services/env_knobs.py": ("fix.unnamed",),
+    "services/rpc_legs.py": (),
+}

@@ -1,0 +1,11 @@
+"""Seeded config-registry fixture: one healthy knob, one undocumented
+knob, one dead knob (see ../README.md for the doc side)."""
+
+KNOBS = {
+    "TEMPO_FIX_DOCUMENTED": (
+        "bool", "1", "read by services/env_knobs.py and documented"),
+    "TEMPO_FIX_UNDOCUMENTED": (  # EXPECT: env-doc-drift
+        "int", "4", "read, registered, but absent from every doc"),
+    "TEMPO_FIX_DEAD_KNOB": (  # EXPECT: env-dead
+        "str", "", "documented but nothing reads it"),
+}

@@ -1,0 +1,19 @@
+"""Seeded cross-module lock cycle, A side: takes LOCK_A then calls
+into B while holding it. Nothing lexical in either module inverts --
+only the interprocedural lock graph sees the cycle."""
+
+import threading
+
+from .lock_cycle_b import helper_b
+
+LOCK_A = threading.Lock()
+
+
+def path_ab() -> None:
+    with LOCK_A:
+        helper_b()  # EXPECT: lock-order-global
+
+
+def touch_a() -> None:
+    with LOCK_A:
+        pass

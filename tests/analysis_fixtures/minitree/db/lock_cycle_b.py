@@ -1,0 +1,19 @@
+"""Seeded cross-module lock cycle, B side: takes LOCK_B then calls
+back into A while holding it (the finding anchors on the A side's
+minimal edge)."""
+
+import threading
+
+from .lock_cycle_a import touch_a
+
+LOCK_B = threading.Lock()
+
+
+def helper_b() -> None:
+    with LOCK_B:
+        pass
+
+
+def path_ba() -> None:
+    with LOCK_B:
+        touch_a()

@@ -1,0 +1,15 @@
+"""Seeded env-read fixture: registered reads plus one rogue knob."""
+
+import os
+
+
+def documented() -> bool:
+    return os.environ.get("TEMPO_FIX_DOCUMENTED", "1") != "0"
+
+
+def undocumented() -> int:
+    return int(os.environ.get("TEMPO_FIX_UNDOCUMENTED", "4"))
+
+
+def rogue() -> str:
+    return os.environ.get("TEMPO_FIX_ROGUE", "")  # EXPECT: env-unregistered

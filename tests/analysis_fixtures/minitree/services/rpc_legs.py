@@ -1,0 +1,24 @@
+"""Seeded resilience fixture: deadline and guarding contracts on
+remote legs. Claimed with an empty seam tuple in chaos/plane.py (fault
+source, not a seam) so only the deadline/guard rules fire here."""
+
+import urllib.request
+
+
+def no_deadline(url: str) -> bytes:
+    return urllib.request.urlopen(url).read()  # EXPECT: rpc-no-deadline
+
+
+def with_deadline(url: str) -> bytes:
+    return urllib.request.urlopen(url, timeout=5.0).read()
+
+
+def push_blind(client, blob: bytes) -> int:
+    return client.push_segments(blob)  # EXPECT: rpc-unguarded
+
+
+def push_caught(client, blob: bytes) -> int:
+    try:
+        return client.push_segments(blob)
+    except OSError:
+        return 0

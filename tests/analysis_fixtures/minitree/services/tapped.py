@@ -1,0 +1,5 @@
+"""Seeded healthy chaos seam: claims and actually names its site."""
+
+
+def poke(plane) -> object:
+    return plane.tap("fix.tapped", key="poke")

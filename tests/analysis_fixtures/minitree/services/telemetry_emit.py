@@ -1,0 +1,21 @@
+"""Seeded telemetry fixture: one family the ops files know, one orphan,
+and both sides of the label-escaping contract."""
+
+from util.metrics import Counter, Gauge
+
+PUSHES = Counter("tempo_fix_pushes_total")
+ORPHAN_DEPTH = Gauge("tempo_fix_orphan_depth")  # EXPECT: metric-orphan
+
+
+def _esc(v: str) -> str:
+    return v.replace('"', '\\"')
+
+
+def render_bad(tenant: str) -> list[str]:
+    return [f'tempo_fix_pushes_total{{tenant="{tenant}"}} 1']  # EXPECT: metric-label-cardinality
+
+
+def render_ok(tenant: str) -> list[str]:
+    t = _esc(tenant)
+    return [f'tempo_fix_pushes_total{{tenant="{t}"}} 1',
+            f'tempo_fix_pushes_total{{tenant="{_esc(tenant)}"}} 1']

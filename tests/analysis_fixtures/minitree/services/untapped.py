@@ -1,0 +1,8 @@
+"""Seeded seam gap: remote side effect in scope, module claims no
+seam in chaos/plane.py SEAM_MODULES."""
+
+import urllib.request
+
+
+def fetch(url: str) -> bytes:
+    return urllib.request.urlopen(url, timeout=2.0).read()  # EXPECT: chaos-seam-gap

@@ -1,0 +1,12 @@
+"""Seeded pragma-hygiene fixture: a reasonless suppression that works,
+and a reasoned suppression that suppresses nothing."""
+
+_flags = {}
+
+
+def set_flag(k) -> None:
+    _flags[k] = True  # tempo: ignore[global-mutation-unlocked] # EXPECT: pragma-no-reason
+
+
+def read_flag(k):
+    return _flags.get(k)  # tempo: ignore[global-mutation-unlocked] reads mutate nothing # EXPECT: pragma-unused

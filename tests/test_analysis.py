@@ -29,8 +29,12 @@ EXPECT_RE = re.compile(r"#\s*EXPECT:\s*([a-z\-]+(?:\s*,\s*[a-z\-]+)*)")
 
 
 def _expected_findings() -> set[tuple[str, int, str]]:
+    # yaml/json too: the telemetry contract anchors findings in the ops
+    # files themselves (EXPECT rides inside a string value there)
+    paths = [p for pat in ("*.py", "*.yaml", "*.json")
+             for p in MINITREE.rglob(pat)]
     out = set()
-    for p in sorted(MINITREE.rglob("*.py")):
+    for p in sorted(paths):
         rel = p.relative_to(MINITREE).as_posix()
         for lineno, line in enumerate(p.read_text().splitlines(), start=1):
             m = EXPECT_RE.search(line)
@@ -59,11 +63,10 @@ def test_seeded_corpus_fires_every_rule_exactly():
     got = {(f.file, f.line, f.rule) for f in report.findings}
     assert got == expected, (
         f"unexpected: {sorted(got - expected)}; missing: {sorted(expected - got)}")
-    # the corpus must keep >= 8 distinct rules under test (acceptance
-    # criterion); parse-error is covered separately below
-    assert len({r for _, _, r in expected}) >= 8
-    # every corpus rule is a registered rule
-    assert {r for _, _, r in expected} <= set(RULES)
+    # the corpus keeps EVERY registered rule under test except
+    # parse-error (covered separately below): a new rule lands with its
+    # fixture or this fails
+    assert {r for _, _, r in expected} == set(RULES) - {"parse-error"}
 
 
 def test_ignore_pragma_suppresses_and_counts(tmp_path):
@@ -99,11 +102,13 @@ def test_parse_error_exits_nonzero_unless_skipped(tmp_path, capsys):
 def test_json_report_shape(capsys):
     assert analysis_main([str(MINITREE), "--json"]) == 0  # not strict
     out = json.loads(capsys.readouterr().out)
-    assert out["files_scanned"] == 18
+    assert out["files_scanned"] == 28
     assert set(out["rules"]) == set(RULES)
     sample = out["findings"][0]
-    assert {"file", "line", "rule", "message", "hint"} <= set(sample)
+    assert {"file", "line", "rule", "message", "hint", "severity"} <= set(sample)
     assert "wall_ms" in out
+    assert out["schema_version"] == 2
+    assert out["family_ms"]  # per-family timing rides along
 
 
 def test_strict_and_baseline_workflow(tmp_path, capsys):

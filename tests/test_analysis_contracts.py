@@ -1,0 +1,241 @@
+"""Contract-analysis families as tier-1 gates: the config registry
+round-trips against the live tree, deliberately broken telemetry /
+config / resilience trees fail --strict, and the interprocedural lock
+graph reports its witness path exactly.
+
+tests/test_analysis.py owns the corpus-vs-EXPECT exactness and the
+live-tree cleanliness gate; this file owns the *semantics* of the new
+families -- each synthetic tree here is the minimal reproduction of the
+production failure its rule exists to prevent.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import re
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import pytest
+
+import tempo_tpu
+from tempo_tpu import config_registry
+from tempo_tpu.analysis import run_analysis
+from tempo_tpu.analysis.__main__ import main as analysis_main
+
+PKG_ROOT = Path(tempo_tpu.__file__).resolve().parent
+MINITREE = Path(__file__).resolve().parent / "analysis_fixtures" / "minitree"
+ENV_RE = re.compile(r"^TEMPO_[A-Z0-9_]+$")
+
+
+# ------------------------------------------------------ config registry
+def test_registry_round_trip_against_live_tree():
+    """Both directions of the config contract, checked at runtime the
+    same way the analyzer checks them statically: every TEMPO_* literal
+    the package spells is registered, and every registered knob is
+    spelled somewhere outside the registry."""
+    reads: set[str] = set()
+    for p in PKG_ROOT.rglob("*.py"):
+        if "__pycache__" in p.parts or p.name == "config_registry.py":
+            continue
+        for n in ast.walk(ast.parse(p.read_text(encoding="utf-8"))):
+            if (isinstance(n, ast.Constant) and isinstance(n.value, str)
+                    and ENV_RE.match(n.value)):
+                reads.add(n.value)
+    registered = set(config_registry.KNOBS)
+    assert reads - registered == set(), "unregistered reads"
+    assert registered - reads == set(), "dead registry entries"
+
+
+def test_registry_typed_readers(monkeypatch):
+    monkeypatch.delenv("TEMPO_BATCH_MAX", raising=False)
+    assert config_registry.get_int("TEMPO_BATCH_MAX") == 16  # default
+    monkeypatch.setenv("TEMPO_BATCH_MAX", "4")
+    assert config_registry.get_int("TEMPO_BATCH_MAX") == 4
+    monkeypatch.setenv("TEMPO_BATCH", "false")
+    assert config_registry.get_bool("TEMPO_BATCH") is False
+    monkeypatch.setenv("TEMPO_SLO_EVAL_S", "2.5")
+    assert config_registry.get_float("TEMPO_SLO_EVAL_S") == 2.5
+    with pytest.raises(KeyError):
+        config_registry.get("TEMPO_NOT_A_KNOB")
+
+
+def test_every_knob_has_type_default_doc():
+    for name, (typ, default, doc) in config_registry.KNOBS.items():
+        assert ENV_RE.match(name), name
+        assert typ in ("bool", "int", "float", "str", "path"), name
+        assert isinstance(default, str), name
+        assert doc.strip(), f"{name} has no doc line"
+
+
+def test_undeclared_env_read_fails_strict(tmp_path):
+    (tmp_path / "config_registry.py").write_text("KNOBS = {}\n")
+    svc = tmp_path / "services"
+    svc.mkdir()
+    svc.joinpath("reader.py").write_text(textwrap.dedent("""\
+        import os
+
+
+        def knob() -> str:
+            return os.environ.get("TEMPO_SNEAKY_FLAG", "")
+    """))
+    assert analysis_main([str(tmp_path), "--strict"]) == 1
+    report = run_analysis(tmp_path)
+    assert [f.rule for f in report.findings] == ["env-unregistered"]
+
+
+# ---------------------------------------------------- telemetry contract
+def _telemetry_tree(tmp_path: Path, alert_family: str) -> Path:
+    svc = tmp_path / "services"
+    svc.mkdir()
+    svc.joinpath("emit.py").write_text(textwrap.dedent("""\
+        from util.metrics import Counter
+
+        PUSHES = Counter("tempo_t_pushes_total")
+    """))
+    ops = tmp_path / "ops"
+    ops.mkdir()
+    ops.joinpath("alerts.yaml").write_text(textwrap.dedent(f"""\
+        groups:
+          - name: t
+            rules:
+              - alert: TPushesStalled
+                expr: rate({alert_family}[5m]) == 0
+    """))
+    return tmp_path
+
+
+def test_broken_alerts_yaml_fails_strict(tmp_path):
+    """An alert expression naming a family nothing emits is an alert
+    that can never fire: --strict must reject the tree."""
+    root = _telemetry_tree(tmp_path, "tempo_t_ghost_total")
+    report = run_analysis(root)
+    assert [(f.file, f.rule) for f in report.findings] == [
+        ("ops/alerts.yaml", "alert-unknown-metric")]
+    assert analysis_main([str(root), "--strict"]) == 1
+
+
+def test_matching_alerts_yaml_is_clean(tmp_path):
+    root = _telemetry_tree(tmp_path, "tempo_t_pushes_total")
+    assert run_analysis(root).findings == []
+
+
+def test_live_ops_files_reference_only_emitted_families():
+    """The shipped alerts.yaml / dashboard reference real families (the
+    run_analysis-level restatement of the acceptance criterion)."""
+    report = run_analysis(PKG_ROOT)
+    bad = [f for f in report.findings
+           if f.rule in ("alert-unknown-metric", "dashboard-unknown-metric")]
+    assert bad == [], [f.render() for f in bad]
+
+
+# -------------------------------------------------- resilience contract
+def test_deadline_less_rpc_fails_strict(tmp_path):
+    svc = tmp_path / "services"
+    svc.mkdir()
+    svc.joinpath("leg.py").write_text(textwrap.dedent("""\
+        import urllib.request
+
+
+        def poke(url: str) -> bytes:
+            return urllib.request.urlopen(url).read()
+    """))
+    report = run_analysis(tmp_path)
+    assert [f.rule for f in report.findings] == ["rpc-no-deadline"]
+    assert analysis_main([str(tmp_path), "--strict"]) == 1
+    # the fix the hint prescribes makes the same tree clean
+    svc.joinpath("leg.py").write_text(textwrap.dedent("""\
+        import urllib.request
+
+
+        def poke(url: str) -> bytes:
+            return urllib.request.urlopen(url, timeout=5.0).read()
+    """))
+    assert run_analysis(tmp_path).findings == []
+
+
+def test_live_seam_registry_is_complete():
+    """chaos/plane.py SEAM_MODULES covers every declared site and every
+    urlopen in resilience scope (the fault-certification reachability
+    contract)."""
+    from tempo_tpu.chaos import plane
+
+    claimed = {s for sites in plane.SEAM_MODULES.values() for s in sites}
+    assert claimed == set(plane.SITES), "seam registry out of sync"
+    report = run_analysis(PKG_ROOT)
+    gaps = [f for f in report.findings if f.rule == "chaos-seam-gap"]
+    assert gaps == [], [f.render() for f in gaps]
+
+
+# ------------------------------------------------------------ lock graph
+def test_lock_cycle_witness_path_exact():
+    """The fixture cycle reports once, anchored on the A side, with the
+    full witness call path -- the part of the finding an engineer
+    debugging a deadlock actually needs."""
+    report = run_analysis(MINITREE)
+    cycles = [f for f in report.findings if f.rule == "lock-order-global"]
+    assert len(cycles) == 1
+    f = cycles[0]
+    assert (f.file, f.line) == ("db/lock_cycle_a.py", 14)
+    assert f.message == (
+        "lock cycle db.lock_cycle_a.LOCK_A -> db.lock_cycle_b.LOCK_B "
+        "-> db.lock_cycle_a.LOCK_A; witness call path: "
+        "db.lock_cycle_a.path_ab -> db.lock_cycle_b.helper_b")
+
+
+def test_lexical_single_module_cycle_left_to_per_module_rule(tmp_path):
+    """A lexically inverted pair inside one file belongs to the
+    per-module lock-order rule; the global pass must not double-report
+    it."""
+    svc = tmp_path / "services"
+    svc.mkdir()
+    svc.joinpath("inverted.py").write_text(textwrap.dedent("""\
+        import threading
+
+        LOCK_X = threading.Lock()
+        LOCK_Y = threading.Lock()
+
+
+        def xy():
+            with LOCK_X:
+                with LOCK_Y:
+                    pass
+
+
+        def yx():
+            with LOCK_Y:
+                with LOCK_X:
+                    pass
+    """))
+    report = run_analysis(tmp_path)
+    rules = sorted(f.rule for f in report.findings)
+    assert "lock-order" in rules
+    assert "lock-order-global" not in rules
+
+
+# -------------------------------------------------------------- CLI gates
+def test_live_strict_subprocess_all_families():
+    """`python -m tempo_tpu.analysis --strict --json` exits 0 on the
+    repo with every family having actually run (family_ms proves the
+    pass executed, not just registered)."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "tempo_tpu.analysis", "--strict", "--json"],
+        cwd=PKG_ROOT.parent, capture_output=True, text=True, timeout=180)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    out = json.loads(proc.stdout)
+    assert out["schema_version"] == 2
+    for family in ("kernel", "concurrency", "config", "telemetry",
+                   "resilience", "lockgraph", "pragma"):
+        assert family in out["family_ms"], family
+
+
+def test_diff_mode_scopes_and_falls_back(tmp_path, capsys):
+    """--diff against a bogus rev falls back to the full (strict-clean)
+    run rather than silently checking nothing."""
+    assert analysis_main(["--diff", "definitely-not-a-rev",
+                          "--strict"]) == 0
+    err = capsys.readouterr().err
+    assert "falling back to the full run" in err
