@@ -1654,6 +1654,119 @@ def bench_generator_tap(tmp: str) -> None:
     _emit("service_graph_edges_per_sec", E * iters / dt, "edges/s", tel=tel)
 
 
+def bench_caching(tmp: str) -> None:
+    """The tiered cache plane, two rows:
+
+    - search_result_cache_hit_p50_ms: p50 of a repeated search through
+      the frontend once the result cache holds the entry -- the
+      dashboard-refresh hot path, admitted AHEAD of the QoS queue. The
+      tel carries the zero-work proof: device launches during the
+      measured hits must be 0.
+    - chunk_cache_restage_speedup: stage_block served from the host
+      chunk pool (a demoted, recompressed HBM eviction victim) vs the
+      cold path (backend ranged read + decode + pad + upload) on the
+      same (block, columns) entry. The acceptance bar is >= 3x.
+    """
+    from tempo_tpu.services.app import App, AppConfig, IngesterConfig
+    from tempo_tpu.util.kerneltel import TEL
+    from tempo_tpu.util.testdata import make_traces
+    from tempo_tpu.wire import otlp_pb
+
+    cfg = AppConfig(
+        target="all", http_port=0, storage_path=tmp + "/cache-store",
+        compaction_cycle_s=9999,
+        ingester=IngesterConfig(max_trace_idle_s=0.0, max_block_age_s=0.0,
+                                flush_check_period_s=9999),
+    )
+    app = App(cfg)
+    app.start()
+    try:
+        from tempo_tpu.db.search import SearchRequest
+
+        tenant = app.tenant_of({})
+        for _, tr in make_traces(64, seed=7, n_spans=8):
+            app.distributor.push_raw(tenant, otlp_pb.encode_trace(tr))
+        app.ingester.flush_all()
+        app.db.poll_now()
+        req = SearchRequest(query="{ true }", limit=20)
+        r0 = app.frontend.search(tenant, req)  # miss: executes + stores
+        assert r0.traces, "bench corpus not searchable"
+        app.frontend.search(tenant, req)  # warm: first hit
+        rc = app.frontend.result_cache
+        assert rc is not None and rc.stats_hits >= 1, \
+            "result cache did not hit on the repeat"
+        l0 = TEL.launch_count()
+        lats: list[float] = []
+        for _ in range(400):
+            t0 = time.perf_counter()
+            app.frontend.search(tenant, req)
+            lats.append(time.perf_counter() - t0)
+        launches = TEL.launch_count() - l0
+        assert launches == 0, f"cache hits launched {launches} kernels"
+    finally:
+        app.stop()
+    _emit("search_result_cache_hit_p50_ms",
+          float(np.percentile(lats, 50)) * 1e3, "ms",
+          tel={"hits": len(lats),
+               "p95_ms": round(float(np.percentile(lats, 95)) * 1e3, 4),
+               "device_launches_during_hits": launches})
+
+    # --- chunk-tier restage vs cold stage, same entry
+    from tempo_tpu.backend.local import LocalBackend
+    from tempo_tpu.db import TempoDB, TempoDBConfig
+    from tempo_tpu.ops import chunkpool
+    from tempo_tpu.ops.filter import Cond, required_columns
+    from tempo_tpu.ops.stage import set_staged_cache_budget, stage_block
+
+    rng = np.random.default_rng(29)
+    backend = LocalBackend(tmp + "/store-chunk")
+    meta_a, _ = synth_block(backend, "bench", rng, 1 << 14, 24)
+    meta_b, _ = synth_block(backend, "bench", rng, 1 << 14, 24)
+    db = TempoDB(TempoDBConfig(wal_path=tmp + "/wal-chunk"), backend=backend)
+    db.poll_now()
+    blk_a, blk_b = db.open_block(meta_a), db.open_block(meta_b)
+    needed = required_columns(
+        (Cond(target="res", col="res.service_id", op="eq"),))
+
+    # cold leg: a FRESH reader per sample (the pack object keeps its
+    # own decoded-chunk/column caches, which a warm reader would serve
+    # from) and cache=False to skip the HBM store and the pool probe --
+    # every sample pays footer + ranged reads + decode + pad + upload,
+    # the exact work a pool hit skips
+    from tempo_tpu.block.versioned import open_block_versioned
+
+    stage_block(blk_a, needed, cache=False)  # compile/warm the upload
+    cold_dt = best_window(
+        lambda: stage_block(open_block_versioned(backend, meta_a),
+                            needed, cache=False), windows=3)
+
+    chunkpool.clear()
+    pool_hits0 = chunkpool.stats()["hits"]
+    restage_lats: list[float] = []
+    for _ in range(6):
+        # park A in the pool: stage A then B (A becomes the LRU head),
+        # squeeze the HBM budget so A demotes, restore the budget
+        stage_block(blk_a, needed)
+        stage_block(blk_b, needed)
+        set_staged_cache_budget(1)
+        set_staged_cache_budget(4 << 30)
+        assert chunkpool.probe(meta_a.block_id,
+                               (tuple(needed), None)), "demotion missed"
+        t0 = time.perf_counter()
+        stage_block(blk_a, needed)
+        restage_lats.append(time.perf_counter() - t0)
+    pool_hits = chunkpool.stats()["hits"] - pool_hits0
+    assert pool_hits >= len(restage_lats), \
+        f"only {pool_hits} pool hits across {len(restage_lats)} restages"
+    restage_dt = min(restage_lats)
+    set_staged_cache_budget(4 << 30)
+    _emit("chunk_cache_restage_speedup", cold_dt / restage_dt, "x",
+          tel={"cold_ms": round(cold_dt * 1e3, 3),
+               "restage_ms": round(restage_dt * 1e3, 3),
+               "codec": chunkpool.codec_name(),
+               "pool_hits": pool_hits})
+
+
 def bench_fleet() -> None:
     """`python bench.py --fleet`: multi-process fleet certification.
 
@@ -1698,6 +1811,7 @@ def main() -> None:
         bench_mesh_batched(tmp)
         bench_search_live(tmp)
         bench_search_affinity(tmp)
+        bench_caching(tmp)
         _emit("search_block_e2e_cold_spans_per_sec", cold, "spans/s",
               cold / BASELINE_SPANS_PER_SEC, tel=cold_tel)
         # headline LAST: hot-block search (cached device staging), the

@@ -23,6 +23,8 @@ or self-hosted (spawns a single-binary app on an ephemeral port):
     python soak.py --self-host --duration 30
 mixed-tenant with QoS overrides:
     python soak.py --self-host --tenants 4 --overrides overrides.yaml
+dashboard-shaped repeat traffic (result-cache acceptance):
+    python soak.py --self-host --repeat-zipf 1.1 --duration 30
 """
 
 from __future__ import annotations
@@ -58,7 +60,8 @@ class Soak:
     def __init__(self, target: str, writers: int, readers: int,
                  spans_per_trace: int = 8, batch: int = 5,
                  tenants: list[str] | None = None, zipf: float = 1.2,
-                 live_tail: bool = False, query_target: str = ""):
+                 live_tail: bool = False, query_target: str = "",
+                 repeat_zipf: float = 0.0):
         self.target = target.rstrip("/")
         # split-role fleets write to the distributor and read from the
         # query-frontend; "" = one process serves both (today's default)
@@ -85,6 +88,38 @@ class Soak:
         self.sheds: dict[str, int] = {t: 0 for t in self.tenants}  # 429s
         self.found = 0
         self.not_yet = 0  # reads that raced ingest (retried at the end)
+        # --repeat-zipf: dashboard-shaped read traffic -- a FIXED pool
+        # of query templates drawn Zipf(s) by rank, so the same few
+        # queries repeat exactly like auto-refreshing dashboard panels
+        # and the result cache has something to hit. Each response is
+        # classified by its X-Tempo-Cache header.
+        self.repeat_zipf = repeat_zipf
+        self.cache_lat: dict[str, list[float]] = {
+            k: [] for k in ("hit", "extend", "miss", "off")}
+        if repeat_zipf > 0:
+            t0 = int(time.time())
+
+            def hist(svc: str, off_s: int):
+                # immutable historical window: end sits behind the
+                # live window, so only a blocklist change invalidates
+                return lambda: (f"/api/search?tags=service.name%3D{svc}"
+                                f"&limit=20&start={t0 - off_s}&end={t0 - 60}")
+
+            def edge(svc: str):
+                # moving now-edge window: the auto-refresh panel shape
+                # the incremental-extension path exists for
+                def f():
+                    now = int(time.time())
+                    return (f"/api/search?tags=service.name%3D{svc}"
+                            f"&limit=20&start={now - 600}&end={now}")
+                return f
+
+            self._qtemplates = (
+                [hist(f"soak-svc-{i}", 3600) for i in range(4)]
+                + [hist(f"soak-svc-{i}", 1800) for i in range(4)]
+                + [edge("soak-svc-0"), edge("soak-svc-1")])
+            self._qweights = [1.0 / (r + 1) ** repeat_zipf
+                              for r in range(len(self._qtemplates))]
 
     def _headers(self, tenant: str, ctype: str = "") -> dict:
         h = {}
@@ -106,6 +141,14 @@ class Soak:
                                      headers=self._headers(tenant))
         with urllib.request.urlopen(req, timeout=15) as r:
             return r.read()
+
+    def _get_with_cache_header(self, path: str, tenant: str = ""):
+        """GET returning (body, X-Tempo-Cache header) -- "" when the
+        result cache is disabled or the target predates it."""
+        req = urllib.request.Request(self.query_target + path,
+                                     headers=self._headers(tenant))
+        with urllib.request.urlopen(req, timeout=15) as r:
+            return r.read(), r.headers.get("X-Tempo-Cache", "")
 
     def _pick_tenant(self, rng: random.Random) -> str:
         if len(self.tenants) == 1:
@@ -218,14 +261,25 @@ class Soak:
                     if not shed:
                         with self.lock:
                             self.find_lat[tenant].append(time.perf_counter() - t0)
+                outcome = None
+                if self.repeat_zipf > 0:
+                    path = rng.choices(self._qtemplates,
+                                       weights=self._qweights)[0]()
+                else:
+                    path = "/api/search?tags=service.name%3Dsoak-svc-1&limit=20"
+                    if self.live_tail:
+                        now = int(time.time())
+                        path += f"&start={now - 60}&end={now + 5}"
                 t0 = time.perf_counter()
                 shed = False
-                path = "/api/search?tags=service.name%3Dsoak-svc-1&limit=20"
-                if self.live_tail:
-                    now = int(time.time())
-                    path += f"&start={now - 60}&end={now + 5}"
                 try:
-                    self._get(path, tenant=tenant)
+                    if self.repeat_zipf > 0:
+                        _body, hdr = self._get_with_cache_header(
+                            path, tenant=tenant)
+                        outcome = hdr if hdr in ("hit", "extend", "miss") \
+                            else "off"
+                    else:
+                        self._get(path, tenant=tenant)
                 except urllib.error.HTTPError as e:
                     if e.code != 429:
                         raise
@@ -233,8 +287,11 @@ class Soak:
                     with self.lock:
                         self.sheds[tenant] += 1
                 if not shed:
+                    dt = time.perf_counter() - t0
                     with self.lock:
-                        self.search_lat[tenant].append(time.perf_counter() - t0)
+                        self.search_lat[tenant].append(dt)
+                        if outcome is not None:
+                            self.cache_lat[outcome].append(dt)
             except Exception as e:
                 with self.lock:
                     self.errors.append(f"read[{tenant}]: {type(e).__name__}: {e}")
@@ -309,6 +366,33 @@ class Soak:
             and _pct(all_writes, 0.95) <= max_write_p95_s
             and _pct(all_search, 0.95) <= max_search_p95_s
         )
+        if self.repeat_zipf > 0:
+            hits, ext = self.cache_lat["hit"], self.cache_lat["extend"]
+            misses, off = self.cache_lat["miss"], self.cache_lat["off"]
+            total = len(hits) + len(ext) + len(misses)
+            cached = hits + ext
+            report["result_cache"] = {
+                "enabled": total > 0,  # 0 classified = kill switch off
+                "requests": total + len(off),
+                "hits": len(hits),
+                "extensions": len(ext),
+                "misses": len(misses),
+                "uncached": len(off),
+                "hit_rate": round(len(cached) / total, 3) if total else 0.0,
+                "cached_p50_ms": round(_pct(cached, 0.5) * 1e3, 3),
+                "cached_p95_ms": round(_pct(cached, 0.95) * 1e3, 3),
+                "fresh_p50_ms": round(_pct(misses, 0.5) * 1e3, 2),
+            }
+            # the acceptance gate: dashboard-shaped traffic must
+            # mostly hit (>= 50%) -- but only when the cache is on
+            # (a kill-switch run measures the baseline, not the cache)
+            if total >= 20 and len(cached) / total < 0.5:
+                report["ok"] = False
+                self.errors.append(
+                    f"result_cache: hit rate {len(cached) / total:.2f} "
+                    f"< 0.5 under repeat-zipf traffic")
+                report["errors"] = self.errors[:5]
+                report["error_count"] = len(self.errors)
         return report
 
 
@@ -347,6 +431,14 @@ def main(argv=None) -> int:
     ap.add_argument("--live-tail", action="store_true",
                     help="searches query only the most recent 60s window "
                          "(exercises the live-head device engine)")
+    ap.add_argument("--repeat-zipf", type=float, default=0.0, metavar="S",
+                    help="dashboard-shaped reads: draw searches from a "
+                         "fixed template pool Zipf(S)-skewed by rank "
+                         "(incl. a moving now-edge window), classify "
+                         "each response by its X-Tempo-Cache header and "
+                         "report result-cache hit rate + cached p50; "
+                         "hit rate < 0.5 fails the run when the cache "
+                         "is on")
     ap.add_argument("--vulture", action="store_true",
                     help="run the continuous-verification prober beside "
                          "the soak; its SLO verdicts + freshness "
@@ -436,7 +528,8 @@ def main(argv=None) -> int:
     try:
         soak = Soak(target, args.writers, args.readers, tenants=tenants,
                     zipf=args.zipf, live_tail=args.live_tail,
-                    query_target=args.query_target)
+                    query_target=args.query_target,
+                    repeat_zipf=args.repeat_zipf)
         report = soak.run(args.duration, max_write_p95_s=args.write_p95,
                           max_search_p95_s=args.search_p95)
         if vult is not None:

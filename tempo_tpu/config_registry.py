@@ -60,6 +60,27 @@ KNOBS: dict[str, tuple[str, str, str]] = {
         "str", "",
         "fault-injection rules: inline JSON or a rules file path "
         "('' = chaos off)"),
+    "TEMPO_CHUNK_CACHE": (
+        "bool", "1",
+        "host-RAM compressed column-chunk tier under the HBM staged "
+        "cache (0 = evictions discard, misses re-read the backend)"),
+    "TEMPO_CHUNK_CACHE_BUDGET": (
+        "int", "1073741824",
+        "chunk-tier host pool budget in compressed bytes"),
+    "TEMPO_CHUNK_CACHE_CODEC": (
+        "str", "none",
+        "chunk-tier recompression codec: none/lz4/snappy/zstd -- the "
+        "default stores raw bytes (a restage must beat the backend "
+        "read + decode + assemble it replaces; recompression only "
+        "pays where a native codec wheel is installed)"),
+    "TEMPO_CHUNK_CACHE_MAX_ENTRY": (
+        "int", "268435456",
+        "largest single staged-column set the chunk tier admits (raw "
+        "bytes)"),
+    "TEMPO_CHUNK_CACHE_MIN_REUSE": (
+        "int", "1",
+        "stage count a block needs before eviction demotes instead of "
+        "discards (bytes x reuse admission)"),
     "TEMPO_COMPACT_CONCURRENCY": (
         "int", "1", "parallel compaction pipeline workers"),
     "TEMPO_COMPACT_MEM_BUDGET": (
@@ -116,6 +137,26 @@ KNOBS: dict[str, tuple[str, str, str]] = {
         "flamegraph/slow-query artifact directory ('' = artifacts off)"),
     "TEMPO_PROFILE_HZ": (
         "float", "19.0", "continuous profiler sampling rate (0 = off)"),
+    "TEMPO_RESULT_CACHE": (
+        "bool", "1",
+        "frontend query-result cache ahead of queue admission (0 = "
+        "every query executes; byte-identical to a cacheless build)"),
+    "TEMPO_RESULT_CACHE_EXTEND": (
+        "bool", "1",
+        "incremental extension of cached results for moving now-edge "
+        "ranges (0 = exact-range hits only)"),
+    "TEMPO_RESULT_CACHE_LIVE_WINDOW_S": (
+        "float", "30.0",
+        "trailing window treated as mutable live head: ranges ending "
+        "inside it key on the ingester live generation, and extension "
+        "prefixes stop this far behind now"),
+    "TEMPO_RESULT_CACHE_MAX_BYTES": (
+        "int", "67108864",
+        "result-cache LRU budget in serialized-payload bytes"),
+    "TEMPO_RESULT_CACHE_TTL_S": (
+        "float", "300.0",
+        "result-cache entry lifetime; bounds staleness from spans "
+        "arriving later than the live window into old ranges"),
     "TEMPO_RETRY_BUDGET": (
         "int", "0",
         "per-query retry budget override (0 = max(4, jobs/4))"),

@@ -34,6 +34,15 @@ class Blocklist:
         # blocks added/removed since the current poll started
         self._added: dict[str, list[BlockMeta]] = {}
         self._removed: dict[str, set[str]] = {}
+        # per-tenant mutation generation: bumps whenever the tenant's
+        # searchable block set actually changes (flush, compaction,
+        # poll drift). The frontend result cache keys on it, so any
+        # blocklist change invalidates cached query results naturally.
+        self._gen: dict[str, int] = {}
+
+    def generation(self, tenant: str) -> int:
+        with self._lock:
+            return self._gen.get(tenant, 0)
 
     def tenants(self) -> list[str]:
         with self._lock:
@@ -67,18 +76,24 @@ class Blocklist:
         with self._lock:
             metas = self._metas.setdefault(tenant, [])
             removed = self._removed.setdefault(tenant, set())
+            changed = False
             if add:
                 known = {m.block_id for m in metas}
                 for m in add:
                     if m.block_id not in known:
                         metas.append(m)
+                        changed = True
                 self._added.setdefault(tenant, []).extend(add)
             if remove:
                 rm = set(remove)
-                self._metas[tenant] = [m for m in metas if m.block_id not in rm]
+                kept = [m for m in metas if m.block_id not in rm]
+                changed = changed or len(kept) != len(metas)
+                self._metas[tenant] = kept
                 removed |= rm
             if add_compacted:
                 self._compacted.setdefault(tenant, []).extend(add_compacted)
+            if changed:
+                self._gen[tenant] = self._gen.get(tenant, 0) + 1
 
     def apply_poll_results(
         self, metas: dict[str, list[BlockMeta]], compacted: dict[str, list[BlockMeta]]
@@ -93,7 +108,13 @@ class Blocklist:
                         fresh.append(m)
                         ids.add(m.block_id)
                 rm = self._removed.get(tenant, set())
+                before = {m.block_id for m in self._metas.get(tenant, [])}
                 self._metas[tenant] = [m for m in fresh if m.block_id not in rm]
+                # a steady-state poll returning the same set must NOT
+                # bump: generation-keyed result-cache entries would
+                # churn on every poll cycle with nothing changed
+                if {m.block_id for m in self._metas[tenant]} != before:
+                    self._gen[tenant] = self._gen.get(tenant, 0) + 1
             self._compacted = {t: list(v) for t, v in compacted.items()}
             self._added.clear()
             self._removed.clear()

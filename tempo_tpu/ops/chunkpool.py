@@ -1,0 +1,263 @@
+"""Tier B of the cache plane: a host-RAM compressed column-chunk pool
+under the HBM staged cache (ops/stage).
+
+When the staged-column LRU evicts an entry to stay under the HBM
+budget, the padded device arrays are pulled back to host and parked
+here instead of discarded -- the bytes already paid object-store IO,
+decompression AND pad/assemble once. Entries are stored raw by
+default and optionally recompressed through the block codec layer
+(block/blockcodecs): a restage must beat the backend read + decode +
+assemble it replaces, and without a native codec wheel the
+compression round trip costs more than the RAM it saves. A later stage of the same
+(block, column set, group range) decompresses and re-uploads straight
+from the pool: no backend ranged read, no column decode, no
+owner-offset assembly. The pool is per-process, which under PR-7
+affinity placement means per cache domain -- the queries that staged an
+entry are the ones routed back to the process holding its demotion.
+
+Demotion happens OUTSIDE the stage LRU lock (stage.py collects victims
+under the lock and drains them after release): device->host transfers
+and compression are milliseconds, the lock protects microsecond
+bookkeeping.
+
+Knobs (config_registry): TEMPO_CHUNK_CACHE (kill switch; 0 restores
+discard-on-evict exactly), TEMPO_CHUNK_CACHE_BUDGET (compressed-byte
+pool bound), TEMPO_CHUNK_CACHE_MAX_ENTRY (per-entry raw-byte admission
+cap), TEMPO_CHUNK_CACHE_MIN_REUSE (stagings of a key before its
+demotion is worth host RAM), TEMPO_CHUNK_CACHE_CODEC
+(lz4/snappy/zstd/none).
+"""
+
+from __future__ import annotations
+
+import time as _time
+from collections import OrderedDict
+from dataclasses import dataclass
+
+import numpy as np
+
+from .. import config_registry as _cfg
+from ..util.profiler import timed_lock
+
+
+def enabled() -> bool:
+    return _cfg.get_bool("TEMPO_CHUNK_CACHE")
+
+
+def _budget() -> int:
+    return max(0, _cfg.get_int("TEMPO_CHUNK_CACHE_BUDGET"))
+
+
+def _max_entry() -> int:
+    return max(0, _cfg.get_int("TEMPO_CHUNK_CACHE_MAX_ENTRY"))
+
+
+def _min_reuse() -> int:
+    return max(1, _cfg.get_int("TEMPO_CHUNK_CACHE_MIN_REUSE"))
+
+
+# ---------------------------------------------------------------- codecs
+def _codec_pair(name: str):
+    """(compress(bytes) -> bytes, decompress(bytes, raw_len) -> bytes).
+    raw_len travels out of band in the entry, matching the colio
+    convention."""
+    if name == "none":
+        return (lambda d: d), (lambda d, n: d)
+    if name == "zstd":
+        from ..util import zstdshim
+
+        return (lambda d: zstdshim.ZstdCompressor(3).compress(d),
+                lambda d, n: zstdshim.ZstdDecompressor().decompress(
+                    d, max_output_size=n))
+    from ..block import blockcodecs
+
+    if name == "snappy":
+        return blockcodecs.snappy_compress, blockcodecs.snappy_decompress
+    # default: lz4 -- the cheapest round trip in the codec layer
+    return blockcodecs.lz4_compress, blockcodecs.lz4_decompress
+
+
+def codec_name() -> str:
+    name = (_cfg.get("TEMPO_CHUNK_CACHE_CODEC") or "none").lower()
+    return name if name in ("lz4", "snappy", "zstd", "none") else "none"
+
+
+@dataclass
+class _Entry:
+    """One demoted staged-cache entry: the padded columns' compressed
+    bytes plus everything restage() needs to rebuild the StagedBlock
+    bit-identically."""
+
+    cols: list  # [(name, dtype_str, shape, comp_bytes, raw_len), ...]
+    shape_meta: tuple  # (n_spans, n_traces, n_res, *_b, span_base)
+    codec: str
+    raw_bytes: int
+    comp_bytes: int
+
+
+# a cataloged hot lock, like stage_lru (TEMPO_LOCK_PROFILE arms timing)
+_pool_lock = timed_lock("chunk_pool")
+_pool: OrderedDict[tuple[str, tuple], _Entry] = OrderedDict()
+_pool_bytes = 0
+# (block_id, key) -> times stage_block built/looked for this entry; the
+# bytesxreuse admission signal (entries staged once and never again are
+# not worth host RAM when MIN_REUSE > 1)
+_stage_counts: dict[tuple[str, tuple], int] = {}
+_STAGE_COUNTS_MAX = 4096
+
+
+def _tel():
+    from ..util.kerneltel import TEL
+
+    return TEL
+
+
+def note_stage(block_id: str, key: tuple) -> None:
+    """Record one staging of (block, key) -- the reuse signal demote
+    admission checks."""
+    if not enabled():
+        return
+    with _pool_lock:
+        if len(_stage_counts) >= _STAGE_COUNTS_MAX and (
+                block_id, key) not in _stage_counts:
+            _stage_counts.clear()  # coarse reset; admission degrades soft
+        _stage_counts[(block_id, key)] = _stage_counts.get(
+            (block_id, key), 0) + 1
+
+
+def _evict_over_budget_locked() -> None:
+    global _pool_bytes
+    budget = _budget()
+    while _pool_bytes > budget and _pool:
+        _, ent = _pool.popitem(last=False)
+        _pool_bytes -= ent.comp_bytes
+        _tel().chunk_cache_evictions.inc()
+    _tel().chunk_cache_bytes.set(_pool_bytes)
+
+
+def demote(block_id: str, key: tuple, staged) -> bool:
+    """Compress an evicted StagedBlock's padded columns into the pool.
+    Called by ops/stage AFTER releasing the stage LRU lock. Returns
+    whether the entry was admitted."""
+    global _pool_bytes
+    if not enabled() or not block_id or not staged.cols:
+        return False
+    pk = (block_id, key)
+    with _pool_lock:
+        if pk in _pool:  # already demoted once; just re-rank it
+            _pool.move_to_end(pk)
+            return True
+        reuse = _stage_counts.get(pk, 1)
+    raw = sum(int(a.nbytes) for a in staged.cols.values())
+    if raw > _max_entry() or reuse < _min_reuse():
+        return False
+    name = codec_name()
+    comp_fn, _ = _codec_pair(name)
+    cols = []
+    comp_total = 0
+    for cname, arr in staged.cols.items():
+        # device -> host pull; contiguous bytes for the codec
+        host = np.ascontiguousarray(np.asarray(arr))
+        blob = comp_fn(host.tobytes())
+        cols.append((cname, str(host.dtype), host.shape, blob, host.nbytes))
+        comp_total += len(blob)
+    ent = _Entry(
+        cols=cols,
+        shape_meta=(staged.n_spans, staged.n_traces, staged.n_res,
+                    staged.n_spans_b, staged.n_traces_b, staged.n_res_b,
+                    staged.span_base),
+        codec=name, raw_bytes=raw, comp_bytes=comp_total,
+    )
+    with _pool_lock:
+        if pk in _pool:
+            _pool.move_to_end(pk)
+            return True
+        _pool[pk] = ent
+        _pool_bytes += comp_total
+        _tel().chunk_cache_demotions.inc()
+        _evict_over_budget_locked()
+    return True
+
+
+def probe(block_id: str, key: tuple) -> bool:
+    """Whether a restage of (block, key) would hit -- the plan-time
+    check stream pipelines use to skip issuing backend ranged reads."""
+    if not enabled():
+        return False
+    with _pool_lock:
+        return (block_id, key) in _pool
+
+
+def restage(block_id: str, key: tuple):
+    """Rebuild the StagedBlock for (block, key) from the pool:
+    decompress on host, one batched device upload. Returns None on a
+    pool miss. Counts hits/misses and attaches a cache:chunk-hit span
+    to the active self-trace."""
+    if not enabled():
+        return None
+    tel = _tel()
+    with _pool_lock:
+        ent = _pool.get((block_id, key))
+        if ent is not None:
+            _pool.move_to_end((block_id, key))
+    if ent is None:
+        tel.chunk_cache_misses.inc()
+        return None
+    import jax
+
+    from .stage import StagedBlock
+
+    t0 = _time.time()
+    _, dec_fn = _codec_pair(ent.codec)
+    host = []
+    for cname, dtype, shape, blob, raw_len in ent.cols:
+        arr = np.frombuffer(dec_fn(blob, raw_len), dtype=dtype).reshape(shape)
+        host.append((cname, arr))
+    # ONE batched transfer, same as upload_stage: per-array device_puts
+    # each pay a full link round trip
+    devs = jax.device_put([a for _, a in host])
+    (n_spans, n_traces, n_res, n_spans_b, n_traces_b, n_res_b,
+     span_base) = ent.shape_meta
+    staged = StagedBlock(
+        n_spans=n_spans, n_traces=n_traces, n_res=n_res,
+        n_spans_b=n_spans_b, n_traces_b=n_traces_b, n_res_b=n_res_b,
+        span_base=span_base,
+        cols={cname: dev for (cname, _), dev in zip(host, devs)},
+    )
+    tel.chunk_cache_hits.inc()
+    tel.child_span("cache:chunk-hit", t0, _time.time(),
+                   {"block": block_id[:8], "bytes": ent.raw_bytes,
+                    "codec": ent.codec})
+    return staged
+
+
+def stats() -> dict:
+    """Point-in-time pool view for /status/kernels."""
+    tel = _tel()
+    with _pool_lock:
+        entries = len(_pool)
+        comp = _pool_bytes
+        raw = sum(e.raw_bytes for e in _pool.values())
+    return {
+        "enabled": enabled(),
+        "codec": codec_name(),
+        "entries": entries,
+        "compressed_bytes": int(comp),
+        "raw_bytes": int(raw),
+        "budget_bytes": _budget(),
+        "hits": int(tel.chunk_cache_hits.get()),
+        "misses": int(tel.chunk_cache_misses.get()),
+        "demotions": int(tel.chunk_cache_demotions.get()),
+        "evictions": int(tel.chunk_cache_evictions.get()),
+    }
+
+
+def clear() -> None:
+    """Drop everything (tests + budget reconfiguration)."""
+    global _pool_bytes
+    with _pool_lock:
+        _pool.clear()
+        _stage_counts.clear()
+        _pool_bytes = 0
+        _tel().chunk_cache_bytes.set(0)
+

@@ -39,6 +39,12 @@ _lru_lock = timed_lock("stage_lru")
 _lru: OrderedDict[tuple[int, tuple], tuple] = OrderedDict()  # -> (blk weakref, nbytes)
 _lru_bytes = 0
 
+# HBM-evicted entries awaiting demotion into the host chunk pool
+# (ops/chunkpool): collected under _lru_lock, compressed OUTSIDE it --
+# the D2H pull + codec work is milliseconds, the lock guards
+# microsecond bookkeeping
+_pending_demote: list[tuple[str, tuple, object]] = []
+
 
 def staged_cache_stats(max_entries: int = 32) -> dict:
     """Point-in-time view of the device staged-column cache for
@@ -70,6 +76,7 @@ def set_staged_cache_budget(n_bytes: int) -> None:
         # leave the cache over the new one
         _GLOBAL_CACHE_BUDGET = n_bytes
         _evict_over_budget_locked()
+    _drain_demotions()
 
 
 def _sweep_dead_locked() -> None:
@@ -101,6 +108,7 @@ def _lru_touch(blk, key: tuple, nbytes: int) -> None:
         # the eviction pass sweeps dead weakrefs first, so every insert
         # restores the accounting invariant in one O(n) scan
         _evict_over_budget_locked()
+    _drain_demotions()
 
 
 def _lru_drop(blk, key: tuple) -> None:
@@ -123,7 +131,33 @@ def _evict_over_budget_locked() -> None:
         if blk is not None:
             store = getattr(blk, "_staged_cache", None)
             if store is not None:
-                store.pop(key, None)
+                staged = store.pop(key, None)
+                if staged is not None:
+                    # Tier B demotion candidate: the padded device
+                    # arrays still exist here -- park them for the
+                    # post-lock compress instead of discarding
+                    block_id = getattr(
+                        getattr(blk, "meta", None), "block_id", "") or ""
+                    if block_id:
+                        _pending_demote.append((block_id, key, staged))
+
+
+def _drain_demotions() -> None:
+    """Compress HBM-evicted entries into the host chunk pool. Called by
+    every path that may have run an eviction pass, AFTER _lru_lock is
+    released. With TEMPO_CHUNK_CACHE=0 the pool refuses every entry and
+    eviction degrades to exactly the old discard."""
+    if not _pending_demote:
+        return
+    with _lru_lock:
+        victims = list(_pending_demote)
+        _pending_demote.clear()
+    if not victims:
+        return
+    from . import chunkpool
+
+    for block_id, key, staged in victims:
+        chunkpool.demote(block_id, key, staged)
 
 # absolute-seconds origin (2020-01-01 UTC) for the derived trace@gkey_s
 # column: a global trace start time in int32 seconds (valid until 2088)
@@ -253,6 +287,19 @@ def stage_block(
     if cache:
         TEL.staged_cache_misses.inc()
         TEL.record_staged_lookup(False)
+        # Tier B probe: a previous HBM eviction may have demoted exactly
+        # this (block, columns, groups) entry into the host chunk pool
+        # -- restaging from there skips the backend ranged read, the
+        # column decode AND the pad/assemble phase
+        block_id = getattr(blk.meta, "block_id", "") or ""
+        if block_id:
+            from . import chunkpool
+
+            chunkpool.note_stage(block_id, key)
+            warm = chunkpool.restage(block_id, key)
+            if warm is not None:
+                _cache_insert(blk, key, warm)
+                return warm
     plan = plan_stage(needed)
     span_ax = blk.pack.axes[S.AX_SPAN]
     if groups is None:
@@ -261,18 +308,32 @@ def stage_block(
     staged, padded, real_rows = assemble_stage(blk, plan, groups, host, n_res)
     upload_stage(blk, plan, staged, padded, real_rows)
     if cache:
-        nbytes = sum(a.nbytes for a in staged.cols.values())
-        if nbytes <= _CACHE_MAX_ENTRY_BYTES:
-            if store is None:
-                store = {}
-                blk._staged_cache = store
-            if len(store) >= _CACHE_MAX_ENTRIES:
-                victim = next(iter(store))
-                store.pop(victim)
-                _lru_drop(blk, victim)
-            store[key] = staged
-            _lru_touch(blk, key, nbytes)
+        _cache_insert(blk, key, staged)
     return staged
+
+
+def _cache_insert(blk: BackendBlock, key: tuple, staged: StagedBlock) -> None:
+    """Admit a freshly staged (or pool-restaged) entry into the
+    per-block store + global LRU; a per-block cap victim demotes into
+    the host chunk pool the same way budget evictions do."""
+    nbytes = sum(a.nbytes for a in staged.cols.values())
+    if nbytes > _CACHE_MAX_ENTRY_BYTES:
+        return
+    store = getattr(blk, "_staged_cache", None)
+    if store is None:
+        store = {}
+        blk._staged_cache = store
+    if len(store) >= _CACHE_MAX_ENTRIES:
+        victim = next(iter(store))
+        vstaged = store.pop(victim)
+        _lru_drop(blk, victim)
+        block_id = getattr(blk.meta, "block_id", "") or ""
+        if block_id and vstaged is not None:
+            from . import chunkpool
+
+            chunkpool.demote(block_id, victim, vstaged)
+    store[key] = staged
+    _lru_touch(blk, key, nbytes)
 
 
 def assemble_stage(blk: BackendBlock, plan: StagePlan, groups: list[int],

@@ -173,6 +173,8 @@ class StreamUnit:
     est_bytes: int = 0  # filled at plan time (admission gate)
     index: int = 0  # position in its pipeline (set by _run_unit; the
     # upload turnstile orders the double buffer by it)
+    pool_hit: bool = False  # plan-time host chunk-pool probe hit: the
+    # fetch/decompress/assemble stages are skipped (ops/chunkpool)
 
 
 class _PipeState:
@@ -224,11 +226,29 @@ def _unit_groups(u: StreamUnit) -> list[int]:
     return list(range(span_ax.n_groups)) if span_ax else []
 
 
+def _unit_pool_key(u: StreamUnit) -> tuple:
+    """The (columns, groups) identity a stage_block caching of this
+    unit would use -- ONE key shape shared with ops/stage so demotions
+    from either path restage on the other."""
+    return (tuple(u.needed),
+            tuple(u.groups) if u.groups is not None else None)
+
+
 def _plan_unit(u: StreamUnit):
     """(stage plan, column-fetch plan) for a unit -- footer metadata
-    only, no IO; fills u.est_bytes for the admission gate."""
+    only, no IO; fills u.est_bytes for the admission gate. Upload units
+    probe the host chunk pool (ops/chunkpool) first: a warm entry means
+    no backend ranged read to plan and no admission bytes to hold."""
     if u.upload:
         plan = plan_stage(u.needed)
+        block_id = getattr(u.blk.meta, "block_id", "") or ""
+        if block_id:
+            from . import chunkpool
+
+            if chunkpool.probe(block_id, _unit_pool_key(u)):
+                u.pool_hit = True
+                u.est_bytes = 0
+                return plan, None
         wants = stage_fetch_wants(u.blk, plan, u.groups)
     else:
         plan = None
@@ -243,6 +263,21 @@ def _run_stages(u: StreamUnit, plan, cf, state: _PipeState | None):
     per-stage kerneltel timings. state=None runs without cancellation
     checks (the serial path)."""
     pack = u.blk.pack
+    if u.upload and u.pool_hit:
+        from . import chunkpool
+
+        if state is not None and not state.wait_upload_turn(u.index):
+            return None  # cancelled before the restage upload
+        t0 = _time.perf_counter()
+        staged = chunkpool.restage(u.blk.meta.block_id, _unit_pool_key(u))
+        if staged is not None:
+            TEL.record_stream_stage("upload", _time.perf_counter() - t0)
+            return staged
+        # evicted between plan and run: late-plan the cold fetch and
+        # fall through to the normal stages (est_bytes stays 0 -- the
+        # gate's one-always-admits rule bounds the raced unit)
+        u.pool_hit = False
+        cf = pack.plan_fetch(stage_fetch_wants(u.blk, plan, u.groups))
     t0 = _time.perf_counter()
     if cf is not None:
         pack.fetch_ranges(cf)

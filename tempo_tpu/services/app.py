@@ -316,6 +316,13 @@ class App:
                 n_workers = 0
             self.frontend = Frontend(self.querier, n_workers=n_workers,
                                      overrides=self.overrides)
+            if self.frontend.result_cache is not None and self.ingester is not None:
+                # live-head generation feed: result-cache entries over
+                # ranges touching the live window key on it, so every
+                # push/cut/flush invalidates them naturally. Without a
+                # local ingester those ranges stay uncacheable (the
+                # extension prefix never includes the live window).
+                self.frontend.result_cache.live_gen = self.ingester.live_generation
             if cfg.target == "querier" and cfg.frontend_addr:
                 from .worker import QuerierWorker
 
@@ -612,14 +619,28 @@ def _make_handler(app: App):
         def log_message(self, *a):  # quiet
             pass
 
-        def _send(self, code: int, body: bytes | str, ctype="application/json"):
+        def _send(self, code: int, body: bytes | str, ctype="application/json",
+                  headers: dict | None = None):
             if isinstance(body, str):
                 body = body.encode()
             self.send_response(code)
             self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
             self.end_headers()
             self.wfile.write(body)
+
+        @staticmethod
+        def _cache_headers() -> dict:
+            """X-Tempo-Cache: hit|miss|extend for the query routes --
+            how soak's --repeat-zipf and the vulture cached_vs_fresh
+            probes classify responses client-side."""
+            from .resultcache import LAST_OUTCOME
+
+            outcome = LAST_OUTCOME.get()
+            LAST_OUTCOME.set(None)
+            return {"X-Tempo-Cache": outcome} if outcome else {}
 
         def _err(self, code: int, msg: str):
             self._send(code, json.dumps({"error": msg}))
@@ -907,9 +928,10 @@ def _make_handler(app: App):
             start = int(q.get("start", 0))
             end = int(q.get("end", 0))
             tr = app.frontend.find_trace_by_id(tenant, tid, start, end)
+            hdrs = self._cache_headers()
             if tr is None:
                 return self._err(404, "trace not found")
-            return self._send(200, otlp_json.dumps(tr))
+            return self._send(200, otlp_json.dumps(tr), headers=hdrs)
 
         def _metrics_query_range(self, tenant: str, q: dict):
             """GET /api/metrics/query_range?q=...&start=...&end=...&step=...
@@ -958,7 +980,8 @@ def _make_handler(app: App):
                 # execution-time request errors (e.g. by() cardinality
                 # over the accumulator budget) are the caller's to fix
                 return self._err(400, f"query_range failed: {e}")
-            return self._send(200, json.dumps(to_prometheus(resp)))
+            return self._send(200, json.dumps(to_prometheus(resp)),
+                              headers=self._cache_headers())
 
         def _search(self, tenant: str, q: dict):
             tags = {}
@@ -1042,6 +1065,7 @@ def _make_handler(app: App):
                         },
                     }
                 ),
+                headers=self._cache_headers(),
             )
 
         # ---------------------------------------------------------- POST
@@ -1198,6 +1222,15 @@ def _kernel_status(app: App) -> dict:
     out["staged_cache"] = staged_cache_stats()
     out["staged_cache"]["budget_note"] = (
         "device HBM budget for staged block columns (ops/stage)")
+    # the tiered cache plane: Tier A (frontend result cache) + Tier B
+    # (host-RAM compressed column-chunk pool under the HBM staged LRU)
+    from ..ops import chunkpool
+
+    rc = app.frontend.result_cache if app.frontend is not None else None
+    out["caching"] = {
+        "result_cache": rc.stats() if rc is not None else {"enabled": False},
+        "chunk_pool": chunkpool.stats(),
+    }
     return out
 
 

@@ -46,6 +46,7 @@ from ..db.search import (
     request_to_dict,
     response_from_dict,
 )
+from .. import config_registry as _cfg
 from ..ring.ring import InMemoryKV, InstanceDesc, InstanceState, Ring, deterministic_tokens
 from ..util.breaker import CircuitOpen, RetryBudget, get_breaker
 from ..util.profiler import timed_lock
@@ -457,6 +458,24 @@ class Frontend:
 
         self.query_latency = Histogram("tempo_frontend_query_duration_seconds")
         self.self_tracer = None  # set by the app when self-tracing is on
+        # Tier A result cache, AHEAD of queue admission: a hit answers
+        # without touching QoS budgets, the queue, or a device. With
+        # TEMPO_RESULT_CACHE=0 no cache object exists at all and every
+        # query path below is byte-identical to a cacheless build. The
+        # app points live_gen at the local ingester when one exists.
+        if _cfg.get_bool("TEMPO_RESULT_CACHE"):
+            from .resultcache import ResultCache
+
+            # blocklists without a generation feed (stub queriers in
+            # tests, exotic embeddings) get a constant generation: the
+            # cache still keys correctly, it just can't observe block
+            # churn -- real db.Blocklist always provides one
+            bl_gen = getattr(
+                getattr(querier.db, "blocklist", None), "generation", None)
+            self.result_cache = ResultCache(
+                blocklist_gen=bl_gen or (lambda t: 0))
+        else:
+            self.result_cache = None
         self._workers = [
             threading.Thread(target=self._worker, daemon=True, name=f"frontend-worker-{i}")
             for i in range(n_workers)
@@ -1198,6 +1217,24 @@ class Frontend:
 
     def _find_trace_by_id(self, tenant: str, trace_id: bytes,
                           time_start: int = 0, time_end: int = 0, trace=None):
+        rc = self.result_cache
+        if rc is None:
+            return self._find_trace_exec(tenant, trace_id, time_start,
+                                         time_end, trace)
+        hex_id = trace_id.hex()
+        tr = rc.probe_trace(tenant, hex_id, time_start, time_end)
+        if tr is not None:
+            return tr
+        tr = self._find_trace_exec(tenant, trace_id, time_start, time_end, trace)
+        if tr is not None:
+            # sized by span count (a serialization pass per store would
+            # cost more than the lookup it saves); ~1KiB/span wire-side
+            rc.store_trace(tenant, hex_id, time_start, time_end, tr,
+                           nbytes=max(1024, tr.span_count() * 1024))
+        return tr
+
+    def _find_trace_exec(self, tenant: str, trace_id: bytes,
+                         time_start: int = 0, time_end: int = 0, trace=None):
         db = self.querier.db
         candidates = db.find_candidates(tenant, trace_id, time_start, time_end)
         charge = self._qos_admit_traced(
@@ -1324,6 +1361,24 @@ class Frontend:
         return jobs
 
     def _search(self, tenant: str, req: SearchRequest, trace=None) -> SearchResponse:
+        rc = self.result_cache
+        if rc is None:
+            return self._search_exec(tenant, req, trace)
+        out = rc.probe_search(tenant, req)
+        if isinstance(out, SearchResponse):
+            return out  # exact hit: no QoS charge, no jobs, no device
+        if out is not None:
+            # incremental extension: execute ONLY the mutable tail
+            # slice through the normal shard plan, merge with the
+            # cached immutable prefix
+            tail = self._search_exec(tenant, out.tail_req, trace)
+            return rc.complete_search_extension(out, tail)
+        resp = self._search_exec(tenant, req, trace)
+        rc.store_search(tenant, req, resp)
+        return resp
+
+    def _search_exec(self, tenant: str, req: SearchRequest,
+                     trace=None) -> SearchResponse:
         limit = req.limit or 20
         resp = SearchResponse()
         lock = threading.Lock()
@@ -1412,8 +1467,34 @@ class Frontend:
                                  f"{k}={v}" for k, v in req.tags.items()),
                              outcome=outcome)
 
+    @staticmethod
+    def _stream_final_body(resp: SearchResponse, limit: int) -> dict:
+        return {
+            "traces": [t.to_dict() for t in resp.traces[:limit]],
+            "metrics": {
+                "inspectedBytes": str(resp.inspected_bytes),
+                "inspectedSpans": str(resp.inspected_spans),
+            },
+            "done": True,
+            "jobsCompleted": 0,  # served from cache: no jobs dispatched
+            "jobsTotal": 0,
+        }
+
     def _search_stream(self, tenant: str, req: SearchRequest):
         limit = req.limit or 20
+        rc = self.result_cache
+        if rc is not None:
+            out = rc.probe_search(tenant, req)
+            if isinstance(out, SearchResponse):
+                # progressive delivery collapses to its final event --
+                # the cached response IS the exact /api/search body
+                yield self._stream_final_body(out, limit)
+                return
+            if out is not None:
+                tail = self._search_exec(tenant, out.tail_req)
+                yield self._stream_final_body(
+                    rc.complete_search_extension(out, tail), limit)
+                return
         req_d = request_to_dict(req)
         metas = [
             m for m in self.querier.db.blocklist.metas(tenant)
@@ -1480,6 +1561,8 @@ class Frontend:
             with lock:
                 resp.traces.sort(key=lambda r: -r.start_time_unix_nano)
                 resp.traces = resp.traces[:limit]
+            if rc is not None:
+                rc.store_search(tenant, req, resp)  # blocking search shares keys
             yield body(True)
         finally:
             if runner is not None:
@@ -1532,6 +1615,24 @@ class Frontend:
                              outcome=outcome)
 
     def _metrics_query_range(self, tenant: str, req, trace=None):
+        rc = self.result_cache
+        if rc is None:
+            return self._metrics_exec(tenant, req, trace)
+        out = rc.probe_metrics(tenant, req)
+        if out is None:
+            resp = self._metrics_exec(tenant, req, trace)
+            rc.store_metrics(tenant, req, resp)
+            return resp
+        from .resultcache import MetricsExtension
+
+        if isinstance(out, MetricsExtension):
+            # re-execute only the tail buckets; the prefix accumulator
+            # states merge exactly like the time-shard jobs below
+            tail = self._metrics_exec(tenant, out.tail_req, trace)
+            return rc.complete_metrics_extension(out, tail)
+        return out  # exact hit
+
+    def _metrics_exec(self, tenant: str, req, trace=None):
         from ..db.metrics_exec import (
             MetricsRequest,
             MetricsResponse,

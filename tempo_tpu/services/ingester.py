@@ -148,6 +148,10 @@ class Instance:
         # modules/ingester/instance.go:428-476)
         self.flushing: dict[bytes, LiveTrace] = {}
         self.blocks_flushed = 0
+        # live-head mutation generation: bumps on every push / cut /
+        # flush so the frontend result cache can key live-touching
+        # query results on the exact snapshot they were computed from
+        self.live_gen = 0
         # live-head device engine (db/live_engine): staged columnar
         # tails so live searches run the fused filter->top-k kernels;
         # None = device runtime unavailable, the index path serves alone
@@ -203,6 +207,7 @@ class Instance:
                 lt.last_append = now
                 lt.start_s = min(lt.start_s or s, s)
                 lt.end_s = max(lt.end_s, e)
+            self.live_gen += 1
             t_wal = time.perf_counter()
             if hasattr(self.head, "append_window"):
                 # columnar WAL: the whole push window is ONE framed
@@ -262,6 +267,8 @@ class Instance:
                         self.cut[tid] = lt
                     del self.live[tid]
                     n += 1
+            if n:
+                self.live_gen += 1
         return n
 
     def cut_block_if_ready(self, force: bool = False, now: float | None = None):
@@ -357,6 +364,7 @@ class Instance:
             for tid, lt in cut_snapshot.items():
                 if self.flushing.get(tid) is lt:
                     del self.flushing[tid]
+            self.live_gen += 1  # the live window's contents changed
             # flushed segments left the live window: release their
             # decoded-feature cache entries
             for lt in cut_snapshot.values():
@@ -590,6 +598,14 @@ class Ingester:
         with self.lock:
             inst = self.instances.get(tenant)
         return inst.metrics_query_range(req) if inst else None
+
+    def live_generation(self, tenant: str) -> int:
+        """The tenant's live-head mutation generation (0 = no instance
+        yet). The frontend result cache keys live-touching query
+        results on this, so every push/cut/flush invalidates them."""
+        with self.lock:
+            inst = self.instances.get(tenant)
+        return inst.live_gen if inst else 0
 
     def trace_snapshot(self, tenant: str, trace_id: bytes) -> list[tuple[str, bytes]]:
         """[(segment digest, segment bytes)] this replica holds for a

@@ -313,6 +313,44 @@ class KernelTelemetry:
                  "(retry/budget_exhausted)")
         self._hedges: dict[str, int] = {}
         self._retries: dict[str, int] = {}
+        # tiered cache plane (PR 20): Tier A frontend result cache
+        # (services/resultcache) and Tier B host-RAM compressed
+        # column-chunk pool under the HBM staged cache (ops/chunkpool)
+        self.result_cache_hits = Counter(
+            "tempo_result_cache_hits_total",
+            help="frontend result-cache hits served without touching "
+                 "QoS budgets, the queue, or a device")
+        self.result_cache_misses = Counter(
+            "tempo_result_cache_misses_total",
+            help="frontend result-cache misses (full execution)")
+        self.result_cache_extensions = Counter(
+            "tempo_result_cache_extensions_total",
+            help="now-edge queries answered by extending a cached "
+                 "immutable prefix with a tail-only execution")
+        self.result_cache_invalidations = Counter(
+            "tempo_result_cache_invalidations_total",
+            help="result-cache entries invalidated by a blocklist or "
+                 "live-head generation change")
+        self.result_cache_bytes = Gauge(
+            "tempo_result_cache_bytes",
+            help="bytes held by the frontend result cache")
+        self.chunk_cache_hits = Counter(
+            "tempo_chunk_cache_hits_total",
+            help="staged-column restages served from the host-RAM "
+                 "compressed demote pool (no backend read)")
+        self.chunk_cache_misses = Counter(
+            "tempo_chunk_cache_misses_total",
+            help="demote-pool probes that fell through to the backend")
+        self.chunk_cache_demotions = Counter(
+            "tempo_chunk_cache_demotions_total",
+            help="staged-column entries demoted (recompressed) into the "
+                 "host pool on HBM eviction instead of discarded")
+        self.chunk_cache_evictions = Counter(
+            "tempo_chunk_cache_evictions_total",
+            help="demote-pool entries evicted by the host-RAM budget")
+        self.chunk_cache_bytes = Gauge(
+            "tempo_chunk_cache_bytes",
+            help="compressed bytes held by the demote pool")
         # every instrument exported through /metrics -- ONE list shared
         # by metrics_lines() and help_entries() so an instrument can't
         # ship samples without its HELP (or vice versa)
@@ -338,6 +376,11 @@ class KernelTelemetry:
             self.generator_shed,
             self.selftrace_spans, self.query_cost,
             self.query_outcomes, self.hedge_total, self.retry_total,
+            self.result_cache_hits, self.result_cache_misses,
+            self.result_cache_extensions, self.result_cache_invalidations,
+            self.result_cache_bytes, self.chunk_cache_hits,
+            self.chunk_cache_misses, self.chunk_cache_demotions,
+            self.chunk_cache_evictions, self.chunk_cache_bytes,
         )
         # full compile-key signatures, LRU-bounded (SEEN_SIGNATURES_MAX)
         self._seen: OrderedDict = OrderedDict()

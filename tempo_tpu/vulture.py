@@ -619,6 +619,13 @@ class Vulture:
             run("service_graph",
                 lambda: self._service_graph_probe(svc), svc)
 
+        # -- cached_vs_fresh: the tiered result-cache contract (repeat
+        # hit, bit-equality, mutation invalidation). Runs after the
+        # series probes: its mutation push would otherwise perturb
+        # their client-side expected counts for this service.
+        run("cached_vs_fresh",
+            lambda: self._cached_vs_fresh_probe(svc, tags), svc)
+
         # -- cold_read + durability ledger maintenance
         if self.cfg.flush_every and self.seq % self.cfg.flush_every == 0:
             run("cold_read",
@@ -692,6 +699,89 @@ class Vulture:
                        f"({len(final.get('traces', []))} vs "
                        f"{len(blocking.get('traces', []))} traces)")
         return ProbeResult("search_stream", "ok")
+
+    # --------------------------------------- cached_vs_fresh probe
+    def _search_with_header(self, params: dict) -> tuple[dict, str]:
+        """Like _search_body but also returns the X-Tempo-Cache
+        response header ("hit"/"extend"/"miss", or "" when the result
+        cache is disabled or the target predates it)."""
+        qs = urllib.parse.urlencode(params)
+        req = urllib.request.Request(
+            f"{self.query_url}/api/search?{qs}", headers=self._headers())
+        try:
+            with urllib.request.urlopen(req, timeout=self.cfg.timeout_s) as r:
+                return json.loads(r.read()), r.headers.get("X-Tempo-Cache", "")
+        except urllib.error.HTTPError as e:
+            if e.code == 429:
+                raise Shed(str(e)) from e
+            raise
+
+    def _result_cache_live_wired(self) -> bool:
+        """Whether the target can cache live-touching ranges (needs a
+        local ingester generation feed -- /status/kernels reports it).
+        Without it a now-edge repeat legitimately misses."""
+        try:
+            with urllib.request.urlopen(
+                    self.query_url + "/status/kernels",
+                    timeout=self.cfg.timeout_s) as r:
+                status = json.load(r)
+            return bool(status.get("caching", {})
+                        .get("result_cache", {}).get("live_gen_wired"))
+        except Exception:
+            return False
+
+    def _cached_vs_fresh_probe(self, svc: str, tags: str) -> ProbeResult:
+        """The tiered result-cache contract: (a) the same query twice
+        answers bit-identically, (b) the repeat is served from the
+        cache (X-Tempo-Cache: hit/extend) when the target can cache
+        the range, and (c) a corpus mutation under the entry yields
+        fresh data -- a stale cached body here is a correctness bug,
+        not a performance one."""
+        now = int(time.time())
+        params = {"tags": tags, "limit": 50,
+                  "start": str(now - 300), "end": str(now + 5)}
+        fresh, h1 = self._search_with_header(params)
+        if not h1:
+            return ProbeResult("cached_vs_fresh", "ok",
+                               detail=f"{svc} result cache disabled")
+        cached, h2 = fresh, h1
+        for _ in range(3):  # a concurrent write may invalidate between reads
+            cached, h2 = self._search_with_header(params)
+            if h2 in ("hit", "extend"):
+                break
+        if cached.get("traces") != fresh.get("traces"):
+            return ProbeResult(
+                "cached_vs_fresh", "corrupt",
+                detail=f"{svc} cached body != fresh body (outcome {h2!r})")
+        if h2 not in ("hit", "extend") and self._result_cache_live_wired():
+            return ProbeResult(
+                "cached_vs_fresh", "miss",
+                detail=f"{svc} repeat read outcome {h2!r}, "
+                       f"expected hit/extend")
+        # corpus mutation: one more trace under the same tag must
+        # invalidate the entry -- if it doesn't, the stale body keeps
+        # being served and the new id never appears
+        tid = make_trace_id(self.rng)
+        self._push(_make_probe_trace(self.rng, tid, svc, 1, time.time_ns()))
+
+        def see_new() -> dict | None:
+            body, _h = self._search_with_header(params)
+            ids = {t["traceID"] for t in body.get("traces", [])}
+            return body if tid.hex() in ids else None
+
+        body, lag = self._await(see_new)
+        if body is None:
+            return ProbeResult(
+                "cached_vs_fresh", "corrupt", lag,
+                f"{svc} stale cache: id {tid.hex()} never appeared "
+                f"after corpus mutation")
+        # and the post-mutation cached read must match the fresh one
+        again, _h3 = self._search_with_header(params)
+        if again.get("traces") != body.get("traces"):
+            return ProbeResult(
+                "cached_vs_fresh", "corrupt", lag,
+                f"{svc} post-mutation cached body != fresh body")
+        return ProbeResult("cached_vs_fresh", "ok", lag)
 
     # -------------------------------------------- query_range probe
     def _query_range_probe(self, svc: str, traces, base_ns: int) -> ProbeResult:

@@ -555,6 +555,9 @@ def test_matrix_a_transient_faults_masked(tmp_path, monkeypatch):
     read-availability SLO stays ok, and the retry/injection counters
     prove faults actually flowed."""
     monkeypatch.setenv("TEMPO_RETRY_BUDGET", "64")
+    # the drill repeats one query to force backend reads; the result
+    # cache would serve the repeats without touching the backend
+    monkeypatch.setenv("TEMPO_RESULT_CACHE", "0")
     plane.configure([], seed=5)  # arm BEFORE the app builds its backend
     app, base = _mk_app(tmp_path)
     try:
@@ -595,6 +598,9 @@ def test_matrix_b_partition_trips_breaker_then_recovers(tmp_path,
     monkeypatch.setenv("TEMPO_BREAKER_MIN_VOLUME", "4")
     monkeypatch.setenv("TEMPO_BREAKER_OPEN_S", "0.3")
     monkeypatch.setenv("TEMPO_BREAKER_PROBES", "1")
+    # the drill repeats one by-id lookup to drive the breaker; the
+    # result cache would serve the repeats without touching the backend
+    monkeypatch.setenv("TEMPO_RESULT_CACHE", "0")
     plane.configure([], seed=2)
     app, _base = _mk_app(tmp_path)
     try:

@@ -463,6 +463,10 @@ def test_slow_query_e2e_chaos_to_artifact(tmp_path, monkeypatch, capsys):
 
     monkeypatch.setenv("TEMPO_SLO_SEARCH_P99_S", "0.05")
     monkeypatch.setenv(profmod.PROFILE_HZ_ENV, "97")
+    # the drill repeats one slow query until the profiler catches it
+    # in-flight; a result-cache hit would answer in microseconds and
+    # never cross the slow threshold again
+    monkeypatch.setenv("TEMPO_RESULT_CACHE", "0")
     cfg = AppConfig(
         storage_path=str(tmp_path / "store"),
         http_port=_free_port(),

@@ -368,7 +368,11 @@ def _free_port() -> int:
 
 
 @pytest.fixture(scope="function")
-def traced_app(tmp_path):
+def traced_app(tmp_path, monkeypatch):
+    # these tests certify the execution-path timeline (queue-wait,
+    # stage, kernel spans); a result-cache hit legitimately has none
+    # of those, so repeats must keep executing
+    monkeypatch.setenv("TEMPO_RESULT_CACHE", "0")
     from tempo_tpu.services.app import App, AppConfig
     from tempo_tpu.services.ingester import IngesterConfig
     from tempo_tpu.util.testdata import make_traces
